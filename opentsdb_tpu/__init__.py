@@ -1,0 +1,30 @@
+"""opentsdb_tpu — a TPU-native time-series database framework.
+
+A ground-up re-design of the capabilities of OpenTSDB (reference:
+/root/reference, surveyed in SURVEY.md): high-rate ``metric timestamp value
+tag=value`` ingestion over telnet-style and HTTP protocols, UID-dictionary
+byte-packed storage, background row compaction, and aggregated / downsampled /
+rate queries with tag group-by.
+
+Unlike the Java reference — sequential pull-iterators over HBase cells — the
+compute path here is *columnar*: storage rows decode into fixed-shape padded
+arrays and every aggregation (compaction merge, downsample, rate, lerp
+alignment, group-by reduction, t-digest / HLL sketches) runs as a batched
+JAX/XLA segment reduction, jit-compiled for TPU, sharded over a
+``jax.sharding.Mesh`` for multi-chip. The byte codec survives only at the
+storage and wire boundaries for ``scan --import`` round-trip compatibility.
+
+Layering (see SURVEY.md §7):
+    core     codecs & schema (pure), TSDB facade, compaction
+    storage  embedded ordered-KV engine (memtable + WAL)
+    uid      name<->id dictionaries
+    ops      TPU kernels: segment reductions, downsample, rate, sketches
+    parallel mesh shardings + cross-chip merges
+    query    planner/executor + Aggregators registry
+    server   asyncio telnet + HTTP front-end
+    tools    tsdb-style CLI
+    stats    self-monitoring counters & latency digests
+    graph    PNG / JSON rendering
+"""
+
+__version__ = "0.1.0"

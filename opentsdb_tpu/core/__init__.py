@@ -1,0 +1,12 @@
+"""Core schema, codecs, and the TSDB facade."""
+
+from opentsdb_tpu.core.const import (
+    FLAG_BITS,
+    FLAG_FLOAT,
+    FLAGS_MASK,
+    LENGTH_MASK,
+    MAX_NUM_TAGS,
+    MAX_TIMESPAN,
+    TIMESTAMP_BYTES,
+)
+from opentsdb_tpu.core.errors import IllegalDataError

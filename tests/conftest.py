@@ -1,0 +1,14 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip sharding paths (opentsdb_tpu.parallel) are exercised on 8 virtual
+CPU devices; real-TPU runs happen only in bench.py. Must run before any jax
+import, hence the env mutation at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,255 @@
+"""Golden byte-vector tests for the storage codec.
+
+Vectors are derived from the wire format itself (SURVEY.md §2.1): qualifier =
+(delta << 4) | flags big-endian on 2 bytes; ints big-endian two's complement
+on the smallest of 1/2/4/8 bytes; floats IEEE754; compacted cell = quals ||
+values || 0x00.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core import codec
+from opentsdb_tpu.core.errors import IllegalDataError
+
+
+class TestValueEncoding:
+    def test_smallest_int_widths(self):
+        assert codec.encode_long(0) == (b"\x00", 0)
+        assert codec.encode_long(127) == (b"\x7f", 0)
+        assert codec.encode_long(-128) == (b"\x80", 0)
+        assert codec.encode_long(128) == (b"\x00\x80", 1)
+        assert codec.encode_long(-129) == (b"\xff\x7f", 1)
+        assert codec.encode_long(32767) == (b"\x7f\xff", 1)
+        assert codec.encode_long(32768) == (b"\x00\x00\x80\x00", 3)
+        assert codec.encode_long(2**31 - 1) == (b"\x7f\xff\xff\xff", 3)
+        assert codec.encode_long(2**31) == (
+            b"\x00\x00\x00\x00\x80\x00\x00\x00", 7)
+        assert codec.encode_long(-(2**63)) == (b"\x80" + b"\x00" * 7, 7)
+
+    def test_int_out_of_range(self):
+        with pytest.raises(ValueError):
+            codec.encode_long(2**63)
+
+    def test_int_roundtrip(self):
+        for v in (0, 1, -1, 42, 255, 256, -4242, 10**6, -(10**12), 2**62):
+            buf, flags = codec.encode_long(v)
+            assert codec.decode_value(buf, flags) == v
+
+    def test_float_encoding(self):
+        buf, flags = codec.encode_float(4.2)
+        assert flags == 0xB
+        assert buf == struct.pack(">f", 4.2)
+        assert codec.decode_value(buf, flags) == pytest.approx(4.2)
+
+    def test_double_encoding(self):
+        buf, flags = codec.encode_double(3.14159265358979)
+        assert flags == 0xF
+        assert len(buf) == 8
+        assert codec.decode_value(buf, flags) == 3.14159265358979
+
+    def test_nan_inf_rejected(self):
+        for bad in (float("nan"), float("inf"), float("-inf")):
+            with pytest.raises(ValueError):
+                codec.encode_float(bad)
+            with pytest.raises(ValueError):
+                codec.encode_double(bad)
+
+    def test_legacy_8byte_float_decodes(self):
+        # Historical bug: float flags (len 4) but 8 bytes with leading zeros.
+        buf = b"\x00\x00\x00\x00" + struct.pack(">f", 4.2)
+        assert codec.decode_value(buf, 0xB) == pytest.approx(4.2)
+
+    def test_corrupt_8byte_float_raises(self):
+        buf = b"\x00\x00\x00\x01" + struct.pack(">f", 4.2)
+        with pytest.raises(IllegalDataError):
+            codec.decode_value(buf, 0xB)
+
+
+class TestQualifier:
+    def test_pack_layout(self):
+        # delta=1, int flags len-1=0 -> 0x0010
+        assert codec.encode_qualifier(1, 0) == b"\x00\x10"
+        # delta=2, float 4B -> (2<<4)|0xB = 0x002B
+        assert codec.encode_qualifier(2, 0xB) == b"\x00\x2b"
+        # delta=3599 (max), 8B int -> (3599<<4)|7
+        assert codec.encode_qualifier(3599, 7) == struct.pack(
+            ">H", (3599 << 4) | 7)
+
+    def test_roundtrip(self):
+        for delta in (0, 1, 59, 3599):
+            for flags in (0, 1, 3, 7, 0xB, 0xF):
+                q = codec.encode_qualifier(delta, flags)
+                assert codec.decode_qualifier(q) == (delta, flags)
+
+    def test_delta_range(self):
+        with pytest.raises(ValueError):
+            codec.encode_qualifier(3600, 0)
+        with pytest.raises(ValueError):
+            codec.encode_qualifier(-1, 0)
+
+    def test_fix_qualifier_flags(self):
+        # Mis-flagged float claiming 8 bytes when value is 4.
+        assert codec.fix_qualifier_flags(0xF, 4) == 0xB
+        # Correct flags unchanged.
+        assert codec.fix_qualifier_flags(0xB, 4) == 0xB
+        assert codec.fix_qualifier_flags(0x0, 1) == 0x0
+        # Delta bits preserved.
+        assert codec.fix_qualifier_flags(0x5B, 4) == 0x5B
+
+
+class TestRowKey:
+    METRIC = b"\x00\x00\x01"
+    TAGK = b"\x00\x00\x02"
+    TAGV = b"\x00\x00\x03"
+
+    def test_build_and_parse(self):
+        key = codec.row_key(self.METRIC, 1356998400,
+                            [(self.TAGK, self.TAGV)])
+        assert len(key) == 13
+        assert key == self.METRIC + struct.pack(">I", 1356998400) + \
+            self.TAGK + self.TAGV
+        parsed = codec.parse_row_key(key)
+        assert parsed.metric_uid == self.METRIC
+        assert parsed.base_time == 1356998400
+        assert parsed.tag_uids == ((self.TAGK, self.TAGV),)
+
+    def test_template_patch(self):
+        tmpl = codec.row_key_template(self.METRIC, [(self.TAGK, self.TAGV)])
+        codec.set_base_time(tmpl, 7200)
+        assert bytes(tmpl) == codec.row_key(self.METRIC, 7200,
+                                            [(self.TAGK, self.TAGV)])
+
+    def test_series_key_ignores_time(self):
+        k1 = codec.row_key(self.METRIC, 0, [(self.TAGK, self.TAGV)])
+        k2 = codec.row_key(self.METRIC, 3600, [(self.TAGK, self.TAGV)])
+        assert codec.series_key(k1) == codec.series_key(k2)
+
+    def test_base_time_floor(self):
+        assert codec.base_time(1356998400) == 1356998400
+        assert codec.base_time(1356998400 + 3599) == 1356998400
+        assert codec.base_time(1356998400 + 3600) == 1356998400 + 3600
+
+    def test_bad_key_length(self):
+        with pytest.raises(IllegalDataError):
+            codec.parse_row_key(b"\x00" * 9)
+
+
+def _cell(delta, value):
+    if isinstance(value, float):
+        buf, flags = codec.encode_float(value)
+    else:
+        buf, flags = codec.encode_long(value)
+    return codec.encode_qualifier(delta, flags), buf
+
+
+class TestCompaction:
+    def test_trivial_merge_two_ints(self):
+        q1, v1 = _cell(1, 4)
+        q2, v2 = _cell(2, 5)
+        qual, val = codec.compact_cells([(q1, v1), (q2, v2)])
+        assert qual == q1 + q2
+        assert val == v1 + v2 + b"\x00"
+
+    def test_merge_sorts_by_delta(self):
+        q1, v1 = _cell(2, 5)
+        q2, v2 = _cell(1, 4)
+        qual, val = codec.compact_cells([(q1, v1), (q2, v2)])
+        assert qual == q2 + q1
+        assert val == v2 + v1 + b"\x00"
+
+    def test_merge_compacted_with_individual(self):
+        # A previously compacted cell [d1, d3] plus an individual d2.
+        q1, v1 = _cell(1, 4)
+        q3, v3 = _cell(3, 6)
+        compacted_q, compacted_v = codec.compact_cells([(q1, v1), (q3, v3)])
+        q2, v2 = _cell(2, 5)
+        qual, val = codec.compact_cells(
+            [(compacted_q, compacted_v), (q2, v2)])
+        assert qual == q1 + q2 + q3
+        assert val == v1 + v2 + v3 + b"\x00"
+
+    def test_true_duplicate_dropped(self):
+        q1, v1 = _cell(1, 4)
+        qual, val = codec.compact_cells([(q1, v1), (q1, v1)])
+        assert qual == q1
+        assert val == v1 + b"\x00"
+
+    def test_conflicting_duplicate_raises(self):
+        q1, v1 = _cell(1, 4)
+        _, v2 = _cell(1, 5)
+        with pytest.raises(IllegalDataError):
+            codec.compact_cells([(q1, v1), (q1, v2)])
+
+    def test_mixed_width_values(self):
+        q1, v1 = _cell(1, 4)          # 1 byte
+        q2, v2 = _cell(2, 300)        # 2 bytes
+        q3, v3 = _cell(3, 4.2)        # 4-byte float
+        qual, val = codec.compact_cells([(q1, v1), (q2, v2), (q3, v3)])
+        assert qual == q1 + q2 + q3
+        assert val == v1 + v2 + v3 + b"\x00"
+        cells = codec.explode_cell(qual, val)
+        assert [c.decode() for c in cells[:2]] == [4, 300]
+        assert cells[2].decode() == pytest.approx(4.2)
+
+    def test_float_fix_during_merge(self):
+        # Mis-encoded float: flags 0xB, 8-byte value with leading zeros.
+        bad_v = b"\x00\x00\x00\x00" + struct.pack(">f", 4.2)
+        bad_q = codec.encode_qualifier(1, 0xB)
+        q2, v2 = _cell(2, 5)
+        qual, val = codec.compact_cells([(bad_q, bad_v), (q2, v2)])
+        assert qual == bad_q + q2  # flags were already "right" (0xB)
+        assert val == struct.pack(">f", 4.2) + v2 + b"\x00"
+
+    def test_misflagged_double_fixed(self):
+        # flags claim 8-byte float (0xF) but value is 4-byte with zeros
+        # prefix: the fix strips zeros AND rewrites length flags to 0xB.
+        bad_v = b"\x00\x00\x00\x00" + struct.pack(">f", 1.5)
+        bad_q = codec.encode_qualifier(5, 0xB)
+        cells = codec.explode_cell(bad_q, bad_v)
+        assert cells[0].value == struct.pack(">f", 1.5)
+        assert cells[0].flags == 0xB
+
+    def test_bad_meta_byte_raises(self):
+        q1, v1 = _cell(1, 4)
+        q2, v2 = _cell(2, 5)
+        qual, val = codec.compact_cells([(q1, v1), (q2, v2)])
+        corrupt = val[:-1] + b"\x01"
+        with pytest.raises(IllegalDataError):
+            codec.explode_cell(qual, corrupt)
+
+    def test_truncated_value_raises(self):
+        q1, v1 = _cell(1, 4)
+        q2, v2 = _cell(2, 5)
+        qual, val = codec.compact_cells([(q1, v1), (q2, v2)])
+        with pytest.raises(IllegalDataError):
+            codec.explode_cell(qual, val[:-2] + b"\x00")
+
+    def test_junk_odd_qualifier_skipped(self):
+        q1, v1 = _cell(1, 4)
+        qual, val = codec.compact_cells([(b"\x01\x02\x03", b"junk"),
+                                         (q1, v1)])
+        assert qual == q1
+        assert val == v1 + b"\x00"
+
+
+class TestColumnar:
+    def test_cells_to_columns(self):
+        cells = [codec.Cell(*_cell(1, 4)),
+                 codec.Cell(*_cell(2, 4.5)),
+                 codec.Cell(*_cell(3599, -7))]
+        cols = codec.cells_to_columns(3600, cells)
+        np.testing.assert_array_equal(cols.timestamps, [3601, 3602, 7199])
+        np.testing.assert_allclose(cols.values, [4.0, 4.5, -7.0])
+        np.testing.assert_array_equal(cols.is_float, [False, True, False])
+        np.testing.assert_array_equal(cols.int_values[[0, 2]], [4, -7])
+
+    def test_concat(self):
+        c1 = codec.cells_to_columns(0, [codec.Cell(*_cell(1, 1))])
+        c2 = codec.cells_to_columns(3600, [codec.Cell(*_cell(0, 2))])
+        cat = codec.columns_concat([c1, c2])
+        np.testing.assert_array_equal(cat.timestamps, [1, 3600])
+        empty = codec.columns_concat([])
+        assert empty.timestamps.size == 0

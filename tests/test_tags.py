@@ -1,0 +1,133 @@
+"""Tests for tag grammar and time parsing."""
+
+import pytest
+
+from opentsdb_tpu.core import tags
+from opentsdb_tpu.core.errors import BadRequestError
+from opentsdb_tpu.utils import timeparse
+
+
+class TestSplitString:
+    def test_basic(self):
+        assert tags.split_string("a b c") == ["a", "b", "c"]
+
+    def test_skips_empty_runs(self):
+        assert tags.split_string("  a   b ") == ["a", "b"]
+        assert tags.split_string("") == []
+
+
+class TestParse:
+    def test_pair(self):
+        d = {}
+        tags.parse(d, "host=web01")
+        assert d == {"host": "web01"}
+
+    def test_duplicate_same_value_ok(self):
+        d = {"host": "web01"}
+        tags.parse(d, "host=web01")
+        assert d == {"host": "web01"}
+
+    def test_duplicate_conflict(self):
+        d = {"host": "web01"}
+        with pytest.raises(ValueError):
+            tags.parse(d, "host=web02")
+
+    @pytest.mark.parametrize("bad", ["noequals", "=value", "name=", "="])
+    def test_malformed(self, bad):
+        with pytest.raises(ValueError):
+            tags.parse({}, bad)
+
+
+class TestParseWithMetric:
+    def test_plain_metric(self):
+        d = {}
+        assert tags.parse_with_metric("sys.cpu.user", d) == "sys.cpu.user"
+        assert d == {}
+
+    def test_metric_with_tags(self):
+        d = {}
+        m = tags.parse_with_metric("sys.cpu.user{host=web01,cpu=0}", d)
+        assert m == "sys.cpu.user"
+        assert d == {"host": "web01", "cpu": "0"}
+
+    @pytest.mark.parametrize("bad", ["{host=a}", "m{}", "m{host=a"])
+    def test_malformed(self, bad):
+        with pytest.raises(ValueError):
+            tags.parse_with_metric(bad, {})
+
+
+class TestValidate:
+    def test_allowed_charset(self):
+        tags.validate_string("metric", "sys.cpu-0_user/x9")
+
+    @pytest.mark.parametrize("bad", ["", "with space", "café", "semi;colon"])
+    def test_rejected(self, bad):
+        with pytest.raises(ValueError):
+            tags.validate_string("metric", bad)
+
+    def test_check_metric_and_tags(self):
+        tags.check_metric_and_tags("m", {"a": "b"})
+        with pytest.raises(ValueError):
+            tags.check_metric_and_tags("m", {})
+        with pytest.raises(ValueError):
+            tags.check_metric_and_tags(
+                "m", {f"k{i}": "v" for i in range(9)})
+
+
+class TestParseLong:
+    def test_values(self):
+        assert tags.parse_long("0") == 0
+        assert tags.parse_long("-42") == -42
+        assert tags.parse_long("+7") == 7
+        assert tags.parse_long("9223372036854775807") == 2**63 - 1
+
+    @pytest.mark.parametrize("bad", ["", "-", "1.5", "abc", "1e3",
+                                     "9223372036854775808"])
+    def test_rejects(self, bad):
+        with pytest.raises(ValueError):
+            tags.parse_long(bad)
+
+    def test_looks_like_integer(self):
+        assert tags.looks_like_integer("42")
+        assert tags.looks_like_integer("-42")
+        assert not tags.looks_like_integer("4.2")
+        assert not tags.looks_like_integer("")
+
+
+class TestDuration:
+    @pytest.mark.parametrize("text,seconds", [
+        ("1s", 1), ("10m", 600), ("3h", 10800), ("2d", 172800),
+        ("1w", 604800), ("1y", 31536000),
+    ])
+    def test_units(self, text, seconds):
+        assert timeparse.parse_duration(text) == seconds
+
+    @pytest.mark.parametrize("bad", ["", "m", "10", "0s", "-5m", "10x", "h3"])
+    def test_rejects(self, bad):
+        with pytest.raises(BadRequestError):
+            timeparse.parse_duration(bad)
+
+
+class TestDate:
+    def test_unix_timestamp(self):
+        assert timeparse.parse_date("1356998400") == 1356998400
+
+    def test_relative(self):
+        assert timeparse.parse_date("1h-ago", now=10000) == 10000 - 3600
+        assert timeparse.parse_date("1d-ago", now=10**6) == 10**6 - 86400
+
+    def test_absolute_utc(self):
+        ts = timeparse.parse_date("2013/01/01-00:00:00", tz="UTC")
+        assert ts == 1356998400
+        assert timeparse.parse_date("2013/01/01", tz="UTC") == 1356998400
+
+    def test_is_relative(self):
+        assert timeparse.is_relative_date(None)
+        assert timeparse.is_relative_date("5m-ago")
+        assert not timeparse.is_relative_date("1356998400")
+
+    def test_bad(self):
+        with pytest.raises(BadRequestError):
+            timeparse.parse_date("2013/13/45-99:00:00")
+        with pytest.raises(BadRequestError):
+            timeparse.parse_date("2013/01/01", tz="Not/AZone")
