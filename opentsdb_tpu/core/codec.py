@@ -296,11 +296,16 @@ def explode_cell(qual: bytes, value: bytes) -> list[Cell]:
 def merge_cells(cells: list[Cell]) -> tuple[bytes, bytes]:
     """Merge sorted-deduped Cells into one compacted (qualifier, value).
 
-    Appends the trailing 0x00 meta byte. Callers must have sorted and
-    deduplicated (see ``compact_cells``).
+    Appends the trailing 0x00 meta byte for multi-point cells. A merge that
+    collapses to a single point yields a plain single-value cell (2-byte
+    qualifier, raw value): on the wire a 2-byte qualifier always means "raw
+    value, no meta byte". Callers must have sorted and deduplicated (see
+    ``compact_cells``).
     """
     quals = b"".join(c.qualifier for c in cells)
-    vals = b"".join(c.value for c in cells) + b"\x00"
+    vals = b"".join(c.value for c in cells)
+    if len(cells) != 1:
+        vals += b"\x00"
     return quals, vals
 
 

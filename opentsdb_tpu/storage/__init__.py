@@ -1,0 +1,11 @@
+"""Embedded ordered-KV storage engine.
+
+Provides exactly the primitives the reference consumes from HBase through
+asynchbase (SURVEY.md §2.9/§5.8): ordered scans over [start, stop) with an
+optional key regexp, single-key get/put/delete-qualifiers, atomic increment,
+compare-and-set, a durability bit, and PleaseThrottle backpressure.
+"""
+
+from opentsdb_tpu.storage.kv import Cell, KVStore, MemKVStore
+
+__all__ = ["Cell", "KVStore", "MemKVStore"]

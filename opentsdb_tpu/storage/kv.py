@@ -1,0 +1,354 @@
+"""Ordered key-value store: in-memory memtable + append-only WAL.
+
+This engine stands in for the HBase cluster of the reference deployment. The
+API surface is intentionally the exact set of primitives OpenTSDB uses via
+asynchbase (reference src/core/TSDB.java:479-494 get/put/delete;
+src/uid/UniqueId.java:243,297,326 atomicIncrement/compareAndSet;
+src/core/TsdbQuery.java:368-492 ordered scan + key regexp), so the layers
+above translate one-to-one while staying storage-agnostic behind ``KVStore``.
+
+Design notes (TPU-first, not an HBase rebuild):
+- Rows live in a dict keyed by row key; each row is a dict keyed by
+  (family, qualifier). Scans sort lazily: the sorted key index is rebuilt
+  only when a scan happens after inserts, keeping the hot ingest path O(1)
+  per put — the analog of an LSM memtable without the merge machinery.
+- Durability is an append-only WAL with length-prefixed records, replayed on
+  open. ``durable=False`` puts skip the WAL (batch-import mode, parity with
+  setDurable(false), reference IncomingDataPoints.java:253).
+- Backpressure: once the row count crosses ``throttle_rows``, writes raise
+  PleaseThrottleError until a flush/compaction shrinks it — the analog of
+  HBase's PleaseThrottleException signal.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import struct
+import threading
+from bisect import bisect_left
+from typing import Iterator, NamedTuple
+
+from opentsdb_tpu.core.errors import PleaseThrottleError
+
+_REC = struct.Struct(">BI")  # op, payload length
+
+
+class Cell(NamedTuple):
+    key: bytes
+    family: bytes
+    qualifier: bytes
+    value: bytes
+
+
+class KVStore:
+    """Abstract ordered-KV interface; see MemKVStore for the semantics."""
+
+    def get(self, table: str, key: bytes,
+            family: bytes | None = None) -> list[Cell]:
+        raise NotImplementedError
+
+    def has_row(self, table: str, key: bytes) -> bool:
+        return bool(self.get(table, key))
+
+    def put(self, table: str, key: bytes, family: bytes, qualifier: bytes,
+            value: bytes, durable: bool = True) -> None:
+        raise NotImplementedError
+
+    def delete(self, table: str, key: bytes, family: bytes,
+               qualifiers: list[bytes]) -> None:
+        raise NotImplementedError
+
+    def delete_row(self, table: str, key: bytes) -> None:
+        raise NotImplementedError
+
+    def scan(self, table: str, start: bytes, stop: bytes,
+             family: bytes | None = None,
+             key_regexp: bytes | None = None) -> Iterator[list[Cell]]:
+        raise NotImplementedError
+
+    def atomic_increment(self, table: str, key: bytes, family: bytes,
+                         qualifier: bytes, amount: int = 1) -> int:
+        raise NotImplementedError
+
+    def compare_and_set(self, table: str, key: bytes, family: bytes,
+                        qualifier: bytes, expected: bytes | None,
+                        value: bytes) -> bool:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        raise NotImplementedError
+
+    def ensure_table(self, table: str) -> None:
+        raise NotImplementedError
+
+
+class _Table:
+    __slots__ = ("rows", "sorted_keys", "dirty")
+
+    def __init__(self) -> None:
+        self.rows: dict[bytes, dict[tuple[bytes, bytes], bytes]] = {}
+        self.sorted_keys: list[bytes] = []
+        self.dirty = False  # sorted_keys is stale
+
+    def index(self) -> list[bytes]:
+        if self.dirty:
+            self.sorted_keys = sorted(self.rows)
+            self.dirty = False
+        return self.sorted_keys
+
+
+# WAL opcodes
+_OP_PUT = 1
+_OP_DELETE = 2
+_OP_DELETE_ROW = 3
+
+
+class MemKVStore(KVStore):
+    """In-memory ordered KV with optional WAL persistence.
+
+    Thread-safe: a single lock guards all mutation (ingest is batched above
+    this layer, so lock traffic is per-batch, not per-point).
+    """
+
+    def __init__(self, wal_path: str | None = None,
+                 throttle_rows: int | None = None,
+                 fsync: bool = False) -> None:
+        self._tables: dict[str, _Table] = {}
+        self._lock = threading.RLock()
+        self.throttle_rows = throttle_rows
+        self._fsync = fsync
+        self._wal_path = wal_path
+        self._wal: io.BufferedWriter | None = None
+        if wal_path:
+            valid_bytes = 0
+            if os.path.exists(wal_path):
+                valid_bytes = self._replay(wal_path)
+                if valid_bytes < os.path.getsize(wal_path):
+                    # Torn record at the tail (crash mid-write): truncate it
+                    # away so appends continue from the last valid boundary —
+                    # otherwise the next replay would stop at the garbage and
+                    # silently drop everything written after it.
+                    with open(wal_path, "r+b") as f:
+                        f.truncate(valid_bytes)
+            self._wal = open(wal_path, "ab")
+
+    # -- table helpers ----------------------------------------------------
+
+    def _table(self, name: str) -> _Table:
+        t = self._tables.get(name)
+        if t is None:
+            t = self._tables[name] = _Table()
+        return t
+
+    def ensure_table(self, table: str) -> None:
+        with self._lock:
+            self._table(table)
+
+    def row_count(self, table: str) -> int:
+        return len(self._table(table).rows)
+
+    def has_row(self, table: str, key: bytes) -> bool:
+        return key in self._table(table).rows
+
+    def cell_count(self, table: str, key: bytes) -> int:
+        row = self._table(table).rows.get(key)
+        return len(row) if row else 0
+
+    # -- WAL --------------------------------------------------------------
+
+    def _wal_append(self, op: int, *parts: bytes) -> None:
+        if self._wal is None:
+            return
+        payload = b"".join(struct.pack(">I", len(p)) + p for p in parts)
+        self._wal.write(_REC.pack(op, len(payload)) + payload)
+        if self._fsync:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+
+    @staticmethod
+    def _split_payload(payload: bytes) -> list[bytes]:
+        parts = []
+        off = 0
+        while off < len(payload):
+            (n,) = struct.unpack_from(">I", payload, off)
+            off += 4
+            parts.append(payload[off:off + n])
+            off += n
+        return parts
+
+    def _replay(self, path: str) -> int:
+        """Apply every complete WAL record; returns the valid byte count."""
+        valid = 0
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(_REC.size)
+                if len(hdr) < _REC.size:
+                    break  # truncated tail: stop at last complete record
+                op, plen = _REC.unpack(hdr)
+                payload = f.read(plen)
+                if len(payload) < plen:
+                    break
+                valid += _REC.size + plen
+                parts = self._split_payload(payload)
+                table = parts[0].decode()
+                if op == _OP_PUT:
+                    _, key, fam, qual, value = parts
+                    self._apply_put(table, key, fam, qual, value)
+                elif op == _OP_DELETE:
+                    _, key, fam, *quals = parts
+                    self._apply_delete(table, key, fam, quals)
+                elif op == _OP_DELETE_ROW:
+                    _, key = parts
+                    self._apply_delete_row(table, key)
+        return valid
+
+    def flush(self) -> None:
+        """Force WAL to stable storage (reference: HBaseClient.flush)."""
+        with self._lock:
+            if self._wal is not None:
+                self._wal.flush()
+                os.fsync(self._wal.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                self.flush()
+                self._wal.close()
+                self._wal = None
+
+    # -- mutation ---------------------------------------------------------
+
+    def _apply_put(self, table: str, key: bytes, family: bytes,
+                   qualifier: bytes, value: bytes) -> None:
+        t = self._table(table)
+        row = t.rows.get(key)
+        if row is None:
+            row = t.rows[key] = {}
+            t.dirty = True
+        row[(family, qualifier)] = value
+
+    def _apply_delete(self, table: str, key: bytes, family: bytes,
+                      qualifiers: list[bytes]) -> None:
+        t = self._table(table)
+        row = t.rows.get(key)
+        if row is None:
+            return
+        for q in qualifiers:
+            row.pop((family, q), None)
+        if not row:
+            del t.rows[key]
+            t.dirty = True
+
+    def _apply_delete_row(self, table: str, key: bytes) -> None:
+        t = self._table(table)
+        if t.rows.pop(key, None) is not None:
+            t.dirty = True
+
+    def _check_throttle(self, table: str, key: bytes) -> None:
+        # Only throttle puts that would create a NEW row: updates to
+        # existing rows (including compaction rewrites, which relieve
+        # pressure) must keep flowing or backpressure can never clear.
+        if self.throttle_rows is not None and \
+                len(self._table(table).rows) >= self.throttle_rows and \
+                key not in self._table(table).rows:
+            raise PleaseThrottleError(
+                f"table '{table}' holds >= {self.throttle_rows} rows")
+
+    def put(self, table: str, key: bytes, family: bytes, qualifier: bytes,
+            value: bytes, durable: bool = True) -> None:
+        with self._lock:
+            self._check_throttle(table, key)
+            if durable:
+                self._wal_append(_OP_PUT, table.encode(), key, family,
+                                 qualifier, value)
+            self._apply_put(table, key, family, qualifier, value)
+
+    def delete(self, table: str, key: bytes, family: bytes,
+               qualifiers: list[bytes]) -> None:
+        with self._lock:
+            self._wal_append(_OP_DELETE, table.encode(), key, family,
+                             *qualifiers)
+            self._apply_delete(table, key, family, qualifiers)
+
+    def delete_row(self, table: str, key: bytes) -> None:
+        with self._lock:
+            self._wal_append(_OP_DELETE_ROW, table.encode(), key)
+            self._apply_delete_row(table, key)
+
+    # -- reads ------------------------------------------------------------
+
+    def get(self, table: str, key: bytes,
+            family: bytes | None = None) -> list[Cell]:
+        with self._lock:
+            row = self._table(table).rows.get(key)
+            if not row:
+                return []
+            cells = [Cell(key, f, q, v) for (f, q), v in row.items()
+                     if family is None or f == family]
+            cells.sort(key=lambda c: (c.family, c.qualifier))
+            return cells
+
+    def scan(self, table: str, start: bytes, stop: bytes,
+             family: bytes | None = None,
+             key_regexp: bytes | None = None) -> Iterator[list[Cell]]:
+        """Yield one sorted cell-list per row with key in [start, stop).
+
+        ``key_regexp`` applies a DOTALL bytes regex to the whole key —
+        parity with the HBase KeyRegexpFilter used for tag filtering
+        (reference TsdbQuery.createAndSetFilter :433-492).
+
+        Snapshot semantics: keys are snapshotted at call time; rows deleted
+        mid-scan are skipped, rows mutated mid-scan show their new cells —
+        the same weak guarantees an HBase scanner gives across RPC batches.
+        """
+        pattern = re.compile(key_regexp, re.S) if key_regexp else None
+        with self._lock:
+            index = self._table(table).index()
+            lo = bisect_left(index, start)
+            hi = bisect_left(index, stop) if stop else len(index)
+            keys = index[lo:hi]
+        for key in keys:
+            if pattern is not None and not pattern.match(key):
+                continue
+            with self._lock:
+                row = self._table(table).rows.get(key)
+                if not row:
+                    continue
+                cells = [Cell(key, f, q, v) for (f, q), v in row.items()
+                         if family is None or f == family]
+            cells.sort(key=lambda c: (c.family, c.qualifier))
+            if cells:
+                yield cells
+
+    # -- atomics ----------------------------------------------------------
+
+    def atomic_increment(self, table: str, key: bytes, family: bytes,
+                         qualifier: bytes, amount: int = 1) -> int:
+        """Increment an 8-byte big-endian counter cell, returning the new
+        value (initialized from 0 like HBase's ICV)."""
+        with self._lock:
+            row = self._table(table).rows.get(key)
+            cur = row.get((family, qualifier)) if row else None
+            value = (struct.unpack(">q", cur)[0] if cur else 0) + amount
+            packed = struct.pack(">q", value)
+            self._wal_append(_OP_PUT, table.encode(), key, family, qualifier,
+                             packed)
+            self._apply_put(table, key, family, qualifier, packed)
+            return value
+
+    def compare_and_set(self, table: str, key: bytes, family: bytes,
+                        qualifier: bytes, expected: bytes | None,
+                        value: bytes) -> bool:
+        """Atomic CAS: write only if the cell currently equals ``expected``
+        (None = cell must not exist). Returns success."""
+        with self._lock:
+            row = self._table(table).rows.get(key)
+            cur = row.get((family, qualifier)) if row else None
+            if cur != expected:
+                return False
+            self._wal_append(_OP_PUT, table.encode(), key, family, qualifier,
+                             value)
+            self._apply_put(table, key, family, qualifier, value)
+            return True

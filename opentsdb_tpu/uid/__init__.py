@@ -1,0 +1,5 @@
+"""Unique-ID dictionaries (name <-> fixed-width byte id)."""
+
+from opentsdb_tpu.uid.uniqueid import UniqueId
+
+__all__ = ["UniqueId"]

@@ -1,0 +1,50 @@
+"""One coherent configuration object for the whole framework.
+
+The reference scatters configuration across a hand-rolled flag parser and JVM
+system properties (SURVEY.md §5.6: tsd.feature.compactions,
+tsd.core.auto_create_metrics, tsd.http.staticroot, tsd.http.cachedir). Here
+every knob lives in a single dataclass, constructible from CLI flags or a
+dict, defaulting to the reference's behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import multiprocessing
+
+
+@dataclasses.dataclass
+class Config:
+    # storage
+    table: str = "tsdb"
+    uidtable: str = "tsdb-uid"
+    wal_path: str | None = None
+    fsync: bool = False
+    throttle_rows: int | None = None
+
+    # core behavior (names mirror the reference's system properties)
+    auto_create_metrics: bool = False   # tsd.core.auto_create_metrics
+    enable_compactions: bool = True     # tsd.feature.compactions
+    flush_interval: float = 10.0        # compaction thread wake period (s)
+    compaction_min_flush_threshold: int = 100
+    compaction_max_concurrent_flushes: int = 10_000
+    compaction_flush_speed: int = 2
+
+    # compute backend: 'tpu' = jitted JAX kernels; 'cpu' = numpy oracle
+    backend: str = "tpu"
+
+    # network
+    port: int = 4242
+    bind: str = "0.0.0.0"
+    staticroot: str | None = None       # tsd.http.staticroot
+    cachedir: str | None = None         # tsd.http.cachedir
+    worker_threads: int = dataclasses.field(
+        default_factory=lambda: 2 * multiprocessing.cpu_count())
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Config":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return cls(**d)

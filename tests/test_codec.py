@@ -172,10 +172,11 @@ class TestCompaction:
         assert val == v1 + v2 + v3 + b"\x00"
 
     def test_true_duplicate_dropped(self):
+        # Collapsing to one point yields a plain single-value cell.
         q1, v1 = _cell(1, 4)
         qual, val = codec.compact_cells([(q1, v1), (q1, v1)])
         assert qual == q1
-        assert val == v1 + b"\x00"
+        assert val == v1
 
     def test_conflicting_duplicate_raises(self):
         q1, v1 = _cell(1, 4)
@@ -232,7 +233,7 @@ class TestCompaction:
         qual, val = codec.compact_cells([(b"\x01\x02\x03", b"junk"),
                                          (q1, v1)])
         assert qual == q1
-        assert val == v1 + b"\x00"
+        assert val == v1
 
 
 class TestColumnar:

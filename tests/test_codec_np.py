@@ -1,0 +1,129 @@
+"""Vectorized codec must agree byte-for-byte with the scalar oracle."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core import codec, codec_np
+from opentsdb_tpu.core.errors import IllegalDataError
+
+
+def _scalar_cell(points):
+    """Build a compacted cell via the scalar oracle from (delta, value)."""
+    cells = []
+    for delta, value in points:
+        if isinstance(value, float):
+            buf, flags = codec.encode_float(value)
+        else:
+            buf, flags = codec.encode_long(value)
+        cells.append((codec.encode_qualifier(delta, flags), buf))
+    return codec.compact_cells(cells)
+
+
+def _np_cell(points):
+    deltas = np.array([d for d, _ in points], dtype=np.int64)
+    is_float = np.array([isinstance(v, float) for _, v in points])
+    fvals = np.array([float(v) for _, v in points])
+    ivals = np.array([0 if isinstance(v, float) else v for _, v in points],
+                     dtype=np.int64)
+    d, f, i, isf = codec_np.sort_dedup(deltas, fvals, ivals, is_float)
+    return codec_np.encode_cell(d, f, i, isf)
+
+
+MIXED = [(1, 4), (2, 300), (3, 70000), (4, 2**40), (5, 4.25),
+         (3599, -1), (0, -129)]
+
+
+class TestEncodeParity:
+    def test_mixed_widths_match_oracle(self):
+        assert _np_cell(MIXED) == _scalar_cell(sorted(MIXED))
+
+    def test_single_point(self):
+        assert _np_cell([(7, 42)]) == _scalar_cell([(7, 42)])
+
+    def test_all_floats(self):
+        pts = [(i, float(i) / 3) for i in range(50)]
+        assert _np_cell(pts) == _scalar_cell(pts)
+
+    def test_int_width_boundaries(self):
+        pts = [(i, v) for i, v in enumerate(
+            [127, 128, -128, -129, 32767, 32768, -32768, -32769,
+             2**31 - 1, 2**31, -(2**31), -(2**31) - 1, 2**62, -(2**63)])]
+        assert _np_cell(pts) == _scalar_cell(pts)
+
+
+class TestDecodeParity:
+    def test_roundtrip_columns(self):
+        qual, val = _np_cell(MIXED)
+        cols = codec_np.decode_cell(qual, val, 7200)
+        exp = sorted(MIXED)
+        np.testing.assert_array_equal(
+            cols.timestamps, [7200 + d for d, _ in exp])
+        for i, (_, v) in enumerate(exp):
+            if isinstance(v, float):
+                assert cols.is_float[i]
+                assert cols.values[i] == pytest.approx(v)
+            else:
+                assert not cols.is_float[i]
+                assert cols.int_values[i] == v
+
+    def test_single_cell_decode(self):
+        buf, flags = codec.encode_long(300)
+        q = codec.encode_qualifier(10, flags)
+        cols = codec_np.decode_cell(q, buf, 0)
+        assert cols.timestamps[0] == 10 and cols.int_values[0] == 300
+
+    def test_single_cell_legacy_float(self):
+        import struct
+        q = codec.encode_qualifier(1, 0xB)
+        val = b"\x00\x00\x00\x00" + struct.pack(">f", 2.5)
+        cols = codec_np.decode_cell(q, val, 0)
+        assert cols.values[0] == 2.5
+
+    def test_double_in_compacted_cell(self):
+        buf, flags = codec.encode_double(1.0 / 3.0)
+        q1 = codec.encode_qualifier(1, flags)
+        b2, f2 = codec.encode_long(9)
+        q2 = codec.encode_qualifier(2, f2)
+        qual, val = codec.merge_cells(
+            [codec.Cell(q1, buf), codec.Cell(q2, b2)])
+        cols = codec_np.decode_cell(qual, val, 0)
+        assert cols.values[0] == 1.0 / 3.0
+        assert cols.int_values[1] == 9
+
+    def test_bad_meta_byte(self):
+        qual, val = _np_cell([(1, 2), (2, 3)])
+        with pytest.raises(IllegalDataError):
+            codec_np.decode_cell(qual, val[:-1] + b"\x09", 0)
+
+    def test_truncated(self):
+        qual, val = _np_cell([(1, 2), (2, 300)])
+        with pytest.raises(IllegalDataError):
+            codec_np.decode_cell(qual, val[:-2] + b"\x00", 0)
+
+
+class TestSortDedup:
+    def test_sorts(self):
+        d, f, i, isf = codec_np.sort_dedup(
+            np.array([5, 1, 3]), np.zeros(3), np.array([50, 10, 30]),
+            np.zeros(3, dtype=bool))
+        np.testing.assert_array_equal(d, [1, 3, 5])
+        np.testing.assert_array_equal(i, [10, 30, 50])
+
+    def test_dedup_exact(self):
+        d, f, i, isf = codec_np.sort_dedup(
+            np.array([1, 1, 2]), np.zeros(3), np.array([7, 7, 8]),
+            np.zeros(3, dtype=bool))
+        np.testing.assert_array_equal(d, [1, 2])
+        np.testing.assert_array_equal(i, [7, 8])
+
+    def test_conflict_raises(self):
+        with pytest.raises(IllegalDataError):
+            codec_np.sort_dedup(
+                np.array([1, 1]), np.zeros(2), np.array([7, 9]),
+                np.zeros(2, dtype=bool))
+
+    def test_type_conflict_raises(self):
+        with pytest.raises(IllegalDataError):
+            codec_np.sort_dedup(
+                np.array([1, 1]), np.array([7.0, 7.0]), np.array([7, 7]),
+                np.array([False, True]))

@@ -1,0 +1,76 @@
+"""Regression tests for review findings on the storage/uid/core layer."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core import codec, codec_np
+from opentsdb_tpu.core.errors import IllegalDataError, PleaseThrottleError
+from opentsdb_tpu.storage.kv import MemKVStore
+
+T = "tsdb"
+F = b"t"
+
+
+class TestWalTornTail:
+    def test_appends_after_torn_tail_survive(self, tmp_path):
+        """A torn record must be truncated so later writes aren't shadowed."""
+        wal = str(tmp_path / "wal")
+        kv1 = MemKVStore(wal_path=wal)
+        kv1.put(T, b"k1", F, b"q", b"v1")
+        kv1.close()
+        with open(wal, "ab") as f:
+            f.write(b"\x01\x00\x00\x00\xffpartial")  # torn record
+
+        kv2 = MemKVStore(wal_path=wal)  # recovery run
+        kv2.put(T, b"k2", F, b"q", b"v2")  # written AFTER the torn tail
+        kv2.close()
+
+        kv3 = MemKVStore(wal_path=wal)
+        assert kv3.get(T, b"k1")[0].value == b"v1"
+        assert kv3.get(T, b"k2")[0].value == b"v2"  # must not be lost
+        kv3.close()
+
+
+class TestThrottleExistingRows:
+    def test_updates_to_existing_rows_not_throttled(self):
+        kv = MemKVStore(throttle_rows=2)
+        kv.put(T, b"a", F, b"q1", b"v")
+        kv.put(T, b"b", F, b"q1", b"v")
+        # At the limit: new rows rejected, existing rows still writable
+        # (compaction rewrites must be able to relieve pressure).
+        with pytest.raises(PleaseThrottleError):
+            kv.put(T, b"c", F, b"q", b"v")
+        kv.put(T, b"a", F, b"q2", b"v2")
+        assert len(kv.get(T, b"a")) == 2
+
+
+class TestCodecNpGuards:
+    def test_out_of_range_delta_raises(self):
+        with pytest.raises(ValueError):
+            codec_np.encode_cell(np.array([4096]), np.zeros(1),
+                                 np.array([1]), np.array([False]))
+        with pytest.raises(ValueError):
+            codec_np.encode_cell(np.array([-1]), np.zeros(1),
+                                 np.array([1]), np.array([False]))
+
+    def test_bad_int_width_raises_like_oracle(self):
+        q = codec.encode_qualifier(1, 0)  # int flags
+        bad_val = b"\x01\x02\x03"  # 3-byte int: invalid
+        with pytest.raises(IllegalDataError):
+            codec_np.decode_cell(q, bad_val, 0)
+        with pytest.raises(IllegalDataError):
+            codec.decode_value(bad_val, 0)
+
+
+class TestSuggestEdge:
+    def test_prefix_ending_in_0xff(self):
+        from opentsdb_tpu.uid.uniqueid import UniqueId
+        kv = MemKVStore()
+        uid = UniqueId(kv, "tsdb-uid", "metrics", 3)
+        name = "a\xff"
+        uid.get_or_create_id(name)
+        uid.get_or_create_id("a~x")
+        assert uid.suggest("a\xff") == [name]
+        # all-0xFF prefix: open-ended scan, no crash
+        uid.drop_caches()
+        assert uid.suggest("\xff\xff") == []

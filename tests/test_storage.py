@@ -1,0 +1,159 @@
+"""Tests for the ordered-KV storage engine: scans, atomics, WAL, throttle."""
+
+import struct
+
+import pytest
+
+from opentsdb_tpu.core.errors import PleaseThrottleError
+from opentsdb_tpu.storage.kv import MemKVStore
+
+T = "tsdb"
+F = b"t"
+
+
+@pytest.fixture
+def kv():
+    return MemKVStore()
+
+
+class TestBasicOps:
+    def test_put_get(self, kv):
+        kv.put(T, b"k1", F, b"q1", b"v1")
+        cells = kv.get(T, b"k1")
+        assert len(cells) == 1
+        assert cells[0].qualifier == b"q1" and cells[0].value == b"v1"
+
+    def test_get_missing(self, kv):
+        assert kv.get(T, b"nope") == []
+
+    def test_overwrite(self, kv):
+        kv.put(T, b"k", F, b"q", b"v1")
+        kv.put(T, b"k", F, b"q", b"v2")
+        assert kv.get(T, b"k")[0].value == b"v2"
+
+    def test_delete_qualifiers(self, kv):
+        kv.put(T, b"k", F, b"q1", b"v1")
+        kv.put(T, b"k", F, b"q2", b"v2")
+        kv.delete(T, b"k", F, [b"q1"])
+        cells = kv.get(T, b"k")
+        assert [c.qualifier for c in cells] == [b"q2"]
+
+    def test_delete_row(self, kv):
+        kv.put(T, b"k", F, b"q", b"v")
+        kv.delete_row(T, b"k")
+        assert kv.get(T, b"k") == []
+
+    def test_family_filter(self, kv):
+        kv.put(T, b"k", b"id", b"q", b"a")
+        kv.put(T, b"k", b"name", b"q", b"b")
+        assert [c.value for c in kv.get(T, b"k", b"id")] == [b"a"]
+
+    def test_qualifiers_sorted(self, kv):
+        kv.put(T, b"k", F, b"\x00\x20", b"b")
+        kv.put(T, b"k", F, b"\x00\x10", b"a")
+        assert [c.qualifier for c in kv.get(T, b"k")] == \
+            [b"\x00\x10", b"\x00\x20"]
+
+
+class TestScan:
+    def test_ordered_range(self, kv):
+        for k in (b"c", b"a", b"b", b"d"):
+            kv.put(T, k, F, b"q", k)
+        rows = list(kv.scan(T, b"a", b"c"))
+        assert [r[0].key for r in rows] == [b"a", b"b"]
+
+    def test_scan_all_with_empty_stop(self, kv):
+        for k in (b"b", b"a"):
+            kv.put(T, k, F, b"q", k)
+        rows = list(kv.scan(T, b"", b""))
+        assert [r[0].key for r in rows] == [b"a", b"b"]
+
+    def test_key_regexp(self, kv):
+        # Binary regex like the tag-filter path: match keys whose 2nd byte
+        # is \x02 regardless of other bytes (incl. newlines -> DOTALL).
+        kv.put(T, b"\x01\x02\x03", F, b"q", b"x")
+        kv.put(T, b"\x01\n\x03", F, b"q", b"y")
+        kv.put(T, b"\x01\x02\xff", F, b"q", b"z")
+        rows = list(kv.scan(T, b"\x01", b"\x02",
+                            key_regexp=rb"^.\x02.$"))
+        assert sorted(r[0].key for r in rows) == \
+            [b"\x01\x02\x03", b"\x01\x02\xff"]
+
+    def test_scan_sees_inserts_before_call(self, kv):
+        kv.put(T, b"a", F, b"q", b"1")
+        list(kv.scan(T, b"", b""))  # build index
+        kv.put(T, b"b", F, b"q", b"2")  # index goes stale
+        rows = list(kv.scan(T, b"", b""))
+        assert [r[0].key for r in rows] == [b"a", b"b"]
+
+
+class TestAtomics:
+    def test_increment_from_zero(self, kv):
+        assert kv.atomic_increment(T, b"\x00", b"id", b"metrics") == 1
+        assert kv.atomic_increment(T, b"\x00", b"id", b"metrics") == 2
+        raw = kv.get(T, b"\x00", b"id")[0].value
+        assert struct.unpack(">q", raw)[0] == 2
+
+    def test_increment_amount(self, kv):
+        assert kv.atomic_increment(T, b"k", F, b"q", 10) == 10
+
+    def test_cas_create(self, kv):
+        assert kv.compare_and_set(T, b"k", F, b"q", None, b"v1")
+        assert not kv.compare_and_set(T, b"k", F, b"q", None, b"v2")
+        assert kv.get(T, b"k")[0].value == b"v1"
+
+    def test_cas_replace(self, kv):
+        kv.put(T, b"k", F, b"q", b"v1")
+        assert not kv.compare_and_set(T, b"k", F, b"q", b"wrong", b"v2")
+        assert kv.compare_and_set(T, b"k", F, b"q", b"v1", b"v2")
+        assert kv.get(T, b"k")[0].value == b"v2"
+
+
+class TestWAL:
+    def test_replay(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        kv1 = MemKVStore(wal_path=wal)
+        kv1.put(T, b"k1", F, b"q", b"v1")
+        kv1.put(T, b"k2", F, b"q", b"v2")
+        kv1.delete(T, b"k1", F, [b"q"])
+        kv1.atomic_increment(T, b"\x00", b"id", b"metrics")
+        kv1.close()
+
+        kv2 = MemKVStore(wal_path=wal)
+        assert kv2.get(T, b"k1") == []
+        assert kv2.get(T, b"k2")[0].value == b"v2"
+        assert kv2.atomic_increment(T, b"\x00", b"id", b"metrics") == 2
+        kv2.close()
+
+    def test_non_durable_put_skips_wal(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        kv1 = MemKVStore(wal_path=wal)
+        kv1.put(T, b"k", F, b"q", b"v", durable=False)
+        kv1.close()
+        kv2 = MemKVStore(wal_path=wal)
+        assert kv2.get(T, b"k") == []
+        kv2.close()
+
+    def test_truncated_tail_tolerated(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        kv1 = MemKVStore(wal_path=wal)
+        kv1.put(T, b"k1", F, b"q", b"v1")
+        kv1.close()
+        with open(wal, "ab") as f:
+            f.write(b"\x01\x00\x00\x00\xff partial")  # torn record
+        kv2 = MemKVStore(wal_path=wal)
+        assert kv2.get(T, b"k1")[0].value == b"v1"
+        kv2.close()
+
+
+class TestThrottle:
+    def test_backpressure(self):
+        kv = MemKVStore(throttle_rows=2)
+        kv.put(T, b"a", F, b"q", b"v")
+        kv.put(T, b"b", F, b"q", b"v")
+        with pytest.raises(PleaseThrottleError):
+            kv.put(T, b"c", F, b"q", b"v")
+        # Existing-row updates still throttled at the limit, but deleting
+        # frees capacity again.
+        kv.delete_row(T, b"a")
+        kv.put(T, b"c", F, b"q", b"v")

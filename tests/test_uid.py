@@ -1,0 +1,139 @@
+"""Tests for the UID dictionary: allocation discipline, caches, suggest."""
+
+import struct
+
+import pytest
+
+from opentsdb_tpu.core.errors import NoSuchUniqueId, NoSuchUniqueName
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.uid.uniqueid import (
+    ID_FAMILY,
+    MAXID_ROW,
+    NAME_FAMILY,
+    IllegalStateError,
+    UniqueId,
+)
+
+UT = "tsdb-uid"
+
+
+@pytest.fixture
+def kv():
+    return MemKVStore()
+
+
+@pytest.fixture
+def uid(kv):
+    return UniqueId(kv, UT, "metrics", 3)
+
+
+class TestAllocation:
+    def test_first_id_is_one(self, uid):
+        assert uid.get_or_create_id("foo") == b"\x00\x00\x01"
+        assert uid.get_or_create_id("bar") == b"\x00\x00\x02"
+
+    def test_idempotent(self, uid):
+        a = uid.get_or_create_id("foo")
+        assert uid.get_or_create_id("foo") == a
+        assert uid.max_id() == 1  # no id wasted on re-lookup
+
+    def test_mappings_written(self, kv, uid):
+        row = uid.get_or_create_id("foo")
+        # Forward: name -> id under family 'id'.
+        fwd = kv.get(UT, b"foo", ID_FAMILY)
+        assert fwd[0].qualifier == b"metrics" and fwd[0].value == row
+        # Reverse: id -> name under family 'name'.
+        rev = kv.get(UT, row, NAME_FAMILY)
+        assert rev[0].qualifier == b"metrics" and rev[0].value == b"foo"
+
+    def test_kinds_share_counter_rows_independently(self, kv):
+        m = UniqueId(kv, UT, "metrics", 3)
+        k = UniqueId(kv, UT, "tagk", 3)
+        assert m.get_or_create_id("foo") == b"\x00\x00\x01"
+        assert k.get_or_create_id("foo") == b"\x00\x00\x01"
+        # Same MAXID row, different qualifier per kind.
+        cells = kv.get(UT, MAXID_ROW, ID_FAMILY)
+        assert {c.qualifier for c in cells} == {b"metrics", b"tagk"}
+
+    def test_width_overflow(self, kv):
+        u = UniqueId(kv, UT, "metrics", 1)
+        kv.put(UT, MAXID_ROW, ID_FAMILY, b"metrics", struct.pack(">q", 255))
+        with pytest.raises(IllegalStateError):
+            u.get_or_create_id("overflow")
+
+    def test_race_loser_discovers_winner(self, kv, uid):
+        # Simulate a concurrent TSD winning the forward CAS: pre-plant the
+        # forward mapping after our increment would have happened.
+        winner_id = b"\x00\x00\x07"
+        kv.put(UT, b"foo", ID_FAMILY, b"metrics", winner_id)
+        assert uid.get_or_create_id("foo") == winner_id
+
+
+class TestLookups:
+    def test_get_id_unknown(self, uid):
+        with pytest.raises(NoSuchUniqueName):
+            uid.get_id("nope")
+
+    def test_get_name_unknown(self, uid):
+        with pytest.raises(NoSuchUniqueId):
+            uid.get_name(b"\x00\x00\x63")
+
+    def test_get_name_wrong_width(self, uid):
+        with pytest.raises(ValueError):
+            uid.get_name(b"\x01")
+
+    def test_roundtrip_and_cache(self, uid):
+        row = uid.get_or_create_id("foo")
+        misses_before = uid.cache_misses
+        hits_before = uid.cache_hits
+        assert uid.get_id("foo") == row
+        assert uid.get_name(row) == "foo"
+        assert uid.cache_hits == hits_before + 2
+        assert uid.cache_misses == misses_before
+
+    def test_cache_miss_then_hit(self, kv, uid):
+        row = uid.get_or_create_id("foo")
+        fresh = UniqueId(kv, UT, "metrics", 3)
+        assert fresh.get_name(row) == "foo"
+        assert fresh.cache_misses == 1
+        assert fresh.get_name(row) == "foo"
+        assert fresh.cache_hits == 1
+
+    def test_drop_caches(self, uid):
+        uid.get_or_create_id("foo")
+        uid.drop_caches()
+        assert uid.cache_size() == 0
+
+
+class TestSuggest:
+    def test_prefix(self, uid):
+        for name in ("sys.cpu.user", "sys.cpu.sys", "sys.mem.free", "proc"):
+            uid.get_or_create_id(name)
+        assert uid.suggest("sys.cpu") == ["sys.cpu.sys", "sys.cpu.user"]
+        assert uid.suggest("zzz") == []
+
+    def test_empty_prefix_lists_all(self, uid):
+        for name in ("a", "b"):
+            uid.get_or_create_id(name)
+        assert uid.suggest("") == ["a", "b"]
+
+    def test_limit(self, uid):
+        for i in range(30):
+            uid.get_or_create_id(f"m{i:02d}")
+        assert len(uid.suggest("m")) == 25
+
+
+class TestRename:
+    def test_rename(self, uid):
+        row = uid.get_or_create_id("foo")
+        uid.rename("foo", "bar")
+        assert uid.get_id("bar") == row
+        assert uid.get_name(row) == "bar"
+        with pytest.raises(NoSuchUniqueName):
+            uid.get_id("foo")
+
+    def test_rename_to_existing(self, uid):
+        uid.get_or_create_id("foo")
+        uid.get_or_create_id("bar")
+        with pytest.raises(ValueError):
+            uid.rename("foo", "bar")
