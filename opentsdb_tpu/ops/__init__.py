@@ -1,0 +1,7 @@
+"""TPU compute kernels: batched reductions, downsampling, rate, alignment.
+
+``oracle`` holds exact numpy (float64) implementations of the reference
+semantics — the ground truth for golden tests. ``kernels`` holds the jitted
+JAX equivalents operating on fixed-shape padded arrays with masks, vmapped
+over series and shardable over a device mesh (see opentsdb_tpu.parallel).
+"""

@@ -1,0 +1,307 @@
+"""Jitted JAX kernels for the query/compaction compute path.
+
+Design rules (SURVEY.md §7.4):
+- Fixed shapes everywhere: callers pad to static sizes and pass masks or
+  counts. No data-dependent Python control flow; everything lowers to one
+  XLA computation per (shape, static-arg) combination.
+- The primary layout is FLAT: all points of all series in a query live in
+  one [N] array with a parallel [N] series-id array, so ragged series waste
+  no compute. Downsample + group-by is then one fused pair of segment
+  reductions (points -> series x bucket -> bucket), which XLA maps onto the
+  VPU with no gather/scatter loops — this replaces the reference's k-way
+  merge iterator stack (SpanGroup.SGIterator, Span.DownsamplingIterator).
+- Timestamps enter as int32 *offsets from the query start*; values as
+  float32. Bucket mean-timestamps are computed relative to each bucket
+  start so float32 stays exact (offsets < interval <= 2^24).
+
+Aggregator semantics match ops/oracle.py (the numpy float64 oracle); golden
+tests compare the two.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGG_IDS = {"sum": 0, "min": 1, "max": 2, "avg": 3, "dev": 4, "count": 5}
+
+# Plain Python floats: creating jnp scalars at import time would
+# instantiate a device array and eagerly initialize the backend.
+_NEG_INF = float("-inf")
+_POS_INF = float("inf")
+
+
+# ---------------------------------------------------------------------------
+# Masked segment reductions
+# ---------------------------------------------------------------------------
+
+def _segment_moments(vals: jnp.ndarray, seg: jnp.ndarray, valid: jnp.ndarray,
+                     num_segments: int):
+    """Per-segment count, sum, centered-M2, min, max over masked points.
+
+    The second moment is centered (two-pass: mean first, then
+    sum((x-mean)^2)) — the naive E[x^2]-E[x]^2 form cancels catastrophically
+    in float32 when stddev << |mean|.
+    """
+    v = jnp.where(valid, vals, 0.0)
+    count = jax.ops.segment_sum(valid.astype(jnp.float32), seg, num_segments)
+    total = jax.ops.segment_sum(v, seg, num_segments)
+    mean = total / jnp.maximum(count, 1.0)
+    centered = jnp.where(valid, vals - mean[seg], 0.0)
+    m2 = jax.ops.segment_sum(centered * centered, seg, num_segments)
+    mn = jax.ops.segment_min(jnp.where(valid, vals, _POS_INF), seg,
+                             num_segments)
+    mx = jax.ops.segment_max(jnp.where(valid, vals, _NEG_INF), seg,
+                             num_segments)
+    return count, total, m2, mn, mx
+
+
+def _finish(agg: str, count, total, m2, mn, mx):
+    """Combine segment moments (m2 = centered sum of squares) into the agg."""
+    safe = jnp.maximum(count, 1.0)
+    if agg == "sum":
+        return total
+    if agg == "min":
+        return mn
+    if agg == "max":
+        return mx
+    if agg == "avg":
+        return total / safe
+    if agg == "dev":
+        return jnp.sqrt(jnp.maximum(m2, 0.0) / safe)
+    if agg == "count":
+        return count
+    raise ValueError(f"unknown aggregator: {agg}")
+
+
+def gap_fill(series_values: jnp.ndarray, series_mask: jnp.ndarray,
+             num_buckets: int):
+    """Lerp-fill each series' empty buckets between its nonempty ones.
+
+    A series with an empty bucket between two nonempty ones contributes a
+    linear interpolation (the reference lerps missing samples at group
+    time, SpanGroup.java:702-784); outside its first/last nonempty bucket
+    it contributes nothing. Fill via cumulative min/max index scans — no
+    sort, no gather loops. Bucket starts are affine in the bucket index,
+    so lerping in index space equals lerping in time space.
+
+    Returns (filled [S, B], in_range [S, B]).
+    """
+    b_idx = jnp.arange(num_buckets)
+    prev_i = jax.lax.cummax(
+        jnp.where(series_mask, b_idx[None, :], -1), axis=1)
+    next_i = jax.lax.cummin(
+        jnp.where(series_mask, b_idx[None, :], num_buckets), axis=1,
+        reverse=True)
+    in_range = (prev_i >= 0) & (next_i < num_buckets)
+    p = jnp.clip(prev_i, 0, num_buckets - 1)
+    q = jnp.clip(next_i, 0, num_buckets - 1)
+    y0 = jnp.take_along_axis(series_values, p, axis=1)
+    y1 = jnp.take_along_axis(series_values, q, axis=1)
+    dx = jnp.maximum((q - p).astype(jnp.float32), 1.0)
+    frac = (b_idx[None, :] - p).astype(jnp.float32) / dx
+    filled = jnp.where(series_mask, series_values, y0 + frac * (y1 - y0))
+    return filled, in_range
+
+
+def group_moments(filled: jnp.ndarray, in_range: jnp.ndarray):
+    """Masked per-bucket moments across series (axis 0): count, total,
+    centered M2, mean, min, max."""
+    n = in_range.astype(jnp.float32).sum(axis=0)
+    total = jnp.where(in_range, filled, 0.0).sum(axis=0)
+    mean = total / jnp.maximum(n, 1.0)
+    centered = jnp.where(in_range, filled - mean[None, :], 0.0)
+    m2 = (centered * centered).sum(axis=0)
+    mn = jnp.where(in_range, filled, _POS_INF).min(axis=0)
+    mx = jnp.where(in_range, filled, _NEG_INF).max(axis=0)
+    return n, total, m2, mean, mn, mx
+
+
+# ---------------------------------------------------------------------------
+# Fused downsample + group-by (the hot query kernel)
+# ---------------------------------------------------------------------------
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_series", "num_buckets", "interval", "agg_down",
+                     "agg_group"))
+def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
+                     valid: jnp.ndarray, *, num_series: int,
+                     num_buckets: int, interval: int, agg_down: str,
+                     agg_group: str):
+    """Downsample every series into aligned buckets, then aggregate across
+    series — one fused computation.
+
+    Args:
+      ts:    [N] int32 offsets from the query start (bucket-aligned base).
+      vals:  [N] float32 point values.
+      sid:   [N] int32 series index in [0, num_series).
+      valid: [N] bool padding mask.
+      interval: bucket width (seconds); num_buckets: static bucket count
+        covering the query range.
+
+    Returns dict with:
+      series_values [S, B] per-series downsampled buckets,
+      series_ts     [S, B] int32 mean member-timestamp offset per bucket,
+      series_mask   [S, B] bool bucket-nonempty mask,
+      group_values  [B] cross-series aggregate (over nonempty buckets),
+      group_mask    [B] bool.
+
+    Semantics parity: aligned buckets + integer-mean member timestamps =
+    oracle.downsample(mode='aligned', bucket_ts='avg'); cross-series
+    aggregation on the shared bucket grid = the lerp-free fast path
+    (identical grids need no interpolation).
+    """
+    bucket = ts // interval
+    bucket = jnp.clip(bucket, 0, num_buckets - 1)
+    seg = jnp.where(valid, sid * num_buckets + bucket, num_series * num_buckets)
+    nseg = num_series * num_buckets + 1  # +1 trash segment for padding
+
+    count, total, sumsq, mn, mx = _segment_moments(vals, seg, valid, nseg)
+    per = _finish(agg_down, count, total, sumsq, mn, mx)
+
+    # Mean member timestamp, relative to bucket start for f32 exactness.
+    rel = (ts - bucket * interval).astype(jnp.float32)
+    rel_sum = jax.ops.segment_sum(jnp.where(valid, rel, 0.0), seg, nseg)
+    mean_rel = jnp.floor(rel_sum / jnp.maximum(count, 1.0))
+
+    shape = (num_series, num_buckets)
+    series_values = per[:-1].reshape(shape)
+    series_count = count[:-1].reshape(shape)
+    series_mask = series_count > 0
+    bucket_starts = (jnp.arange(num_buckets, dtype=jnp.int32) * interval)
+    series_ts = bucket_starts[None, :] + mean_rel[:-1].reshape(shape) \
+        .astype(jnp.int32)
+
+    # Group stage: aggregate across series on the shared bucket grid.
+    filled, in_range = gap_fill(series_values, series_mask, num_buckets)
+    g_count, g_total, g_m2, _, g_mn, g_mx = group_moments(filled, in_range)
+    group_values = _finish(agg_group, g_count, g_total, g_m2, g_mn, g_mx)
+
+    return {
+        "series_values": series_values,
+        "series_ts": series_ts,
+        "series_mask": series_mask,
+        "group_values": group_values,
+        # Emit only buckets where some series has a real point (the union
+        # grid); lerp-filled contributions never create grid points.
+        "group_mask": series_mask.any(axis=0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rate (flat layout)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("counter", "drop_resets"))
+def flat_rate(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
+              valid: jnp.ndarray, counter_max: float = 0.0,
+              reset_value: float = 0.0, *, counter: bool = False,
+              drop_resets: bool = False):
+    """Per-point rate of change within each series, in flat layout.
+
+    Requires points sorted by (sid, ts) — the natural scan order. The first
+    point of each series yields no rate (its valid bit clears), matching
+    oracle.rate. ``counter`` adds rollover correction at counter_max;
+    ``drop_resets``/reset_value zeroes implausible spikes.
+
+    Returns (rates [N] float32 emitted at each point's own ts, valid [N]).
+    """
+    prev_ts = jnp.roll(ts, 1)
+    prev_v = jnp.roll(vals, 1)
+    prev_sid = jnp.roll(sid, 1)
+    prev_valid = jnp.roll(valid, 1)
+    ok = valid & prev_valid & (prev_sid == sid)
+    ok = ok.at[0].set(False)
+    dt = jnp.maximum((ts - prev_ts).astype(jnp.float32), 1e-9)
+    dv = vals - prev_v
+    if counter:
+        dv = jnp.where(dv < 0, dv + counter_max, dv)
+    r = dv / dt
+    if drop_resets:
+        r = jnp.where(jnp.abs(r) > reset_value, 0.0, r)
+    return jnp.where(ok, r, 0.0), ok
+
+
+# ---------------------------------------------------------------------------
+# Union-grid group aggregation with interpolation (reference-parity path)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("agg", "interp"))
+def group_interpolate(ts: jnp.ndarray, vals: jnp.ndarray,
+                      counts: jnp.ndarray, *, agg: str,
+                      interp: str = "lerp"):
+    """Aggregate S padded series on the union of their timestamps.
+
+    Args:
+      ts:     [S, T] int32, each row sorted, left-aligned (valid prefix).
+      vals:   [S, T] float32.
+      counts: [S] int32 valid-point counts per row.
+      interp: 'lerp' or 'step' (last-value hold, for rates).
+
+    Returns (grid [G=S*T] int32, out [G] float32, gmask [G] bool): the
+    deduplicated union grid (padded; gmask marks real entries) and the
+    aggregate at each grid point. A series contributes exact values at its
+    own timestamps, interpolation elsewhere, nothing outside its
+    [first, last] — reference SGIterator semantics (SpanGroup.java:370-796).
+    """
+    S, T = ts.shape
+    idx = jnp.arange(T)
+    row_valid = idx[None, :] < counts[:, None]
+    big = jnp.int32(2**31 - 1)
+    ts_masked = jnp.where(row_valid, ts, big)
+
+    # Union grid: sort all timestamps, mark first occurrence of each value.
+    flat = ts_masked.reshape(-1)
+    sorted_ts = jnp.sort(flat)
+    first = jnp.concatenate([
+        jnp.array([True]), sorted_ts[1:] != sorted_ts[:-1]])
+    gmask = first & (sorted_ts != big)
+    # Compact real grid entries to the front (stable argsort of ~gmask).
+    order = jnp.argsort(~gmask, stable=True)
+    grid = sorted_ts[order]
+    gmask = gmask[order]
+    G = S * T
+
+    # Per-series contribution at every grid point.
+    def one_series(row_ts, row_vals, n):
+        # row_ts padded with +inf-alike; searchsorted right gives the count
+        # of points <= x.
+        safe_ts = jnp.where(idx < n, row_ts, big)
+        pos = jnp.searchsorted(safe_ts, grid, side="right")
+        has_prev = pos > 0
+        i0 = jnp.clip(pos - 1, 0, T - 1)
+        i1 = jnp.clip(pos, 0, T - 1)
+        x0 = safe_ts[i0]
+        y0 = row_vals[i0]
+        x1 = safe_ts[i1]
+        y1 = row_vals[i1]
+        exact = has_prev & (x0 == grid)
+        in_range = has_prev & (pos < n) | exact  # first <= x <= last
+        if interp == "lerp":
+            dx = jnp.maximum((x1 - x0).astype(jnp.float32), 1e-9)
+            t = (grid - x0).astype(jnp.float32) / dx
+            interpd = y0 + t * (y1 - y0)
+        elif interp == "step":
+            interpd = y0
+        else:
+            raise ValueError(f"unknown interp: {interp}")
+        contrib = jnp.where(exact, y0, interpd)
+        return jnp.where(in_range, contrib, 0.0), in_range
+
+    contrib, cmask = jax.vmap(one_series)(ts, vals, counts)  # [S, G]
+
+    cnt = cmask.astype(jnp.float32).sum(axis=0)
+    v = jnp.where(cmask, contrib, 0.0)
+    total = v.sum(axis=0)
+    mean = total / jnp.maximum(cnt, 1.0)
+    centered = jnp.where(cmask, contrib - mean[None, :], 0.0)
+    m2 = (centered * centered).sum(axis=0)
+    mn = jnp.where(cmask, contrib, _POS_INF).min(axis=0)
+    mx = jnp.where(cmask, contrib, _NEG_INF).max(axis=0)
+    out = _finish(agg, cnt, total, m2, mn, mx)
+    gmask = gmask & (cnt > 0)
+    return grid, out, gmask

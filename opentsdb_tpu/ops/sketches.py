@@ -1,0 +1,209 @@
+"""Mergeable sketch kernels: t-digest percentiles and HyperLogLog counts.
+
+These replace the reference's exact-but-sequential structures per the north
+star (BASELINE.json): src/stats/Histogram.java's fixed buckets give way to
+t-digest quantiles; distinct-tag-value counting (which the reference can
+only do by materializing every group) becomes HyperLogLog.
+
+Both sketches are designed for XLA:
+- Fixed-size state resident in HBM: a t-digest is exactly (means[K],
+  weights[K]); an HLL is registers[M]. No data-dependent shapes.
+- Batch-compress instead of per-point control flow: t-digest updates
+  concatenate centroids with the new batch, sort once, assign each point a
+  cluster via the scale function k(q) = delta/(2pi) * asin(2q-1) evaluated
+  on cumulative weights, and segment-reduce — the one-pass vectorized form
+  of the MergingDigest algorithm (Dunning, arXiv:1902.04023). HLL updates
+  are one segment_max.
+- Merging across chips is elementwise max (HLL) or concatenate+recompress
+  (t-digest), so cross-shard fan-in rides psum/all_gather (see
+  opentsdb_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# t-digest
+# ---------------------------------------------------------------------------
+
+DEFAULT_COMPRESSION = 128  # max centroids (delta)
+
+
+def tdigest_init(compression: int = DEFAULT_COMPRESSION):
+    """Empty digest state: (means[K], weights[K]) with zero weights."""
+    return (jnp.zeros(compression, jnp.float32),
+            jnp.zeros(compression, jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("compression",))
+def _compress(means: jnp.ndarray, weights: jnp.ndarray, *,
+              compression: int):
+    """Sort centroids and merge them into <= compression clusters.
+
+    Cluster assignment uses the k1 scale function on cumulative quantiles:
+    k(q) = (delta / (2*pi)) * asin(2q - 1); cluster id = floor(k(q_mid) +
+    delta/4), which concentrates resolution at the tails.
+    """
+    # Sort with empty (weight-0) slots pushed to the end so they never
+    # perturb the quantile positions of real centroids.
+    key = jnp.where(weights > 0, means, jnp.inf)
+    order = jnp.argsort(key)
+    m = means[order]
+    w = weights[order]
+    total = jnp.maximum(w.sum(), 1e-30)
+    cum = jnp.cumsum(w)
+    q_mid = (cum - w / 2) / total
+    q_mid = jnp.clip(q_mid, 1e-7, 1 - 1e-7)
+    delta = jnp.float32(compression)
+    # k1 scale spanning the full [0, compression] range (asin covers
+    # [-pi/2, pi/2], so the delta/pi coefficient uses every slot).
+    k = delta / jnp.pi * jnp.arcsin(2 * q_mid - 1) + delta / 2
+    cluster = jnp.clip(k.astype(jnp.int32), 0, compression - 1)
+    # Empty (weight 0) entries go to a trash cluster.
+    cluster = jnp.where(w > 0, cluster, compression)
+    wsum = jax.ops.segment_sum(w, cluster, compression + 1)[:-1]
+    msum = jax.ops.segment_sum(m * w, cluster, compression + 1)[:-1]
+    new_means = jnp.where(wsum > 0, msum / jnp.maximum(wsum, 1e-30), 0.0)
+    return new_means, wsum
+
+
+@functools.partial(jax.jit, static_argnames=("compression",))
+def tdigest_add(means: jnp.ndarray, weights: jnp.ndarray,
+                values: jnp.ndarray, valid: jnp.ndarray, *,
+                compression: int = DEFAULT_COMPRESSION):
+    """Fold a batch of values (with padding mask) into the digest."""
+    m = jnp.concatenate([means, values.astype(jnp.float32)])
+    w = jnp.concatenate([weights, valid.astype(jnp.float32)])
+    return _compress(m, w, compression=compression)
+
+
+@functools.partial(jax.jit, static_argnames=("compression",))
+def tdigest_merge(means_a, weights_a, means_b, weights_b, *,
+                  compression: int = DEFAULT_COMPRESSION):
+    """Merge two digests (associative, commutative up to compression error)."""
+    m = jnp.concatenate([means_a, means_b])
+    w = jnp.concatenate([weights_a, weights_b])
+    return _compress(m, w, compression=compression)
+
+
+@jax.jit
+def tdigest_quantile(means: jnp.ndarray, weights: jnp.ndarray,
+                     q: jnp.ndarray):
+    """Estimate quantiles q (in [0,1]) by interpolating between centroids.
+
+    Zero-weight (empty) centroid slots are excluded: they sort to the end
+    and both the search and the support clamps only see real centroids —
+    otherwise empties (mean 0.0) would drag extreme quantiles toward zero
+    for data not spanning zero.
+    """
+    key = jnp.where(weights > 0, means, jnp.inf)
+    order = jnp.argsort(key)
+    m = means[order]
+    w = weights[order]
+    nreal = jnp.maximum((weights > 0).sum(), 1)
+    last = nreal - 1
+    total = jnp.maximum(w.sum(), 1e-30)
+    cum = jnp.cumsum(w)
+    centers = (cum - w / 2) / total  # quantile at each centroid center
+    # Empty slots all have centers == 1.0; push them past any target.
+    centers = jnp.where(jnp.arange(len(m)) < nreal, centers, jnp.inf)
+
+    def one(qi):
+        target = jnp.clip(qi, 0.0, 1.0)
+        # Index of first real centroid whose center >= target.
+        idx = jnp.searchsorted(centers, target)
+        lo = jnp.clip(idx - 1, 0, last)
+        hi = jnp.clip(idx, 0, last)
+        c0, c1 = centers[lo], centers[hi]
+        m0, m1 = m[lo], m[hi]
+        frac = jnp.where(c1 > c0, (target - c0) / jnp.maximum(c1 - c0, 1e-30),
+                         0.0)
+        frac = jnp.clip(frac, 0.0, 1.0)
+        est = m0 + frac * (m1 - m0)
+        # Clamp to the digest's support where q falls outside centers.
+        est = jnp.where(target <= centers[0], m[0], est)
+        est = jnp.where(target >= centers[last], m[last], est)
+        return est
+
+    return jax.vmap(one)(jnp.atleast_1d(jnp.asarray(q, jnp.float32)))
+
+
+def tdigest_count(weights: jnp.ndarray) -> jnp.ndarray:
+    return weights.sum()
+
+
+# ---------------------------------------------------------------------------
+# HyperLogLog
+# ---------------------------------------------------------------------------
+
+DEFAULT_HLL_P = 14  # 2^14 = 16384 registers -> ~0.8% standard error
+
+
+def hll_init(p: int = DEFAULT_HLL_P):
+    return jnp.zeros(1 << p, jnp.int32)
+
+
+@jax.jit
+def hash32(x: jnp.ndarray) -> jnp.ndarray:
+    """32-bit avalanche mixer (murmur3 finalizer) over int32/uint32 input."""
+    h = x.astype(jnp.uint32)
+    h ^= h >> 16
+    h *= jnp.uint32(0x85EBCA6B)
+    h ^= h >> 13
+    h *= jnp.uint32(0xC2B2AE35)
+    h ^= h >> 16
+    return h
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def hll_add(registers: jnp.ndarray, items: jnp.ndarray,
+            valid: jnp.ndarray, *, p: int = DEFAULT_HLL_P):
+    """Fold hashed items (e.g. tagv UIDs as int32) into the registers."""
+    h = hash32(items)
+    idx = (h >> (32 - p)).astype(jnp.int32)
+    w = (h << p) >> p  # low (32-p) bits
+    # rank = leading-zero count within (32-p) bits, + 1. floor(log2) via
+    # float32 exponent is exact for w < 2^24 (here w < 2^18 when p=14).
+    lg = jnp.frexp(w.astype(jnp.float32))[1] - 1  # floor(log2(w)), w>0
+    rank = jnp.where(w > 0, (32 - p) - lg, (32 - p) + 1).astype(jnp.int32)
+    idx = jnp.where(valid, idx, 1 << p)  # trash register for padding
+    new = jax.ops.segment_max(rank, idx, (1 << p) + 1)[:-1]
+    return jnp.maximum(registers, new)
+
+
+@jax.jit
+def hll_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.maximum(a, b)
+
+
+@jax.jit
+def hll_estimate(registers: jnp.ndarray) -> jnp.ndarray:
+    """Cardinality estimate with small/large-range corrections."""
+    m = registers.shape[0]
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    inv = jnp.sum(jnp.exp2(-registers.astype(jnp.float32)))
+    raw = alpha * m * m / inv
+    zeros = jnp.sum(registers == 0).astype(jnp.float32)
+    small = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    est = jnp.where((raw <= 2.5 * m) & (zeros > 0), small, raw)
+    two32 = jnp.float32(2.0) ** 32
+    est = jnp.where(est > two32 / 30.0,
+                    -two32 * jnp.log1p(-est / two32), est)
+    return est
+
+
+# ---------------------------------------------------------------------------
+# Numpy oracles (for tests)
+# ---------------------------------------------------------------------------
+
+def exact_quantile(values: np.ndarray, q: float) -> float:
+    return float(np.quantile(np.asarray(values, dtype=np.float64), q))
+
+
+def exact_distinct(values: np.ndarray) -> int:
+    return int(len(np.unique(values)))

@@ -1,0 +1,15 @@
+"""Multi-chip parallelism: device meshes, sharded kernels, collectives.
+
+The reference scales by running many independent TSDs over HBase region
+servers (SURVEY.md §2.9). Here the analog is explicit: series are sharded
+across TPU chips over a ``jax.sharding.Mesh``; per-chip segment reductions
+produce partial aggregates that merge across ICI with ``psum``-family
+collectives; sketch states merge with ``pmax`` (HLL) / gather+recompress
+(t-digest). Time-axis sharding exchanges boundary carries between
+neighbors for rate/lerp correctness (the ring-attention analog for the
+time dimension, SURVEY.md §5.7).
+"""
+
+from opentsdb_tpu.parallel.mesh import make_mesh
+
+__all__ = ["make_mesh"]

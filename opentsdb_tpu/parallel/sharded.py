@@ -1,0 +1,176 @@
+"""Sharded query kernels: series-parallel execution over a device mesh.
+
+Layout: the host partitions a query's series into ``D`` blocks (one per
+chip) and packs each block's points into the flat layout, padded to a
+common [N_shard] size; arrays stack to [D, N_shard] and shard over the
+mesh's series axis via ``shard_map``. Each chip runs the same fused
+downsample kernel on its local series (zero communication), then the
+cross-series group stage combines per-bucket partial moments with psum
+collectives. Variances combine exactly via the pairwise (Chan et al.)
+update: M2 = sum_i M2_i + sum_i n_i * (mean_i - mean)^2 — two psums, no
+catastrophic cancellation.
+
+Sketch fan-in: HLL registers combine with lax.pmax; t-digests all_gather
+their centroids and recompress locally (every chip ends with the identical
+merged digest).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from opentsdb_tpu.ops import sketches
+from opentsdb_tpu.ops.kernels import (
+    downsample_group,
+    gap_fill,
+    group_moments,
+)
+from opentsdb_tpu.parallel.mesh import SERIES_AXIS
+
+
+def _local_group_moments(ts, vals, sid, valid, *, num_series, num_buckets,
+                         interval, agg_down):
+    """Per-chip: fused downsample + lerp-fill, returning partial group
+    moments per bucket (count, total, M2-around-local-mean, local mean,
+    min, max, any-real-point)."""
+    out = downsample_group(
+        ts, vals, sid, valid, num_series=num_series,
+        num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+        agg_group="sum")  # agg_group unused; we recompute moments below
+    filled, in_range = gap_fill(out["series_values"], out["series_mask"],
+                                num_buckets)
+    n, total, m2, mean, mn, mx = group_moments(filled, in_range)
+    return n, total, m2, mean, mn, mx, out["series_mask"].any(axis=0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "series_per_shard", "num_buckets", "interval",
+                     "agg_down", "agg_group"))
+def sharded_downsample_group(ts, vals, sid, valid, *, mesh,
+                             series_per_shard: int, num_buckets: int,
+                             interval: int, agg_down: str, agg_group: str):
+    """Fused downsample + cross-chip group aggregation.
+
+    Args are [D, N_shard] stacked shards (sid local to each shard, in
+    [0, series_per_shard)); returns (group_values [B], group_mask [B])
+    replicated on every chip.
+    """
+
+    def shard_fn(ts, vals, sid, valid):
+        ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
+        n, total, m2, mean, mn, mx, any_real = _local_group_moments(
+            ts, vals, sid, valid, num_series=series_per_shard,
+            num_buckets=num_buckets, interval=interval, agg_down=agg_down)
+        # Cross-chip exact moment combination (Chan et al.).
+        g_n = jax.lax.psum(n, SERIES_AXIS)
+        g_total = jax.lax.psum(total, SERIES_AXIS)
+        g_mean = g_total / jnp.maximum(g_n, 1.0)
+        corr = n * (mean - g_mean) ** 2
+        g_m2 = jax.lax.psum(m2 + corr, SERIES_AXIS)
+        g_mn = jax.lax.pmin(mn, SERIES_AXIS)
+        g_mx = jax.lax.pmax(mx, SERIES_AXIS)
+        g_any = jax.lax.pmax(any_real.astype(jnp.int32), SERIES_AXIS) > 0
+
+        safe = jnp.maximum(g_n, 1.0)
+        if agg_group == "sum":
+            out = g_total
+        elif agg_group == "min":
+            out = g_mn
+        elif agg_group == "max":
+            out = g_mx
+        elif agg_group == "avg":
+            out = g_total / safe
+        elif agg_group == "dev":
+            out = jnp.sqrt(jnp.maximum(g_m2, 0.0) / safe)
+        elif agg_group == "count":
+            out = g_n
+        else:
+            raise ValueError(f"unknown aggregator: {agg_group}")
+        return out[None], g_any[None]
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(SERIES_AXIS), P(SERIES_AXIS), P(SERIES_AXIS),
+                  P(SERIES_AXIS)),
+        out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
+    group_values, group_mask = fn(ts, vals, sid, valid)
+    # Every shard returned the identical replicated answer; take shard 0.
+    return group_values[0], group_mask[0]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "p"))
+def sharded_hll_distinct(items, valid, *, mesh, p: int = 14):
+    """Distinct count over [D, N_shard] sharded items: local HLL registers,
+    pmax merge across chips, single estimate."""
+
+    def shard_fn(items, valid):
+        regs = sketches.hll_init(p)
+        regs = sketches.hll_add(regs, items[0], valid[0], p=p)
+        merged = jax.lax.pmax(regs, SERIES_AXIS)
+        return sketches.hll_estimate(merged)[None]
+
+    fn = jax.shard_map(shard_fn, mesh=mesh,
+                       in_specs=(P(SERIES_AXIS), P(SERIES_AXIS)),
+                       out_specs=P(SERIES_AXIS))
+    return fn(items, valid)[0]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "compression"))
+def sharded_tdigest(values, valid, qs, *, mesh, compression: int = 128):
+    """Quantiles over [D, N_shard] sharded values: local digests,
+    all_gather + recompress, shared quantile answer."""
+
+    def shard_fn(values, valid):
+        means, weights = sketches.tdigest_init(compression)
+        means, weights = sketches.tdigest_add(
+            means, weights, values[0], valid[0], compression=compression)
+        all_means = jax.lax.all_gather(means, SERIES_AXIS).reshape(-1)
+        all_weights = jax.lax.all_gather(weights, SERIES_AXIS).reshape(-1)
+        m, w = sketches._compress(all_means, all_weights,
+                                  compression=compression)
+        return sketches.tdigest_quantile(m, w, qs)[None]
+
+    fn = jax.shard_map(shard_fn, mesh=mesh,
+                       in_specs=(P(SERIES_AXIS), P(SERIES_AXIS)),
+                       out_specs=P(SERIES_AXIS))
+    return fn(values, valid)[0]
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing
+# ---------------------------------------------------------------------------
+
+def pack_shards(series: list[tuple], n_shards: int):
+    """Partition [(ts, vals)] series round-robin into n stacked shards.
+
+    Returns (ts, vals, sid, valid) as [D, N_shard] numpy arrays plus
+    series_per_shard — ready for sharded_downsample_group.
+    """
+    import numpy as np
+
+    blocks: list[list[tuple]] = [[] for _ in range(n_shards)]
+    for i, s in enumerate(series):
+        blocks[i % n_shards].append(s)
+    series_per_shard = max(len(b) for b in blocks)
+    n_shard = max(
+        (sum(len(s[0]) for s in b) for b in blocks), default=1)
+    n_shard = max(n_shard, 1)
+    ts = np.zeros((n_shards, n_shard), np.int32)
+    vals = np.zeros((n_shards, n_shard), np.float32)
+    sid = np.zeros((n_shards, n_shard), np.int32)
+    valid = np.zeros((n_shards, n_shard), bool)
+    for d, block in enumerate(blocks):
+        off = 0
+        for local_id, (sts, svals) in enumerate(block):
+            n = len(sts)
+            ts[d, off:off + n] = sts
+            vals[d, off:off + n] = svals
+            sid[d, off:off + n] = local_id
+            valid[d, off:off + n] = True
+            off += n
+    return ts, vals, sid, valid, series_per_shard

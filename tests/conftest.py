@@ -7,8 +7,18 @@ import, hence the env mutation at conftest import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Override unconditionally: the ambient environment pins JAX_PLATFORMS=axon
+# (the real TPU tunnel), which tests must never use.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Pytest plugins (jaxtyping, typeguard, ...) import jax before this file
+# runs, so the env mutation alone may be too late for jax.config's cached
+# default — but backends initialize lazily, so updating the config here
+# (before any computation) still forces the virtual CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

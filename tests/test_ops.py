@@ -1,0 +1,228 @@
+"""Golden tests: JAX kernels must agree with the numpy oracle.
+
+The oracle (ops/oracle.py) pins the reference semantics; the kernels run the
+same math as fixed-shape batched reductions. Tolerances are float32-level.
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.ops import kernels, oracle
+
+RNG = np.random.default_rng(42)
+
+
+def random_series(n_points, t0=0, span=7200, float_vals=True):
+    ts = np.sort(RNG.choice(np.arange(span), size=n_points, replace=False))
+    ts = (ts + t0).astype(np.int64)
+    if float_vals:
+        vals = RNG.normal(100.0, 25.0, size=n_points)
+    else:
+        vals = RNG.integers(-1000, 1000, size=n_points).astype(np.float64)
+    return ts, vals
+
+
+def to_flat(series, num_series):
+    """Pack [(ts, vals)] into the flat (ts, vals, sid, valid) layout."""
+    ts = np.concatenate([s[0] for s in series]).astype(np.int32)
+    vals = np.concatenate([s[1] for s in series]).astype(np.float32)
+    sid = np.concatenate([
+        np.full(len(s[0]), i, dtype=np.int32)
+        for i, s in enumerate(series)])
+    valid = np.ones(len(ts), dtype=bool)
+    # Pad to a static size like the query layer does.
+    pad = 16
+    ts = np.concatenate([ts, np.zeros(pad, np.int32)])
+    vals = np.concatenate([vals, np.zeros(pad, np.float32)])
+    sid = np.concatenate([sid, np.zeros(pad, np.int32)])
+    valid = np.concatenate([valid, np.zeros(pad, bool)])
+    return ts, vals, sid, valid
+
+
+class TestOracleDownsample:
+    def test_legacy_windows_are_data_driven(self):
+        # Points at 0, 50, 120, 130, 260 with interval 100:
+        # windows [0,100) -> {0,50}, [120,220) -> {120,130}, [260,360).
+        ts = np.array([0, 50, 120, 130, 260])
+        vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+        ots, ov = oracle.downsample(ts, vals, 100, "sum", mode="legacy")
+        np.testing.assert_array_equal(ots, [25, 125, 260])
+        np.testing.assert_allclose(ov, [3.0, 7.0, 5.0])
+
+    def test_aligned_buckets(self):
+        ts = np.array([0, 50, 120, 130, 260])
+        vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+        ots, ov = oracle.downsample(ts, vals, 100, "sum", mode="aligned")
+        np.testing.assert_array_equal(ots, [25, 125, 260])
+        np.testing.assert_allclose(ov, [3.0, 7.0, 5.0])
+
+    def test_aligned_differs_from_legacy_on_offset_data(self):
+        # Legacy windows start at the first point (90): [90,190) grabs 90
+        # and 150; aligned buckets split them at 100.
+        ts = np.array([90, 150])
+        vals = np.array([1.0, 2.0])
+        lts, lv = oracle.downsample(ts, vals, 100, "sum", mode="legacy")
+        ats, av = oracle.downsample(ts, vals, 100, "sum", mode="aligned")
+        np.testing.assert_allclose(lv, [3.0])
+        np.testing.assert_allclose(av, [1.0, 2.0])
+
+    def test_bucket_ts_is_integer_mean(self):
+        ts = np.array([10, 11, 14])
+        _, _ = oracle.downsample(ts, np.ones(3), 100, "avg")
+        ots, _ = oracle.downsample(ts, np.ones(3), 100, "avg")
+        assert ots[0] == (10 + 11 + 14) // 3
+
+    def test_bucket_ts_start(self):
+        ts = np.array([110, 190])
+        ots, _ = oracle.downsample(ts, np.ones(2), 100, "avg",
+                                   bucket_ts="start")
+        np.testing.assert_array_equal(ots, [100])
+
+    @pytest.mark.parametrize("agg", ["sum", "min", "max", "avg", "dev"])
+    def test_agg_math(self, agg):
+        vals = np.array([4.0, 7.0, 1.0, 10.0])
+        got = oracle.agg_reduce(vals, agg)
+        exp = {"sum": 22.0, "min": 1.0, "max": 10.0, "avg": 5.5,
+               "dev": np.sqrt(np.var(vals))}[agg]
+        assert got == pytest.approx(exp)
+
+
+class TestDownsampleGroupKernel:
+    @pytest.mark.parametrize("agg_down", ["sum", "min", "max", "avg", "dev"])
+    @pytest.mark.parametrize("agg_group", ["sum", "avg", "max"])
+    def test_matches_oracle(self, agg_down, agg_group):
+        series = [random_series(40), random_series(60), random_series(25)]
+        interval = 300
+        num_buckets = 7200 // interval
+        ts, vals, sid, valid = to_flat(series, 3)
+        out = kernels.downsample_group(
+            ts, vals, sid, valid, num_series=3, num_buckets=num_buckets,
+            interval=interval, agg_down=agg_down, agg_group=agg_group)
+
+        for s, (sts, svals) in enumerate(series):
+            ots, ov = oracle.downsample(sts, svals, interval, agg_down,
+                                        mode="aligned")
+            mask = np.asarray(out["series_mask"][s])
+            got_v = np.asarray(out["series_values"][s])[mask]
+            got_t = np.asarray(out["series_ts"][s])[mask]
+            np.testing.assert_allclose(got_v, ov, rtol=2e-5, atol=1e-4)
+            np.testing.assert_array_equal(got_t, ots)
+
+        # Group stage: oracle aggregation of the per-series bucket values
+        # on the shared bucket grid.
+        per_series = [
+            oracle.downsample(sts, svals, interval, agg_down, mode="aligned",
+                              bucket_ts="start")
+            for sts, svals in series]
+        gts, gv = oracle.group_aggregate(per_series, agg_group)
+        gmask = np.asarray(out["group_mask"])
+        got_g = np.asarray(out["group_values"])[gmask]
+        got_bt = (np.flatnonzero(gmask) * interval)
+        np.testing.assert_array_equal(got_bt, gts)
+        np.testing.assert_allclose(got_g, gv, rtol=2e-5, atol=1e-4)
+
+    def test_single_series_single_bucket(self):
+        ts = np.array([5, 10], dtype=np.int32)
+        vals = np.array([1.0, 3.0], dtype=np.float32)
+        out = kernels.downsample_group(
+            ts, vals, np.zeros(2, np.int32), np.ones(2, bool),
+            num_series=1, num_buckets=1, interval=3600,
+            agg_down="avg", agg_group="sum")
+        assert float(out["group_values"][0]) == pytest.approx(2.0)
+        assert int(out["series_ts"][0][0]) == 7  # (5+10)//2
+
+
+class TestRateKernel:
+    def test_matches_oracle(self):
+        series = [random_series(30), random_series(50)]
+        ts, vals, sid, valid = to_flat(series, 2)
+        r, ok = kernels.flat_rate(ts, vals, sid, valid)
+        r, ok = np.asarray(r), np.asarray(ok)
+        for s, (sts, svals) in enumerate(series):
+            ots, orates = oracle.rate(sts, svals)
+            m = (sid == s) & ok
+            np.testing.assert_allclose(r[m], orates, rtol=2e-4, atol=1e-5)
+            np.testing.assert_array_equal(ts[m], ots)
+
+    def test_first_point_of_each_series_dropped(self):
+        series = [random_series(5), random_series(5)]
+        ts, vals, sid, valid = to_flat(series, 2)
+        _, ok = kernels.flat_rate(ts, vals, sid, valid)
+        ok = np.asarray(ok)
+        assert ok[valid].sum() == 8  # 2 series x (5-1)
+
+    def test_counter_rollover(self):
+        ts = np.array([0, 10, 20], dtype=np.int32)
+        vals = np.array([100.0, 200.0, 50.0], dtype=np.float32)
+        sid = np.zeros(3, np.int32)
+        valid = np.ones(3, bool)
+        r, ok = kernels.flat_rate(ts, vals, sid, valid,
+                                  counter_max=256.0, counter=True)
+        # Delta -150 wraps to +106 over 10s.
+        assert float(np.asarray(r)[2]) == pytest.approx(10.6)
+        ots, orates = oracle.rate(np.array([0, 10, 20]),
+                                  np.array([100.0, 200.0, 50.0]),
+                                  counter_max=256.0)
+        np.testing.assert_allclose(np.asarray(r)[np.asarray(ok)], orates,
+                                   rtol=1e-5)
+
+
+class TestGroupInterpolate:
+    def _pad(self, series, T=64):
+        S = len(series)
+        ts = np.zeros((S, T), np.int32)
+        vals = np.zeros((S, T), np.float32)
+        counts = np.zeros(S, np.int32)
+        for i, (sts, svals) in enumerate(series):
+            n = len(sts)
+            ts[i, :n] = sts
+            vals[i, :n] = svals
+            counts[i] = n
+        return ts, vals, counts
+
+    @pytest.mark.parametrize("agg", ["sum", "min", "max", "avg", "dev"])
+    def test_matches_oracle(self, agg):
+        series = [random_series(20), random_series(35), random_series(10)]
+        ts, vals, counts = self._pad(series)
+        grid, out, gmask = kernels.group_interpolate(ts, vals, counts,
+                                                     agg=agg)
+        grid = np.asarray(grid)[np.asarray(gmask)]
+        out = np.asarray(out)[np.asarray(gmask)]
+        ots, ov = oracle.group_aggregate(series, agg)
+        np.testing.assert_array_equal(grid, ots)
+        np.testing.assert_allclose(out, ov, rtol=2e-4, atol=1e-3)
+
+    def test_lerp_values(self):
+        # Two series; series B has no point at t=10: contributes the lerp
+        # between (0, 0) and (20, 20) -> 10.
+        series = [(np.array([0, 10, 20]), np.array([1.0, 1.0, 1.0])),
+                  (np.array([0, 20]), np.array([0.0, 20.0]))]
+        ts, vals, counts = self._pad(series)
+        grid, out, gmask = kernels.group_interpolate(ts, vals, counts,
+                                                     agg="sum")
+        gm = np.asarray(gmask)
+        np.testing.assert_array_equal(np.asarray(grid)[gm], [0, 10, 20])
+        np.testing.assert_allclose(np.asarray(out)[gm], [1.0, 11.0, 21.0])
+
+    def test_no_extrapolation_outside_span(self):
+        # Series B spans only [10, 20]: it contributes nothing at t=0/30.
+        series = [(np.array([0, 10, 20, 30]), np.array([1.0, 1, 1, 1])),
+                  (np.array([10, 20]), np.array([5.0, 5.0]))]
+        ts, vals, counts = self._pad(series)
+        grid, out, gmask = kernels.group_interpolate(ts, vals, counts,
+                                                     agg="sum")
+        gm = np.asarray(gmask)
+        np.testing.assert_allclose(np.asarray(out)[gm],
+                                   [1.0, 6.0, 6.0, 1.0])
+
+    def test_step_interp_for_rates(self):
+        series = [(np.array([0, 10, 20]), np.array([2.0, 4.0, 8.0])),
+                  (np.array([5, 15]), np.array([1.0, 3.0]))]
+        ts, vals, counts = self._pad(series)
+        grid, out, gmask = kernels.group_interpolate(ts, vals, counts,
+                                                     agg="sum",
+                                                     interp="step")
+        gm = np.asarray(gmask)
+        ots, ov = oracle.group_aggregate(series, "sum", interp="step")
+        np.testing.assert_array_equal(np.asarray(grid)[gm], ots)
+        np.testing.assert_allclose(np.asarray(out)[gm], ov)

@@ -1,0 +1,47 @@
+"""Regressions for review findings on the sketch layer."""
+
+import numpy as np
+
+from opentsdb_tpu.ops import sketches
+
+
+def _digest(data, compression=128):
+    means, weights = sketches.tdigest_init(compression)
+    chunk = 4096
+    for i in range(0, len(data), chunk):
+        b = np.zeros(chunk, np.float32)
+        c = data[i:i + chunk]
+        b[:len(c)] = c
+        means, weights = sketches.tdigest_add(
+            means, weights, b, np.arange(chunk) < len(c),
+            compression=compression)
+    return means, weights
+
+
+class TestZeroWeightCentroids:
+    def test_all_negative_data_extreme_quantiles(self):
+        """Empty centroids (mean 0.0) must not drag q=1.0 toward zero."""
+        rng = np.random.default_rng(5)
+        data = rng.uniform(-200, -100, 50_000)
+        m, w = _digest(data)
+        q0, q1 = np.asarray(sketches.tdigest_quantile(
+            m, w, np.array([0.0, 1.0])))
+        assert -205 < q0 < -195, q0
+        assert -105 < q1 < -95, q1
+
+    def test_all_positive_data_min_quantile(self):
+        rng = np.random.default_rng(6)
+        data = rng.uniform(500, 600, 20_000)
+        m, w = _digest(data)
+        q0 = float(sketches.tdigest_quantile(m, w, np.array([0.0]))[0])
+        assert 495 < q0 < 510, q0
+
+
+class TestCentroidUtilization:
+    def test_scale_function_uses_full_range(self):
+        """The k1 mapping must populate (almost) all compression slots."""
+        rng = np.random.default_rng(7)
+        data = rng.normal(0, 1, 100_000)
+        m, w = _digest(data, compression=128)
+        used = int((np.asarray(w) > 0).sum())
+        assert used > 100, used

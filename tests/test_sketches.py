@@ -1,0 +1,127 @@
+"""Accuracy and mergeability tests for t-digest and HyperLogLog sketches."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.ops import sketches
+
+RNG = np.random.default_rng(7)
+
+
+def build_digest(values, compression=128, chunk=4096):
+    means, weights = sketches.tdigest_init(compression)
+    for i in range(0, len(values), chunk):
+        batch = values[i:i + chunk]
+        padded = np.zeros(chunk, np.float32)
+        padded[:len(batch)] = batch
+        valid = np.arange(chunk) < len(batch)
+        means, weights = sketches.tdigest_add(
+            means, weights, padded, valid, compression=compression)
+    return means, weights
+
+
+class TestTDigest:
+    @pytest.mark.parametrize("dist", ["normal", "lognormal", "uniform"])
+    def test_quantile_accuracy(self, dist):
+        n = 50_000
+        if dist == "normal":
+            data = RNG.normal(100, 15, n)
+        elif dist == "lognormal":
+            data = RNG.lognormal(3, 1, n)
+        else:
+            data = RNG.uniform(-5, 5, n)
+        means, weights = build_digest(data)
+        for q in (0.5, 0.95, 0.99):
+            est = float(sketches.tdigest_quantile(means, weights,
+                                                  np.array([q]))[0])
+            exact = sketches.exact_quantile(data, q)
+            spread = np.quantile(data, 0.999) - np.quantile(data, 0.001)
+            assert abs(est - exact) < 0.02 * spread, (q, est, exact)
+
+    def test_count_preserved(self):
+        data = RNG.normal(0, 1, 10_000)
+        means, weights = build_digest(data)
+        assert float(sketches.tdigest_count(weights)) == pytest.approx(
+            10_000, rel=1e-5)
+
+    def test_merge_matches_combined(self):
+        # Bimodal data: measure error in rank space (|CDF(est) - q|), the
+        # proper metric for quantile sketches — value-space error blows up
+        # in the density gap between modes for any sketch.
+        a = RNG.normal(0, 1, 20_000)
+        b = RNG.normal(10, 2, 20_000)
+        both = np.sort(np.concatenate([a, b]))
+        da = build_digest(a)
+        db = build_digest(b)
+        merged = sketches.tdigest_merge(*da, *db)
+        combined = build_digest(both)
+        for q in (0.25, 0.5, 0.9, 0.99):
+            em = float(sketches.tdigest_quantile(*merged, np.array([q]))[0])
+            ec = float(sketches.tdigest_quantile(*combined,
+                                                 np.array([q]))[0])
+            for est in (em, ec):
+                rank = np.searchsorted(both, est) / len(both)
+                assert abs(rank - q) < 0.02, (q, est, rank)
+
+    def test_extreme_quantiles_clamped_to_support(self):
+        data = RNG.uniform(0, 1, 1000)
+        means, weights = build_digest(data)
+        q0 = float(sketches.tdigest_quantile(means, weights,
+                                             np.array([0.0]))[0])
+        q1 = float(sketches.tdigest_quantile(means, weights,
+                                             np.array([1.0]))[0])
+        assert 0.0 <= q0 <= 0.05
+        assert 0.95 <= q1 <= 1.0
+
+    def test_small_n_exactish(self):
+        data = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+        means, weights = build_digest(data)
+        est = float(sketches.tdigest_quantile(means, weights,
+                                              np.array([0.5]))[0])
+        assert est == pytest.approx(3.0, abs=0.5)
+
+
+class TestHLL:
+    def _estimate(self, items, p=14, chunk=8192):
+        regs = sketches.hll_init(p)
+        for i in range(0, len(items), chunk):
+            batch = items[i:i + chunk]
+            padded = np.zeros(chunk, np.int64)
+            padded[:len(batch)] = batch
+            valid = np.arange(chunk) < len(batch)
+            regs = sketches.hll_add(regs, padded.astype(np.int32), valid,
+                                    p=p)
+        return float(sketches.hll_estimate(regs)), regs
+
+    @pytest.mark.parametrize("n", [100, 5_000, 200_000])
+    def test_cardinality_accuracy(self, n):
+        items = np.arange(n, dtype=np.int64) * 2654435761 % (2**31)
+        # ^ distinct values spread over the id space
+        items = np.unique(items)
+        est, _ = self._estimate(items)
+        err = abs(est - len(items)) / len(items)
+        assert err < 0.05, (n, est, len(items), err)
+
+    def test_duplicates_dont_count(self):
+        items = np.tile(np.arange(1000, dtype=np.int64), 50)
+        est, _ = self._estimate(items)
+        assert abs(est - 1000) / 1000 < 0.05
+
+    def test_merge_equals_union(self):
+        a = np.arange(0, 60_000, dtype=np.int64)
+        b = np.arange(30_000, 90_000, dtype=np.int64)
+        _, ra = self._estimate(a)
+        _, rb = self._estimate(b)
+        merged = sketches.hll_merge(ra, rb)
+        est = float(sketches.hll_estimate(merged))
+        assert abs(est - 90_000) / 90_000 < 0.05
+
+    def test_empty_estimate_zero(self):
+        regs = sketches.hll_init(14)
+        assert float(sketches.hll_estimate(regs)) == pytest.approx(0.0)
+
+    def test_hash_avalanche(self):
+        # Consecutive ints must spread across registers.
+        h = np.asarray(sketches.hash32(np.arange(10_000, dtype=np.int32)))
+        idx = h >> (32 - 14)
+        assert len(np.unique(idx)) > 5_000
