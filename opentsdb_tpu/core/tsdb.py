@@ -107,20 +107,32 @@ class TSDB:
 
     def add_batch(self, metric: str, timestamps: np.ndarray,
                   values: np.ndarray, tag_map: dict[str, str],
-                  durable: bool = True) -> int:
+                  durable: bool = True,
+                  is_float: np.ndarray | None = None,
+                  int_values: np.ndarray | None = None) -> int:
         """Columnar ingest for one series: pre-compacted cell per row-hour.
 
-        ``values`` may be an integer or floating dtype; float arrays are
-        stored as 4-byte floats (matching telnet ingest), int arrays on
-        their smallest widths. Returns the number of points written.
+        ``values`` may be an integer or floating dtype; float points are
+        stored as 4-byte floats (matching telnet ingest), int points on
+        their smallest widths. Pass ``is_float`` to type points
+        individually within a float-dtyped ``values`` array (mixed series,
+        like per-line telnet/import ingest produces) — and ``int_values``
+        (int64) alongside it to keep integers above 2^53 exact, since
+        float64 cannot represent them. Returns the points written.
         """
         timestamps = np.asarray(timestamps, dtype=np.int64)
         if timestamps.size == 0:
             return 0
         if (timestamps & ~np.int64(0xFFFFFFFF)).any():
             raise ValueError("timestamp out of range in batch")
-        is_float = np.issubdtype(np.asarray(values).dtype, np.floating)
-        if is_float:
+        if is_float is not None:
+            fmask = np.asarray(is_float, dtype=bool)
+            fvals = np.asarray(values, dtype=np.float64)
+            if int_values is not None:
+                ivals = np.asarray(int_values, dtype=np.int64)
+            else:
+                ivals = np.where(fmask, 0, fvals).astype(np.int64)
+        elif np.issubdtype(np.asarray(values).dtype, np.floating):
             fvals = np.asarray(values, dtype=np.float64)
             ivals = np.zeros_like(timestamps)
             fmask = np.ones(timestamps.shape, dtype=bool)

@@ -1,0 +1,5 @@
+"""Graph rendering: PNG via matplotlib Agg, JSON series output."""
+
+from opentsdb_tpu.graph.plot import Plot
+
+__all__ = ["Plot"]

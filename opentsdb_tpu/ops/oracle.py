@@ -35,7 +35,11 @@ AGGS = ("sum", "min", "max", "avg", "dev", "count")
 
 
 def agg_reduce(values: np.ndarray, agg: str) -> float:
-    """Aggregate a 1-D array per the reference aggregator semantics."""
+    """Aggregate a 1-D array per the reference aggregator semantics.
+
+    Percentile aggregators are named pNN / pNNN ('p50', 'p999'): numpy
+    linear-interpolated quantiles.
+    """
     if len(values) == 0:
         raise ValueError("empty aggregation")
     if agg == "sum":
@@ -52,6 +56,9 @@ def agg_reduce(values: np.ndarray, agg: str) -> float:
         return float(np.sqrt(np.var(values)))  # population (M2/n)
     if agg == "count":
         return float(len(values))
+    if len(agg) > 1 and agg[0] == "p" and agg[1:].isdigit():
+        q = int(agg[1:]) / 10 ** len(agg[1:])
+        return float(np.quantile(values, q))
     raise ValueError(f"unknown aggregator: {agg}")
 
 

@@ -1,0 +1,6 @@
+"""Query planner/executor and the Aggregators registry."""
+
+from opentsdb_tpu.query.aggregators import Aggregators
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+
+__all__ = ["Aggregators", "QueryExecutor", "QuerySpec"]

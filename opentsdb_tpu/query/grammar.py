@@ -1,0 +1,64 @@
+"""The m= query expression grammar.
+
+Parity: reference GraphHandler.parseQuery (:828-879) —
+``agg:[interval-agg:][rate:]metric[{tag=value,...}]`` where the optional
+middle parts may appear in either order; tag values support ``*`` (group
+by all values) and ``v1|v2`` (group by listed values).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from opentsdb_tpu.core import tags as tags_mod
+from opentsdb_tpu.core.errors import BadRequestError
+from opentsdb_tpu.query.aggregators import Aggregators
+from opentsdb_tpu.utils.timeparse import parse_duration
+
+
+def _validate_agg(name: str) -> None:
+    try:
+        Aggregators.get(name)
+    except ValueError as e:
+        raise BadRequestError(str(e)) from None
+
+
+class ParsedMetric(NamedTuple):
+    aggregator: str
+    metric: str
+    tags: dict[str, str]
+    rate: bool
+    downsample: tuple[int, str] | None  # (interval_seconds, agg)
+
+
+def parse_m(expr: str) -> ParsedMetric:
+    parts = expr.split(":")
+    if len(parts) < 2:
+        raise BadRequestError(
+            f"smallest possible metric name is 7 chars, got: {expr}"
+            if not expr else f"Invalid parameter m={expr}")
+    agg = parts[0]
+    _validate_agg(agg)
+
+    rate = False
+    downsample = None
+    for part in parts[1:-1]:
+        if part == "rate":
+            rate = True
+        elif "-" in part:
+            interval_s, _, ds_agg = part.partition("-")
+            interval = parse_duration(interval_s)
+            _validate_agg(ds_agg)
+            if not Aggregators.is_moment(ds_agg):
+                raise BadRequestError(
+                    f"downsampler must be a moment aggregator: {ds_agg}")
+            downsample = (interval, ds_agg)
+        else:
+            raise BadRequestError(f"Invalid query part: {part} in m={expr}")
+
+    tag_map: dict[str, str] = {}
+    try:
+        metric = tags_mod.parse_with_metric(parts[-1], tag_map)
+    except ValueError as e:
+        raise BadRequestError(str(e)) from None
+    return ParsedMetric(agg, metric, tag_map, rate, downsample)

@@ -1,0 +1,5 @@
+"""Network front-end: asyncio TCP server speaking telnet-RPC and HTTP."""
+
+from opentsdb_tpu.server.tsd import TSDServer
+
+__all__ = ["TSDServer"]

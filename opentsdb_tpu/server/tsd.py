@@ -1,0 +1,554 @@
+"""The TSD network server: one asyncio TCP listener, two protocols.
+
+Parity: reference src/tsd/ — PipelineFactory's first-byte protocol sniff
+(a capital ASCII letter means HTTP, :68-98), the telnet command set
+(put/stats/version/help/exit/diediedie/dropcaches, RpcHandler :66-96), and
+the HTTP endpoint set (/ /aggregators /diediedie /dropcaches /favicon.ico
+/logs /q /s /stats /suggest /version, :71-103) plus a /distinct extension
+for the HLL cardinality aggregator.
+
+Design departure (fixing the reference's acknowledged flaw, GraphHandler
+:180-181 "XXX ... will block Netty"): queries run in a bounded thread pool
+off the event loop, so ingest keeps flowing while graphs render. The /q
+disk cache keyed on the query-string hash follows GraphHandler
+(:335-468): nocache honored, max-age from the end-time rules.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import hashlib
+import json
+import logging
+import os
+import time
+import urllib.parse
+
+from opentsdb_tpu import __version__
+from opentsdb_tpu.core import tags as tags_mod
+from opentsdb_tpu.core.errors import (
+    BadRequestError,
+    NoSuchUniqueName,
+    PleaseThrottleError,
+)
+from opentsdb_tpu.graph.plot import Plot
+from opentsdb_tpu.query.aggregators import Aggregators
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.query.grammar import parse_m
+from opentsdb_tpu.server import logbuffer
+from opentsdb_tpu.stats.collector import LatencyDigest, StatsCollector
+from opentsdb_tpu.utils import timeparse
+
+LOG = logging.getLogger(__name__)
+
+MAX_LINE = 1024  # telnet framing limit (reference LineBasedFrameDecoder)
+
+_CONTENT_TYPES = {
+    ".html": "text/html; charset=UTF-8",
+    ".css": "text/css",
+    ".js": "application/javascript",
+    ".png": "image/png",
+    ".gif": "image/gif",
+    ".ico": "image/x-icon",
+    ".txt": "text/plain",
+}
+
+
+class TSDServer:
+    def __init__(self, tsdb, executor: QueryExecutor | None = None) -> None:
+        self.tsdb = tsdb
+        self.executor = executor or QueryExecutor(tsdb)
+        self.config = tsdb.config
+        self._server: asyncio.AbstractServer | None = None
+        self._shutdown = asyncio.Event()
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(2, self.config.worker_threads))
+        self.log_ring = logbuffer.install()
+        # counters (reference ConnectionManager/RpcHandler/PutDataPointRpc)
+        self.connections_established = 0
+        self.exceptions_caught = 0
+        self.telnet_rpcs = 0
+        self.http_rpcs = 0
+        self.rpcs_unknown = 0
+        self.requests_put = 0
+        self.hbase_errors_put = 0
+        self.illegal_arguments_put = 0
+        self.unknown_metrics_put = 0
+        self.put_latency = LatencyDigest()
+        self.http_latency = LatencyDigest()
+        self.graph_latency = LatencyDigest()
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.start_time = int(time.time())
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.config.bind, self.config.port)
+        LOG.info("Ready to serve on %s:%d", self.config.bind,
+                 self.config.port)
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        await self._shutdown.wait()
+        await self.stop()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self._pool.shutdown(wait=False)
+        self.tsdb.shutdown()
+        LOG.info("Server shut down")
+
+    def request_shutdown(self) -> None:
+        self._shutdown.set()
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    # ------------------------------------------------------------------
+    # Connection handling: protocol sniff
+    # ------------------------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        self.connections_established += 1
+        try:
+            first = await reader.read(1)
+            if not first:
+                return
+            if b"A" <= first <= b"Z":
+                await self._handle_http(first, reader, writer)
+            else:
+                await self._handle_telnet(first, reader, writer)
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            self.exceptions_caught += 1
+            LOG.exception("Unexpected exception from client")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # Telnet protocol
+    # ------------------------------------------------------------------
+
+    async def _handle_telnet(self, first: bytes, reader, writer) -> None:
+        buf = first
+        while not self._shutdown.is_set():
+            nl = buf.find(b"\n")
+            if nl < 0:
+                if len(buf) > MAX_LINE:
+                    raise ValueError("frame length exceeds " + str(MAX_LINE))
+                chunk = await reader.read(4096)
+                if not chunk:
+                    break
+                buf += chunk
+                continue
+            line, buf = buf[:nl], buf[nl + 1:]
+            words = tags_mod.split_string(
+                line.decode("utf-8", "replace").rstrip("\r"))
+            if not words:
+                continue
+            self.telnet_rpcs += 1
+            if not await self._telnet_command(words, writer):
+                return
+
+    async def _telnet_command(self, words: list[str], writer) -> bool:
+        """Dispatch one telnet command; False closes the connection."""
+        cmd = words[0]
+        if cmd == "put":
+            self._telnet_put(words, writer)
+        elif cmd == "version":
+            writer.write(self._version_text().encode())
+        elif cmd == "stats":
+            writer.write(("\n".join(self._collect_stats()) + "\n").encode())
+        elif cmd == "help":
+            writer.write((
+                "available commands: put stats dropcaches version help "
+                "exit diediedie\n").encode())
+        elif cmd == "exit":
+            return False
+        elif cmd == "dropcaches":
+            self.tsdb.drop_caches()
+            writer.write(b"Caches dropped.\n")
+        elif cmd == "diediedie":
+            writer.write(b"Cleaning up and exiting now.\n")
+            self.request_shutdown()
+            return False
+        else:
+            self.rpcs_unknown += 1
+            writer.write(f"unknown command: {cmd}\n".encode())
+        await writer.drain()
+        return True
+
+    def _telnet_put(self, words: list[str], writer) -> None:
+        """Parity: reference PutDataPointRpc.importDataPoint (:93-123)."""
+        t0 = time.time()
+        self.requests_put += 1
+        try:
+            if len(words) < 5:
+                raise ValueError("not enough arguments"
+                                 f" (need least 5, got {len(words)})")
+            metric = words[1]
+            timestamp = tags_mod.parse_long(words[2])
+            if timestamp <= 0:
+                raise ValueError("invalid timestamp: " + str(timestamp))
+            value = words[3]
+            if not value:
+                raise ValueError("empty value")
+            tag_map: dict[str, str] = {}
+            for tag in words[4:]:
+                tags_mod.parse(tag_map, tag)
+            if tags_mod.looks_like_integer(value):
+                self.tsdb.add_point(metric, timestamp,
+                                    tags_mod.parse_long(value), tag_map)
+            else:
+                self.tsdb.add_point(metric, timestamp, float(value),
+                                    tag_map)
+            self.put_latency.add((time.time() - t0) * 1000)
+        except NoSuchUniqueName as e:
+            self.unknown_metrics_put += 1
+            writer.write(f"put: unknown metric: {e}\n".encode())
+        except (ValueError, ArithmeticError) as e:
+            self.illegal_arguments_put += 1
+            writer.write(f"put: illegal argument: {e}\n".encode())
+        except PleaseThrottleError as e:
+            self.hbase_errors_put += 1
+            writer.write(f"put: Please throttle writes: {e}\n".encode())
+
+    # ------------------------------------------------------------------
+    # HTTP protocol
+    # ------------------------------------------------------------------
+
+    async def _handle_http(self, first: bytes, reader, writer) -> None:
+        data = first
+        while b"\r\n\r\n" not in data and b"\n\n" not in data:
+            chunk = await reader.read(4096)
+            if not chunk:
+                return
+            data = data + chunk
+            if len(data) > 65536:
+                return
+        head, _, _body = data.partition(b"\r\n\r\n")
+        lines = head.decode("latin-1").split("\r\n")
+        try:
+            method, target, _version = lines[0].split(" ", 2)
+        except ValueError:
+            return
+        t0 = time.time()
+        try:
+            status, ctype, body, extra = await self._route(method, target)
+        except BadRequestError as e:
+            status, ctype, extra = e.status, "text/plain", {}
+            body = f"{e}\n".encode()
+        except NoSuchUniqueName as e:
+            status, ctype, body, extra = 400, "text/plain", \
+                f"{e}\n".encode(), {}
+        except Exception as e:
+            self.exceptions_caught += 1
+            LOG.exception("HTTP error on %s", target)
+            status, ctype, body, extra = 500, "text/plain", \
+                f"Internal Server Error: {e}\n".encode(), {}
+        self.http_latency.add((time.time() - t0) * 1000)
+        reason = {200: "OK", 304: "Not Modified", 400: "Bad Request",
+                  404: "Not Found", 405: "Method Not Allowed",
+                  500: "Internal Server Error"}.get(status, "OK")
+        hdrs = [f"HTTP/1.1 {status} {reason}",
+                f"Content-Type: {ctype}",
+                f"Content-Length: {len(body)}",
+                "Connection: close"]
+        for k, v in extra.items():
+            hdrs.append(f"{k}: {v}")
+        writer.write(("\r\n".join(hdrs) + "\r\n\r\n").encode() + body)
+        await writer.drain()
+
+    async def _route(self, method: str, target: str):
+        self.http_rpcs += 1
+        parsed = urllib.parse.urlsplit(target)
+        path = parsed.path
+        params = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+        q = {k: v[-1] for k, v in params.items()}
+
+        if path.startswith("/s/") or path == "/s":
+            return self._static_file(path[2:].lstrip("/"))
+        route = path.rstrip("/") or "/"
+        if route == "/":
+            return (200, "text/html; charset=UTF-8",
+                    self._homepage().encode(), {})
+        if route == "/aggregators":
+            return (200, "application/json",
+                    json.dumps(Aggregators.available()).encode(), {})
+        if route == "/version":
+            if "json" in q:
+                body = json.dumps({"version": __version__,
+                                   "timestamp": self.start_time}).encode()
+                return 200, "application/json", body, {}
+            return 200, "text/plain", self._version_text().encode(), {}
+        if route == "/stats":
+            lines = self._collect_stats()
+            if "json" in q:
+                return (200, "application/json",
+                        json.dumps(lines).encode(), {})
+            return 200, "text/plain", ("\n".join(lines) + "\n").encode(), {}
+        if route == "/logs":
+            logbuffer_lines = self.log_ring.formatted()
+            if "level" in q:
+                try:
+                    logbuffer.set_level(q["level"])
+                except ValueError as e:
+                    raise BadRequestError(str(e)) from None
+            if "json" in q:
+                return (200, "application/json",
+                        json.dumps(logbuffer_lines).encode(), {})
+            return (200, "text/plain",
+                    ("\n".join(logbuffer_lines) + "\n").encode(), {})
+        if route == "/suggest":
+            return self._suggest(q)
+        if route == "/q":
+            return await self._query(q, parsed.query, params)
+        if route == "/distinct":
+            return await self._distinct(q)
+        if route == "/dropcaches":
+            self.tsdb.drop_caches()
+            return 200, "text/plain", b"Caches dropped.\n", {}
+        if route == "/diediedie":
+            self.request_shutdown()
+            return (200, "text/html; charset=UTF-8",
+                    b"Cleaning up and exiting now.\n", {})
+        if route == "/favicon.ico":
+            return 404, "text/plain", b"", {}
+        self.rpcs_unknown += 1
+        return 404, "text/plain", b"Page Not Found\n", {}
+
+    def _suggest(self, q) -> tuple:
+        kind = q.get("type", "metrics")
+        prefix = q.get("q", "")
+        try:
+            limit = int(q.get("max", "25"))
+        except ValueError:
+            raise BadRequestError("invalid 'max' parameter") from None
+        if kind == "metrics":
+            names = self.tsdb.metrics.suggest(prefix, limit)
+        elif kind == "tagk":
+            names = self.tsdb.tagk.suggest(prefix, limit)
+        elif kind == "tagv":
+            names = self.tsdb.tagv.suggest(prefix, limit)
+        else:
+            raise BadRequestError(f"Invalid 'type' parameter: {kind}")
+        return 200, "application/json", json.dumps(names).encode(), {}
+
+    # -- /q ------------------------------------------------------------
+
+    async def _query(self, q, query_string: str, params) -> tuple:
+        if "start" not in q:
+            raise BadRequestError("Missing parameter: start")
+        tz = q.get("tz")
+        now = int(time.time())
+        start = timeparse.parse_date(q["start"], tz=tz, now=now)
+        end_param = q.get("end")
+        end = timeparse.parse_date(end_param, tz=tz, now=now) \
+            if end_param else now
+        ms = params.get("m", [])
+        if not ms:
+            raise BadRequestError("Missing parameter: m")
+
+        cache_path = self._cache_path(query_string, q)
+        if cache_path and self._cache_fresh(cache_path, q, end, now):
+            self.cache_hits += 1
+            with open(cache_path, "rb") as f:
+                body = f.read()
+            ctype = ("image/png" if cache_path.endswith(".png")
+                     else "text/plain" if cache_path.endswith(".txt")
+                     else "application/json")
+            return 200, ctype, body, {}
+        self.cache_misses += 1
+
+        loop = asyncio.get_running_loop()
+        results = []
+        for m in ms:
+            parsed = parse_m(m)
+            spec = QuerySpec(
+                metric=parsed.metric, tags=parsed.tags,
+                aggregator=parsed.aggregator, rate=parsed.rate,
+                downsample=parsed.downsample)
+            rs = await loop.run_in_executor(
+                self._pool, self.executor.run, spec, start, end)
+            results.extend(rs)
+
+        if "ascii" in q:
+            body = self._ascii_output(results).encode()
+            ctype = "text/plain"
+        elif "json" in q:
+            body = json.dumps(self._json_output(results)).encode()
+            ctype = "application/json"
+        else:
+            t0 = time.time()
+            body = await loop.run_in_executor(
+                self._pool, self._render_png, results, start, end, q)
+            self.graph_latency.add((time.time() - t0) * 1000)
+            ctype = "image/png"
+        if cache_path:
+            tmp = cache_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(body)
+            os.replace(tmp, cache_path)
+        return 200, ctype, body, {}
+
+    def _cache_path(self, query_string: str, q) -> str | None:
+        if self.config.cachedir is None or "nocache" in q:
+            return None
+        suffix = (".txt" if "ascii" in q
+                  else ".json" if "json" in q else ".png")
+        h = hashlib.md5(query_string.encode()).hexdigest()
+        return os.path.join(self.config.cachedir, h + suffix)
+
+    def _cache_fresh(self, path: str, q, end: int, now: int) -> bool:
+        """Staleness rules following reference computeMaxAge (:223-244):
+        queries ending >1d in the past cache long; recent/relative
+        queries cache briefly."""
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            return False
+        if end < now - 86400:
+            max_age = 86400
+        elif timeparse.is_relative_date(q.get("end")):
+            max_age = 60
+        else:
+            max_age = 300
+        return (now - mtime) < max_age
+
+    @staticmethod
+    def _fmt_value(v: float) -> str:
+        return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+    def _ascii_output(self, results) -> str:
+        """One "metric timestamp value tags" line per point (reference
+        GraphHandler.respondAsciiQuery :770-818) — re-importable."""
+        out = []
+        for r in results:
+            tag_str = " ".join(
+                f"{k}={v}" for k, v in sorted(r.tags.items()))
+            for ts, v in zip(r.timestamps, r.values):
+                line = f"{r.metric} {int(ts)} {self._fmt_value(v)}"
+                out.append(line + (" " + tag_str if tag_str else ""))
+        return "\n".join(out) + ("\n" if out else "")
+
+    def _json_output(self, results):
+        return [{
+            "metric": r.metric,
+            "tags": r.tags,
+            "aggregateTags": r.aggregated_tags,
+            "dps": {str(int(t)): float(v)
+                    for t, v in zip(r.timestamps, r.values)},
+        } for r in results]
+
+    def _render_png(self, results, start, end, q) -> bytes:
+        plot = Plot(start, end)
+        if "wxh" in q:
+            w, _, h = q["wxh"].partition("x")
+            try:
+                plot.set_dimensions(int(w), int(h))
+            except ValueError:
+                raise BadRequestError(
+                    f"invalid wxh parameter: {q['wxh']}") from None
+        plot.set_params({k: v for k, v in q.items() if k in (
+            "title", "ylabel", "yrange", "ylog", "key", "nokey",
+            "bgcolor", "fgcolor")})
+        for r in results:
+            label = r.metric
+            if r.tags:
+                label += "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(r.tags.items())) + "}"
+            plot.add(label, r.timestamps, r.values)
+        return plot.render()
+
+    async def _distinct(self, q) -> tuple:
+        """Cardinality extension: distinct values of one tag key."""
+        for req in ("metric", "tagk", "start"):
+            if req not in q:
+                raise BadRequestError(f"Missing parameter: {req}")
+        now = int(time.time())
+        start = timeparse.parse_date(q["start"], now=now)
+        end = timeparse.parse_date(q["end"], now=now) if "end" in q else now
+        tag_map: dict[str, str] = {}
+        if "tags" in q and q["tags"]:
+            for t in q["tags"].split(","):
+                tags_mod.parse(tag_map, t)
+        loop = asyncio.get_running_loop()
+        n = await loop.run_in_executor(
+            self._pool, self.executor.distinct_tagv, q["metric"], tag_map,
+            q["tagk"], start, end)
+        body = json.dumps({"metric": q["metric"], "tagk": q["tagk"],
+                           "distinct": n}).encode()
+        return 200, "application/json", body, {}
+
+    # -- static files / home page --------------------------------------
+
+    def _static_file(self, rel: str) -> tuple:
+        root = self.config.staticroot
+        if root is None:
+            raise BadRequestError("No static root configured", 404)
+        if ".." in rel:
+            raise BadRequestError("Malformed path", 404)
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            return 404, "text/plain", b"File Not Found\n", {}
+        with open(path, "rb") as f:
+            body = f.read()
+        ext = os.path.splitext(path)[1]
+        ctype = _CONTENT_TYPES.get(ext, "application/octet-stream")
+        return 200, ctype, body, {"Cache-Control": "max-age=31536000"}
+
+    def _homepage(self) -> str:
+        return f"""<html><head><title>TSD (opentsdb_tpu)</title></head>
+<body><h1>opentsdb_tpu {__version__}</h1>
+<p>A TPU-native time-series database.</p>
+<ul>
+<li><a href="/aggregators">/aggregators</a></li>
+<li>/q?start=1h-ago&amp;m=sum:metric&#123;tag=value&#125;&amp;ascii</li>
+<li>/suggest?type=metrics&amp;q=prefix</li>
+<li><a href="/stats">/stats</a></li>
+<li><a href="/version">/version</a></li>
+<li><a href="/logs">/logs</a></li>
+</ul></body></html>"""
+
+    # -- stats ----------------------------------------------------------
+
+    def _version_text(self) -> str:
+        return (f"opentsdb_tpu {__version__} built on jax/XLA\n")
+
+    def _collect_stats(self) -> list[str]:
+        c = StatsCollector("tsd")
+        c.record("connectionmgr.connections", self.connections_established)
+        c.record("connectionmgr.exceptions", self.exceptions_caught)
+        c.record("rpc.received", self.telnet_rpcs, "type=telnet")
+        c.record("rpc.received", self.http_rpcs, "type=http")
+        c.record("rpc.errors", self.rpcs_unknown, "type=unknown")
+        c.record("rpc.errors", self.hbase_errors_put, "type=hbase_errors")
+        c.record("rpc.errors", self.illegal_arguments_put,
+                 "type=illegal_arguments")
+        c.record("rpc.errors", self.unknown_metrics_put,
+                 "type=unknown_metrics")
+        c.record("rpc.requests", self.requests_put, "type=put")
+        c.record("http.latency", self.http_latency, "type=all")
+        c.record("http.latency", self.graph_latency, "type=graph")
+        c.record("rpc.latency", self.put_latency, "type=put")
+        c.record("http.graph.requests", self.cache_hits, "cache=hit")
+        c.record("http.graph.requests", self.cache_misses, "cache=miss")
+        c.record("uptime", int(time.time()) - self.start_time)
+        self.tsdb.collect_stats(c)
+        return c.lines

@@ -1,0 +1,5 @@
+"""Self-monitoring: counters, latency digests, stats reporting."""
+
+from opentsdb_tpu.stats.collector import LatencyDigest, StatsCollector
+
+__all__ = ["LatencyDigest", "StatsCollector"]

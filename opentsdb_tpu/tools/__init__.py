@@ -1,0 +1,1 @@
+"""Operator CLI: tsd daemon, import, query, scan, fsck, uid, mkmetric."""

@@ -1,0 +1,487 @@
+"""The ``tsdb``-style command-line interface.
+
+Parity: reference tsdb.in subcommand dispatch (:50-82) + src/tools/*:
+  tsd       the network daemon              (TSDMain.java)
+  import    bulk text loader                (TextImporter.java)
+  query     CLI query runner                (CliQuery.java)
+  scan      raw row dumper, --import/--delete  (DumpSeries.java)
+  fsck      table consistency checker, --fix   (Fsck.java)
+  uid       UID admin: grep/assign/rename/fsck (UidManager.java)
+  mkmetric  shortcut for `uid assign metrics`  (tsdb.in:62-64)
+
+Storage note: the embedded engine lives in this process; offline tools
+operate on the same data by replaying the daemon's WAL (pass --wal). Run
+``tsd`` with --wal to make data durable and tool-accessible.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import logging
+import sys
+import time
+
+import numpy as np
+
+from opentsdb_tpu.core import codec, tags as tags_mod
+from opentsdb_tpu.core.errors import IllegalDataError, NoSuchUniqueName
+from opentsdb_tpu.core.tsdb import FAMILY, TSDB
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+from opentsdb_tpu.utils.timeparse import parse_date
+
+LOG = logging.getLogger("opentsdb_tpu.tools")
+
+
+def common_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--table", default="tsdb")
+    p.add_argument("--uidtable", default="tsdb-uid")
+    p.add_argument("--wal", default=None, help="WAL file path (shared state)")
+    p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument("--auto-metric", action="store_true",
+                   help="automatically create metric UIDs (ingest)")
+    p.add_argument("--verbose", action="store_true")
+
+
+def make_tsdb(args, start_thread: bool = False) -> TSDB:
+    cfg = Config(
+        table=args.table, uidtable=args.uidtable, wal_path=args.wal,
+        backend=args.backend, auto_create_metrics=args.auto_metric)
+    if hasattr(args, "port"):
+        cfg.port = args.port
+        cfg.bind = args.bind
+        cfg.staticroot = args.staticroot
+        cfg.cachedir = args.cachedir
+        cfg.flush_interval = args.flush_interval
+    store = MemKVStore(wal_path=args.wal)
+    return TSDB(store, cfg, start_compaction_thread=start_thread)
+
+
+# ---------------------------------------------------------------------------
+# tsd
+# ---------------------------------------------------------------------------
+
+def cmd_tsd(args) -> int:
+    import asyncio
+
+    from opentsdb_tpu.server.tsd import TSDServer
+
+    tsdb = make_tsdb(args, start_thread=True)
+    server = TSDServer(tsdb)
+
+    async def main():
+        await server.start()
+        print(f"Ready to serve on {tsdb.config.bind}:{server.port}",
+              flush=True)
+        await server.serve_forever()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        tsdb.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# import
+# ---------------------------------------------------------------------------
+
+def cmd_import(args) -> int:
+    tsdb = make_tsdb(args)
+    total = 0
+    t_start = time.time()
+    for path in args.files:
+        t0 = time.time()
+        n = _import_file(tsdb, path)
+        dt = max(time.time() - t0, 1e-9)
+        LOG.info("Processed %s in %d ms, %d data points (%.1f points/s)",
+                 path, dt * 1000, n, n / dt)
+        print(f"{path}: {n} points in {dt:.2f}s ({n / dt:,.0f} points/s)")
+        total += n
+    dt = max(time.time() - t_start, 1e-9)
+    print(f"Total: imported {total} data points in {dt:.2f}s "
+          f"({total / dt:,.0f} points/s)")
+    tsdb.shutdown()
+    return 0
+
+
+def _import_file(tsdb: TSDB, path: str) -> int:
+    """Bulk-load one (optionally gzipped) text file.
+
+    Buffers points per series and flushes through the columnar batch path
+    — the TPU-era analog of TextImporter's setBatchImport(true).
+    """
+    opener = gzip.open if path.endswith(".gz") else open
+    series: dict[tuple, tuple[list, list, list]] = {}
+    n = 0
+    with opener(path, "rt") as f:
+        for lineno, line in enumerate(f, 1):
+            words = tags_mod.split_string(line.strip())
+            if not words:
+                continue
+            try:
+                metric = words[0]
+                ts = tags_mod.parse_long(words[1])
+                value = words[2]
+                tag_map: dict[str, str] = {}
+                for t in words[3:]:
+                    tags_mod.parse(tag_map, t)
+                key = (metric, tuple(sorted(tag_map.items())))
+                tsl, vl, il, fl = series.setdefault(key, ([], [], [], []))
+                tsl.append(ts)
+                # int-vs-float sniffed per point, like the reference's
+                # Tags.looksLikeInteger in TextImporter/PutDataPointRpc.
+                # Integers parse exactly (int64) — float64 would corrupt
+                # counters above 2^53.
+                if tags_mod.looks_like_integer(value):
+                    iv = tags_mod.parse_long(value)
+                    fl.append(False)
+                    il.append(iv)
+                    vl.append(float(iv))
+                else:
+                    fl.append(True)
+                    il.append(0)
+                    vl.append(float(value))
+                n += 1
+            except ValueError as e:
+                raise ValueError(
+                    f"Invalid data at line {lineno}: {line!r}: {e}") from e
+    for (metric, tag_items), (tsl, vl, il, fl) in series.items():
+        ts_arr = np.asarray(tsl, np.int64)
+        order = np.argsort(ts_arr, kind="stable")
+        # Durable: unlike the reference's setDurable(false) batch mode,
+        # the WAL is this engine's only persistence AND the shared state
+        # offline tools replay — skipping it would lose the import. The
+        # batch path already writes just one compacted cell per row-hour.
+        tsdb.add_batch(metric, ts_arr[order],
+                       np.asarray(vl, np.float64)[order], dict(tag_items),
+                       is_float=np.asarray(fl, bool)[order],
+                       int_values=np.asarray(il, np.int64)[order])
+    return n
+
+
+# ---------------------------------------------------------------------------
+# query
+# ---------------------------------------------------------------------------
+
+def cmd_query(args) -> int:
+    """CLI grammar parity with CliQuery.parseCommandLineQuery (:191-243):
+    query START-DATE [END-DATE] FUNC [rate] [downsample N FUNC] metric
+    [tag=value...]"""
+    from opentsdb_tpu.query.aggregators import Aggregators
+    from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+
+    tsdb = make_tsdb(args)
+    words = args.args
+    start = parse_date(words.pop(0))
+    end = int(time.time())
+    if words and words[0] not in Aggregators.available():
+        end = parse_date(words.pop(0))
+    agg = words.pop(0)
+    rate = False
+    downsample = None
+    if words and words[0] == "rate":
+        rate = True
+        words.pop(0)
+    if words and words[0] == "downsample":
+        words.pop(0)
+        interval = int(words.pop(0))
+        downsample = (interval, words.pop(0))
+    metric = words.pop(0)
+    tag_map: dict[str, str] = {}
+    for t in words:
+        tags_mod.parse(tag_map, t)
+
+    ex = QueryExecutor(tsdb)
+    spec = QuerySpec(metric, tag_map, aggregator=agg, rate=rate,
+                     downsample=downsample)
+    for r in ex.run(spec, start, end):
+        tag_str = " ".join(f"{k}={v}" for k, v in sorted(r.tags.items()))
+        for ts, v in zip(r.timestamps, r.values):
+            vs = str(int(v)) if float(v).is_integer() else repr(float(v))
+            print(f"{r.metric} {int(ts)} {vs} {tag_str}".rstrip())
+    tsdb.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# scan
+# ---------------------------------------------------------------------------
+
+def cmd_scan(args) -> int:
+    """Raw storage dumper (DumpSeries.java): decodes rows/cells; --import
+    emits re-importable lines; --delete removes what it prints."""
+    tsdb = make_tsdb(args)
+    words = list(args.args)
+    start = parse_date(words.pop(0))
+    end = int(time.time())
+    if words and not words[0][0].isalpha():
+        end = parse_date(words.pop(0))
+    metric = words.pop(0)
+    tag_map: dict[str, str] = {}
+    for t in words:
+        tags_mod.parse(tag_map, t)
+
+    metric_uid = tsdb.metrics.get_id(metric)
+    start_key = metric_uid + int(codec.base_time(start)).to_bytes(4, "big")
+    stop_key = metric_uid + int(
+        min(codec.base_time(end) + 3600, 0xFFFFFFFF)).to_bytes(4, "big")
+    for cells in tsdb.store.scan(tsdb.table, start_key, stop_key,
+                                 family=FAMILY):
+        key = cells[0].key
+        parsed = codec.parse_row_key(key)
+        named = {tsdb.tagk.get_name(k): tsdb.tagv.get_name(v)
+                 for k, v in parsed.tag_uids}
+        if tag_map and any(named.get(k) != v for k, v in tag_map.items()):
+            continue
+        tag_str = " ".join(f"{k}={v}" for k, v in sorted(named.items()))
+        if not args.importfmt:
+            print(f"{key.hex()} {metric} {parsed.base_time} {tag_str}")
+        for cell in cells:
+            for c in codec.explode_cell(cell.qualifier, cell.value):
+                ts = parsed.base_time + c.delta
+                val = c.decode()
+                vs = (str(val) if isinstance(val, int)
+                      else repr(float(val)))
+                if args.importfmt:
+                    print(f"{metric} {ts} {vs} {tag_str}".rstrip())
+                else:
+                    kind = "float" if c.flags & 0x8 else "long"
+                    print(f"  [{c.qualifier.hex()}]\t[{c.value.hex()}]\t"
+                          f"{ts}\t{kind}\t{vs}")
+        if args.delete:
+            tsdb.store.delete_row(tsdb.table, key)
+    tsdb.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# fsck
+# ---------------------------------------------------------------------------
+
+def cmd_fsck(args) -> int:
+    """Table consistency check (Fsck.java): validates qualifiers, values,
+    meta bytes, duplicate/out-of-order points; --fix rewrites rows."""
+    tsdb = make_tsdb(args)
+    kvs = rows = errors = fixed = 0
+    t0 = time.time()
+    for cells in tsdb.store.scan(tsdb.table, b"", b"", family=FAMILY):
+        rows += 1
+        key = cells[0].key
+        bad = False
+        for cell in cells:
+            kvs += 1
+            qual, val = cell.qualifier, cell.value
+            if len(qual) == 0 or len(qual) % 2 != 0:
+                errors += 1
+                bad = True
+                print(f"ERROR: row {key.hex()}: odd qualifier length "
+                      f"{len(qual)}")
+                continue
+            try:
+                codec.explode_cell(qual, val)
+            except IllegalDataError as e:
+                errors += 1
+                bad = True
+                print(f"ERROR: row {key.hex()}: {e}")
+        if not bad:
+            try:
+                codec.compact_cells(
+                    [(c.qualifier, c.value) for c in cells])
+            except IllegalDataError as e:
+                errors += 1
+                bad = True
+                print(f"ERROR: row {key.hex()}: {e}")
+        if bad and args.fix:
+            fixed += _fix_row(tsdb, key, cells)
+    dt = max(time.time() - t0, 1e-9)
+    print(f"{kvs} KVs (in {rows} rows) analyzed in {dt * 1000:.0f}ms "
+          f"(~{kvs / dt:.0f} KV/s)")
+    print(f"Found {errors} errors." + (f" Fixed {fixed} rows."
+                                       if args.fix else ""))
+    tsdb.shutdown()
+    return 1 if errors and not args.fix else 0
+
+
+def _fix_row(tsdb: TSDB, key: bytes, cells) -> int:
+    """Salvage: explode what decodes, keep first value per delta, rewrite."""
+    points: dict[int, codec.Cell] = {}
+    for cell in cells:
+        if len(cell.qualifier) == 0 or len(cell.qualifier) % 2 != 0:
+            continue
+        try:
+            for c in codec.explode_cell(cell.qualifier, cell.value):
+                points.setdefault(c.delta, c)
+        except IllegalDataError:
+            # Salvage per-point: walk the qualifier pairs manually.
+            continue
+    if not points:
+        tsdb.store.delete_row(tsdb.table, key)
+        return 1
+    ordered = [points[d] for d in sorted(points)]
+    qual, val = codec.merge_cells(ordered)
+    tsdb.store.delete_row(tsdb.table, key)
+    tsdb.store.put(tsdb.table, key, FAMILY, qual, val)
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# uid / mkmetric
+# ---------------------------------------------------------------------------
+
+def cmd_uid(args) -> int:
+    """UID admin (UidManager.java): grep / assign / rename / fsck /
+    lookups."""
+    tsdb = make_tsdb(args)
+    words = list(args.args)
+    if not words:
+        print("usage: uid [grep|assign|rename|fsck|KIND NAME|ID]",
+              file=sys.stderr)
+        return 2
+    uids = {"metrics": tsdb.metrics, "tagk": tsdb.tagk, "tagv": tsdb.tagv}
+    cmd = words[0]
+    if cmd == "grep":
+        words.pop(0)
+        kinds = list(uids)
+        if words and words[0] in uids:
+            kinds = [words.pop(0)]
+        import re as _re
+        pattern = _re.compile(words[0] if words else ".")
+        found = False
+        for kind in kinds:
+            for name in uids[kind].suggest("", limit=1 << 30):
+                if pattern.search(name):
+                    print(f"{kind} {name}: "
+                          f"{uids[kind].get_id(name).hex()}")
+                    found = True
+        return 0 if found else 1
+    if cmd == "assign":
+        kind = words[1]
+        for name in words[2:]:
+            uid = uids[kind].get_or_create_id(name)
+            print(f"{name}: [{', '.join(str(b) for b in uid)}]")
+        tsdb.shutdown()
+        return 0
+    if cmd == "rename":
+        _, kind, old, new = words
+        uids[kind].rename(old, new)
+        tsdb.shutdown()
+        return 0
+    if cmd == "fsck":
+        return _uid_fsck(tsdb)
+    if cmd in uids and len(words) == 2:
+        name = words[1]
+        try:
+            print(f"{cmd} {name}: {uids[cmd].get_id(name).hex()}")
+            return 0
+        except NoSuchUniqueName:
+            print(f"{name}: No such {cmd}")
+            return 1
+    print(f"unknown uid subcommand: {cmd}", file=sys.stderr)
+    return 2
+
+
+def _uid_fsck(tsdb: TSDB) -> int:
+    """Forward/reverse mapping consistency check (UidManager.fsck)."""
+    from opentsdb_tpu.uid.uniqueid import ID_FAMILY, MAXID_ROW, NAME_FAMILY
+
+    errors = 0
+    fwd: dict[tuple[bytes, bytes], bytes] = {}
+    rev: dict[tuple[bytes, bytes], bytes] = {}
+    for cells in tsdb.store.scan(tsdb.config.uidtable, b"", b""):
+        for c in cells:
+            if c.key == MAXID_ROW:
+                continue
+            if c.family == ID_FAMILY:
+                fwd[(c.qualifier, c.key)] = c.value
+            elif c.family == NAME_FAMILY:
+                rev[(c.qualifier, c.key)] = c.value
+    for (kind, name), uid in fwd.items():
+        back = rev.get((kind, uid))
+        if back != name:
+            errors += 1
+            print(f"ERROR: forward {kind.decode()} "
+                  f"{name.decode('iso-8859-1')} -> {uid.hex()} but "
+                  f"reverse says {back!r}")
+    for (kind, uid), name in rev.items():
+        if (kind, name) not in fwd:
+            errors += 1
+            print(f"WARN: orphan reverse mapping {kind.decode()} "
+                  f"{uid.hex()} -> {name.decode('iso-8859-1')} "
+                  "(leaked UID, harmless)")
+    print(f"uid fsck: {len(fwd)} forward, {len(rev)} reverse mappings, "
+          f"{errors} errors")
+    return 1 if errors else 0
+
+
+def cmd_mkmetric(args) -> int:
+    tsdb = make_tsdb(args)
+    for name in args.names:
+        uid = tsdb.metrics.get_or_create_id(name)
+        print(f"metrics {name}: [{', '.join(str(b) for b in uid)}]")
+    tsdb.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tsdb", description="opentsdb_tpu command-line tool")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("tsd", help="start the network daemon")
+    common_args(p)
+    p.add_argument("--port", type=int, default=4242)
+    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--staticroot", default=None)
+    p.add_argument("--cachedir", default=None)
+    p.add_argument("--flush-interval", type=float, default=10.0)
+    p.set_defaults(fn=cmd_tsd)
+
+    p = sub.add_parser("import", help="bulk import text files")
+    common_args(p)
+    p.add_argument("files", nargs="+")
+    p.set_defaults(fn=cmd_import, auto=True)
+
+    p = sub.add_parser("query", help="run a query")
+    common_args(p)
+    p.add_argument("args", nargs="+")
+    p.set_defaults(fn=cmd_query)
+
+    p = sub.add_parser("scan", help="dump raw rows")
+    common_args(p)
+    p.add_argument("--import", dest="importfmt", action="store_true")
+    p.add_argument("--delete", action="store_true")
+    p.add_argument("args", nargs="+")
+    p.set_defaults(fn=cmd_scan)
+
+    p = sub.add_parser("fsck", help="check table consistency")
+    common_args(p)
+    p.add_argument("--fix", action="store_true")
+    p.set_defaults(fn=cmd_fsck)
+
+    p = sub.add_parser("uid", help="UID administration")
+    common_args(p)
+    p.add_argument("args", nargs="*")
+    p.set_defaults(fn=cmd_uid)
+
+    p = sub.add_parser("mkmetric", help="create metric UIDs")
+    common_args(p)
+    p.add_argument("names", nargs="+")
+    p.set_defaults(fn=cmd_mkmetric)
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
+    if getattr(args, "auto", False):
+        args.auto_metric = True
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

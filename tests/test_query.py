@@ -1,0 +1,193 @@
+"""End-to-end query tests: ingest -> scan -> group-by -> compute.
+
+Differential testing: the TPU kernel backend must agree with the CPU
+float64 oracle backend on every query shape.
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.query.grammar import parse_m
+from opentsdb_tpu.core.errors import BadRequestError
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400  # hour-aligned epoch
+RNG = np.random.default_rng(11)
+
+
+@pytest.fixture
+def tsdb():
+    t = TSDB(MemKVStore(), Config(auto_create_metrics=True),
+             start_compaction_thread=False)
+    # 3 hosts x 2 cpus of sys.cpu.user over 2 hours, plus unrelated metric.
+    for host in ("web01", "web02", "web03"):
+        for cpu in ("0", "1"):
+            n = int(RNG.integers(60, 120))
+            ts = np.sort(RNG.choice(7200, size=n, replace=False)) + BT
+            vals = RNG.normal(50, 10, n)
+            t.add_batch("sys.cpu.user", ts, vals,
+                        {"host": host, "cpu": cpu})
+    t.add_batch("sys.mem.free", np.arange(BT, BT + 600, 60),
+                np.arange(10) * 100, {"host": "web01"})
+    return t
+
+
+def run_both(tsdb, spec, start=BT, end=BT + 7200):
+    cpu = QueryExecutor(tsdb, backend="cpu").run(spec, start, end)
+    tpu = QueryExecutor(tsdb, backend="tpu").run(spec, start, end)
+    return cpu, tpu
+
+
+class TestPlanning:
+    def test_exact_tag_filter(self, tsdb):
+        spec = QuerySpec("sys.cpu.user", {"host": "web01", "cpu": "0"})
+        groups = QueryExecutor(tsdb)._find_spans(spec, BT, BT + 7200)
+        assert len(groups) == 1
+        spans = next(iter(groups.values()))
+        assert len(spans) == 1
+        assert spans[0].tags == {"host": "web01", "cpu": "0"}
+
+    def test_group_by_star(self, tsdb):
+        spec = QuerySpec("sys.cpu.user", {"host": "*", "cpu": "0"})
+        groups = QueryExecutor(tsdb)._find_spans(spec, BT, BT + 7200)
+        assert len(groups) == 3  # one group per host
+
+    def test_group_by_alternation(self, tsdb):
+        spec = QuerySpec("sys.cpu.user", {"host": "web01|web03"})
+        groups = QueryExecutor(tsdb)._find_spans(spec, BT, BT + 7200)
+        assert len(groups) == 2
+        # Each group holds both cpus of one host.
+        for spans in groups.values():
+            assert len(spans) == 2
+
+    def test_no_tags_aggregates_all(self, tsdb):
+        spec = QuerySpec("sys.cpu.user", {})
+        groups = QueryExecutor(tsdb)._find_spans(spec, BT, BT + 7200)
+        assert len(groups) == 1
+        assert len(next(iter(groups.values()))) == 6
+
+    def test_metric_isolation(self, tsdb):
+        spec = QuerySpec("sys.mem.free", {})
+        groups = QueryExecutor(tsdb)._find_spans(spec, BT, BT + 7200)
+        spans = next(iter(groups.values()))
+        assert len(spans) == 1
+        assert spans[0].tags == {"host": "web01"}
+
+    def test_group_tags_intersection(self, tsdb):
+        spec = QuerySpec("sys.cpu.user", {"host": "*"})
+        results = QueryExecutor(tsdb, backend="cpu").run(
+            spec, BT, BT + 7200)
+        assert len(results) == 3
+        for r in results:
+            assert set(r.tags) == {"host"}  # cpu differs within group
+            assert r.aggregated_tags == ["cpu"]
+
+    def test_time_range_trim(self, tsdb):
+        spec = QuerySpec("sys.mem.free", {})
+        res = QueryExecutor(tsdb, backend="cpu").run(spec, BT + 120,
+                                                     BT + 300)
+        (r,) = res
+        assert r.timestamps.min() >= BT + 120
+        assert r.timestamps.max() <= BT + 300
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("agg", ["sum", "avg", "max", "dev"])
+    def test_plain_aggregation(self, tsdb, agg):
+        cpu, tpu = run_both(tsdb, QuerySpec("sys.cpu.user", {},
+                                            aggregator=agg))
+        (c,), (t,) = cpu, tpu
+        np.testing.assert_array_equal(c.timestamps, t.timestamps)
+        np.testing.assert_allclose(t.values, c.values, rtol=5e-5, atol=1e-3)
+
+    @pytest.mark.parametrize("agg", ["sum", "avg"])
+    def test_downsample_group(self, tsdb, agg):
+        spec = QuerySpec("sys.cpu.user", {"host": "*"}, aggregator=agg,
+                         downsample=(600, "avg"))
+        cpu, tpu = run_both(tsdb, spec)
+        assert len(cpu) == len(tpu) == 3
+        for c, t in zip(cpu, tpu):
+            # Both backends emit epoch-aligned bucket-start timestamps.
+            np.testing.assert_array_equal(c.timestamps, t.timestamps)
+            assert (c.timestamps % 600 == 0).all()
+            np.testing.assert_allclose(t.values, c.values, rtol=5e-4,
+                                       atol=5e-3)
+
+    def test_rate(self, tsdb):
+        spec = QuerySpec("sys.mem.free", {}, aggregator="sum", rate=True)
+        cpu, tpu = run_both(tsdb, spec)
+        (c,), (t,) = cpu, tpu
+        np.testing.assert_array_equal(c.timestamps, t.timestamps)
+        np.testing.assert_allclose(t.values, c.values, rtol=1e-4,
+                                   atol=1e-5)
+        # 100 units per 60 s
+        np.testing.assert_allclose(c.values, 100 / 60, rtol=1e-6)
+
+    def test_rate_of_group(self, tsdb):
+        spec = QuerySpec("sys.cpu.user", {"host": "web01"},
+                         aggregator="sum", rate=True)
+        cpu, tpu = run_both(tsdb, spec)
+        (c,), (t,) = cpu, tpu
+        np.testing.assert_array_equal(c.timestamps, t.timestamps)
+        np.testing.assert_allclose(t.values, c.values, rtol=1e-3,
+                                   atol=1e-2)
+
+    def test_percentile_aggregator(self, tsdb):
+        spec = QuerySpec("sys.cpu.user", {}, aggregator="p95")
+        cpu, tpu = run_both(tsdb, spec)
+        (c,), (t,) = cpu, tpu
+        np.testing.assert_array_equal(c.timestamps, t.timestamps)
+        np.testing.assert_allclose(t.values, c.values, rtol=1e-4,
+                                   atol=1e-2)
+
+    def test_percentile_downsampled(self, tsdb):
+        spec = QuerySpec("sys.cpu.user", {}, aggregator="p50",
+                         downsample=(600, "avg"))
+        cpu, tpu = run_both(tsdb, spec)
+        (c,), (t,) = cpu, tpu
+        assert len(c.values) == len(t.values)
+        np.testing.assert_allclose(t.values, c.values, rtol=5e-3,
+                                   atol=0.5)
+
+
+class TestCardinality:
+    def test_distinct_tagv(self, tsdb):
+        ex = QueryExecutor(tsdb, backend="tpu")
+        n = ex.distinct_tagv("sys.cpu.user", {}, "host", BT, BT + 7200)
+        assert n == 3
+        n = ex.distinct_tagv("sys.cpu.user", {"cpu": "0"}, "host",
+                             BT, BT + 7200)
+        assert n == 3
+        exact = QueryExecutor(tsdb, backend="cpu").distinct_tagv(
+            "sys.cpu.user", {}, "host", BT, BT + 7200)
+        assert exact == 3
+
+
+class TestGrammar:
+    def test_full_expression(self):
+        p = parse_m("sum:10m-avg:rate:sys.cpu.user{host=*,cpu=0}")
+        assert p.aggregator == "sum"
+        assert p.downsample == (600, "avg")
+        assert p.rate
+        assert p.metric == "sys.cpu.user"
+        assert p.tags == {"host": "*", "cpu": "0"}
+
+    def test_minimal(self):
+        p = parse_m("avg:sys.mem.free")
+        assert (p.aggregator, p.metric, p.rate, p.downsample) == \
+            ("avg", "sys.mem.free", False, None)
+
+    @pytest.mark.parametrize("bad", [
+        "sys.cpu.user", "bogus:sys.cpu.user", "sum:10x-avg:m",
+        "sum:10m-p95:m", "sum:wat:m{a=b}", "",
+    ])
+    def test_rejects(self, bad):
+        with pytest.raises(BadRequestError):
+            parse_m(bad)
+
+    def test_run_validates_range(self, tsdb):
+        with pytest.raises(BadRequestError):
+            QueryExecutor(tsdb).run(QuerySpec("sys.cpu.user", {}), BT, BT)

@@ -1,0 +1,267 @@
+"""End-to-end network tests: telnet ingest + HTTP query over real sockets."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.server.tsd import TSDServer
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+
+
+@pytest.fixture
+def server_env(tmp_path):
+    """(server, tsdb) started on an ephemeral port inside a fresh loop."""
+    cfg = Config(auto_create_metrics=True, port=0, bind="127.0.0.1",
+                 cachedir=str(tmp_path / "cache"),
+                 staticroot=str(tmp_path / "static"))
+    (tmp_path / "cache").mkdir()
+    (tmp_path / "static").mkdir()
+    (tmp_path / "static" / "hello.txt").write_text("hi\n")
+    tsdb = TSDB(MemKVStore(), cfg, start_compaction_thread=False)
+    server = TSDServer(tsdb)
+    return server, tsdb
+
+
+async def telnet(port, lines, read_bytes=0, wait=0.05):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    for line in lines:
+        writer.write(line.encode() + b"\n")
+    await writer.drain()
+    await asyncio.sleep(wait)
+    data = b""
+    if read_bytes:
+        try:
+            data = await asyncio.wait_for(reader.read(read_bytes), 1.0)
+        except asyncio.TimeoutError:
+            pass
+    writer.close()
+    return data
+
+
+async def http_get(port, target):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    return status, head, body
+
+
+def run_async(server, coro_fn):
+    async def main():
+        await server.start()
+        try:
+            return await coro_fn(server.port)
+        finally:
+            server._pool.shutdown(wait=False)
+            server._server.close()
+            await server._server.wait_closed()
+    return asyncio.run(main())
+
+
+class TestTelnet:
+    def test_put_and_version(self, server_env):
+        server, tsdb = server_env
+
+        async def drive(port):
+            await telnet(port, [
+                f"put sys.cpu.user {BT + 1} 42 host=web01",
+                f"put sys.cpu.user {BT + 2} 4.5 host=web01",
+            ])
+            out = await telnet(port, ["version"], read_bytes=200)
+            return out
+
+        out = run_async(server, drive)
+        assert b"opentsdb_tpu" in out
+        assert tsdb.datapoints_added == 2
+
+    def test_put_errors_reported(self, server_env):
+        server, tsdb = server_env
+
+        async def drive(port):
+            return await telnet(port, ["put sys.cpu.user notatime 1 a=b"],
+                                read_bytes=200)
+
+        out = run_async(server, drive)
+        assert b"put: illegal argument" in out
+        assert server.illegal_arguments_put == 1
+
+    def test_unknown_command(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            return await telnet(port, ["bogus"], read_bytes=100)
+
+        assert b"unknown command: bogus" in run_async(server, drive)
+
+    def test_stats_command(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            return await telnet(port, ["stats"], read_bytes=8192)
+
+        out = run_async(server, drive)
+        assert b"tsd.rpc.received" in out
+        assert b"tsd.uid.cache-hit" in out
+
+    def test_dropcaches(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            return await telnet(port, ["dropcaches"], read_bytes=100)
+
+        assert b"Caches dropped" in run_async(server, drive)
+
+
+class TestHttp:
+    def test_query_ascii_roundtrip(self, server_env):
+        server, tsdb = server_env
+        tsdb.add_batch("sys.cpu.user", np.arange(BT, BT + 60, 10),
+                       np.array([1, 2, 3, 4, 5, 6]), {"host": "web01"})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/q?start={BT}&end={BT + 60}"
+                      "&m=sum:sys.cpu.user&ascii&nocache")
+
+        status, head, body = run_async(server, drive)
+        assert status == 200
+        lines = body.decode().strip().split("\n")
+        assert len(lines) == 6
+        assert lines[0].startswith(f"sys.cpu.user {BT} 1")
+        assert "host=web01" in lines[0]
+
+    def test_query_json(self, server_env):
+        server, tsdb = server_env
+        tsdb.add_batch("m.x", np.array([BT + 1]), np.array([7]),
+                       {"a": "b"})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/q?start={BT}&end={BT + 10}&m=sum:m.x&json&nocache")
+
+        status, _, body = run_async(server, drive)
+        data = json.loads(body)
+        assert data[0]["metric"] == "m.x"
+        assert data[0]["dps"] == {str(BT + 1): 7.0}
+
+    def test_query_png(self, server_env):
+        server, tsdb = server_env
+        tsdb.add_batch("m.x", np.arange(BT, BT + 600, 60),
+                       np.arange(10.0), {"a": "b"})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/q?start={BT}&end={BT + 600}&m=sum:m.x&nocache")
+
+        status, head, body = run_async(server, drive)
+        assert status == 200
+        assert b"image/png" in head
+        assert body[:8] == b"\x89PNG\r\n\x1a\n"
+
+    def test_query_cache(self, server_env):
+        server, tsdb = server_env
+        tsdb.add_batch("m.x", np.array([BT + 1]), np.array([7]),
+                       {"a": "b"})
+        target = f"/q?start={BT}&end={BT + 10}&m=sum:m.x&ascii"
+
+        async def drive(port):
+            first = await http_get(port, target)
+            second = await http_get(port, target)
+            return first, second
+
+        (s1, _, b1), (s2, _, b2) = run_async(server, drive)
+        assert s1 == s2 == 200 and b1 == b2
+        assert server.cache_hits == 1
+        assert server.cache_misses == 1
+
+    def test_suggest(self, server_env):
+        server, tsdb = server_env
+        tsdb.metrics.get_or_create_id("sys.cpu.user")
+        tsdb.metrics.get_or_create_id("sys.mem.free")
+
+        async def drive(port):
+            return await http_get(port, "/suggest?type=metrics&q=sys.cpu")
+
+        _, _, body = run_async(server, drive)
+        assert json.loads(body) == ["sys.cpu.user"]
+
+    def test_aggregators(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            return await http_get(port, "/aggregators")
+
+        _, _, body = run_async(server, drive)
+        aggs = json.loads(body)
+        for a in ("sum", "min", "max", "avg", "dev", "p99", "cardinality"):
+            assert a in aggs
+
+    def test_distinct(self, server_env):
+        server, tsdb = server_env
+        for host in ("a", "b", "c"):
+            tsdb.add_batch("m.x", np.array([BT + 1]), np.array([1]),
+                           {"host": host})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/distinct?metric=m.x&tagk=host&start={BT}"
+                      f"&end={BT + 10}")
+
+        _, _, body = run_async(server, drive)
+        assert json.loads(body)["distinct"] == 3
+
+    def test_static_file_and_traversal(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            ok = await http_get(port, "/s/hello.txt")
+            trav = await http_get(port, "/s/../secret")
+            missing = await http_get(port, "/s/nope.txt")
+            return ok, trav, missing
+
+        ok, trav, missing = run_async(server, drive)
+        assert ok[0] == 200 and ok[2] == b"hi\n"
+        assert trav[0] == 404
+        assert missing[0] == 404
+
+    def test_version_stats_logs(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            v = await http_get(port, "/version?json")
+            s = await http_get(port, "/stats")
+            lg = await http_get(port, "/logs")
+            home = await http_get(port, "/")
+            bad = await http_get(port, "/nosuch")
+            return v, s, lg, home, bad
+
+        v, s, lg, home, bad = run_async(server, drive)
+        assert json.loads(v[2])["version"]
+        assert b"tsd.uptime" in s[2]
+        assert lg[0] == 200
+        assert b"opentsdb_tpu" in home[2]
+        assert bad[0] == 404
+
+    def test_query_missing_params(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            no_start = await http_get(port, "/q?m=sum:m.x")
+            no_m = await http_get(port, f"/q?start={BT}")
+            bad_agg = await http_get(
+                port, f"/q?start={BT}&m=bogus:m.x&nocache")
+            return no_start, no_m, bad_agg
+
+        no_start, no_m, bad_agg = run_async(server, drive)
+        assert no_start[0] == 400 and b"start" in no_start[2]
+        assert no_m[0] == 400
+        assert bad_agg[0] == 400 and b"aggregator" in bad_agg[2]

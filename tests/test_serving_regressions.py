@@ -1,0 +1,72 @@
+"""Regressions for review findings on the serving stack."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.stats.collector import LatencyDigest
+
+
+class TestImportInt64Exact:
+    def test_large_counter_exact(self, tmp_path, capsys):
+        from opentsdb_tpu.tools.cli import main
+        wal = str(tmp_path / "wal")
+        big = 2**53 + 1  # not representable in float64
+        f = tmp_path / "d.txt"
+        f.write_text(f"m.big 1356998401 {big} a=b\n")
+        main(["import", "--wal", wal, str(f)])
+        capsys.readouterr()
+        main(["scan", "--wal", wal, "--import", "1356998400",
+              "1356998500", "m.big"])
+        out = capsys.readouterr().out.strip()
+        assert out == f"m.big 1356998401 {big} a=b"
+
+
+class TestLatencyDigestBounded:
+    def test_memory_bounded_and_accurate(self):
+        d = LatencyDigest()
+        for v in range(100_000):
+            d.add(float(v))
+        # Buffer folds incrementally: never holds more than the threshold.
+        assert len(d._buf) < 8192
+        assert len(d._means) <= 128
+        assert abs(d.percentile(50) - 50_000) < 2_000
+        assert abs(d.percentile(95) - 95_000) < 2_000
+        assert d.count == 100_000
+
+    def test_empty(self):
+        assert LatencyDigest().percentile(50) == 0.0
+
+
+class TestLogsLevelParam:
+    def test_bad_level_is_400(self, tmp_path):
+        import asyncio
+
+        from opentsdb_tpu.core.tsdb import TSDB
+        from opentsdb_tpu.server.tsd import TSDServer
+        from opentsdb_tpu.storage.kv import MemKVStore
+        from opentsdb_tpu.utils.config import Config
+
+        tsdb = TSDB(MemKVStore(),
+                    Config(auto_create_metrics=True, port=0,
+                           bind="127.0.0.1"),
+                    start_compaction_thread=False)
+        server = TSDServer(tsdb)
+
+        async def main():
+            await server.start()
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", server.port)
+                writer.write(b"GET /logs?level=bogus HTTP/1.1\r\n\r\n")
+                await writer.drain()
+                data = await reader.read()
+                writer.close()
+                return data
+            finally:
+                server._pool.shutdown(wait=False)
+                server._server.close()
+                await server._server.wait_closed()
+
+        data = asyncio.run(main())
+        assert b"400" in data.split(b"\r\n")[0]
+        assert server.exceptions_caught == 0

@@ -1,0 +1,175 @@
+"""CLI tool tests: import/query/scan/fsck/uid round-trips via main()."""
+
+import gzip
+
+import pytest
+
+from opentsdb_tpu.tools.cli import main
+
+BT = 1356998400
+
+
+@pytest.fixture
+def wal(tmp_path):
+    return str(tmp_path / "wal")
+
+
+def write_datafile(path, lines):
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+class TestImportQuery:
+    def test_import_then_query(self, tmp_path, wal, capsys):
+        f = write_datafile(tmp_path / "data.txt", [
+            f"sys.cpu.user {BT + i * 10} {i} host=web01" for i in range(6)
+        ])
+        assert main(["import", "--wal", wal, f]) == 0
+        out = capsys.readouterr().out
+        assert "6 points" in out
+
+        assert main(["query", "--wal", wal, str(BT), str(BT + 60),
+                     "sum", "sys.cpu.user", "host=web01"]) == 0
+        out = capsys.readouterr().out.strip().split("\n")
+        assert len(out) == 6
+        assert out[0] == f"sys.cpu.user {BT} 0 host=web01"
+        assert out[5] == f"sys.cpu.user {BT + 50} 5 host=web01"
+
+    def test_import_gzip(self, tmp_path, wal, capsys):
+        p = tmp_path / "data.txt.gz"
+        with gzip.open(p, "wt") as f:
+            f.write(f"m.gz {BT} 1.25 a=b\n")
+        assert main(["import", "--wal", wal, str(p)]) == 0
+        assert main(["query", "--wal", wal, str(BT), str(BT + 5),
+                     "sum", "m.gz"]) == 0
+        out = capsys.readouterr().out
+        assert "1.25" in out
+
+    def test_import_bad_line(self, tmp_path, wal):
+        f = write_datafile(tmp_path / "bad.txt", ["not valid"])
+        with pytest.raises(Exception):
+            main(["import", "--wal", wal, f])
+
+    def test_query_downsample(self, tmp_path, wal, capsys):
+        f = write_datafile(tmp_path / "d.txt", [
+            f"m.ds {BT + i * 10} {i} a=b" for i in range(12)
+        ])
+        main(["import", "--wal", wal, f])
+        capsys.readouterr()
+        main(["query", "--wal", wal, str(BT), str(BT + 120),
+              "sum", "downsample", "60", "avg", "m.ds"])
+        out = capsys.readouterr().out.strip().split("\n")
+        assert len(out) == 2  # two 60s buckets
+        assert out[0] == f"m.ds {BT} 2.5 a=b"
+
+
+class TestScan:
+    def test_scan_import_roundtrip(self, tmp_path, wal, capsys):
+        f = write_datafile(tmp_path / "d.txt", [
+            f"m.scan {BT + 1} 42 a=b",
+            f"m.scan {BT + 2} 4.25 a=b",
+        ])
+        main(["import", "--wal", wal, f])
+        capsys.readouterr()
+        main(["scan", "--wal", wal, "--import", str(BT), str(BT + 10),
+              "m.scan"])
+        out = capsys.readouterr().out.strip().split("\n")
+        assert out[0] == f"m.scan {BT + 1} 42 a=b"
+        assert out[1] == f"m.scan {BT + 2} 4.25 a=b"
+
+    def test_scan_raw_shows_cells(self, tmp_path, wal, capsys):
+        f = write_datafile(tmp_path / "d.txt", [f"m.raw {BT + 1} 7 a=b"])
+        main(["import", "--wal", wal, f])
+        capsys.readouterr()
+        main(["scan", "--wal", wal, str(BT), str(BT + 10), "m.raw"])
+        out = capsys.readouterr().out
+        assert "m.raw" in out and "long" in out
+
+    def test_scan_delete(self, tmp_path, wal, capsys):
+        f = write_datafile(tmp_path / "d.txt", [f"m.del {BT + 1} 7 a=b"])
+        main(["import", "--wal", wal, f])
+        main(["scan", "--wal", wal, "--delete", str(BT), str(BT + 10),
+              "m.del"])
+        capsys.readouterr()
+        main(["query", "--wal", wal, str(BT), str(BT + 10), "sum",
+              "m.del"])
+        assert capsys.readouterr().out.strip() == ""
+
+
+class TestFsck:
+    def test_clean_table(self, tmp_path, wal, capsys):
+        f = write_datafile(tmp_path / "d.txt", [f"m.ok {BT + 1} 7 a=b"])
+        main(["import", "--wal", wal, f])
+        capsys.readouterr()
+        assert main(["fsck", "--wal", wal]) == 0
+        out = capsys.readouterr().out
+        assert "Found 0 errors" in out
+
+    def test_detects_and_fixes_duplicates(self, tmp_path, wal, capsys):
+        # Two separate imports create two cells at one timestamp whose
+        # values need different widths (1-byte vs 2-byte int), i.e.
+        # different qualifiers — the detectable-duplicate case. (Same-width
+        # duplicates share a qualifier and silently overwrite, in HBase
+        # semantics too.)
+        f1 = write_datafile(tmp_path / "a.txt", [f"m.dup {BT + 1} 1 a=b"])
+        f2 = write_datafile(tmp_path / "b.txt",
+                            [f"m.dup {BT + 1} 300 a=b"])
+        main(["import", "--wal", wal, f1])
+        main(["import", "--wal", wal, f2])
+        capsys.readouterr()
+        assert main(["fsck", "--wal", wal]) == 1
+        assert "Found 1 errors" in capsys.readouterr().out
+        assert main(["fsck", "--wal", wal, "--fix"]) == 0
+        capsys.readouterr()
+        assert main(["fsck", "--wal", wal]) == 0
+        main(["query", "--wal", wal, str(BT), str(BT + 10), "sum",
+              "m.dup"])
+        out = capsys.readouterr().out.strip().split("\n")
+        assert out[-1] == f"m.dup {BT + 1} 1 a=b"  # first value kept
+
+
+class TestUid:
+    def test_assign_lookup_grep(self, wal, capsys):
+        assert main(["uid", "--wal", wal, "assign", "metrics",
+                     "one", "two"]) == 0
+        capsys.readouterr()
+        assert main(["uid", "--wal", wal, "metrics", "one"]) == 0
+        assert "000001" in capsys.readouterr().out
+        assert main(["uid", "--wal", wal, "grep", "metrics", "^t"]) == 0
+        assert "two" in capsys.readouterr().out
+        assert main(["uid", "--wal", wal, "metrics", "nope"]) == 1
+
+    def test_rename(self, wal, capsys):
+        main(["uid", "--wal", wal, "assign", "tagk", "host"])
+        assert main(["uid", "--wal", wal, "rename", "tagk", "host",
+                     "server"]) == 0
+        capsys.readouterr()
+        assert main(["uid", "--wal", wal, "tagk", "server"]) == 0
+
+    def test_uid_fsck(self, wal, capsys):
+        main(["uid", "--wal", wal, "assign", "metrics", "m1"])
+        capsys.readouterr()
+        assert main(["uid", "--wal", wal, "fsck"]) == 0
+        assert "0 errors" in capsys.readouterr().out
+
+    def test_mkmetric(self, wal, capsys):
+        assert main(["mkmetric", "--wal", wal, "my.metric"]) == 0
+        assert "my.metric" in capsys.readouterr().out
+
+
+class TestStats:
+    def test_latency_digest(self):
+        from opentsdb_tpu.stats.collector import LatencyDigest
+        d = LatencyDigest()
+        for v in range(1000):
+            d.add(v)
+        assert abs(d.percentile(50) - 500) < 25
+        assert abs(d.percentile(95) - 950) < 25
+        assert d.count == 1000
+
+    def test_collector_lines(self):
+        from opentsdb_tpu.stats.collector import StatsCollector
+        c = StatsCollector("tsd", host_tag=False)
+        c.record("test.metric", 42, "type=x")
+        assert c.lines[0].startswith("tsd.test.metric ")
+        assert c.lines[0].endswith(" 42 type=x")
