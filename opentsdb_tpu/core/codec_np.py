@@ -47,29 +47,43 @@ def encode_cell(deltas: np.ndarray, float_values: np.ndarray,
     byte only for multi-point cells: a 2-byte qualifier means "single data
     point, raw value" on the wire, so single-point cells omit it.
     """
+    if len(deltas) == 0:
+        raise ValueError("empty cell")
+    return encode_cells_multi(deltas, float_values, int_values, is_float,
+                              np.array([0]))[0]
+
+
+def encode_cells_multi(deltas: np.ndarray, float_values: np.ndarray,
+                       int_values: np.ndarray, is_float: np.ndarray,
+                       row_starts: np.ndarray,
+                       ) -> list[tuple[bytes, bytes]]:
+    """Encode MANY rows' points in one vectorized pass.
+
+    Points must be sorted by row then delta, deduplicated, with
+    ``row_starts`` marking each row's first index (ascending, starting at
+    0). All qualifier/value bytes are computed in two flat buffers and
+    sliced per row — no per-point Python. Returns one (qualifier, value)
+    cell per row, with the trailing meta byte on multi-point cells.
+    """
     n = len(deltas)
     if n == 0:
-        raise ValueError("empty cell")
+        raise ValueError("empty batch")
     deltas = np.asarray(deltas, dtype=np.int64)
     if ((deltas < 0) | (deltas >= 3600)).any():
         raise ValueError("time delta out of range in batch")
     is_float = np.asarray(is_float, dtype=bool)
     widths = np.where(is_float, 4, int_widths(np.asarray(int_values)))
     flags = np.where(is_float, FLAG_FLOAT | 0x3, widths - 1)
-
-    quals = ((deltas << FLAG_BITS) | flags).astype(">u2")
+    quals = ((deltas << FLAG_BITS) | flags).astype(">u2").tobytes()
 
     offsets = np.zeros(n, dtype=np.int64)
     np.cumsum(widths[:-1], out=offsets[1:])
-    total = int(offsets[-1] + widths[-1])
-    meta = 1 if n > 1 else 0  # trailing meta byte on compacted cells only
-    buf = np.zeros(total + meta, dtype=np.uint8)
-
-    fmask = is_float
-    if fmask.any():
-        fbytes = np.asarray(float_values)[fmask].astype(">f4") \
+    total = int(offsets[-1] + widths[-1]) if n else 0
+    buf = np.zeros(total, dtype=np.uint8)
+    if is_float.any():
+        fbytes = np.asarray(float_values)[is_float].astype(">f4") \
             .view(np.uint8).reshape(-1, 4)
-        pos = offsets[fmask, None] + np.arange(4)
+        pos = offsets[is_float, None] + np.arange(4)
         buf[pos.ravel()] = fbytes.ravel()
     ivals = np.asarray(int_values)
     for width in (1, 2, 4, 8):
@@ -80,7 +94,21 @@ def encode_cell(deltas: np.ndarray, float_values: np.ndarray,
             .reshape(-1, 8)[:, 8 - width:]
         pos = offsets[m, None] + np.arange(width)
         buf[pos.ravel()] = wbytes.ravel()
-    return quals.tobytes(), buf.tobytes()
+    vbytes = buf.tobytes()
+
+    row_starts = np.asarray(row_starts, dtype=np.int64)
+    row_ends = np.append(row_starts[1:], n)
+    val_starts = offsets[row_starts]
+    val_ends = np.append(val_starts[1:], total)
+    out = []
+    for i in range(len(row_starts)):
+        a, b = int(row_starts[i]), int(row_ends[i])
+        va, vb = int(val_starts[i]), int(val_ends[i])
+        v = vbytes[va:vb]
+        if b - a > 1:
+            v += b"\x00"
+        out.append((quals[2 * a:2 * b], v))
+    return out
 
 
 def decode_cell(qual: bytes, value: bytes, base_ts: int) -> Columns:

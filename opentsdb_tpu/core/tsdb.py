@@ -141,16 +141,20 @@ class TSDB:
             fvals = ivals.astype(np.float64)
             fmask = np.zeros(timestamps.shape, dtype=bool)
 
-        base = timestamps - timestamps % MAX_TIMESPAN
+        # One vectorized pass for the whole series: global sort + dedup
+        # (same-timestamp points are same-hour by definition), then all
+        # row-hours' cells encoded in one flat-buffer pass.
+        ts_s, f_s, i_s, m_s = codec_np.sort_dedup(
+            timestamps, fvals, ivals, fmask)
+        base = ts_s - ts_s % MAX_TIMESPAN
+        deltas = ts_s - base
+        row_starts = np.concatenate(
+            ([0], np.flatnonzero(np.diff(base)) + 1))
+        cells = codec_np.encode_cells_multi(deltas, f_s, i_s, m_s,
+                                            row_starts)
         tmpl = bytearray(self.row_key_for(metric, tag_map, 0))
-        n = 0
-        for bt in np.unique(base):
-            m = base == bt
-            deltas = timestamps[m] - bt
-            d, f, i, isf = codec_np.sort_dedup(
-                deltas, fvals[m], ivals[m], fmask[m])
-            qual, val = codec_np.encode_cell(d, f, i, isf)
-            codec.set_base_time(tmpl, int(bt))
+        for start_idx, (qual, val) in zip(row_starts, cells):
+            codec.set_base_time(tmpl, int(base[start_idx]))
             key = bytes(tmpl)
             # Check row existence BEFORE the put: if the row already held
             # cells, this batch makes it multi-cell and it must be queued
@@ -160,7 +164,7 @@ class TSDB:
                            durable=durable)
             if existed and self.config.enable_compactions:
                 self.compactionq.add(key)
-            n += len(d)
+        n = len(ts_s)
         self.datapoints_added += n
         return n
 
