@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -95,12 +96,38 @@ def bench_ingest(num_series: int, points_per_series: int, span: int):
     scalar_dt = time.perf_counter() - t0
     scalar_rate = sub_points / scalar_dt
 
+    # Full telnet pipeline: put-line bytes -> native decode -> columnar
+    # ingest (config 5's "telnet put ingestion with compaction", minus
+    # socket I/O).
+    from opentsdb_tpu.server import wire
+
+    wire_points = min(total, 1_000_000)
+    lines = []
+    count = 0
+    for i, (ts, vals) in enumerate(series):
+        for t, v in zip(ts, vals):
+            lines.append(f"put bench.metric {int(t)} {float(v):.3f} "
+                         f"host=h{i}")
+            count += 1
+        if count >= wire_points:
+            break
+    buf = ("\n".join(lines) + "\n").encode()
+    tsdb3 = TSDB(MemKVStore(), Config(auto_create_metrics=True),
+                 start_compaction_thread=False)
+    t0 = time.perf_counter()
+    batch = wire.decode_puts(buf)
+    n, _ = wire.ingest_batch(tsdb3, batch)
+    telnet_dt = time.perf_counter() - t0
+    telnet_rate = n / telnet_dt
+
     return {
         "config": "ingest+compact",
         "points": total,
         "batch_dps": batch_rate,
         "scalar_dps": scalar_rate,
         "speedup": batch_rate / scalar_rate,
+        "telnet_pipeline_dps": telnet_rate,
+        "native_decoder": wire.native_available(),
     }
 
 
@@ -237,6 +264,13 @@ def main() -> int:
     if args.quick:
         args.series, args.points_per_series = 200, 100
 
+    # Best-effort build of the native wire decoder (gitignored artifact).
+    import subprocess
+    native_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "native")
+    if not os.path.exists(os.path.join(native_dir, "libtsdwire.so")):
+        subprocess.run(["make", "-C", native_dir], capture_output=True)
+
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -252,7 +286,9 @@ def main() -> int:
                        args.points_per_series, args.span)
     details["ingest"] = ing
     log(f"  batch: {ing['batch_dps']:,.0f} dps | scalar(ref-style): "
-        f"{ing['scalar_dps']:,.0f} dps | speedup {ing['speedup']:.1f}x")
+        f"{ing['scalar_dps']:,.0f} dps | speedup {ing['speedup']:.1f}x | "
+        f"telnet pipeline: {ing['telnet_pipeline_dps']:,.0f} dps "
+        f"(native={ing['native_decoder']})")
 
     log("generating query workload ...")
     base, series = gen_workload(args.series, args.points_per_series,

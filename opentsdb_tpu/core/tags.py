@@ -8,6 +8,8 @@ O(1)-space integer parsing (:137-178).
 
 from __future__ import annotations
 
+import re
+
 from opentsdb_tpu.core.const import MAX_NUM_TAGS
 
 _ALLOWED = frozenset(
@@ -85,6 +87,25 @@ def looks_like_integer(s: str) -> bool:
         return False
     body = s[1:] if s[0] in "+-" else s
     return body.isdigit()
+
+
+_FLOAT_RE = re.compile(r"[+-]?(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?")
+
+
+def parse_value(s: str) -> tuple[bool, int, float]:
+    """Parse a wire value into (is_float, int_value, float_value).
+
+    The float grammar is strict — [+-]?(digits[.digits] | .digits)[exp] —
+    and shared byte-for-byte with the native decoder, so acceptance never
+    depends on which parser handled the line (no hex floats, no
+    underscore literals, no nan/inf).
+    """
+    if looks_like_integer(s):
+        iv = parse_long(s)
+        return False, iv, float(iv)
+    if not _FLOAT_RE.fullmatch(s):
+        raise ValueError(f"invalid value: {s}")
+    return True, 0, float(s)
 
 
 def check_metric_and_tags(metric: str, tags: dict[str, str]) -> None:

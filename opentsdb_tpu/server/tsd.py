@@ -42,7 +42,21 @@ from opentsdb_tpu.utils import timeparse
 
 LOG = logging.getLogger(__name__)
 
-MAX_LINE = 1024  # telnet framing limit (reference LineBasedFrameDecoder)
+MAX_LINE = 1024       # per-line telnet framing limit (reference
+                      # LineBasedFrameDecoder's 1024 B discard protection)
+MAX_BUFFER = 1 << 20  # pipelined-burst buffer bound for the bulk path
+
+
+def _put_prefix_len(buf: bytes) -> int:
+    """Byte length of the longest prefix of complete ``put `` lines."""
+    pos = 0
+    while True:
+        nl = buf.find(b"\n", pos)
+        if nl < 0:
+            return pos
+        if not buf.startswith(b"put ", pos):
+            return pos
+        pos = nl + 1
 
 _CONTENT_TYPES = {
     ".html": "text/html; charset=UTF-8",
@@ -150,14 +164,28 @@ class TSDServer:
         while not self._shutdown.is_set():
             nl = buf.find(b"\n")
             if nl < 0:
-                if len(buf) > MAX_LINE:
-                    raise ValueError("frame length exceeds " + str(MAX_LINE))
-                chunk = await reader.read(4096)
+                if len(buf) > MAX_BUFFER:
+                    raise ValueError("frame length exceeds buffer limit")
+                chunk = await reader.read(1 << 16)
                 if not chunk:
                     break
                 buf += chunk
                 continue
+            # Bulk fast path: a pipelined burst of puts decodes natively
+            # into columnar arrays and lands through add_batch — this is
+            # how the 1M dps/s target is met (SURVEY.md §7). One scan
+            # finds the longest prefix of complete put lines; anything
+            # after it falls to the per-line command path below.
+            if buf.startswith(b"put ") and buf.find(b"\n", nl + 1) >= 0:
+                prefix_len = _put_prefix_len(buf)
+                if prefix_len > nl + 1:
+                    chunk, buf = buf[:prefix_len], buf[prefix_len:]
+                    self._bulk_puts(chunk, writer)
+                    await writer.drain()
+                    continue
             line, buf = buf[:nl], buf[nl + 1:]
+            if len(line) > MAX_LINE:
+                raise ValueError(f"frame length exceeds {MAX_LINE}")
             words = tags_mod.split_string(
                 line.decode("utf-8", "replace").rstrip("\r"))
             if not words:
@@ -165,6 +193,30 @@ class TSDServer:
             self.telnet_rpcs += 1
             if not await self._telnet_command(words, writer):
                 return
+
+    def _bulk_puts(self, chunk: bytes, writer) -> None:
+        from opentsdb_tpu.server import wire
+
+        t0 = time.time()
+        batch = wire.decode_puts(chunk)
+        n, series_errors = wire.ingest_batch(self.tsdb, batch)
+        self.telnet_rpcs += n + len(batch.errors)
+        self.requests_put += n + len(batch.errors)
+        for err in batch.errors:
+            self.illegal_arguments_put += 1
+            writer.write(f"put: illegal argument: {err}\n".encode())
+        for err in series_errors:
+            if "No such name" in err:
+                self.unknown_metrics_put += 1
+                writer.write(f"put: unknown metric: {err}\n".encode())
+            elif "throttle" in err.lower():
+                self.hbase_errors_put += 1
+                writer.write(
+                    f"put: Please throttle writes: {err}\n".encode())
+            else:
+                self.illegal_arguments_put += 1
+                writer.write(f"put: illegal argument: {err}\n".encode())
+        self.put_latency.add((time.time() - t0) * 1000)
 
     async def _telnet_command(self, words: list[str], writer) -> bool:
         """Dispatch one telnet command; False closes the connection."""
@@ -206,18 +258,16 @@ class TSDServer:
             timestamp = tags_mod.parse_long(words[2])
             if timestamp <= 0:
                 raise ValueError("invalid timestamp: " + str(timestamp))
-            value = words[3]
-            if not value:
-                raise ValueError("empty value")
+            # Same strict value grammar as the bulk/native path, so
+            # acceptance never depends on pipelining.
+            is_float, ival, fval = tags_mod.parse_value(words[3])
             tag_map: dict[str, str] = {}
             for tag in words[4:]:
                 tags_mod.parse(tag_map, tag)
-            if tags_mod.looks_like_integer(value):
-                self.tsdb.add_point(metric, timestamp,
-                                    tags_mod.parse_long(value), tag_map)
+            if is_float:
+                self.tsdb.add_point(metric, timestamp, fval, tag_map)
             else:
-                self.tsdb.add_point(metric, timestamp, float(value),
-                                    tag_map)
+                self.tsdb.add_point(metric, timestamp, ival, tag_map)
             self.put_latency.add((time.time() - t0) * 1000)
         except NoSuchUniqueName as e:
             self.unknown_metrics_put += 1

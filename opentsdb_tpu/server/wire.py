@@ -1,0 +1,214 @@
+"""Wire decoding: native (C++) batch parser with pure-Python fallback.
+
+``decode_puts(buf)`` turns a byte buffer of telnet ``put`` lines into
+columnar arrays plus a canonical series table — the array form the whole
+ingest pipeline (TSDB.add_batch / the TPU kernels) consumes. The native
+path (native/wire_decoder.cpp via ctypes) parses ~10-30x faster than
+line-by-line Python; build it with ``make -C native``. The fallback is
+semantically identical (differential-tested).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+from typing import NamedTuple
+
+import numpy as np
+
+from opentsdb_tpu.core import tags as tags_mod
+
+LOG = logging.getLogger(__name__)
+
+_LIB_PATHS = (
+    os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                 "libtsdwire.so"),
+    "libtsdwire.so",
+)
+
+
+class DecodedBatch(NamedTuple):
+    timestamps: np.ndarray   # int64 [N]
+    fvalues: np.ndarray      # float64 [N]
+    ivalues: np.ndarray      # int64 [N] (exact ints where ~is_float)
+    is_float: np.ndarray     # bool [N]
+    sid: np.ndarray          # int32 [N] index into series
+    series: list[tuple[str, dict[str, str]]]  # sid -> (metric, tags)
+    errors: list[str]
+    consumed: int            # bytes of complete lines consumed
+
+
+def _load_native():
+    for path in _LIB_PATHS:
+        try:
+            lib = ctypes.CDLL(os.path.abspath(path)
+                              if os.path.sep in path else path)
+        except OSError:
+            continue
+        lib.tsd_parse.restype = ctypes.c_void_p
+        lib.tsd_parse.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        for fn in ("tsd_npoints", "tsd_nseries", "tsd_nerrors",
+                   "tsd_consumed"):
+            getattr(lib, fn).restype = ctypes.c_size_t
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.tsd_copy_points.restype = None
+        lib.tsd_copy_points.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.tsd_series_name.restype = ctypes.c_char_p
+        lib.tsd_series_name.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        lib.tsd_error.restype = ctypes.c_char_p
+        lib.tsd_error.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        lib.tsd_free.restype = None
+        lib.tsd_free.argtypes = [ctypes.c_void_p]
+        LOG.info("native wire decoder loaded from %s", path)
+        return lib
+    return None
+
+
+_NATIVE = _load_native()
+
+
+def native_available() -> bool:
+    return _NATIVE is not None
+
+
+def _parse_series_name(name: str) -> tuple[str, dict[str, str]]:
+    parts = name.split(" ")
+    tag_map: dict[str, str] = {}
+    for t in parts[1:]:
+        k, _, v = t.partition("=")
+        tag_map[k] = v
+    return parts[0], tag_map
+
+
+def decode_puts(buf: bytes, use_native: bool | None = None) -> DecodedBatch:
+    if use_native is None:
+        use_native = _NATIVE is not None
+    if use_native and _NATIVE is not None:
+        return _decode_native(buf)
+    return _decode_python(buf)
+
+
+def _decode_native(buf: bytes) -> DecodedBatch:
+    arena = _NATIVE.tsd_parse(buf, len(buf))
+    try:
+        n = _NATIVE.tsd_npoints(arena)
+        ts = np.empty(n, np.int64)
+        fv = np.empty(n, np.float64)
+        iv = np.empty(n, np.int64)
+        isf = np.empty(n, np.uint8)
+        sid = np.empty(n, np.int32)
+        if n:
+            _NATIVE.tsd_copy_points(
+                arena,
+                ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                fv.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                iv.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                isf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                sid.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        series = [
+            _parse_series_name(
+                _NATIVE.tsd_series_name(arena, i).decode())
+            for i in range(_NATIVE.tsd_nseries(arena))]
+        errors = [_NATIVE.tsd_error(arena, i).decode()
+                  for i in range(_NATIVE.tsd_nerrors(arena))]
+        consumed = _NATIVE.tsd_consumed(arena)
+    finally:
+        _NATIVE.tsd_free(arena)
+    return DecodedBatch(ts, fv, iv, isf.astype(bool), sid, series,
+                        errors, consumed)
+
+
+def _decode_python(buf: bytes) -> DecodedBatch:
+    ts_l: list[int] = []
+    fv_l: list[float] = []
+    iv_l: list[int] = []
+    isf_l: list[bool] = []
+    sid_l: list[int] = []
+    series: list[tuple[str, dict[str, str]]] = []
+    series_ids: dict[str, int] = {}
+    errors: list[str] = []
+    consumed = buf.rfind(b"\n") + 1
+    for raw in buf[:consumed].split(b"\n"):
+        line = raw.decode("utf-8", "replace").rstrip("\r")
+        words = tags_mod.split_string(line)
+        if not words:
+            continue
+        try:
+            if words[0] != "put":
+                raise ValueError(f"unknown command: {words[0]}")
+            if len(words) < 5:
+                raise ValueError(f"not enough arguments: {line}")
+            metric = words[1]
+            tags_mod.validate_string("metric name", metric)
+            try:
+                ts = tags_mod.parse_long(words[2])
+            except ValueError:
+                raise ValueError(
+                    f"invalid timestamp: {words[2]}") from None
+            if ts <= 0 or ts > 0xFFFFFFFF:
+                raise ValueError(f"invalid timestamp: {words[2]}")
+            tag_map: dict[str, str] = {}
+            for t in words[4:]:
+                tags_mod.parse(tag_map, t)
+                k, _, v = t.partition("=")
+                tags_mod.validate_string("tag name", k)
+                tags_mod.validate_string("tag value", v)
+            if not tag_map:
+                raise ValueError("need at least one tag")
+            isf, iv, fv = tags_mod.parse_value(words[3])
+        except ValueError as e:
+            errors.append(str(e))
+            continue
+        canon = metric + "".join(
+            f" {k}={v}" for k, v in sorted(tag_map.items()))
+        sid = series_ids.get(canon)
+        if sid is None:
+            sid = len(series)
+            series_ids[canon] = sid
+            series.append((metric, tag_map))
+        ts_l.append(ts)
+        fv_l.append(fv)
+        iv_l.append(iv)
+        isf_l.append(isf)
+        sid_l.append(sid)
+    return DecodedBatch(
+        np.asarray(ts_l, np.int64), np.asarray(fv_l, np.float64),
+        np.asarray(iv_l, np.int64), np.asarray(isf_l, bool),
+        np.asarray(sid_l, np.int32), series, errors, consumed)
+
+
+def ingest_batch(tsdb, batch: DecodedBatch,
+                 durable: bool = True) -> tuple[int, list[str]]:
+    """Feed a decoded batch into the TSDB via the columnar write path.
+
+    Series are ingested independently: one series failing (unknown
+    metric, conflicting duplicate, throttle) does not drop the others —
+    matching the per-line put semantics. Returns (points_written,
+    per-series error strings). One argsort groups points by series;
+    no per-series full-array masks.
+    """
+    n = 0
+    errors: list[str] = []
+    if len(batch.sid) == 0:
+        return 0, errors
+    order = np.argsort(batch.sid, kind="stable")
+    sid_sorted = batch.sid[order]
+    starts = np.concatenate(
+        ([0], np.flatnonzero(np.diff(sid_sorted)) + 1, [len(order)]))
+    for i in range(len(starts) - 1):
+        run = order[starts[i]:starts[i + 1]]
+        s = int(sid_sorted[starts[i]])
+        metric, tag_map = batch.series[s]
+        try:
+            n += tsdb.add_batch(
+                metric, batch.timestamps[run], batch.fvalues[run],
+                tag_map, durable=durable, is_float=batch.is_float[run],
+                int_values=batch.ivalues[run])
+        except Exception as e:
+            errors.append(f"{metric}: {e}")
+    return n, errors

@@ -1,0 +1,102 @@
+"""Bulk telnet fast-path tests: pipelined bursts, mixed streams."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.server.tsd import TSDServer, _put_prefix_len
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+
+
+class TestPutPrefix:
+    def test_all_puts(self):
+        buf = b"put a 1 1 x=y\nput b 2 2 x=y\n"
+        assert _put_prefix_len(buf) == len(buf)
+
+    def test_stops_at_command(self):
+        buf = b"put a 1 1 x=y\nstats\nput b 2 2 x=y\n"
+        assert _put_prefix_len(buf) == len(b"put a 1 1 x=y\n")
+
+    def test_excludes_partial_tail(self):
+        buf = b"put a 1 1 x=y\nput b 2 2 x"
+        assert _put_prefix_len(buf) == len(b"put a 1 1 x=y\n")
+
+
+def run_with_server(coro_fn):
+    cfg = Config(auto_create_metrics=True, port=0, bind="127.0.0.1")
+    tsdb = TSDB(MemKVStore(), cfg, start_compaction_thread=False)
+    server = TSDServer(tsdb)
+
+    async def main():
+        await server.start()
+        try:
+            return await coro_fn(server.port)
+        finally:
+            server._pool.shutdown(wait=False)
+            server._server.close()
+            await server._server.wait_closed()
+
+    return asyncio.run(main()), server, tsdb
+
+
+class TestBulkIngest:
+    def test_pipelined_burst(self):
+        lines = [f"put bulk.m {BT + i} {i} host=h{i % 3}"
+                 for i in range(500)]
+
+        async def drive(port):
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write(("\n".join(lines) + "\n").encode())
+            await writer.drain()
+            await asyncio.sleep(0.3)
+            writer.close()
+
+        _, server, tsdb = run_with_server(drive)
+        assert tsdb.datapoints_added == 500
+        assert server.requests_put == 500
+
+    def test_mixed_burst_commands_still_work(self):
+        async def drive(port):
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            payload = (
+                f"put m.a {BT + 1} 1 a=b\n"
+                f"put m.a {BT + 2} 2 a=b\n"
+                "version\n"
+                f"put m.a {BT + 3} 3 a=b\n").encode()
+            writer.write(payload)
+            await writer.drain()
+            await asyncio.sleep(0.3)
+            data = await asyncio.wait_for(reader.read(500), 1.0)
+            writer.close()
+            return data
+
+        out, server, tsdb = run_with_server(drive)
+        assert b"opentsdb_tpu" in out  # the version command ran
+        assert tsdb.datapoints_added == 3
+
+    def test_burst_with_bad_lines_reports_each(self):
+        async def drive(port):
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            payload = (
+                f"put m.a {BT + 1} 1 a=b\n"
+                f"put m.a notatime 2 a=b\n"
+                f"put m.a {BT + 3} 0x1F a=b\n").encode()
+            writer.write(payload)
+            await writer.drain()
+            await asyncio.sleep(0.3)
+            data = await asyncio.wait_for(reader.read(1000), 1.0)
+            writer.close()
+            return data
+
+        out, server, tsdb = run_with_server(drive)
+        assert tsdb.datapoints_added == 1
+        assert out.count(b"put: illegal argument") == 2
+        assert server.illegal_arguments_put == 2

@@ -1,0 +1,115 @@
+"""Wire decoder tests: native C++ vs pure-Python differential + semantics."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.server import wire
+
+LINES = b"""put sys.cpu.user 1356998401 42 host=web01 cpu=0
+put sys.cpu.user 1356998402 4.5 host=web01 cpu=0
+put sys.cpu.user 1356998401 7 cpu=0 host=web02
+put sys.mem.free 1356998403 -300 host=web01
+put big.counter 1356998404 9007199254740993 host=web01
+put bad.line notatime 5 host=web01
+put missing.tags 1356998405 5
+bogus command line here
+put bad.tag 1356998406 5 ===
+put bad.value 1356998407 nan host=a
+put sp.ced 1356998408   8   a=b
+"""
+
+
+@pytest.fixture(params=["python"] + (
+    ["native"] if wire.native_available() else []))
+def decoded(request):
+    return wire.decode_puts(LINES, use_native=request.param == "native")
+
+
+class TestDecode:
+    def test_good_points(self, decoded):
+        assert len(decoded.timestamps) == 6
+        np.testing.assert_array_equal(
+            decoded.timestamps,
+            [1356998401, 1356998402, 1356998401, 1356998403, 1356998404,
+             1356998408])
+        np.testing.assert_array_equal(decoded.is_float,
+                                      [False, True, False, False, False,
+                                       False])
+        assert decoded.ivalues[4] == 9007199254740993  # int64-exact
+        assert decoded.fvalues[1] == 4.5
+
+    def test_series_canonicalization(self, decoded):
+        # web01/cpu0 appears twice with different tag order upstream? No -
+        # but tags are sorted: "cpu=0 host=web01" and "host=web02 cpu=0"
+        # canonicalize consistently.
+        names = [(m, tuple(sorted(t.items()))) for m, t in decoded.series]
+        assert names[0] == ("sys.cpu.user",
+                            (("cpu", "0"), ("host", "web01")))
+        assert len(decoded.series) == 5
+        # Points 0 and 1 share a series; point 2 is a different series.
+        assert decoded.sid[0] == decoded.sid[1]
+        assert decoded.sid[0] != decoded.sid[2]
+
+    def test_errors_reported(self, decoded):
+        assert len(decoded.errors) == 5
+        joined = "\n".join(decoded.errors)
+        assert "timestamp" in joined
+        assert "unknown command" in joined
+
+    def test_consumed_excludes_partial_tail(self):
+        buf = b"put m 1356998401 1 a=b\nput m 135699840"
+        d = wire.decode_puts(buf, use_native=False)
+        assert d.consumed == buf.find(b"\n") + 1
+        assert len(d.timestamps) == 1
+
+
+@pytest.mark.skipif(not wire.native_available(),
+                    reason="native decoder not built")
+class TestNativeParity:
+    def test_differential_random(self):
+        rng = np.random.default_rng(9)
+        lines = []
+        for i in range(500):
+            kind = rng.integers(0, 5)
+            if kind == 0:
+                lines.append(f"put m{i % 7} {1356998400 + i} {i} h=a")
+            elif kind == 1:
+                lines.append(
+                    f"put m{i % 7} {1356998400 + i} {i / 3:.4f} h=b k=c")
+            elif kind == 2:
+                lines.append(f"put m{i % 7} bad {i} h=a")
+            elif kind == 3:
+                lines.append(f"put m{i % 7} {1356998400 + i} {-i} "
+                             f"z={i % 3} a=x")
+            else:
+                lines.append("garbage")
+        buf = ("\n".join(lines) + "\n").encode()
+        py = wire.decode_puts(buf, use_native=False)
+        nat = wire.decode_puts(buf, use_native=True)
+        np.testing.assert_array_equal(py.timestamps, nat.timestamps)
+        np.testing.assert_allclose(py.fvalues, nat.fvalues)
+        np.testing.assert_array_equal(py.ivalues, nat.ivalues)
+        np.testing.assert_array_equal(py.is_float, nat.is_float)
+        assert py.series == nat.series
+        np.testing.assert_array_equal(py.sid, nat.sid)
+        assert len(py.errors) == len(nat.errors)
+        assert py.consumed == nat.consumed
+
+
+class TestIngestBatch:
+    def test_ingest(self):
+        from opentsdb_tpu.core.tsdb import TSDB
+        from opentsdb_tpu.storage.kv import MemKVStore
+        from opentsdb_tpu.utils.config import Config
+
+        tsdb = TSDB(MemKVStore(), Config(auto_create_metrics=True),
+                    start_compaction_thread=False)
+        batch = wire.decode_puts(LINES, use_native=False)
+        n, errors = wire.ingest_batch(tsdb, batch)
+        assert n == 6
+        assert errors == []
+        key = tsdb.row_key_for("sys.cpu.user",
+                               {"host": "web01", "cpu": "0"}, 1356998400)
+        cols = tsdb.read_row(key)
+        np.testing.assert_array_equal(cols.timestamps,
+                                      [1356998401, 1356998402])
