@@ -77,8 +77,12 @@ def _finish(agg: str, count, total, m2, mn, mx):
     raise ValueError(f"unknown aggregator: {agg}")
 
 
+_I32_BIG = int(np.int32(2**31 - 1))
+
+
 def gap_fill(series_values: jnp.ndarray, series_mask: jnp.ndarray,
-             num_buckets: int):
+             num_buckets: int, *, glob_offset=0, left_idx=None,
+             left_val=None, right_idx=None, right_val=None):
     """Lerp-fill each series' empty buckets between its nonempty ones.
 
     A series with an empty bucket between two nonempty ones contributes a
@@ -88,23 +92,51 @@ def gap_fill(series_values: jnp.ndarray, series_mask: jnp.ndarray,
     sort, no gather loops. Bucket starts are affine in the bucket index,
     so lerping in index space equals lerping in time space.
 
-    Returns (filled [S, B], in_range [S, B]).
+    The optional carry args serve the time-sharded path
+    (parallel/timeshard.py), where this tile's buckets are a window
+    ``[glob_offset, glob_offset + num_buckets)`` of a larger grid:
+    ``left_idx/left_val`` [S] give the nearest nonempty *global* bucket
+    before the window (-1 = none), ``right_idx/right_val`` the nearest
+    after (sentinel 2^31-1 = none); rows with no local prev/next fall
+    back to them so cross-tile lerp matches the unsharded fill exactly.
+
+    Returns (filled [S, B], in_range [S, B]); filled is 0 outside range.
     """
-    b_idx = jnp.arange(num_buckets)
-    prev_i = jax.lax.cummax(
+    b_idx = jnp.arange(num_buckets, dtype=jnp.int32)
+    glob = glob_offset + b_idx
+    prev_loc = jax.lax.cummax(
         jnp.where(series_mask, b_idx[None, :], -1), axis=1)
-    next_i = jax.lax.cummin(
+    next_loc = jax.lax.cummin(
         jnp.where(series_mask, b_idx[None, :], num_buckets), axis=1,
         reverse=True)
-    in_range = (prev_i >= 0) & (next_i < num_buckets)
-    p = jnp.clip(prev_i, 0, num_buckets - 1)
-    q = jnp.clip(next_i, 0, num_buckets - 1)
+    has_prev_loc = prev_loc >= 0
+    has_next_loc = next_loc < num_buckets
+    p = jnp.clip(prev_loc, 0, num_buckets - 1)
+    q = jnp.clip(next_loc, 0, num_buckets - 1)
     y0 = jnp.take_along_axis(series_values, p, axis=1)
     y1 = jnp.take_along_axis(series_values, q, axis=1)
-    dx = jnp.maximum((q - p).astype(jnp.float32), 1.0)
-    frac = (b_idx[None, :] - p).astype(jnp.float32) / dx
-    filled = jnp.where(series_mask, series_values, y0 + frac * (y1 - y0))
-    return filled, in_range
+
+    if left_idx is None:
+        prev_idx = jnp.where(has_prev_loc, glob_offset + prev_loc, -1)
+        prev_val = y0
+    else:
+        prev_idx = jnp.where(has_prev_loc, glob_offset + prev_loc,
+                             left_idx[:, None])
+        prev_val = jnp.where(has_prev_loc, y0, left_val[:, None])
+    if right_idx is None:
+        next_idx = jnp.where(has_next_loc, glob_offset + next_loc, _I32_BIG)
+        next_val = y1
+    else:
+        next_idx = jnp.where(has_next_loc, glob_offset + next_loc,
+                             right_idx[:, None])
+        next_val = jnp.where(has_next_loc, y1, right_val[:, None])
+
+    in_range = (prev_idx >= 0) & (next_idx < _I32_BIG)
+    dx = jnp.maximum((next_idx - prev_idx).astype(jnp.float32), 1.0)
+    frac = (glob[None, :] - prev_idx).astype(jnp.float32) / dx
+    filled = jnp.where(series_mask, series_values,
+                       prev_val + frac * (next_val - prev_val))
+    return jnp.where(in_range, filled, 0.0), in_range
 
 
 def group_moments(filled: jnp.ndarray, in_range: jnp.ndarray):

@@ -10,6 +10,6 @@ neighbors for rate/lerp correctness (the ring-attention analog for the
 time dimension, SURVEY.md §5.7).
 """
 
-from opentsdb_tpu.parallel.mesh import make_mesh
+from opentsdb_tpu.parallel.mesh import SERIES_AXIS, TIME_AXIS, make_mesh
 
-__all__ = ["make_mesh"]
+__all__ = ["make_mesh", "SERIES_AXIS", "TIME_AXIS"]

@@ -7,6 +7,7 @@ from jax.sharding import Mesh
 
 
 SERIES_AXIS = "series"  # data-parallel axis: series blocks across chips
+TIME_AXIS = "time"      # sequence-parallel axis: contiguous time tiles
 
 
 def make_mesh(n_devices: int | None = None,

@@ -1,0 +1,288 @@
+"""Time-axis (sequence/context) parallelism: ring-sharded long queries.
+
+The reference chunks the time axis into 3600-s HBase rows that one thread
+scans *sequentially*, stitching them back with Span/RowSeq delta re-basing
+(reference src/core/Span.java:87-132, src/core/Const.java:41 MAX_TIMESPAN).
+Here the time axis is a mesh dimension: a long query range is cut into D
+contiguous tiles of ``buckets_per_shard`` downsample buckets, one tile per
+chip, and every chip reduces its tile in parallel — the blockwise /
+ring-attention analog for this workload (SURVEY.md §5.7, §2.9 SP/CP row).
+
+Cross-tile semantics need *carries* exchanged between neighbors:
+
+- **rate** — the first point of a series inside tile d takes its backward
+  difference against the last point of the same series on the nearest
+  earlier tile that has it (reference SpanGroup.java:741-754 computes rate
+  over consecutive points with no tile concept). Per-series
+  (last_ts, last_val) tile summaries are exchanged and max-scanned to
+  find each tile's true predecessor, restoring exact parity.
+- **lerp gap-fill** — a series with no sample inside a tile still
+  contributes linear interpolation between its neighbors outside the tile
+  (reference SpanGroup.java:702-784 lerps missing samples at group time).
+  Gaps may span *many* tiles, so a one-hop ring is not enough: each chip
+  publishes a tiny per-series edge summary (first/last nonempty bucket +
+  value, 4 scalars/series) and an ``all_gather`` over the time axis lets
+  every chip locate its true prev/next neighbors in O(D·S) — the same
+  bandwidth shape as ring attention's K/V block exchange, collapsed to
+  summaries because aggregation only needs the edge values.
+- **downsample buckets** never straddle tiles: tiles are bucket-aligned by
+  construction (the host cuts on ``buckets_per_shard * interval``
+  boundaries, the analog of the reference's row alignment on MAX_TIMESPAN,
+  IncomingDataPoints.java:159-163), so bucket moments stay chip-local.
+
+Everything is fixed-shape and jit-compiled once per (mesh, static-args);
+the collectives ride ICI on a real pod.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from opentsdb_tpu.ops.kernels import (
+    _finish,
+    _segment_moments,
+    gap_fill,
+    group_moments,
+)
+from opentsdb_tpu.parallel.mesh import TIME_AXIS
+
+_I32_BIG = np.int32(2**31 - 1)
+
+
+def _local_edge_summary(series_values, series_mask, bps):
+    """Per-series (first/last nonempty local bucket idx, value there).
+
+    Returns (first_idx [S] int32 local-or-bps, first_val [S],
+             last_idx [S] int32 local-or--1, last_val [S]).
+    """
+    b_idx = jnp.arange(bps, dtype=jnp.int32)
+    last_idx = jnp.max(jnp.where(series_mask, b_idx[None, :], -1), axis=1)
+    first_idx = jnp.min(jnp.where(series_mask, b_idx[None, :], bps), axis=1)
+    lp = jnp.clip(last_idx, 0, bps - 1)
+    fp = jnp.clip(first_idx, 0, bps - 1)
+    last_val = jnp.take_along_axis(series_values, lp[:, None], axis=1)[:, 0]
+    first_val = jnp.take_along_axis(series_values, fp[:, None], axis=1)[:, 0]
+    return first_idx, first_val, last_idx, last_val
+
+
+def _cross_tile_gap_fill(series_values, series_mask, *, d, bps):
+    """gap_fill with lerp carries across tile boundaries.
+
+    ``d`` is this chip's index on the time axis. Publishes per-series edge
+    summaries, all_gathers them over TIME_AXIS, and fills local empty
+    buckets using the nearest nonempty bucket on *any* tile — identical
+    results to running ops.kernels.gap_fill on the unsharded [S, D*bps]
+    grid. Returns (filled [S, bps], in_range [S, bps]).
+    """
+    first_i, first_v, last_i, last_v = _local_edge_summary(
+        series_values, series_mask, bps)
+    # Globalize local indices; sentinel-preserve "none" markers.
+    g_last = jnp.where(last_i >= 0, d * bps + last_i, -1)
+    g_first = jnp.where(first_i < bps, d * bps + first_i, _I32_BIG)
+
+    # [D, S] summaries on every chip (tiny: 4 scalars per series per tile).
+    all_last_i = jax.lax.all_gather(g_last, TIME_AXIS)
+    all_last_v = jax.lax.all_gather(last_v, TIME_AXIS)
+    all_first_i = jax.lax.all_gather(g_first, TIME_AXIS)
+    all_first_v = jax.lax.all_gather(first_v, TIME_AXIS)
+
+    ndev = all_last_i.shape[0]
+    dev = jnp.arange(ndev, dtype=jnp.int32)
+    # Left carry: nearest nonempty bucket on tiles strictly before d. Tiles
+    # are time-ordered, so the max global index among candidates wins.
+    lcand = jnp.where((dev[:, None] < d) & (all_last_i >= 0),
+                      all_last_i, -1)  # [D, S]
+    lsel = jnp.argmax(lcand, axis=0)  # [S]
+    left_idx = jnp.take_along_axis(lcand, lsel[None, :], axis=0)[0]
+    left_val = jnp.take_along_axis(all_last_v, lsel[None, :], axis=0)[0]
+    # Right carry: nearest nonempty bucket on tiles strictly after d.
+    rcand = jnp.where((dev[:, None] > d) & (all_first_i < _I32_BIG),
+                      all_first_i, _I32_BIG)
+    rsel = jnp.argmin(rcand, axis=0)
+    right_idx = jnp.take_along_axis(rcand, rsel[None, :], axis=0)[0]
+    right_val = jnp.take_along_axis(all_first_v, rsel[None, :], axis=0)[0]
+
+    # The scan+lerp itself is the shared unsharded kernel, windowed to
+    # this tile's global index range with the carries as fallbacks.
+    return gap_fill(series_values, series_mask, bps, glob_offset=d * bps,
+                    left_idx=left_idx, left_val=left_val,
+                    right_idx=right_idx, right_val=right_val)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "num_series", "buckets_per_shard", "interval",
+                     "agg_down", "agg_group"))
+def timeshard_downsample_group(ts, vals, sid, valid, *, mesh,
+                               num_series: int, buckets_per_shard: int,
+                               interval: int, agg_down: str, agg_group: str):
+    """Fused downsample + group-by with the time axis sharded over chips.
+
+    Args:
+      ts:    [D, N_tile] int32 *global* offsets from the query start.
+      vals:  [D, N_tile] float32.
+      sid:   [D, N_tile] int32 series index in [0, num_series) (globally
+             consistent across tiles — unlike the series-sharded path).
+      valid: [D, N_tile] bool. Points of tile d must satisfy
+             ts // (interval * buckets_per_shard) == d (the host packs
+             this; see pack_time_shards).
+
+    Returns (group_values [D*bps], group_mask [D*bps]) — the full bucket
+    grid, concatenated across tiles by shard_map's output spec.
+    """
+    bps = buckets_per_shard
+
+    def shard_fn(ts, vals, sid, valid):
+        ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
+        d = jax.lax.axis_index(TIME_AXIS).astype(jnp.int32)
+        # Tile-local bucketing: tiles are bucket-aligned so no bucket
+        # straddles chips; every point's bucket is chip-local.
+        local = ts - d * bps * interval
+        bucket = jnp.clip(local // interval, 0, bps - 1)
+        seg = jnp.where(valid, sid * bps + bucket, num_series * bps)
+        nseg = num_series * bps + 1
+        count, total, m2, mn, mx = _segment_moments(vals, seg, valid, nseg)
+        per = _finish(agg_down, count, total, m2, mn, mx)
+        shape = (num_series, bps)
+        series_values = per[:-1].reshape(shape)
+        series_mask = count[:-1].reshape(shape) > 0
+
+        filled, in_range = _cross_tile_gap_fill(
+            series_values, series_mask, d=d, bps=bps)
+        g_n, g_total, g_m2, _, g_mn, g_mx = group_moments(filled, in_range)
+        group_values = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
+        return group_values, series_mask.any(axis=0)
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS)),
+        out_specs=(P(TIME_AXIS), P(TIME_AXIS)))
+    return fn(ts, vals, sid, valid)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "num_series", "counter", "drop_resets"))
+def timeshard_rate(ts, vals, sid, valid, *, mesh, num_series: int,
+                   counter_max: float = 0.0, reset_value: float = 0.0,
+                   counter: bool = False, drop_resets: bool = False):
+    """Per-point rate with the time axis sharded: each tile's first point
+    per series differences against a carried-in predecessor found by an
+    ``all_gather`` of per-series (last_ts, last_val) tile summaries — a
+    gap can span many tiles, so the nearest predecessor may live on any
+    earlier tile, not just the neighbor.
+
+    Args are [D, N_tile]; each tile's points must be sorted by (sid, ts)
+    and tile d's timestamps all precede tile d+1's (per series). Matches
+    ops.kernels.flat_rate run on the globally concatenated sorted arrays:
+    the first point of each series overall has no rate; first points of
+    later tiles difference against the carried-in predecessor.
+
+    Returns (rates [D, N_tile], ok [D, N_tile]).
+    """
+
+    def shard_fn(ts, vals, sid, valid):
+        ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
+        n = ts.shape[0]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        seg = jnp.where(valid, sid, num_series)
+        nseg = num_series + 1
+
+        # Per-series last valid point in this tile.
+        last_pos = jax.ops.segment_max(
+            jnp.where(valid, pos, -1), seg, nseg)[:num_series]
+        has_last = last_pos >= 0
+        lp = jnp.clip(last_pos, 0, n - 1)
+        tile_last_ts = ts[lp]
+        tile_last_val = vals[lp]
+
+        # Nearest predecessor per series across *all* earlier tiles: a
+        # series may be absent from whole tiles, so a one-hop neighbor
+        # exchange isn't enough; gather the tiny [D, S] summaries and
+        # max-scan for the closest earlier tile that has the series.
+        d = jax.lax.axis_index(TIME_AXIS).astype(jnp.int32)
+        all_has = jax.lax.all_gather(has_last, TIME_AXIS)      # [D, S]
+        all_ts = jax.lax.all_gather(tile_last_ts, TIME_AXIS)   # [D, S]
+        all_val = jax.lax.all_gather(tile_last_val, TIME_AXIS)
+        dev = jnp.arange(all_has.shape[0], dtype=jnp.int32)
+        cand = jnp.where((dev[:, None] < d) & all_has, dev[:, None], -1)
+        sel = jnp.argmax(cand, axis=0)
+        has_carry = jnp.take_along_axis(cand, sel[None, :], axis=0)[0] >= 0
+        carry_ts = jnp.take_along_axis(all_ts, sel[None, :], axis=0)[0]
+        carry_val = jnp.take_along_axis(all_val, sel[None, :], axis=0)[0]
+
+        # Local backward differences.
+        prev_ts = jnp.roll(ts, 1)
+        prev_v = jnp.roll(vals, 1)
+        prev_sid = jnp.roll(sid, 1)
+        prev_valid = jnp.roll(valid, 1)
+        ok_local = valid & prev_valid & (prev_sid == sid)
+        ok_local = ok_local.at[0].set(False)
+
+        # First valid point of each series in this tile uses the carry.
+        first_pos = jax.ops.segment_min(
+            jnp.where(valid, pos, _I32_BIG), seg, nseg)[:num_series]
+        is_first = valid & (pos == first_pos[jnp.clip(sid, 0, num_series - 1)])
+        use_carry = is_first & has_carry[jnp.clip(sid, 0, num_series - 1)]
+        cts = carry_ts[jnp.clip(sid, 0, num_series - 1)]
+        cval = carry_val[jnp.clip(sid, 0, num_series - 1)]
+
+        eff_pts = jnp.where(use_carry, cts, prev_ts)
+        eff_pv = jnp.where(use_carry, cval, prev_v)
+        ok = ok_local | use_carry
+        dt = jnp.maximum((ts - eff_pts).astype(jnp.float32), 1e-9)
+        dv = vals - eff_pv
+        if counter:
+            dv = jnp.where(dv < 0, dv + counter_max, dv)
+        r = dv / dt
+        if drop_resets:
+            r = jnp.where(jnp.abs(r) > reset_value, 0.0, r)
+        return jnp.where(ok, r, 0.0)[None], ok[None]
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS)),
+        out_specs=(P(TIME_AXIS), P(TIME_AXIS)))
+    return fn(ts, vals, sid, valid)
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing
+# ---------------------------------------------------------------------------
+
+def pack_time_shards(ts, vals, sid, n_shards: int, interval: int,
+                     buckets_per_shard: int):
+    """Partition flat (ts, vals, sid) points into n bucket-aligned time
+    tiles, each padded to the max tile population.
+
+    ``ts`` are global offsets from the query start; tile d owns
+    ``[d*bps*interval, (d+1)*bps*interval)``. Within each tile points are
+    sorted by (sid, ts) — the order timeshard_rate requires. Returns
+    (ts, vals, sid, valid) as [D, N_tile] numpy arrays.
+    """
+    ts = np.asarray(ts)
+    vals = np.asarray(vals, np.float32)
+    sid = np.asarray(sid, np.int32)
+    span = interval * buckets_per_shard
+    tile = np.clip(ts // span, 0, n_shards - 1)
+    n_tile = max(int(np.bincount(tile, minlength=n_shards).max()), 1)
+    out_ts = np.zeros((n_shards, n_tile), np.int32)
+    out_vals = np.zeros((n_shards, n_tile), np.float32)
+    out_sid = np.zeros((n_shards, n_tile), np.int32)
+    out_valid = np.zeros((n_shards, n_tile), bool)
+    for d in range(n_shards):
+        m = tile == d
+        t, v, s = ts[m], vals[m], sid[m]
+        order = np.lexsort((t, s))
+        t, v, s = t[order], v[order], s[order]
+        k = len(t)
+        out_ts[d, :k] = t
+        out_vals[d, :k] = v
+        out_sid[d, :k] = s
+        out_valid[d, :k] = True
+    return out_ts, out_vals, out_sid, out_valid
