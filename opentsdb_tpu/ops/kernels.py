@@ -39,23 +39,37 @@ _POS_INF = float("inf")
 # ---------------------------------------------------------------------------
 
 def _segment_moments(vals: jnp.ndarray, seg: jnp.ndarray, valid: jnp.ndarray,
-                     num_segments: int):
+                     num_segments: int, extra: jnp.ndarray | None = None):
     """Per-segment count, sum, centered-M2, min, max over masked points.
 
     The second moment is centered (two-pass: mean first, then
     sum((x-mean)^2)) — the naive E[x^2]-E[x]^2 form cancels catastrophically
     in float32 when stddev << |mean|.
+
+    ``extra`` is an optional [N] feature co-summed in the same fused
+    reduction and returned as a sixth output — downsample_group passes
+    bucket-relative timestamps so count/total/rel ride one kernel launch.
+    The sums route through ops.pallas_kernels.segment_sum_features (MXU
+    one-hot matmul on TPU, XLA segment_sum elsewhere).
     """
+    from opentsdb_tpu.ops.pallas_kernels import segment_sum_features
+
     v = jnp.where(valid, vals, 0.0)
-    count = jax.ops.segment_sum(valid.astype(jnp.float32), seg, num_segments)
-    total = jax.ops.segment_sum(v, seg, num_segments)
+    feats = [valid.astype(jnp.float32), v]
+    if extra is not None:
+        feats.append(jnp.where(valid, extra, 0.0))
+    sums = segment_sum_features(jnp.stack(feats, axis=1), seg, num_segments)
+    count, total = sums[:, 0], sums[:, 1]
     mean = total / jnp.maximum(count, 1.0)
     centered = jnp.where(valid, vals - mean[seg], 0.0)
-    m2 = jax.ops.segment_sum(centered * centered, seg, num_segments)
+    m2 = segment_sum_features((centered * centered)[:, None], seg,
+                              num_segments)[:, 0]
     mn = jax.ops.segment_min(jnp.where(valid, vals, _POS_INF), seg,
                              num_segments)
     mx = jax.ops.segment_max(jnp.where(valid, vals, _NEG_INF), seg,
                              num_segments)
+    if extra is not None:
+        return count, total, m2, mn, mx, sums[:, 2]
     return count, total, m2, mn, mx
 
 
@@ -192,12 +206,12 @@ def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
     seg = jnp.where(valid, sid * num_buckets + bucket, num_series * num_buckets)
     nseg = num_series * num_buckets + 1  # +1 trash segment for padding
 
-    count, total, sumsq, mn, mx = _segment_moments(vals, seg, valid, nseg)
-    per = _finish(agg_down, count, total, sumsq, mn, mx)
-
-    # Mean member timestamp, relative to bucket start for f32 exactness.
+    # Mean member timestamp rides the same fused reduction, relative to
+    # bucket start for f32 exactness.
     rel = (ts - bucket * interval).astype(jnp.float32)
-    rel_sum = jax.ops.segment_sum(jnp.where(valid, rel, 0.0), seg, nseg)
+    count, total, sumsq, mn, mx, rel_sum = _segment_moments(
+        vals, seg, valid, nseg, extra=rel)
+    per = _finish(agg_down, count, total, sumsq, mn, mx)
     mean_rel = jnp.floor(rel_sum / jnp.maximum(count, 1.0))
 
     shape = (num_series, num_buckets)
@@ -253,6 +267,35 @@ def masked_quantile_axis0(vals: jnp.ndarray, mask: jnp.ndarray,
 # Rate (flat layout)
 # ---------------------------------------------------------------------------
 
+def _flat_rate(ts, vals, sid, valid, counter_max, reset_value, *,
+               counter: bool, drop_resets: bool, carry_ts=None,
+               carry_val=None, use_carry=None):
+    """Core of flat_rate; see its docstring. The optional carry args serve
+    the time-sharded path (parallel/timeshard.py): where ``use_carry`` [N]
+    is set, the point's predecessor is (carry_ts, carry_val) [N] — the
+    series' last point on an earlier time tile — instead of the rolled
+    neighbor, keeping counter/reset/epsilon semantics in this one place.
+    """
+    prev_ts = jnp.roll(ts, 1)
+    prev_v = jnp.roll(vals, 1)
+    prev_sid = jnp.roll(sid, 1)
+    prev_valid = jnp.roll(valid, 1)
+    ok = valid & prev_valid & (prev_sid == sid)
+    ok = ok.at[0].set(False)
+    if use_carry is not None:
+        prev_ts = jnp.where(use_carry, carry_ts, prev_ts)
+        prev_v = jnp.where(use_carry, carry_val, prev_v)
+        ok = ok | use_carry
+    dt = jnp.maximum((ts - prev_ts).astype(jnp.float32), 1e-9)
+    dv = vals - prev_v
+    if counter:
+        dv = jnp.where(dv < 0, dv + counter_max, dv)
+    r = dv / dt
+    if drop_resets:
+        r = jnp.where(jnp.abs(r) > reset_value, 0.0, r)
+    return jnp.where(ok, r, 0.0), ok
+
+
 @functools.partial(jax.jit, static_argnames=("counter", "drop_resets"))
 def flat_rate(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
               valid: jnp.ndarray, counter_max: float = 0.0,
@@ -267,20 +310,8 @@ def flat_rate(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
 
     Returns (rates [N] float32 emitted at each point's own ts, valid [N]).
     """
-    prev_ts = jnp.roll(ts, 1)
-    prev_v = jnp.roll(vals, 1)
-    prev_sid = jnp.roll(sid, 1)
-    prev_valid = jnp.roll(valid, 1)
-    ok = valid & prev_valid & (prev_sid == sid)
-    ok = ok.at[0].set(False)
-    dt = jnp.maximum((ts - prev_ts).astype(jnp.float32), 1e-9)
-    dv = vals - prev_v
-    if counter:
-        dv = jnp.where(dv < 0, dv + counter_max, dv)
-    r = dv / dt
-    if drop_resets:
-        r = jnp.where(jnp.abs(r) > reset_value, 0.0, r)
-    return jnp.where(ok, r, 0.0), ok
+    return _flat_rate(ts, vals, sid, valid, counter_max, reset_value,
+                      counter=counter, drop_resets=drop_resets)
 
 
 # ---------------------------------------------------------------------------

@@ -45,6 +45,7 @@ from jax.sharding import PartitionSpec as P
 
 from opentsdb_tpu.ops.kernels import (
     _finish,
+    _flat_rate,
     _segment_moments,
     gap_fill,
     group_moments,
@@ -85,11 +86,17 @@ def _cross_tile_gap_fill(series_values, series_mask, *, d, bps):
     g_last = jnp.where(last_i >= 0, d * bps + last_i, -1)
     g_first = jnp.where(first_i < bps, d * bps + first_i, _I32_BIG)
 
-    # [D, S] summaries on every chip (tiny: 4 scalars per series per tile).
-    all_last_i = jax.lax.all_gather(g_last, TIME_AXIS)
-    all_last_v = jax.lax.all_gather(last_v, TIME_AXIS)
-    all_first_i = jax.lax.all_gather(g_first, TIME_AXIS)
-    all_first_v = jax.lax.all_gather(first_v, TIME_AXIS)
+    # One [S, 4] int32 gather (values bitcast) instead of four [S]
+    # collectives: the payloads are tiny, so launch latency dominates.
+    payload = jnp.stack([
+        g_last, jax.lax.bitcast_convert_type(last_v, jnp.int32),
+        g_first, jax.lax.bitcast_convert_type(first_v, jnp.int32),
+    ], axis=1)
+    allp = jax.lax.all_gather(payload, TIME_AXIS)  # [D, S, 4]
+    all_last_i = allp[:, :, 0]
+    all_last_v = jax.lax.bitcast_convert_type(allp[:, :, 1], jnp.float32)
+    all_first_i = allp[:, :, 2]
+    all_first_v = jax.lax.bitcast_convert_type(allp[:, :, 3], jnp.float32)
 
     ndev = all_last_i.shape[0]
     dev = jnp.arange(ndev, dtype=jnp.int32)
@@ -203,12 +210,18 @@ def timeshard_rate(ts, vals, sid, valid, *, mesh, num_series: int,
 
         # Nearest predecessor per series across *all* earlier tiles: a
         # series may be absent from whole tiles, so a one-hop neighbor
-        # exchange isn't enough; gather the tiny [D, S] summaries and
-        # max-scan for the closest earlier tile that has the series.
+        # exchange isn't enough; gather the tiny [D, S] summaries (one
+        # stacked collective, values bitcast to int32) and max-scan for
+        # the closest earlier tile that has the series.
         d = jax.lax.axis_index(TIME_AXIS).astype(jnp.int32)
-        all_has = jax.lax.all_gather(has_last, TIME_AXIS)      # [D, S]
-        all_ts = jax.lax.all_gather(tile_last_ts, TIME_AXIS)   # [D, S]
-        all_val = jax.lax.all_gather(tile_last_val, TIME_AXIS)
+        payload = jnp.stack([
+            has_last.astype(jnp.int32), tile_last_ts,
+            jax.lax.bitcast_convert_type(tile_last_val, jnp.int32),
+        ], axis=1)
+        allp = jax.lax.all_gather(payload, TIME_AXIS)  # [D, S, 3]
+        all_has = allp[:, :, 0] > 0
+        all_ts = allp[:, :, 1]
+        all_val = jax.lax.bitcast_convert_type(allp[:, :, 2], jnp.float32)
         dev = jnp.arange(all_has.shape[0], dtype=jnp.int32)
         cand = jnp.where((dev[:, None] < d) & all_has, dev[:, None], -1)
         sel = jnp.argmax(cand, axis=0)
@@ -216,33 +229,20 @@ def timeshard_rate(ts, vals, sid, valid, *, mesh, num_series: int,
         carry_ts = jnp.take_along_axis(all_ts, sel[None, :], axis=0)[0]
         carry_val = jnp.take_along_axis(all_val, sel[None, :], axis=0)[0]
 
-        # Local backward differences.
-        prev_ts = jnp.roll(ts, 1)
-        prev_v = jnp.roll(vals, 1)
-        prev_sid = jnp.roll(sid, 1)
-        prev_valid = jnp.roll(valid, 1)
-        ok_local = valid & prev_valid & (prev_sid == sid)
-        ok_local = ok_local.at[0].set(False)
-
-        # First valid point of each series in this tile uses the carry.
+        # First valid point of each series in this tile uses the carry;
+        # the shared _flat_rate core does the differences and
+        # counter/reset semantics (one implementation for both paths).
         first_pos = jax.ops.segment_min(
             jnp.where(valid, pos, _I32_BIG), seg, nseg)[:num_series]
-        is_first = valid & (pos == first_pos[jnp.clip(sid, 0, num_series - 1)])
-        use_carry = is_first & has_carry[jnp.clip(sid, 0, num_series - 1)]
-        cts = carry_ts[jnp.clip(sid, 0, num_series - 1)]
-        cval = carry_val[jnp.clip(sid, 0, num_series - 1)]
-
-        eff_pts = jnp.where(use_carry, cts, prev_ts)
-        eff_pv = jnp.where(use_carry, cval, prev_v)
-        ok = ok_local | use_carry
-        dt = jnp.maximum((ts - eff_pts).astype(jnp.float32), 1e-9)
-        dv = vals - eff_pv
-        if counter:
-            dv = jnp.where(dv < 0, dv + counter_max, dv)
-        r = dv / dt
-        if drop_resets:
-            r = jnp.where(jnp.abs(r) > reset_value, 0.0, r)
-        return jnp.where(ok, r, 0.0)[None], ok[None]
+        sidc = jnp.clip(sid, 0, num_series - 1)
+        is_first = valid & (pos == first_pos[sidc])
+        use_carry = is_first & has_carry[sidc]
+        r, ok = _flat_rate(
+            ts, vals, sid, valid, counter_max, reset_value,
+            counter=counter, drop_resets=drop_resets,
+            carry_ts=carry_ts[sidc], carry_val=carry_val[sidc],
+            use_carry=use_carry)
+        return r[None], ok[None]
 
     fn = jax.shard_map(
         shard_fn, mesh=mesh,
