@@ -474,6 +474,31 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("names", nargs="+")
     p.set_defaults(fn=cmd_mkmetric)
 
+    from opentsdb_tpu.tools import ops
+
+    p = sub.add_parser(
+        "check", help="Nagios-style threshold probe over /q (check_tsd)")
+    ops.add_check_args(p)
+    p.set_defaults(fn=ops.cmd_check)
+
+    p = sub.add_parser(
+        "drain", help="accept put lines to files during maintenance")
+    p.add_argument("--port", type=int, default=4242)
+    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("dir", help="directory for per-client drain files")
+    p.set_defaults(fn=ops.cmd_drain)
+
+    p = sub.add_parser(
+        "clean-cache", help="purge graph cache when the disk is nearly full")
+    p.add_argument("--threshold", type=float, default=90.0,
+                   help="disk-usage %% that triggers cleaning")
+    p.add_argument("--min-age", type=float, default=0.0,
+                   help="spare files younger than this many seconds")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("cachedir")
+    p.set_defaults(fn=ops.cmd_clean_cache)
+
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

@@ -1,0 +1,281 @@
+"""Operational side-tools: alerting probe, maintenance drain, cache cleaner.
+
+Parity targets (semantics, not code):
+  check     Nagios-style threshold alerting over `/q?...&ascii`
+            (reference tools/check_tsd: comparators gt/ge/lt/le/eq/ne,
+            warning/critical thresholds, --ignore-recent window,
+            --no-result-ok, downsample/rate query construction).
+  drain     low-end TCP sink for `put` lines during storage maintenance,
+            one append-only file per client IP, re-importable later with
+            `tsdb import` (reference tools/tsddrain.py).
+  clean-cache
+            delete graph-cache files when the cache volume is nearly full
+            (reference tools/clean_cache.sh: acts at >=90% disk usage).
+
+All three are exposed as `tsdb` subcommands (see tools/cli.py) instead of
+loose scripts, so they share the config/flag system.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import http.client
+import operator
+import os
+import shutil
+import sys
+import time
+
+COMPARATORS = {
+    "gt": operator.gt, "ge": operator.ge, "lt": operator.lt,
+    "le": operator.le, "eq": operator.eq, "ne": operator.ne,
+}
+
+# Nagios exit codes.
+OK, WARNING, CRITICAL = 0, 1, 2
+
+
+def add_check_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-H", "--host", default="localhost")
+    p.add_argument("-p", "--port", type=int, default=4242)
+    p.add_argument("-m", "--metric", required=True)
+    p.add_argument("-t", "--tag", action="append", default=[],
+                   help="tag=value filter (repeatable)")
+    p.add_argument("-d", "--duration", type=int, default=600,
+                   help="how far back to look, seconds")
+    p.add_argument("-D", "--downsample", default="none",
+                   choices=["none", "avg", "min", "sum", "max"])
+    p.add_argument("-W", "--downsample-window", type=int, default=60)
+    p.add_argument("-a", "--aggregator", default="sum")
+    p.add_argument("-x", "--method", dest="comparator", default="gt",
+                   choices=sorted(COMPARATORS))
+    p.add_argument("-r", "--rate", action="store_true")
+    p.add_argument("-w", "--warning", type=float, default=None)
+    p.add_argument("-c", "--critical", type=float, default=None)
+    p.add_argument("-E", "--no-result-ok", action="store_true")
+    p.add_argument("-I", "--ignore-recent", type=int, default=0,
+                   help="ignore data points newer than this many seconds")
+    p.add_argument("-T", "--timeout", type=int, default=10)
+    p.add_argument("-v", "--verbose", action="store_true")
+
+
+def check_query_path(args) -> str:
+    """Build the `/q` target the probe fetches (ascii, one metric)."""
+    tags = ",".join(args.tag)
+    spec = args.aggregator + ":"
+    if args.downsample != "none":
+        spec += f"{args.downsample_window}s-{args.downsample}:"
+    if args.rate:
+        spec += "rate:"
+    spec += args.metric
+    if tags:
+        spec += "{" + tags + "}"
+    return f"/q?start={args.duration}s-ago&m={spec}&ascii&nagios"
+
+
+def evaluate_check(args, lines: list[str], now: int) -> tuple[int, str]:
+    """Threshold logic over ascii output lines `metric ts value tags...`.
+
+    Returns (nagios_rv, message). A point is counted only when it falls
+    inside (now-duration, now-ignore_recent]; the worst offending value
+    (by the chosen comparator) is reported.
+    """
+    cmp_ = COMPARATORS[args.comparator]
+    warning = args.warning if args.warning is not None else args.critical
+    critical = args.critical if args.critical is not None else args.warning
+    rv = OK
+    npoints = nbad = 0
+    badval = badts = None
+    val = None
+    for line in lines:
+        parts = line.split()
+        if len(parts) < 3:
+            continue
+        ts = int(parts[1])
+        delta = now - ts
+        if delta > args.duration or delta <= args.ignore_recent:
+            continue
+        npoints += 1
+        val = float(parts[2]) if "." in parts[2] else int(parts[2])
+        bad = False
+        if cmp_(val, critical):
+            rv, bad = CRITICAL, True
+        elif rv < CRITICAL and cmp_(val, warning):
+            rv, bad = WARNING, True
+        if bad:
+            nbad += 1
+            if badval is None or cmp_(val, badval):
+                badval, badts = val, ts
+    if not npoints:
+        if args.no_result_ok:
+            return OK, "OK: query did not return any data point"
+        return CRITICAL, "CRITICAL: query did not return any data point"
+    tags = ("{" + ",".join(args.tag) + "}") if args.tag else ""
+    tags = tags.replace("|", ":")  # '|' is special to nrpe
+    if rv == OK:
+        return OK, (f"OK: {args.metric}{tags}: {npoints} values OK, "
+                    f"last={val!r}")
+    level = "WARNING" if rv == WARNING else "CRITICAL"
+    threshold = warning if rv == WARNING else critical
+    when = time.asctime(time.localtime(badts))
+    return rv, (f"{level}: {args.metric}{tags} {args.comparator} {threshold}:"
+                f" {nbad}/{npoints} bad values ({nbad * 100.0 / npoints:.1f}%)"
+                f" worst: {badval!r} @ {when}")
+
+
+def cmd_check(args) -> int:
+    if args.warning is None and args.critical is None:
+        print("ERROR: need at least one of --warning/--critical",
+              file=sys.stderr)
+        return CRITICAL
+    url = check_query_path(args)
+    conn = http.client.HTTPConnection(args.host, args.port,
+                                      timeout=args.timeout)
+    now = int(time.time())
+    try:
+        conn.request("GET", url)
+        res = conn.getresponse()
+        body = res.read().decode("utf-8", "replace")
+        conn.close()
+    except (OSError, http.client.HTTPException) as e:
+        print(f"ERROR: couldn't GET {url} from "
+              f"{args.host}:{args.port}: {e}")
+        return CRITICAL
+    if res.status not in (200, 202):
+        print(f"CRITICAL: status = {res.status} when talking to "
+              f"{args.host}:{args.port}")
+        if args.verbose:
+            print(body)
+        return CRITICAL
+    if args.verbose:
+        print(body)
+    rv, msg = evaluate_check(args, body.splitlines(), now)
+    print(msg)
+    return rv
+
+
+# ---------------------------------------------------------------------------
+# drain
+# ---------------------------------------------------------------------------
+
+class DrainServer:
+    """TCP sink for `put` lines while the real daemon is down.
+
+    Each client IP gets one append-only file under `draindir` holding the
+    lines minus the `put ` prefix — exactly the text-import format — so
+    recovery is `tsdb import draindir/*`. Answers `version` so collectors'
+    health checks keep passing.
+    """
+
+    def __init__(self, draindir: str, bind: str = "0.0.0.0",
+                 port: int = 4242) -> None:
+        self.draindir = draindir
+        self.bind = bind
+        self._port = port
+        self._server: asyncio.AbstractServer | None = None
+        self.lines_drained = 0
+
+    @property
+    def port(self) -> int:
+        if self._server is not None:
+            return self._server.sockets[0].getsockname()[1]
+        return self._port
+
+    async def start(self) -> None:
+        os.makedirs(self.draindir, exist_ok=True)
+        self._server = await asyncio.start_server(
+            self._handle, self.bind, self._port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername") or ("unknown",)
+        path = os.path.join(self.draindir, str(peer[0]))
+        try:
+            with open(path, "ab") as out:
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        break
+                    if line.strip() == b"version":
+                        writer.write(b"tsdb drain\n")
+                        await writer.drain()
+                        continue
+                    if not line.startswith(b"put "):
+                        continue
+                    out.write(line[4:])
+                    out.flush()
+                    self.lines_drained += 1
+        finally:
+            writer.close()
+
+
+def cmd_drain(args) -> int:
+    server = DrainServer(args.dir, bind=args.bind, port=args.port)
+
+    async def main():
+        await server.start()
+        print(f"draining to {args.dir} on {args.bind}:{server.port}",
+              flush=True)
+        await server.serve_forever()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# clean-cache
+# ---------------------------------------------------------------------------
+
+def clean_cache(cachedir: str, threshold_pct: float = 90.0,
+                now: float | None = None, min_age: float = 0.0) -> int:
+    """Delete cache files when the volume holding `cachedir` is nearly full.
+
+    Returns the number of files removed (0 when usage < threshold).
+    `min_age` spares files younger than that many seconds (an improvement
+    over the reference's indiscriminate `rm -rf`: in-flight renders
+    survive).
+    """
+    if not os.path.isdir(cachedir):
+        return 0
+    usage = shutil.disk_usage(cachedir)
+    # df's Use%: used/(used+avail), so root-reserved blocks don't hide
+    # pressure on the non-superuser space the cache actually writes to.
+    pct = 100.0 * usage.used / max(usage.used + usage.free, 1)
+    if pct < threshold_pct:
+        return 0
+    now = time.time() if now is None else now
+    removed = 0
+    for name in os.listdir(cachedir):
+        path = os.path.join(cachedir, name)
+        try:
+            if not os.path.isfile(path):
+                continue
+            if min_age and now - os.path.getmtime(path) < min_age:
+                continue
+            os.unlink(path)
+            removed += 1
+        except OSError:
+            continue
+    return removed
+
+
+def cmd_clean_cache(args) -> int:
+    removed = clean_cache(args.cachedir, threshold_pct=args.threshold,
+                          min_age=args.min_age)
+    if args.verbose:
+        print(f"removed {removed} cache files from {args.cachedir}")
+    return 0

@@ -1,0 +1,200 @@
+"""Ops side-tools: check (alerting), drain (maintenance sink), clean-cache.
+
+Covers the reference tools/check_tsd threshold logic, tools/tsddrain.py
+per-client capture, and tools/clean_cache.sh disk pressure behavior.
+"""
+
+import argparse
+import asyncio
+import os
+import threading
+import time
+
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.server.tsd import TSDServer
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.tools import ops
+from opentsdb_tpu.utils.config import Config
+
+
+def make_check_args(**kw):
+    ns = argparse.Namespace(
+        host="127.0.0.1", port=4242, metric="m", tag=[], duration=600,
+        downsample="none", downsample_window=60, aggregator="sum",
+        comparator="gt", rate=False, warning=None, critical=None,
+        no_result_ok=False, ignore_recent=0, timeout=5, verbose=False)
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+class TestCheckQueryPath:
+    def test_simple(self):
+        args = make_check_args(metric="sys.cpu.user", warning=1.0)
+        assert ops.check_query_path(args) == (
+            "/q?start=600s-ago&m=sum:sys.cpu.user&ascii&nagios")
+
+    def test_full(self):
+        args = make_check_args(
+            metric="m", tag=["host=a", "dc=b"], downsample="avg",
+            downsample_window=120, rate=True, aggregator="max", duration=60)
+        assert ops.check_query_path(args) == (
+            "/q?start=60s-ago&m=max:120s-avg:rate:m{host=a,dc=b}"
+            "&ascii&nagios")
+
+
+class TestEvaluateCheck:
+    NOW = 1_700_000_000
+
+    def lines(self, *vals, step=10):
+        return [f"m {self.NOW - (len(vals) - i) * step} {v} host=a"
+                for i, v in enumerate(vals)]
+
+    def test_ok(self):
+        args = make_check_args(warning=100.0)
+        rv, msg = ops.evaluate_check(args, self.lines(1, 2, 3), self.NOW)
+        assert rv == ops.OK and msg.startswith("OK:")
+        assert "3 values OK" in msg
+
+    def test_warning_and_critical(self):
+        args = make_check_args(warning=10.0, critical=50.0)
+        rv, msg = ops.evaluate_check(args, self.lines(5, 20), self.NOW)
+        assert rv == ops.WARNING and "1/2 bad values" in msg
+        rv, msg = ops.evaluate_check(args, self.lines(5, 20, 99), self.NOW)
+        assert rv == ops.CRITICAL and "worst: 99" in msg
+
+    def test_comparator_lt(self):
+        args = make_check_args(comparator="lt", critical=0.0)
+        rv, _ = ops.evaluate_check(args, self.lines(-1, 5), self.NOW)
+        assert rv == ops.CRITICAL
+
+    def test_ignore_recent_window(self):
+        # Newest point (10s old) is bad but inside --ignore-recent 15;
+        # the two older points (20s/30s) still count and are fine.
+        args = make_check_args(critical=50.0, ignore_recent=15)
+        rv, msg = ops.evaluate_check(args, self.lines(1, 2, 99), self.NOW)
+        assert rv == ops.OK and "2 values OK" in msg
+
+    def test_old_points_outside_duration_skipped(self):
+        args = make_check_args(critical=50.0, duration=15)
+        # steps of 10s: only the last point is younger than 15s.
+        rv, msg = ops.evaluate_check(args, self.lines(99, 99, 1), self.NOW)
+        assert rv == ops.OK and "1 values OK" in msg
+
+    def test_no_data(self):
+        args = make_check_args(warning=1.0)
+        rv, _ = ops.evaluate_check(args, [], self.NOW)
+        assert rv == ops.CRITICAL
+        args.no_result_ok = True
+        rv, _ = ops.evaluate_check(args, [], self.NOW)
+        assert rv == ops.OK
+
+    def test_only_warning_threshold_given(self):
+        args = make_check_args(warning=10.0)
+        rv, _ = ops.evaluate_check(args, self.lines(20), self.NOW)
+        assert rv == ops.CRITICAL  # critical defaults to warning
+
+
+class TestCheckEndToEnd:
+    def test_against_live_tsd(self, tmp_path, capsys):
+        cfg = Config(auto_create_metrics=True, port=0, bind="127.0.0.1",
+                     cachedir=str(tmp_path))
+        tsdb = TSDB(MemKVStore(), cfg, start_compaction_thread=False)
+        now = int(time.time())
+        for i in range(5):
+            tsdb.add_point("sys.load", now - 60 + i * 10, 10.0 * (i + 1),
+                           {"host": "a"})
+        server = TSDServer(tsdb)
+        started = threading.Event()
+        loop_holder = {}
+
+        def run_server():
+            async def main():
+                await server.start()
+                loop_holder["loop"] = asyncio.get_running_loop()
+                loop_holder["stop"] = asyncio.Event()
+                started.set()
+                await loop_holder["stop"].wait()
+            asyncio.run(main())
+
+        t = threading.Thread(target=run_server, daemon=True)
+        t.start()
+        assert started.wait(5)
+        try:
+            args = make_check_args(port=server.port, metric="sys.load",
+                                   critical=45.0, duration=300)
+            rv = ops.cmd_check(args)
+            out = capsys.readouterr().out
+            assert rv == ops.CRITICAL and "bad values" in out
+            args = make_check_args(port=server.port, metric="sys.load",
+                                   critical=1000.0, duration=300)
+            assert ops.cmd_check(args) == ops.OK
+        finally:
+            loop_holder["loop"].call_soon_threadsafe(
+                loop_holder["stop"].set)
+            t.join(5)
+
+
+class TestDrain:
+    def test_drain_captures_put_lines(self, tmp_path):
+        draindir = str(tmp_path / "drain")
+        server = ops.DrainServer(draindir, bind="127.0.0.1", port=0)
+
+        async def main():
+            await server.start()
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", server.port)
+                writer.write(b"version\n")
+                await writer.drain()
+                resp = await asyncio.wait_for(reader.readline(), 2)
+                assert b"drain" in resp
+                writer.write(b"put m 1 2 host=a\n")
+                writer.write(b"garbage line\n")
+                writer.write(b"put m 2 3 host=a\n")
+                await writer.drain()
+                writer.close()
+                await writer.wait_closed()
+                await asyncio.sleep(0.1)
+            finally:
+                await server.stop()
+
+        asyncio.run(main())
+        files = os.listdir(draindir)
+        assert files == ["127.0.0.1"]
+        content = open(os.path.join(draindir, files[0])).read()
+        assert content == "m 1 2 host=a\nm 2 3 host=a\n"
+        assert server.lines_drained == 2
+
+
+class TestCleanCache:
+    def test_noop_below_threshold(self, tmp_path):
+        (tmp_path / "x.png").write_bytes(b"d")
+        assert ops.clean_cache(str(tmp_path), threshold_pct=101.0) == 0
+        assert (tmp_path / "x.png").exists()
+
+    def test_cleans_when_full(self, tmp_path):
+        (tmp_path / "a.png").write_bytes(b"d")
+        (tmp_path / "b.dat").write_bytes(b"d")
+        sub = tmp_path / "subdir"
+        sub.mkdir()
+        removed = ops.clean_cache(str(tmp_path), threshold_pct=0.0)
+        assert removed == 2
+        assert sub.exists()  # directories are spared
+
+    def test_min_age_spares_recent(self, tmp_path):
+        fresh = tmp_path / "fresh.png"
+        fresh.write_bytes(b"d")
+        old = tmp_path / "old.png"
+        old.write_bytes(b"d")
+        past = time.time() - 3600
+        os.utime(old, (past, past))
+        removed = ops.clean_cache(str(tmp_path), threshold_pct=0.0,
+                                  min_age=60.0)
+        assert removed == 1
+        assert fresh.exists() and not old.exists()
+
+    def test_missing_dir(self, tmp_path):
+        assert ops.clean_cache(str(tmp_path / "nope")) == 0
