@@ -44,6 +44,9 @@ class CompactionQueue:
         self.min_flush_threshold = cfg.compaction_min_flush_threshold
         self.max_concurrent_flushes = cfg.compaction_max_concurrent_flushes
         self.flush_speed = cfg.compaction_flush_speed
+        self.checkpoint_interval = cfg.checkpoint_interval
+        self._last_checkpoint = time.time()
+        self.checkpoints = 0
         # stats (reference :118-132)
         self.trivial_compactions = 0
         self.complex_compactions = 0
@@ -102,6 +105,13 @@ class CompactionQueue:
     def _loop(self) -> None:
         while not self._stop.wait(self.flush_interval):
             try:
+                now = time.time()
+                if (self.checkpoint_interval
+                        and now - self._last_checkpoint
+                        >= self.checkpoint_interval):
+                    self._tsdb.checkpoint()
+                    self._last_checkpoint = now
+                    self.checkpoints += 1
                 size = len(self._queue)
                 if size <= self.min_flush_threshold:
                     continue

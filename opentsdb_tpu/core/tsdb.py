@@ -261,6 +261,13 @@ class TSDB:
         self.compactionq.flush(cutoff=int(time.time()) - MAX_TIMESPAN - 1)
         self.store.flush()
 
+    def checkpoint(self) -> int:
+        """Spill memtable state to the sstable tier and truncate the WAL
+        (the TPU build's checkpoint/resume story, SURVEY §5.4). Returns
+        rows spilled, 0 when the store is non-persistent."""
+        ckpt = getattr(self.store, "checkpoint", None)
+        return ckpt() if ckpt else 0
+
     def shutdown(self) -> None:
         self.compactionq.shutdown()
         self.store.flush()

@@ -18,6 +18,23 @@ Design notes (TPU-first, not an HBase rebuild):
 - Backpressure: once the row count crosses ``throttle_rows``, writes raise
   PleaseThrottleError until a flush/compaction shrinks it — the analog of
   HBase's PleaseThrottleException signal.
+- Checkpoint/resume (SURVEY §5.4): ``checkpoint()`` merges the memtable
+  (plus the previous spill generation) into one immutable sorted sstable
+  (storage/sstable.py), then truncates the WAL — bounding recovery time
+  and memtable RAM. On open: load sstable, then replay the WAL suffix.
+  Reads merge the tiers, memtable winning; deletes over spilled rows
+  leave tombstones (cell tombstone = None value; row tombstones in
+  ``_Table.row_tombs``) so compaction's put-then-delete-originals cycle
+  stays correct across the spill boundary.
+- Checkpoint does NOT stall ingest: under the lock it only freezes the
+  current memtable as an immutable middle tier and rotates the WAL
+  (pre-checkpoint records move to ``<wal>.old``); the dataset merge and
+  sstable write run outside the lock while writes land in a fresh
+  memtable + fresh WAL; a second brief lock swaps generations and
+  removes ``<wal>.old``. Crash at any point recovers by replaying
+  ``<wal>.old`` then the WAL over whichever sstable generation survived
+  — replay is idempotent (puts rewrite equal values, deletes re-create
+  tombstones, counter increments are logged as absolute values).
 """
 
 from __future__ import annotations
@@ -31,6 +48,7 @@ from bisect import bisect_left
 from typing import Iterator, NamedTuple
 
 from opentsdb_tpu.core.errors import PleaseThrottleError
+from opentsdb_tpu.storage.sstable import SSTable, write_sstable
 
 _REC = struct.Struct(">BI")  # op, payload length
 
@@ -85,12 +103,14 @@ class KVStore:
 
 
 class _Table:
-    __slots__ = ("rows", "sorted_keys", "dirty")
+    __slots__ = ("rows", "sorted_keys", "dirty", "row_tombs")
 
     def __init__(self) -> None:
-        self.rows: dict[bytes, dict[tuple[bytes, bytes], bytes]] = {}
+        # Cell value None = tombstone masking a spilled sstable cell.
+        self.rows: dict[bytes, dict[tuple[bytes, bytes], bytes | None]] = {}
         self.sorted_keys: list[bytes] = []
         self.dirty = False  # sorted_keys is stale
+        self.row_tombs: set[bytes] = set()  # whole-row masks over the sstable
 
     def index(self) -> list[bytes]:
         if self.dirty:
@@ -121,7 +141,26 @@ class MemKVStore(KVStore):
         self._fsync = fsync
         self._wal_path = wal_path
         self._wal: io.BufferedWriter | None = None
+        self._sst: SSTable | None = None
+        self._sst_path = wal_path + ".sst" if wal_path else None
+        # Immutable middle tier while a checkpoint merge is in flight.
+        self._frozen: dict[str, _Table] | None = None
+        if self._sst_path and os.path.exists(self._sst_path):
+            self._sst = SSTable(self._sst_path)
+            for name in self._sst.tables():
+                self._table(name)
         if wal_path:
+            # A leftover <wal>.old means a crash interrupted a checkpoint:
+            # replay it first (records older than everything in the WAL).
+            old_path = wal_path + ".old"
+            if os.path.exists(old_path):
+                old_valid = self._replay(old_path)
+                if old_valid < os.path.getsize(old_path):
+                    # Torn tail: truncate, or a later checkpoint would
+                    # append live records after the garbage where replay
+                    # can never reach them.
+                    with open(old_path, "r+b") as f:
+                        f.truncate(old_valid)
             valid_bytes = 0
             if os.path.exists(wal_path):
                 valid_bytes = self._replay(wal_path)
@@ -147,14 +186,74 @@ class MemKVStore(KVStore):
             self._table(table)
 
     def row_count(self, table: str) -> int:
-        return len(self._table(table).rows)
+        with self._lock:
+            t = self._table(table)
+            keys = set(t.rows)
+            ft = self._frozen.get(table) if self._frozen else None
+            if ft is not None:
+                keys |= set(ft.rows)
+            if self._sst is not None:
+                keys.update(self._sst.scan_keys(table, b"", None))
+            return sum(1 for k in keys if self._merged_row(table, k))
 
     def has_row(self, table: str, key: bytes) -> bool:
-        return key in self._table(table).rows
+        with self._lock:
+            row = self._table(table).rows.get(key)
+            if row:
+                # Tombstones (None cells) only exist once a lower tier
+                # does; the pure-memtable hot ingest path stays O(1).
+                if self._sst is None and self._frozen is None:
+                    return True
+                if any(v is not None for v in row.values()):
+                    return True
+            return self._merged_row(table, key) is not None
 
     def cell_count(self, table: str, key: bytes) -> int:
-        row = self._table(table).rows.get(key)
-        return len(row) if row else 0
+        with self._lock:
+            row = self._merged_row(table, key)
+            return len(row) if row else 0
+
+    def _merged_row(self, table: str,
+                    key: bytes) -> dict[tuple[bytes, bytes], bytes] | None:
+        """Lower tiers (sstable, then frozen memtable) overlaid with the
+        live memtable's cells/tombstones. Caller holds the lock."""
+        t = self._table(table)
+        if self._sst is None and self._frozen is None:
+            # No lower tiers => no tombstones possible; serve the row
+            # as-is (the default-config hot path allocates nothing).
+            return t.rows.get(key) or None
+        ft = self._frozen.get(table) if self._frozen else None
+        merged: dict[tuple[bytes, bytes], bytes] = {}
+        sst_masked = key in t.row_tombs or (
+            ft is not None and key in ft.row_tombs)
+        if self._sst is not None and not sst_masked:
+            cells = self._sst.get(table, key)
+            if cells:
+                merged = {(f, q): v for f, q, v in cells}
+        if ft is not None and key not in t.row_tombs:
+            row = ft.rows.get(key)
+            if row:
+                for ck, v in row.items():
+                    if v is None:
+                        merged.pop(ck, None)
+                    else:
+                        merged[ck] = v
+        row = t.rows.get(key)
+        if row:
+            for ck, v in row.items():
+                if v is None:
+                    merged.pop(ck, None)
+                else:
+                    merged[ck] = v
+        return merged or None
+
+    def _lower_tier_has(self, t: _Table, table: str, key: bytes) -> bool:
+        """Does any tier below the live memtable hold this key? (Decides
+        whether a delete must leave tombstones.)"""
+        ft = self._frozen.get(table) if self._frozen else None
+        if ft is not None and (key in ft.rows):
+            return True
+        return self._sst is not None and self._sst.has_key(table, key)
 
     # -- WAL --------------------------------------------------------------
 
@@ -217,6 +316,112 @@ class MemKVStore(KVStore):
                 self.flush()
                 self._wal.close()
                 self._wal = None
+            if self._sst is not None:
+                self._sst.close()
+                self._sst = None
+
+    # -- checkpoint / spill ----------------------------------------------
+
+    def checkpoint(self) -> int:
+        """Merge frozen memtable + previous spill into a new sstable
+        generation, then drop the pre-checkpoint WAL records. Returns rows
+        written (0 = not persistent / already in progress).
+
+        Three phases, designed so ingest/queries never wait on the merge:
+          1. (brief lock) freeze the memtable as an immutable middle tier,
+             rotate the WAL: pre-checkpoint records move to <wal>.old,
+             writes continue into a fresh WAL.
+          2. (no lock) stream sstable ∪ frozen — tombstones applied — into
+             a temp file, fsync, atomically rename over the generation.
+          3. (brief lock) swap in the new SSTable, discard the frozen
+             tier, unlink <wal>.old.
+        Crash-safe: <wal>.old survives until the new generation is durable
+        (sstable.write_sstable fsyncs the file AND its directory before
+        phase 3); recovery replays <wal>.old then the WAL, which is
+        idempotent over either generation.
+        """
+        if self._sst_path is None:
+            return 0
+        old_path = self._wal_path + ".old"
+        with self._lock:
+            if self._frozen is not None:
+                return 0  # merge already in flight
+            self._frozen = self._tables
+            self._tables = {name: _Table() for name in self._frozen}
+            if self._wal is not None:
+                self._wal.close()
+                if os.path.exists(old_path):
+                    # A crash-recovered .old is still live state: append the
+                    # current WAL to it rather than clobbering it.
+                    with open(old_path, "ab") as dst, \
+                            open(self._wal_path, "rb") as src:
+                        dst.write(src.read())
+                        dst.flush()
+                        os.fsync(dst.fileno())
+                    self._wal = open(self._wal_path, "wb")
+                else:
+                    os.replace(self._wal_path, old_path)
+                    self._wal = open(self._wal_path, "ab")
+            frozen, frozen_sst = self._frozen, self._sst
+
+        def merged_rows():
+            for name in sorted(frozen):
+                ft = frozen[name]
+                keys = set(ft.rows)
+                if frozen_sst is not None:
+                    keys.update(k for k in
+                                frozen_sst.scan_keys(name, b"", None)
+                                if k not in ft.row_tombs)
+                for key in sorted(keys):
+                    merged: dict[tuple[bytes, bytes], bytes] = {}
+                    if frozen_sst is not None and key not in ft.row_tombs:
+                        cells = frozen_sst.get(name, key)
+                        if cells:
+                            merged = {(f, q): v for f, q, v in cells}
+                    row = ft.rows.get(key)
+                    if row:
+                        for ck, v in row.items():
+                            if v is None:
+                                merged.pop(ck, None)
+                            else:
+                                merged[ck] = v
+                    if merged:
+                        yield (name, key,
+                               sorted((f, q, v)
+                                      for (f, q), v in merged.items()))
+
+        try:
+            n = write_sstable(self._sst_path, merged_rows())
+        except Exception:
+            # Disk full or similar mid-merge: thaw the frozen tier back
+            # under the live memtable so the store isn't wedged (a stuck
+            # _frozen would make every future checkpoint a no-op and let
+            # the WAL grow without bound). <wal>.old stays on disk; the
+            # next checkpoint appends the live WAL to it, and recovery
+            # replays .old + WAL, so durability is unaffected.
+            with self._lock:
+                for name, ft in self._frozen.items():
+                    live = self._tables[name]
+                    for k, row in ft.rows.items():
+                        if k in live.row_tombs:
+                            continue  # deleted while merge was in flight
+                        merged = dict(row)
+                        merged.update(live.rows.get(k, {}))
+                        live.rows[k] = merged
+                    live.row_tombs |= ft.row_tombs
+                    live.dirty = True
+                self._frozen = None
+            raise
+
+        with self._lock:
+            old = self._sst
+            self._sst = SSTable(self._sst_path)
+            self._frozen = None
+            if old is not None:
+                old.close()
+            if os.path.exists(old_path):
+                os.unlink(old_path)
+        return n
 
     # -- mutation ---------------------------------------------------------
 
@@ -232,11 +437,19 @@ class MemKVStore(KVStore):
     def _apply_delete(self, table: str, key: bytes, family: bytes,
                       qualifiers: list[bytes]) -> None:
         t = self._table(table)
+        spilled = (key not in t.row_tombs
+                   and self._lower_tier_has(t, table, key))
         row = t.rows.get(key)
         if row is None:
-            return
+            if not spilled:
+                return
+            row = t.rows[key] = {}
+            t.dirty = True
         for q in qualifiers:
-            row.pop((family, q), None)
+            if spilled:
+                row[(family, q)] = None  # tombstone masks the sstable cell
+            else:
+                row.pop((family, q), None)
         if not row:
             del t.rows[key]
             t.dirty = True
@@ -245,6 +458,8 @@ class MemKVStore(KVStore):
         t = self._table(table)
         if t.rows.pop(key, None) is not None:
             t.dirty = True
+        if self._lower_tier_has(t, table, key):
+            t.row_tombs.add(key)
 
     def _check_throttle(self, table: str, key: bytes) -> None:
         # Only throttle puts that would create a NEW row: updates to
@@ -282,7 +497,7 @@ class MemKVStore(KVStore):
     def get(self, table: str, key: bytes,
             family: bytes | None = None) -> list[Cell]:
         with self._lock:
-            row = self._table(table).rows.get(key)
+            row = self._merged_row(table, key)
             if not row:
                 return []
             cells = [Cell(key, f, q, v) for (f, q), v in row.items()
@@ -305,15 +520,32 @@ class MemKVStore(KVStore):
         """
         pattern = re.compile(key_regexp, re.S) if key_regexp else None
         with self._lock:
-            index = self._table(table).index()
+            t = self._table(table)
+            index = t.index()
             lo = bisect_left(index, start)
             hi = bisect_left(index, stop) if stop else len(index)
             keys = index[lo:hi]
+            ft = self._frozen.get(table) if self._frozen else None
+            extra = set()
+            if ft is not None:
+                fidx = ft.index()
+                flo = bisect_left(fidx, start)
+                fhi = bisect_left(fidx, stop) if stop else len(fidx)
+                extra.update(k for k in fidx[flo:fhi]
+                             if k not in t.rows and k not in t.row_tombs)
+            if self._sst is not None:
+                extra.update(
+                    k for k in self._sst.scan_keys(table, start, stop)
+                    if k not in t.rows and k not in t.row_tombs
+                    and not (ft is not None and (k in ft.rows
+                                                 or k in ft.row_tombs)))
+            if extra:
+                keys = sorted(set(keys) | extra)
         for key in keys:
             if pattern is not None and not pattern.match(key):
                 continue
             with self._lock:
-                row = self._table(table).rows.get(key)
+                row = self._merged_row(table, key)
                 if not row:
                     continue
                 cells = [Cell(key, f, q, v) for (f, q), v in row.items()
@@ -329,7 +561,7 @@ class MemKVStore(KVStore):
         """Increment an 8-byte big-endian counter cell, returning the new
         value (initialized from 0 like HBase's ICV)."""
         with self._lock:
-            row = self._table(table).rows.get(key)
+            row = self._merged_row(table, key)
             cur = row.get((family, qualifier)) if row else None
             value = (struct.unpack(">q", cur)[0] if cur else 0) + amount
             packed = struct.pack(">q", value)
@@ -344,7 +576,7 @@ class MemKVStore(KVStore):
         """Atomic CAS: write only if the cell currently equals ``expected``
         (None = cell must not exist). Returns success."""
         with self._lock:
-            row = self._table(table).rows.get(key)
+            row = self._merged_row(table, key)
             cur = row.get((family, qualifier)) if row else None
             if cur != expected:
                 return False

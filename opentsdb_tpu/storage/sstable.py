@@ -1,0 +1,182 @@
+"""Immutable sorted-table file: the spill tier under the memtable.
+
+The reference delegates at-rest storage to HBase HFiles; here a checkpoint
+merges the memtable (and the previous generation, if any) into ONE sorted
+immutable file per store, after which the WAL is truncated — bounding both
+recovery time and memtable RAM for long-running daemons (SURVEY §5.4,
+§7.2: "enough LSM to sustain ingest while scans run, without rebuilding
+HBase").
+
+File layout (all integers big-endian):
+    magic  b"TSST1"
+    record*  :=  [u16 table_len][table][u16 key_len][key][u32 ncells]
+                 ([u16 fam_len][fam][u16 q_len][q][u32 v_len][v])*
+    records sorted by (table, key); one record per row.
+
+The reader mmaps the file and keeps only (key -> offset) indexes in RAM;
+cell payloads are decoded lazily per row, so a spilled store serves gets
+and scans without rehydrating the dataset.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+from bisect import bisect_left
+from typing import Iterable, Iterator
+
+_MAGIC = b"TSST1"
+_U16 = struct.Struct(">H")
+_U32 = struct.Struct(">I")
+
+# row := (table, key, [(family, qualifier, value), ...])
+Row = tuple[str, bytes, list[tuple[bytes, bytes, bytes]]]
+
+
+def write_sstable(path: str, rows: Iterable[Row]) -> int:
+    """Write rows (pre-sorted by (table, key)) to a new sstable at `path`.
+
+    Returns the number of rows written. Writes via a temp file + atomic
+    rename so a crash mid-write never corrupts the previous generation.
+    """
+    tmp = path + ".tmp"
+    n = 0
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        for table, key, cells in rows:
+            tb = table.encode()
+            parts = [_U16.pack(len(tb)), tb, _U16.pack(len(key)), key,
+                     _U32.pack(len(cells))]
+            for fam, qual, value in cells:
+                parts += [_U16.pack(len(fam)), fam, _U16.pack(len(qual)),
+                          qual, _U32.pack(len(value)), value]
+            f.write(b"".join(parts))
+            n += 1
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    # Make the rename itself durable before the caller truncates its WAL:
+    # without the directory fsync a power loss could surface the OLD
+    # generation alongside an already-truncated WAL.
+    dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    return n
+
+
+class SSTable:
+    """mmap-backed reader over one sstable generation."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._f = open(path, "rb")
+        size = os.fstat(self._f.fileno()).st_size
+        self._mm = mmap.mmap(self._f.fileno(), size, access=mmap.ACCESS_READ)
+        if self._mm[:len(_MAGIC)] != _MAGIC:
+            raise IOError(f"{path}: bad sstable magic")
+        # table -> (sorted keys, parallel row offsets)
+        self._index: dict[str, tuple[list[bytes], list[int]]] = {}
+        self._build_index()
+
+    def _build_index(self) -> None:
+        mm, off, end = self._mm, len(_MAGIC), len(self._mm)
+        while off < end:
+            start = off
+            (tlen,) = _U16.unpack_from(mm, off)
+            off += 2
+            table = mm[off:off + tlen].decode()
+            off += tlen
+            (klen,) = _U16.unpack_from(mm, off)
+            off += 2
+            key = bytes(mm[off:off + klen])
+            off += klen
+            (ncells,) = _U32.unpack_from(mm, off)
+            off += 4
+            for _ in range(ncells):
+                (flen,) = _U16.unpack_from(mm, off)
+                off += 2 + flen
+                (qlen,) = _U16.unpack_from(mm, off)
+                off += 2 + qlen
+                (vlen,) = _U32.unpack_from(mm, off)
+                off += 4 + vlen
+            keys, offs = self._index.setdefault(table, ([], []))
+            keys.append(key)
+            offs.append(start)
+
+    def close(self) -> None:
+        self._mm.close()
+        self._f.close()
+
+    def tables(self) -> list[str]:
+        return list(self._index)
+
+    def key_count(self, table: str) -> int:
+        idx = self._index.get(table)
+        return len(idx[0]) if idx else 0
+
+    def has_key(self, table: str, key: bytes) -> bool:
+        idx = self._index.get(table)
+        if not idx:
+            return False
+        keys, _ = idx
+        i = bisect_left(keys, key)
+        return i < len(keys) and keys[i] == key
+
+    def _read_row(self, off: int) -> list[tuple[bytes, bytes, bytes]]:
+        mm = self._mm
+        (tlen,) = _U16.unpack_from(mm, off)
+        off += 2 + tlen
+        (klen,) = _U16.unpack_from(mm, off)
+        off += 2 + klen
+        (ncells,) = _U32.unpack_from(mm, off)
+        off += 4
+        cells = []
+        for _ in range(ncells):
+            (flen,) = _U16.unpack_from(mm, off)
+            off += 2
+            fam = bytes(mm[off:off + flen])
+            off += flen
+            (qlen,) = _U16.unpack_from(mm, off)
+            off += 2
+            qual = bytes(mm[off:off + qlen])
+            off += qlen
+            (vlen,) = _U32.unpack_from(mm, off)
+            off += 4
+            value = bytes(mm[off:off + vlen])
+            off += vlen
+            cells.append((fam, qual, value))
+        return cells
+
+    def get(self, table: str,
+            key: bytes) -> list[tuple[bytes, bytes, bytes]] | None:
+        """Cells of one row, or None when the key is absent."""
+        idx = self._index.get(table)
+        if not idx:
+            return None
+        keys, offs = idx
+        i = bisect_left(keys, key)
+        if i >= len(keys) or keys[i] != key:
+            return None
+        return self._read_row(offs[i])
+
+    def scan_keys(self, table: str, start: bytes,
+                  stop: bytes | None) -> list[bytes]:
+        idx = self._index.get(table)
+        if not idx:
+            return []
+        keys, _ = idx
+        lo = bisect_left(keys, start)
+        hi = bisect_left(keys, stop) if stop else len(keys)
+        return keys[lo:hi]
+
+    def iter_rows(self, table: str) -> Iterator[
+            tuple[bytes, list[tuple[bytes, bytes, bytes]]]]:
+        idx = self._index.get(table)
+        if not idx:
+            return
+        keys, offs = idx
+        for key, off in zip(keys, offs):
+            yield key, self._read_row(off)

@@ -54,6 +54,7 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         cfg.staticroot = args.staticroot
         cfg.cachedir = args.cachedir
         cfg.flush_interval = args.flush_interval
+        cfg.checkpoint_interval = getattr(args, "checkpoint_interval", 0.0)
     store = MemKVStore(wal_path=args.wal)
     return TSDB(store, cfg, start_compaction_thread=start_thread)
 
@@ -440,6 +441,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--staticroot", default=None)
     p.add_argument("--cachedir", default=None)
     p.add_argument("--flush-interval", type=float, default=10.0)
+    p.add_argument("--checkpoint-interval", type=float, default=0.0,
+                   help="seconds between sstable spills + WAL truncation "
+                        "(0 disables; requires --wal)")
     p.set_defaults(fn=cmd_tsd)
 
     p = sub.add_parser("import", help="bulk import text files")

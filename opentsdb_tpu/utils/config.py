@@ -26,6 +26,7 @@ class Config:
     auto_create_metrics: bool = False   # tsd.core.auto_create_metrics
     enable_compactions: bool = True     # tsd.feature.compactions
     flush_interval: float = 10.0        # compaction thread wake period (s)
+    checkpoint_interval: float = 0.0    # spill+WAL-truncate period (s); 0=off
     compaction_min_flush_threshold: int = 100
     compaction_max_concurrent_flushes: int = 10_000
     compaction_flush_speed: int = 2
