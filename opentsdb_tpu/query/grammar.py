@@ -3,7 +3,9 @@
 Parity: reference GraphHandler.parseQuery (:828-879) —
 ``agg:[interval-agg:][rate:]metric[{tag=value,...}]`` where the optional
 middle parts may appear in either order; tag values support ``*`` (group
-by all values) and ``v1|v2`` (group by listed values).
+by all values) and ``v1|v2`` (group by listed values). Extension beyond
+the 1.1 reference: ``rate{counter[,max[,reset]]}`` rollover options (the
+2.x syntax), since the executor's rate kernel handles counter wraps.
 """
 
 from __future__ import annotations
@@ -29,6 +31,31 @@ class ParsedMetric(NamedTuple):
     tags: dict[str, str]
     rate: bool
     downsample: tuple[int, str] | None  # (interval_seconds, agg)
+    counter: bool = False
+    counter_max: float = float(2 ** 64)
+    reset_value: float | None = None
+
+
+def _parse_rate_options(part: str, expr: str) -> tuple[bool, float,
+                                                       float | None]:
+    """``rate{counter[,max[,reset]]}`` -> (counter, counter_max, reset)."""
+    body = part[len("rate{"):-1]
+    fields = body.split(",") if body else []
+    if not fields or fields[0] != "counter":
+        raise BadRequestError(f"Invalid rate options: {part} in m={expr}")
+    counter_max = float(2 ** 64)
+    reset: float | None = None
+    try:
+        if len(fields) > 1 and fields[1]:
+            counter_max = float(fields[1])
+        if len(fields) > 2 and fields[2]:
+            reset = float(fields[2])
+        if len(fields) > 3:
+            raise ValueError("too many rate options")
+    except ValueError as e:
+        raise BadRequestError(
+            f"Invalid rate options: {part} in m={expr}: {e}") from None
+    return True, counter_max, reset
 
 
 def parse_m(expr: str) -> ParsedMetric:
@@ -41,10 +68,17 @@ def parse_m(expr: str) -> ParsedMetric:
     _validate_agg(agg)
 
     rate = False
+    counter = False
+    counter_max = float(2 ** 64)
+    reset_value: float | None = None
     downsample = None
     for part in parts[1:-1]:
         if part == "rate":
             rate = True
+        elif part.startswith("rate{") and part.endswith("}"):
+            rate = True
+            counter, counter_max, reset_value = _parse_rate_options(
+                part, expr)
         elif "-" in part:
             interval_s, _, ds_agg = part.partition("-")
             interval = parse_duration(interval_s)
@@ -61,4 +95,5 @@ def parse_m(expr: str) -> ParsedMetric:
         metric = tags_mod.parse_with_metric(parts[-1], tag_map)
     except ValueError as e:
         raise BadRequestError(str(e)) from None
-    return ParsedMetric(agg, metric, tag_map, rate, downsample)
+    return ParsedMetric(agg, metric, tag_map, rate, downsample,
+                        counter, counter_max, reset_value)

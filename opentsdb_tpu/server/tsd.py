@@ -336,6 +336,12 @@ class TSDServer:
             return self._static_file(path[2:].lstrip("/"))
         route = path.rstrip("/") or "/"
         if route == "/":
+            # Serve the query UI (reference: HomePage bootstraps the GWT
+            # client, RpcHandler.java:304-317); cache headers omitted so
+            # UI updates take effect immediately.
+            status, ctype, body, _hdrs = self._static_file("index.html")
+            if status == 200:
+                return status, ctype, body, {}
             return (200, "text/html; charset=UTF-8",
                     self._homepage().encode(), {})
         if route == "/aggregators":
@@ -433,7 +439,9 @@ class TSDServer:
             spec = QuerySpec(
                 metric=parsed.metric, tags=parsed.tags,
                 aggregator=parsed.aggregator, rate=parsed.rate,
-                downsample=parsed.downsample)
+                downsample=parsed.downsample, counter=parsed.counter,
+                counter_max=parsed.counter_max,
+                reset_value=parsed.reset_value)
             rs = await loop.run_in_executor(
                 self._pool, self.executor.run, spec, start, end)
             results.extend(rs)
@@ -548,20 +556,36 @@ class TSDServer:
 
     # -- static files / home page --------------------------------------
 
+    # Packaged web UI (the GWT-client replacement): used when no
+    # --staticroot is configured, or as a fallback below a custom root.
+    _PACKAGED_STATIC = os.path.join(os.path.dirname(__file__), "static")
+
     def _static_file(self, rel: str) -> tuple:
-        root = self.config.staticroot
-        if root is None:
-            raise BadRequestError("No static root configured", 404)
         if ".." in rel:
             raise BadRequestError("Malformed path", 404)
-        path = os.path.join(root, rel)
-        if not os.path.isfile(path):
+        rel = rel or "index.html"
+        path = None
+        for root in (self.config.staticroot, self._PACKAGED_STATIC):
+            if root is None:
+                continue
+            cand = os.path.join(root, rel)
+            if os.path.isfile(cand):
+                path = cand
+                break
+        if path is None:
             return 404, "text/plain", b"File Not Found\n", {}
         with open(path, "rb") as f:
             body = f.read()
         ext = os.path.splitext(path)[1]
         ctype = _CONTENT_TYPES.get(ext, "application/octet-stream")
-        return 200, ctype, body, {"Cache-Control": "max-age=31536000"}
+        if path.startswith(self._PACKAGED_STATIC):
+            # Packaged UI files aren't content-hashed: an upgrade must
+            # reach browsers. Only operator staticroot assets (hashed GWT
+            # style) earn the year-long header (reference :30-54).
+            hdrs = {"Cache-Control": "no-cache"}
+        else:
+            hdrs = {"Cache-Control": "max-age=31536000"}
+        return 200, ctype, body, hdrs
 
     def _homepage(self) -> str:
         return f"""<html><head><title>TSD (opentsdb_tpu)</title></head>

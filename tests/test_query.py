@@ -183,10 +183,24 @@ class TestGrammar:
     @pytest.mark.parametrize("bad", [
         "sys.cpu.user", "bogus:sys.cpu.user", "sum:10x-avg:m",
         "sum:10m-p95:m", "sum:wat:m{a=b}", "",
+        "sum:rate{}:m", "sum:rate{bogus}:m", "sum:rate{counter,x}:m",
+        "sum:rate{counter,1,2,3}:m",
     ])
     def test_rejects(self, bad):
         with pytest.raises(BadRequestError):
             parse_m(bad)
+
+    def test_rate_counter_options(self):
+        p = parse_m("sum:rate{counter}:m")
+        assert p.rate and p.counter
+        assert p.counter_max == float(2 ** 64) and p.reset_value is None
+        p = parse_m("sum:rate{counter,1000}:m")
+        assert p.counter and p.counter_max == 1000.0
+        p = parse_m("sum:rate{counter,1000,50}:m")
+        assert (p.counter_max, p.reset_value) == (1000.0, 50.0)
+        # plain rate unchanged
+        p = parse_m("sum:rate:m")
+        assert p.rate and not p.counter
 
     def test_run_validates_range(self, tsdb):
         with pytest.raises(BadRequestError):

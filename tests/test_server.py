@@ -233,6 +233,38 @@ class TestHttp:
         assert trav[0] == 404
         assert missing[0] == 404
 
+    def test_packaged_ui_served(self, server_env):
+        """/ and /s/index.html serve the packaged query UI even though
+        the configured staticroot doesn't contain an index.html."""
+        server, _ = server_env
+
+        async def drive(port):
+            home = await http_get(port, "/")
+            via_s = await http_get(port, "/s/index.html")
+            bare = await http_get(port, "/s")
+            return home, via_s, bare
+
+        home, via_s, bare = run_async(server, drive)
+        assert home[0] == 200 and b"opentsdb_tpu" in home[2]
+        assert b"metric-template" in home[2]  # it's the UI, not the stub
+        assert via_s[0] == 200 and via_s[2] == home[2]
+        assert b"text/html" in via_s[1]
+        assert b"no-cache" in via_s[1]  # packaged UI must not cache 1yr
+        assert bare[0] == 200 and bare[2] == home[2]
+
+    def test_staticroot_overrides_packaged_ui(self, tmp_path):
+        cfg = Config(auto_create_metrics=True, port=0, bind="127.0.0.1",
+                     staticroot=str(tmp_path))
+        (tmp_path / "index.html").write_text("<html>custom</html>")
+        tsdb = TSDB(MemKVStore(), cfg, start_compaction_thread=False)
+        server = TSDServer(tsdb)
+
+        async def drive(port):
+            return await http_get(port, "/")
+
+        _, _, body = run_async(server, drive)
+        assert body == b"<html>custom</html>"
+
     def test_version_stats_logs(self, server_env):
         server, _ = server_env
 
