@@ -1,0 +1,241 @@
+"""Expert parallelism: route aggregator families to device groups.
+
+The reference has no MoE-style structure (SURVEY.md §2.9); its nearest
+behavior is that a mixed dashboard request (`/q` with several `m=` specs,
+reference src/tsd/GraphHandler.java:155-187) runs each sub-query's
+aggregator sequentially on one CPU thread. The TPU-native analog planned
+in SURVEY §2.9 is genuine expert parallelism: when one batch of queries
+mixes aggregator *families* — moment reductions (sum/min/max/avg/dev/
+count), t-digest percentiles, HLL cardinality — partition the mesh into
+device groups, one per family, and run every family concurrently under a
+single jit. Each chip traces all three family kernels but executes only
+its own (``lax.switch`` on the device's routed family id), so a mixed
+batch costs max(family) wall-clock instead of sum(family).
+
+Shapes are the usual EP trade: all families share one padded slot layout
+([D, Q, N] point arrays, [D, Q, OUT] results) so the routed computation
+stays static-shaped for XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from opentsdb_tpu.ops import sketches
+from opentsdb_tpu.ops.kernels import downsample_group
+from opentsdb_tpu.parallel.mesh import EXPERT_AXIS
+
+FAMILIES = ("moment", "percentile", "cardinality")
+FAMILY_ID = {name: i for i, name in enumerate(FAMILIES)}
+
+
+class MomentSpec(NamedTuple):
+    """Static params shared by the moment-family queries in a batch."""
+    num_series: int
+    num_buckets: int
+    interval: int
+    agg_down: str = "avg"
+    agg_group: str = "sum"
+
+
+class PercentileSpec(NamedTuple):
+    qs: tuple = (0.5, 0.95, 0.99)
+    compression: int = sketches.DEFAULT_COMPRESSION
+
+
+class CardinalitySpec(NamedTuple):
+    p: int = sketches.DEFAULT_HLL_P
+
+
+class ExpertSpecs(NamedTuple):
+    moment: MomentSpec
+    percentile: PercentileSpec = PercentileSpec()
+    cardinality: CardinalitySpec = CardinalitySpec()
+
+    def out_len(self) -> int:
+        return max(self.moment.num_buckets, len(self.percentile.qs), 1)
+
+
+class ExpertPlan(NamedTuple):
+    """Host-side routing: which (device, slot) runs which query."""
+    fam: np.ndarray          # [D] int32 family id per device
+    ts: np.ndarray           # [D, Q, N] int32
+    vals: np.ndarray         # [D, Q, N] float32
+    items: np.ndarray        # [D, Q, N] int32 (cardinality hash inputs)
+    sid: np.ndarray          # [D, Q, N] int32
+    valid: np.ndarray        # [D, Q, N] bool
+    slot_of: list            # query index -> (device, slot)
+
+
+def plan_expert_batch(queries: Sequence[dict], n_devices: int) -> ExpertPlan:
+    """Route a mixed query batch onto device groups by aggregator family.
+
+    Each query dict: {"family": str, "ts": [n], "vals": [n], "sid": [n]}
+    (moment) or {"family": "percentile"|"cardinality", "vals"|"items": [n]}.
+    Devices are split proportionally to each present family's query count
+    (every present family gets at least one device); queries round-robin
+    within their family's group.
+    """
+    for qi, q in enumerate(queries):
+        if q["family"] not in FAMILY_ID:
+            raise ValueError(
+                f"query {qi}: unknown family {q['family']!r} "
+                f"(expected one of {FAMILIES})")
+    present = [f for f in FAMILIES if any(q["family"] == f for q in queries)]
+    if not present:
+        raise ValueError("empty query batch")
+    if n_devices < len(present):
+        raise ValueError(
+            f"{len(present)} families need >= that many devices, "
+            f"have {n_devices}")
+    counts = {f: sum(q["family"] == f for q in queries) for f in present}
+    total = sum(counts.values())
+    # Proportional split, >=1 each, remainder to the largest families.
+    alloc = {f: max(1, n_devices * counts[f] // total) for f in present}
+    while sum(alloc.values()) > n_devices:
+        alloc[max(alloc, key=lambda f: alloc[f])] -= 1
+    while sum(alloc.values()) < n_devices:
+        alloc[max(present, key=lambda f: counts[f] / alloc[f])] += 1
+
+    dev_fam = []
+    group_devs: dict[str, list[int]] = {}
+    for f in present:
+        group_devs[f] = list(range(len(dev_fam), len(dev_fam) + alloc[f]))
+        dev_fam += [FAMILY_ID[f]] * alloc[f]
+
+    slots: list[list[int]] = [[] for _ in range(n_devices)]
+    slot_of: list[tuple[int, int]] = []
+    rr = {f: 0 for f in present}
+    for qi, q in enumerate(queries):
+        devs = group_devs[q["family"]]
+        d = devs[rr[q["family"]] % len(devs)]
+        rr[q["family"]] += 1
+        slot_of.append((d, len(slots[d])))
+        slots[d].append(qi)
+
+    q_max = max(len(s) for s in slots)
+    n_max = max(
+        (len(np.atleast_1d(q.get("vals", q.get("items", [0.0])))) for q in
+         queries), default=1)
+    n_max = max(n_max, 1)
+    shape = (n_devices, q_max, n_max)
+    ts = np.zeros(shape, np.int32)
+    vals = np.zeros(shape, np.float32)
+    items = np.zeros(shape, np.int32)
+    sid = np.zeros(shape, np.int32)
+    valid = np.zeros(shape, bool)
+    for d, devq in enumerate(slots):
+        for s, qi in enumerate(devq):
+            q = queries[qi]
+            if q["family"] == "cardinality":
+                arr = np.asarray(q["items"])
+                items[d, s, :len(arr)] = arr
+                n = len(arr)
+            else:
+                v = np.asarray(q["vals"], np.float32)
+                vals[d, s, :len(v)] = v
+                n = len(v)
+                if q["family"] == "moment":
+                    t = np.asarray(q["ts"], np.int32)
+                    ts[d, s, :len(t)] = t
+                    sid[d, s, :len(t)] = np.asarray(q["sid"], np.int32)
+            valid[d, s, :n] = True
+    return ExpertPlan(np.asarray(dev_fam, np.int32), ts, vals, items, sid,
+                      valid, slot_of)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "specs"))
+def expert_query_step(fam, ts, vals, items, sid, valid, *, mesh,
+                      specs: ExpertSpecs):
+    """One mixed-family batch over the mesh's expert axis.
+
+    fam [D]; point arrays [D, Q, N]. Returns (values [D, Q, OUT],
+    mask [D, Q, OUT]) — device d's rows hold that device's routed
+    queries, trimmed by the mask.
+    """
+    out = specs.out_len()
+    mspec, pspec, cspec = specs.moment, specs.percentile, specs.cardinality
+    qs = jnp.asarray(pspec.qs, jnp.float32)
+
+    def pad_to(v, m):
+        return (jnp.pad(v, ((0, 0), (0, out - v.shape[1]))),
+                jnp.pad(m, ((0, 0), (0, out - m.shape[1]))))
+
+    def run_moment(ts, vals, items, sid, valid):
+        def one(args):
+            t, v, s, m = args
+            r = downsample_group(
+                t, v, s, m, num_series=mspec.num_series,
+                num_buckets=mspec.num_buckets, interval=mspec.interval,
+                agg_down=mspec.agg_down, agg_group=mspec.agg_group)
+            return r["group_values"], r["group_mask"]
+        gv, gm = jax.lax.map(one, (ts, vals, sid, valid))
+        return pad_to(gv, gm)
+
+    def run_percentile(ts, vals, items, sid, valid):
+        def one(args):
+            _, v, _, m = args
+            means, weights = sketches.tdigest_init(pspec.compression)
+            means, weights = sketches.tdigest_add(
+                means, weights, v, m, compression=pspec.compression)
+            return sketches.tdigest_quantile(means, weights, qs)
+        qv = jax.lax.map(one, (ts, vals, sid, valid))
+        return pad_to(qv, jnp.ones_like(qv, bool))
+
+    def run_cardinality(ts, vals, items, sid, valid):
+        def one(args):
+            t, _, it, m = args
+            regs = sketches.hll_init(cspec.p)
+            regs = sketches.hll_add(regs, it, m, p=cspec.p)
+            return sketches.hll_estimate(regs)[None]
+        cv = jax.lax.map(
+            one, (ts, vals, items, valid))
+        return pad_to(cv, jnp.ones_like(cv, bool))
+
+    def shard_fn(fam, ts, vals, items, sid, valid):
+        my_fam = fam[0]
+        v, m = jax.lax.switch(
+            my_fam,
+            [run_moment, run_percentile, run_cardinality],
+            ts[0], vals[0], items[0], sid[0], valid[0])
+        return v[None], m[None]
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(EXPERT_AXIS),) * 6,
+        out_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS)))
+    return fn(fam, ts, vals, items, sid, valid)
+
+
+def run_mixed_batch(queries: Sequence[dict], mesh, specs: ExpertSpecs):
+    """Plan, execute, and unpack a mixed aggregator batch.
+
+    Returns one numpy array per query: moment queries get their [B] group
+    values (masked entries NaN), percentile queries their quantiles,
+    cardinality queries a scalar estimate.
+    """
+    plan = plan_expert_batch(queries, n_devices=mesh.devices.size)
+    values, mask = expert_query_step(
+        plan.fam, plan.ts, plan.vals, plan.items, plan.sid, plan.valid,
+        mesh=mesh, specs=specs)
+    values = np.asarray(values)
+    mask = np.asarray(mask)
+    results = []
+    for qi, q in enumerate(queries):
+        d, s = plan.slot_of[qi]
+        row, rm = values[d, s], mask[d, s]
+        if q["family"] == "moment":
+            out = np.where(rm[:specs.moment.num_buckets],
+                           row[:specs.moment.num_buckets], np.nan)
+        elif q["family"] == "percentile":
+            out = row[:len(specs.percentile.qs)]
+        else:
+            out = row[0]
+        results.append(out)
+    return results

@@ -8,6 +8,7 @@ from jax.sharding import Mesh
 
 SERIES_AXIS = "series"  # data-parallel axis: series blocks across chips
 TIME_AXIS = "time"      # sequence-parallel axis: contiguous time tiles
+EXPERT_AXIS = "expert"  # expert axis: aggregator families across chips
 
 
 def make_mesh(n_devices: int | None = None,

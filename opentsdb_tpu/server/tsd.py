@@ -337,11 +337,11 @@ class TSDServer:
         route = path.rstrip("/") or "/"
         if route == "/":
             # Serve the query UI (reference: HomePage bootstraps the GWT
-            # client, RpcHandler.java:304-317); cache headers omitted so
+            # client, RpcHandler.java:304-317) with its no-cache header so
             # UI updates take effect immediately.
-            status, ctype, body, _hdrs = self._static_file("index.html")
+            status, ctype, body, hdrs = self._static_file("index.html")
             if status == 200:
-                return status, ctype, body, {}
+                return status, ctype, body, hdrs
             return (200, "text/html; charset=UTF-8",
                     self._homepage().encode(), {})
         if route == "/aggregators":

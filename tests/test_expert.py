@@ -1,0 +1,113 @@
+"""Expert-parallel mixed-aggregator batches on the virtual 8-device mesh.
+
+Routing families to device groups is an execution strategy, never a
+semantics change: every query's answer must match running its family's
+kernel directly (and the exact numpy oracle where one exists).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from opentsdb_tpu.ops import kernels, sketches
+from opentsdb_tpu.parallel.expert import (
+    CardinalitySpec,
+    ExpertSpecs,
+    MomentSpec,
+    PercentileSpec,
+    plan_expert_batch,
+    run_mixed_batch,
+)
+from opentsdb_tpu.parallel.mesh import EXPERT_AXIS, make_mesh
+
+RNG = np.random.default_rng(11)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return make_mesh(8, axis=EXPERT_AXIS)
+
+
+def moment_query(n_series=6, n_points=200, span=3600):
+    ts = RNG.integers(0, span, n_points).astype(np.int32)
+    vals = RNG.normal(40.0, 8.0, n_points).astype(np.float32)
+    sid = RNG.integers(0, n_series, n_points).astype(np.int32)
+    return {"family": "moment", "ts": ts, "vals": vals, "sid": sid}
+
+
+def percentile_query(n=4000):
+    return {"family": "percentile",
+            "vals": RNG.normal(100.0, 25.0, n).astype(np.float32)}
+
+
+def cardinality_query(n=5000, distinct=700):
+    return {"family": "cardinality",
+            "items": RNG.integers(0, distinct, n).astype(np.int32)}
+
+
+SPECS = ExpertSpecs(
+    moment=MomentSpec(num_series=6, num_buckets=12, interval=300,
+                      agg_down="avg", agg_group="sum"),
+    percentile=PercentileSpec(qs=(0.5, 0.95), compression=128),
+    cardinality=CardinalitySpec(p=12),
+)
+
+
+class TestPlan:
+    def test_every_present_family_gets_a_device(self):
+        queries = ([moment_query() for _ in range(5)]
+                   + [percentile_query(100)]
+                   + [cardinality_query(100)])
+        plan = plan_expert_batch(queries, 8)
+        assert sorted(set(plan.fam.tolist())) == [0, 1, 2]
+        assert len(plan.fam) == 8
+        # Each query landed on a device of its own family.
+        for qi, q in enumerate(queries):
+            d, _ = plan.slot_of[qi]
+            assert plan.fam[d] == {"moment": 0, "percentile": 1,
+                                   "cardinality": 2}[q["family"]]
+
+    def test_allocation_tracks_load(self):
+        queries = [moment_query() for _ in range(14)] + [percentile_query(50)]
+        plan = plan_expert_batch(queries, 8)
+        assert (plan.fam == 0).sum() > (plan.fam == 1).sum()
+
+    def test_too_few_devices_rejected(self):
+        queries = [moment_query(), percentile_query(10),
+                   cardinality_query(10)]
+        with pytest.raises(ValueError):
+            plan_expert_batch(queries, 2)
+
+
+class TestMixedBatch:
+    def test_matches_direct_kernels(self, mesh):
+        m_queries = [moment_query() for _ in range(4)]
+        p_queries = [percentile_query() for _ in range(2)]
+        c_queries = [cardinality_query() for _ in range(2)]
+        queries = m_queries + p_queries + c_queries
+        results = run_mixed_batch(queries, mesh, SPECS)
+
+        for q, got in zip(m_queries, results[:4]):
+            ref = kernels.downsample_group(
+                q["ts"], q["vals"], q["sid"],
+                np.ones(len(q["ts"]), bool), num_series=6, num_buckets=12,
+                interval=300, agg_down="avg", agg_group="sum")
+            want = np.where(np.asarray(ref["group_mask"]),
+                            np.asarray(ref["group_values"]), np.nan)
+            np.testing.assert_allclose(got, want, rtol=1e-5, equal_nan=True)
+
+        for q, got in zip(p_queries, results[4:6]):
+            exact = np.quantile(q["vals"], [0.5, 0.95])
+            np.testing.assert_allclose(got, exact, rtol=0.05)
+
+        for q, got in zip(c_queries, results[6:]):
+            exact = len(np.unique(q["items"]))
+            assert abs(got - exact) / exact < 0.1
+
+    def test_single_family_batch(self, mesh):
+        queries = [percentile_query() for _ in range(3)]
+        results = run_mixed_batch(queries, mesh, SPECS)
+        for q, got in zip(queries, results):
+            np.testing.assert_allclose(
+                got, np.quantile(q["vals"], [0.5, 0.95]), rtol=0.05)
