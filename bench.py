@@ -114,9 +114,12 @@ def bench_ingest(num_series: int, points_per_series: int, span: int):
     buf = ("\n".join(lines) + "\n").encode()
     tsdb3 = TSDB(MemKVStore(), Config(auto_create_metrics=True),
                  start_compaction_thread=False)
+    # Two-stage decode/ingest pipeline over socket-read-sized chunks
+    # (decode of chunk N+1 overlaps ingest of batch N).
+    chunk_size = 1 << 22
+    chunks = [buf[i:i + chunk_size] for i in range(0, len(buf), chunk_size)]
     t0 = time.perf_counter()
-    batch = wire.decode_puts(buf)
-    n, _ = wire.ingest_batch(tsdb3, batch)
+    n, _ = wire.pipelined_ingest(tsdb3, chunks)
     telnet_dt = time.perf_counter() - t0
     telnet_rate = n / telnet_dt
 

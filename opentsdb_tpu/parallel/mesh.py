@@ -9,6 +9,7 @@ from jax.sharding import Mesh
 SERIES_AXIS = "series"  # data-parallel axis: series blocks across chips
 TIME_AXIS = "time"      # sequence-parallel axis: contiguous time tiles
 EXPERT_AXIS = "expert"  # expert axis: aggregator families across chips
+HOST_AXIS = "host"      # multi-host axis: collectives here cross DCN
 
 
 def make_mesh(n_devices: int | None = None,

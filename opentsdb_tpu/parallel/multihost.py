@@ -1,0 +1,203 @@
+"""Multi-host scale-out: hybrid ICI x DCN meshes and hierarchical merges.
+
+The reference scales out by running many independent TSD daemons over one
+HBase cluster, with all inter-node I/O delegated to asynchbase RPC over
+TCP + ZooKeeper discovery (SURVEY.md §2.9 'Distributed comm backend';
+reference third_party/hbase/include.mk, src/core/TSDB.java:479-494). The
+TPU-native equivalent keeps that shape — many ingest frontends, one
+logical store — but replaces the RPC mesh with XLA collectives over a
+2-D device mesh:
+
+- axis ``series`` (inner): the chips of one host/pod slice, connected by
+  ICI. Per-bucket partial moments, HLL registers, and t-digest centroids
+  merge here first — high-bandwidth, low-latency.
+- axis ``host`` (outer): across hosts, connected by DCN. Only the tiny
+  already-reduced partials cross this axis ([B]-bucket moment rows,
+  compression-bounded digests), never raw points.
+
+Bootstrap: ``init_multihost`` wraps ``jax.distributed.initialize`` — the
+controller-per-host model (each process sees its local chips; collectives
+span all of them). Single-process runs (tests, the virtual CPU mesh, the
+driver's dryrun) skip initialize and still exercise the same 2-D mesh and
+collective program, which is what makes the multi-host path testable on
+one machine.
+
+Hierarchical moment combination is exact (Chan et al. pairwise update at
+each level); sketch merges are the usual bounded-error unions, with the
+host-level recompress bounding DCN bytes at O(compression) per digest
+regardless of point count.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from opentsdb_tpu.ops import sketches
+from opentsdb_tpu.parallel.mesh import HOST_AXIS, SERIES_AXIS
+from opentsdb_tpu.parallel.sharded import _local_group_moments
+
+
+def init_multihost(coordinator_address: str | None = None,
+                   num_processes: int | None = None,
+                   process_id: int | None = None) -> bool:
+    """Join a multi-process JAX job (one process per host).
+
+    Thin wrapper over ``jax.distributed.initialize``; args default to the
+    standard env vars (JAX_COORDINATOR_ADDRESS etc. / cloud autodetect).
+    Returns True when distributed mode is active after the call. No-op
+    (returns False) when nothing indicates a multi-process launch, so
+    single-host entry points can call it unconditionally.
+    """
+    import os
+
+    if coordinator_address is None and num_processes is None \
+            and "JAX_COORDINATOR_ADDRESS" not in os.environ \
+            and "COORDINATOR_ADDRESS" not in os.environ:
+        return jax.process_count() > 1
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+    return jax.process_count() > 1
+
+
+def make_hybrid_mesh(n_hosts: int | None = None,
+                     chips_per_host: int | None = None,
+                     devices=None) -> Mesh:
+    """2-D (host, series) mesh: inner axis rides ICI, outer axis DCN.
+
+    In a real multi-process job the per-host grouping follows
+    ``jax.local_device_count()``; on a single process (tests / dryrun)
+    the flat device list is folded into [n_hosts, chips_per_host] to
+    rehearse the same collective program.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if chips_per_host is None:
+        chips_per_host = (jax.local_device_count()
+                          if jax.process_count() > 1 else len(devices))
+    if n_hosts is None:
+        n_hosts = len(devices) // chips_per_host
+    if n_hosts * chips_per_host != len(devices):
+        raise ValueError(
+            f"{len(devices)} devices don't fold into "
+            f"{n_hosts} hosts x {chips_per_host} chips")
+    import numpy as np
+
+    grid = np.asarray(devices).reshape(n_hosts, chips_per_host)
+    return Mesh(grid, (HOST_AXIS, SERIES_AXIS))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "series_per_shard", "num_buckets", "interval",
+                     "agg_down", "agg_group"))
+def hybrid_downsample_group(ts, vals, sid, valid, *, mesh,
+                            series_per_shard: int, num_buckets: int,
+                            interval: int, agg_down: str, agg_group: str):
+    """Fused downsample + two-level group aggregation over a hybrid mesh.
+
+    Args are [H*C, N_shard] stacked shards (host-major, matching
+    ``pack_shards(series, n_hosts * chips_per_host)``); sid local to each
+    shard. Moments combine exactly (Chan et al.) over ICI first, then the
+    [B]-sized host partials combine over DCN. Returns (group_values [B],
+    group_mask [B]).
+    """
+
+    def shard_fn(ts, vals, sid, valid):
+        ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
+        n, total, m2, mean, mn, mx, any_real = _local_group_moments(
+            ts, vals, sid, valid, num_series=series_per_shard,
+            num_buckets=num_buckets, interval=interval, agg_down=agg_down)
+
+        def chan(axis, n, total, m2, mean):
+            c_n = jax.lax.psum(n, axis)
+            c_total = jax.lax.psum(total, axis)
+            c_mean = c_total / jnp.maximum(c_n, 1.0)
+            c_m2 = jax.lax.psum(m2 + n * (mean - c_mean) ** 2, axis)
+            return c_n, c_total, c_m2, c_mean
+
+        # Level 1 (ICI): chips of one host.
+        h_n, h_total, h_m2, h_mean = chan(SERIES_AXIS, n, total, m2, mean)
+        h_mn = jax.lax.pmin(mn, SERIES_AXIS)
+        h_mx = jax.lax.pmax(mx, SERIES_AXIS)
+        h_any = jax.lax.pmax(any_real.astype(jnp.int32), SERIES_AXIS)
+        # Level 2 (DCN): [B]-sized partials only.
+        g_n, g_total, g_m2, _ = chan(HOST_AXIS, h_n, h_total, h_m2, h_mean)
+        g_mn = jax.lax.pmin(h_mn, HOST_AXIS)
+        g_mx = jax.lax.pmax(h_mx, HOST_AXIS)
+        g_any = jax.lax.pmax(h_any, HOST_AXIS) > 0
+
+        safe = jnp.maximum(g_n, 1.0)
+        if agg_group == "sum":
+            out = g_total
+        elif agg_group == "min":
+            out = g_mn
+        elif agg_group == "max":
+            out = g_mx
+        elif agg_group == "avg":
+            out = g_total / safe
+        elif agg_group == "dev":
+            out = jnp.sqrt(jnp.maximum(g_m2, 0.0) / safe)
+        elif agg_group == "count":
+            out = g_n
+        else:
+            raise ValueError(f"unknown aggregator: {agg_group}")
+        return out[None], g_any[None]
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 4,
+        out_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2)
+    group_values, group_mask = fn(ts, vals, sid, valid)
+    return group_values[0], group_mask[0]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "p"))
+def hybrid_hll_distinct(items, valid, *, mesh, p: int = 14):
+    """Distinct count over [H*C, N_shard] shards: register pmax over ICI,
+    then over DCN — 2**p bytes cross hosts, independent of point count."""
+
+    def shard_fn(items, valid):
+        regs = sketches.hll_init(p)
+        regs = sketches.hll_add(regs, items[0], valid[0], p=p)
+        host = jax.lax.pmax(regs, SERIES_AXIS)
+        merged = jax.lax.pmax(host, HOST_AXIS)
+        return sketches.hll_estimate(merged)[None]
+
+    fn = jax.shard_map(shard_fn, mesh=mesh,
+                       in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2,
+                       out_specs=P((HOST_AXIS, SERIES_AXIS)))
+    return fn(items, valid)[0]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "compression"))
+def hybrid_tdigest(values, valid, qs, *, mesh, compression: int = 128):
+    """Quantiles over [H*C, N_shard] shards with two-level digest merge:
+    all_gather raw chip digests over ICI and recompress to one host
+    digest, then all_gather only the compressed host digests over DCN —
+    DCN traffic is O(hosts * compression), not O(chips * compression).
+    """
+
+    def shard_fn(values, valid):
+        means, weights = sketches.tdigest_init(compression)
+        means, weights = sketches.tdigest_add(
+            means, weights, values[0], valid[0], compression=compression)
+        # ICI: merge this host's chip digests.
+        hm = jax.lax.all_gather(means, SERIES_AXIS).reshape(-1)
+        hw = jax.lax.all_gather(weights, SERIES_AXIS).reshape(-1)
+        hm, hw = sketches._compress(hm, hw, compression=compression)
+        # DCN: merge the per-host digests.
+        gm = jax.lax.all_gather(hm, HOST_AXIS).reshape(-1)
+        gw = jax.lax.all_gather(hw, HOST_AXIS).reshape(-1)
+        gm, gw = sketches._compress(gm, gw, compression=compression)
+        return sketches.tdigest_quantile(gm, gw, qs)[None]
+
+    fn = jax.shard_map(shard_fn, mesh=mesh,
+                       in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2,
+                       out_specs=P((HOST_AXIS, SERIES_AXIS)))
+    return fn(values, valid)[0]

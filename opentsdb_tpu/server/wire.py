@@ -182,6 +182,56 @@ def _decode_python(buf: bytes) -> DecodedBatch:
         np.asarray(sid_l, np.int32), series, errors, consumed)
 
 
+def pipelined_ingest(tsdb, chunks, durable: bool = True,
+                     use_native: bool | None = None,
+                     max_queue: int = 2) -> tuple[int, list[str]]:
+    """Two-stage host pipeline over a stream of byte chunks: a worker
+    thread decodes chunk N+1 while the caller's thread ingests batch N —
+    the pipeline-parallelism analog for this workload (SURVEY.md §2.9 PP
+    row; the reference's nearest analog is async callback pipelining of
+    scan->compact->aggregate, src/core/TsdbQuery.java:240-285). The
+    native decoder drops the GIL inside ``tsd_parse``, so the stages
+    genuinely overlap. Partial trailing lines carry into the next chunk
+    (the stream analog of LineBasedFrameDecoder framing).
+
+    Returns (points_written, error strings).
+    """
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=max_queue)
+    fail: list[BaseException] = []
+
+    def producer():
+        try:
+            carry = b""
+            for chunk in chunks:
+                buf = carry + chunk
+                batch = decode_puts(buf, use_native)
+                carry = buf[batch.consumed:]
+                q.put(batch)
+            if carry.strip():
+                q.put(decode_puts(carry + b"\n", use_native))
+        except BaseException as e:  # surface in the consumer thread
+            fail.append(e)
+        finally:
+            q.put(None)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    total = 0
+    errors: list[str] = []
+    while (batch := q.get()) is not None:
+        errors += batch.errors  # parse errors, like the one-shot path
+        n, errs = ingest_batch(tsdb, batch, durable)
+        total += n
+        errors += errs
+    t.join()
+    if fail:
+        raise fail[0]
+    return total, errors
+
+
 def ingest_batch(tsdb, batch: DecodedBatch,
                  durable: bool = True) -> tuple[int, list[str]]:
     """Feed a decoded batch into the TSDB via the columnar write path.
