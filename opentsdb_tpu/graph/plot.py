@@ -26,8 +26,14 @@ class Plot:
         self.width = 1024
         self.height = 768
 
-    def add(self, label: str, timestamps, values) -> None:
-        self.series.append((label, timestamps, values))
+    def add(self, label: str, timestamps, values,
+            options: str = "") -> None:
+        """options: per-series render options from the query's ``o=``
+        param (reference GraphHandler passes them per metric to gnuplot's
+        plot command, :182-187); 'axis x1y2' routes the series to the
+        right-hand axis, 'dashed'/'dotted'/'points' pick the line style.
+        """
+        self.series.append((label, timestamps, values, options))
 
     def set_params(self, params: dict[str, str]) -> None:
         self.params.update(params)
@@ -63,16 +69,27 @@ class Plot:
             figsize=(self.width / 100, self.height / 100), dpi=100,
             facecolor=bg)
         ax.set_facecolor(bg)
+        ax2 = None
         try:
             has_data = False
-            for label, ts, vals in self.series:
+            handles = []
+            for label, ts, vals, options in self.series:
                 if len(ts) == 0:
                     continue
                 has_data = True
                 x = [datetime.fromtimestamp(int(t), tz=timezone.utc)
                      for t in ts]
-                style = "-"
-                ax.plot(x, vals, style, label=label, linewidth=1)
+                style = ("--" if "dashed" in options
+                         else ":" if "dotted" in options
+                         else "." if "points" in options else "-")
+                target = ax
+                if "x1y2" in options:
+                    if ax2 is None:
+                        ax2 = ax.twinx()
+                        ax2.set_facecolor(bg)
+                    target = ax2
+                handles += target.plot(x, vals, style, label=label,
+                                       linewidth=1)
             if not has_data:
                 ax.text(0.5, 0.5, "No data", transform=ax.transAxes,
                         ha="center", va="center", fontsize=20, color=fg)
@@ -86,19 +103,30 @@ class Plot:
                 lo, _, hi = p["yrange"].strip("[]").partition(":")
                 ax.set_ylim(float(lo) if lo else None,
                             float(hi) if hi else None)
+            if ax2 is not None:
+                if "y2label" in p:
+                    ax2.set_ylabel(p["y2label"], color=fg)
+                if "y2log" in p:
+                    ax2.set_yscale("log")
+                if "y2range" in p:
+                    lo, _, hi = p["y2range"].strip("[]").partition(":")
+                    ax2.set_ylim(float(lo) if lo else None,
+                                 float(hi) if hi else None)
+                ax2.tick_params(colors=fg)
             if has_data:
                 ax.set_xlim(
                     datetime.fromtimestamp(self.start_time, tz=timezone.utc),
                     datetime.fromtimestamp(self.end_time, tz=timezone.utc))
                 ax.xaxis.set_major_formatter(
                     mdates.DateFormatter(self._x_format(), tz=timezone.utc))
-            if has_data and "nokey" not in p and self.series:
+            if has_data and "nokey" not in p and handles:
                 loc = {"out": "upper left", "top left": "upper left",
                        "top right": "upper right",
                        "bottom left": "lower left",
                        "bottom right": "lower right"}.get(
                            p.get("key", ""), "best")
-                ax.legend(loc=loc, fontsize=8)
+                # One combined legend even when series split across axes.
+                ax.legend(handles=handles, loc=loc, fontsize=8)
             ax.tick_params(colors=fg)
             for spine in ax.spines.values():
                 spine.set_color(fg)

@@ -26,6 +26,7 @@ import time
 import urllib.parse
 
 from opentsdb_tpu import __version__
+from opentsdb_tpu.build_data import build_data, version_string
 from opentsdb_tpu.core import tags as tags_mod
 from opentsdb_tpu.core.errors import (
     BadRequestError,
@@ -341,7 +342,11 @@ class TSDServer:
             # UI updates take effect immediately.
             status, ctype, body, hdrs = self._static_file("index.html")
             if status == 200:
-                return status, ctype, body, hdrs
+                # Force no-cache whatever the file's source (an operator
+                # staticroot copy would otherwise carry the year-long /s
+                # header).
+                return (status, ctype, body,
+                        dict(hdrs, **{"Cache-Control": "no-cache"}))
             return (200, "text/html; charset=UTF-8",
                     self._homepage().encode(), {})
         if route == "/aggregators":
@@ -349,9 +354,9 @@ class TSDServer:
                     json.dumps(Aggregators.available()).encode(), {})
         if route == "/version":
             if "json" in q:
-                body = json.dumps({"version": __version__,
-                                   "timestamp": self.start_time}).encode()
-                return 200, "application/json", body, {}
+                info = dict(build_data(), start_time=self.start_time)
+                return (200, "application/json",
+                        json.dumps(info).encode(), {})
             return 200, "text/plain", self._version_text().encode(), {}
         if route == "/stats":
             lines = self._collect_stats()
@@ -434,7 +439,11 @@ class TSDServer:
 
         loop = asyncio.get_running_loop()
         results = []
-        for m in ms:
+        # Per-metric render options: o= params pair up positionally with
+        # m= params (reference GraphHandler.doGraph :155-187).
+        os_ = params.get("o", [])
+        result_opts: list[str] = []
+        for mi, m in enumerate(ms):
             parsed = parse_m(m)
             spec = QuerySpec(
                 metric=parsed.metric, tags=parsed.tags,
@@ -445,6 +454,7 @@ class TSDServer:
             rs = await loop.run_in_executor(
                 self._pool, self.executor.run, spec, start, end)
             results.extend(rs)
+            result_opts.extend([os_[mi] if mi < len(os_) else ""] * len(rs))
 
         if "ascii" in q:
             body = self._ascii_output(results).encode()
@@ -455,7 +465,8 @@ class TSDServer:
         else:
             t0 = time.time()
             body = await loop.run_in_executor(
-                self._pool, self._render_png, results, start, end, q)
+                self._pool, self._render_png, results, start, end, q,
+                result_opts)
             self.graph_latency.add((time.time() - t0) * 1000)
             ctype = "image/png"
         if cache_path:
@@ -514,7 +525,8 @@ class TSDServer:
                     for t, v in zip(r.timestamps, r.values)},
         } for r in results]
 
-    def _render_png(self, results, start, end, q) -> bytes:
+    def _render_png(self, results, start, end, q,
+                    result_opts=None) -> bytes:
         plot = Plot(start, end)
         if "wxh" in q:
             w, _, h = q["wxh"].partition("x")
@@ -525,13 +537,14 @@ class TSDServer:
                     f"invalid wxh parameter: {q['wxh']}") from None
         plot.set_params({k: v for k, v in q.items() if k in (
             "title", "ylabel", "yrange", "ylog", "key", "nokey",
-            "bgcolor", "fgcolor")})
-        for r in results:
+            "bgcolor", "fgcolor", "y2label", "y2range", "y2log")})
+        for i, r in enumerate(results):
             label = r.metric
             if r.tags:
                 label += "{" + ",".join(
                     f"{k}={v}" for k, v in sorted(r.tags.items())) + "}"
-            plot.add(label, r.timestamps, r.values)
+            plot.add(label, r.timestamps, r.values,
+                     result_opts[i] if result_opts else "")
         return plot.render()
 
     async def _distinct(self, q) -> tuple:
@@ -603,7 +616,7 @@ class TSDServer:
     # -- stats ----------------------------------------------------------
 
     def _version_text(self) -> str:
-        return (f"opentsdb_tpu {__version__} built on jax/XLA\n")
+        return version_string()
 
     def _collect_stats(self) -> list[str]:
         c = StatsCollector("tsd")

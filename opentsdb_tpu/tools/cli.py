@@ -425,6 +425,15 @@ def cmd_mkmetric(args) -> int:
     return 0
 
 
+def cmd_version(args) -> int:
+    from opentsdb_tpu.build_data import build_data, version_string
+    print(version_string(), end="")
+    if args.verbose:
+        for k, v in build_data().items():
+            print(f"{k}: {v}")
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
@@ -477,6 +486,10 @@ def main(argv: list[str] | None = None) -> int:
     common_args(p)
     p.add_argument("names", nargs="+")
     p.set_defaults(fn=cmd_mkmetric)
+
+    p = sub.add_parser("version", help="print build/version information")
+    p.add_argument("--verbose", action="store_true")
+    p.set_defaults(fn=cmd_version)
 
     from opentsdb_tpu.tools import ops
 

@@ -167,6 +167,25 @@ class TestHttp:
         assert b"image/png" in head
         assert body[:8] == b"\x89PNG\r\n\x1a\n"
 
+    def test_query_png_y2_axis_options(self, server_env):
+        """Per-metric o= options pair with m= positionally; 'axis x1y2'
+        routes the second series to the right-hand axis."""
+        server, tsdb = server_env
+        tsdb.add_batch("m.x", np.arange(BT, BT + 600, 60),
+                       np.arange(10.0), {"a": "b"})
+        tsdb.add_batch("m.y", np.arange(BT, BT + 600, 60),
+                       np.arange(10.0) * 1000, {"a": "b"})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/q?start={BT}&end={BT + 600}&m=sum:m.x&o="
+                      f"&m=sum:m.y&o=axis+x1y2&y2label=big&nocache")
+
+        status, head, body = run_async(server, drive)
+        assert status == 200
+        assert b"image/png" in head
+        assert body[:8] == b"\x89PNG\r\n\x1a\n"
+
     def test_query_cache(self, server_env):
         server, tsdb = server_env
         tsdb.add_batch("m.x", np.array([BT + 1]), np.array([7]),

@@ -173,3 +173,20 @@ class TestStats:
         c.record("test.metric", 42, "type=x")
         assert c.lines[0].startswith("tsd.test.metric ")
         assert c.lines[0].endswith(" 42 type=x")
+
+
+class TestBuildData:
+    def test_build_data_fields(self):
+        from opentsdb_tpu.build_data import build_data, version_string
+        d = build_data()
+        assert d["version"] and d["host"]
+        assert d["repo_status"] in ("MINT", "MODIFIED", "unknown")
+        assert len(d["short_revision"]) == 7
+        s = version_string()
+        assert d["short_revision"] in s and "Running on" in s
+
+    def test_cli_version(self, capsys):
+        from opentsdb_tpu.tools.cli import main
+        assert main(["version"]) == 0
+        out = capsys.readouterr().out
+        assert out.startswith("opentsdb_tpu ")
