@@ -1,0 +1,17 @@
+"""Time-series model families: smoothing, forecasting, anomaly bands.
+
+The reference stops at descriptive aggregation (graphs of
+sum/min/max/avg/dev, src/core/Aggregators.java); it has no predictive
+layer. This package is the TPU-native extension of the same query
+pipeline: batched state-space models (EWMA, Holt's linear trend,
+additive Holt-Winters) expressed as ``lax.scan`` over the time axis with
+all series advanced in lockstep — one compiled program scores thousands
+of series per step, where a scalar implementation would loop.
+"""
+
+from opentsdb_tpu.models.smoothing import (  # noqa: F401
+    anomaly_bands,
+    ewma,
+    holt_winters,
+    hw_forecast,
+)

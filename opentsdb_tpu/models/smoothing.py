@@ -1,0 +1,172 @@
+"""Batched exponential-smoothing models as lax.scan kernels.
+
+All functions take ``values [S, T]`` (S series advanced in lockstep on a
+shared bucket grid — the output shape of ops.kernels.downsample_group)
+and ``mask [S, T]`` marking real buckets; masked steps carry the state
+through unchanged, the scan analog of the query pipeline skipping empty
+buckets. Everything is jit-compiled with static hyper-shapes; the scan
+runs over the time axis so XLA keeps the [S]-wide state resident.
+
+No reference analog (the reference's closest feature is plotting a
+moving average via gnuplot's ``smooth`` option, src/graph/Plot.java
+params) — this is the predictive model layer the TPU build adds on top
+of the same query results.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def ewma(values: jnp.ndarray, mask: jnp.ndarray,
+         alpha: float) -> jnp.ndarray:
+    """Exponentially weighted moving average along axis 1.
+
+    The first real sample initializes the mean; masked steps emit the
+    carried mean and don't update it.
+    """
+    values = values.astype(jnp.float32)
+    a = jnp.float32(alpha)
+
+    def step(carry, xs):
+        mean, seen = carry
+        x, m = xs
+        new_mean = jnp.where(seen, (1 - a) * mean + a * x, x)
+        mean = jnp.where(m, new_mean, mean)
+        seen = seen | m
+        return (mean, seen), mean
+
+    s = values.shape[0]
+    init = (jnp.zeros(s, jnp.float32), jnp.zeros(s, bool))
+    _, out = jax.lax.scan(step, init, (values.T, mask.T))
+    return out.T
+
+
+@functools.partial(jax.jit, static_argnames=("season_length",))
+def holt_winters(values: jnp.ndarray, mask: jnp.ndarray,
+                 alpha: float = 0.3, beta: float = 0.1,
+                 gamma: float = 0.1, season_length: int = 0):
+    """Additive Holt(-Winters) smoothing over [S, T] series.
+
+    ``season_length=0`` disables the seasonal component (Holt's linear
+    trend); otherwise an additive seasonal state of that many buckets is
+    carried per series. Returns dict with:
+      fitted   [S, T] one-step-ahead predictions (prediction BEFORE each
+               observation updates the state — honest residuals),
+      level    [S] final level, trend [S] final trend,
+      seasonal [S, max(season_length,1)] final seasonal state.
+    """
+    values = values.astype(jnp.float32)
+    S, T = values.shape
+    m = max(season_length, 1)
+    a, b, g = (jnp.float32(alpha), jnp.float32(beta), jnp.float32(gamma))
+    seasonal_on = season_length > 0
+
+    def step(carry, xs):
+        level, trend, seas, idx, seen = carry
+        x, obs = xs
+        s_t = seas[:, idx % m] if seasonal_on else jnp.zeros(S, jnp.float32)
+        pred = level + trend + s_t
+        # First observation initializes level; prediction there is x.
+        pred = jnp.where(seen, pred, x)
+
+        new_level = a * (x - s_t) + (1 - a) * (level + trend)
+        new_trend = b * (new_level - level) + (1 - b) * trend
+        new_level = jnp.where(seen, new_level, x)
+        new_trend = jnp.where(seen, new_trend, 0.0)
+        if seasonal_on:
+            s_new = g * (x - new_level) + (1 - g) * s_t
+            seas_upd = seas.at[:, idx % m].set(
+                jnp.where(obs, s_new, seas[:, idx % m]))
+        else:
+            seas_upd = seas
+
+        keep = ~obs
+        level = jnp.where(keep, level, new_level)
+        trend = jnp.where(keep, trend, new_trend)
+        seas = jnp.where(keep[:, None], seas, seas_upd)
+        seen = seen | obs
+        return (level, trend, seas, idx + 1, seen), pred
+
+    init = (jnp.zeros(S, jnp.float32), jnp.zeros(S, jnp.float32),
+            jnp.zeros((S, m), jnp.float32), jnp.int32(0),
+            jnp.zeros(S, bool))
+    (level, trend, seas, _, _), fitted = jax.lax.scan(
+        step, init, (values.T, mask.T))
+    return {"fitted": fitted.T, "level": level, "trend": trend,
+            "seasonal": seas}
+
+
+@functools.partial(
+    jax.jit, static_argnames=("horizon", "season_length", "t_fitted"))
+def hw_forecast(level: jnp.ndarray, trend: jnp.ndarray,
+                seasonal: jnp.ndarray, *, horizon: int,
+                season_length: int = 0, t_fitted: int = 0) -> jnp.ndarray:
+    """h-step-ahead forecasts [S, horizon] from final Holt-Winters state.
+
+    ``t_fitted`` is the number of steps holt_winters consumed (its T):
+    seasonal slots are stored by absolute step index mod m, so future
+    step t_fitted + h reads slot (t_fitted + h) % m.
+    """
+    h = jnp.arange(1, horizon + 1, dtype=jnp.float32)
+    base = level[:, None] + trend[:, None] * h[None, :]
+    if season_length > 0:
+        idx = (t_fitted + jnp.arange(horizon)) % season_length
+        base = base + seasonal[:, idx]
+    return base
+
+
+@functools.partial(jax.jit, static_argnames=("season_length", "warmup"))
+def anomaly_bands(values: jnp.ndarray, mask: jnp.ndarray,
+                  alpha: float = 0.3, beta: float = 0.1,
+                  gamma: float = 0.1, season_length: int = 0,
+                  nsigma: float = 3.0, resid_alpha: float = 0.05,
+                  warmup: int = 10):
+    """Residual-based anomaly detection on [S, T] series.
+
+    Fits holt_winters, tracks an exponentially weighted variance of the
+    one-step-ahead residuals, and flags |residual| > nsigma * sigma once
+    at least ``warmup`` observations have seeded the variance (early
+    steps have near-zero sigma and would all flag). Returns dict with
+    fitted, upper, lower [S, T] and anomaly [S, T] bool (False wherever
+    mask is False), plus the final model state for hw_forecast.
+    """
+    fit = holt_winters(values, mask, alpha, beta, gamma, season_length)
+    resid = jnp.where(mask, values - fit["fitted"], 0.0)
+    ra = jnp.float32(resid_alpha)
+
+    def step(carry, xs):
+        var, nobs = carry
+        r, obs = xs
+        new = (1 - ra) * var + ra * r * r
+        var = jnp.where(obs, new, var)
+        nobs = nobs + obs.astype(jnp.int32)
+        return (var, nobs), (var, nobs)
+
+    S = values.shape[0]
+    init = (jnp.zeros(S, jnp.float32), jnp.zeros(S, jnp.int32))
+    _, (var_t, nobs_t) = jax.lax.scan(step, init, (resid.T, mask.T))
+    # Sigma/count from BEFORE each step's own residual folds in, so a
+    # lone spike can't mask itself.
+    var_prev = jnp.concatenate(
+        [jnp.zeros((1, S), jnp.float32), var_t[:-1]], axis=0).T
+    nobs_prev = jnp.concatenate(
+        [jnp.zeros((1, S), jnp.int32), nobs_t[:-1]], axis=0).T
+    # Scale-aware floor so a perfectly constant series (residual variance
+    # exactly 0) still flags a spike instead of being permanently blind.
+    floor = 1e-6 * (1.0 + jnp.abs(fit["fitted"]))
+    sigma = jnp.maximum(jnp.sqrt(var_prev), floor)
+    upper = fit["fitted"] + nsigma * sigma
+    lower = fit["fitted"] - nsigma * sigma
+    anomaly = mask & (nobs_prev >= warmup) & (
+        (values > upper) | (values < lower))
+    return {"fitted": fit["fitted"], "upper": upper, "lower": lower,
+            "sigma": sigma, "anomaly": anomaly,
+            # Final model state, so callers can hw_forecast without
+            # refitting.
+            "level": fit["level"], "trend": fit["trend"],
+            "seasonal": fit["seasonal"]}

@@ -70,6 +70,10 @@ class QueryExecutor:
     def __init__(self, tsdb, backend: str | None = None) -> None:
         self.tsdb = tsdb
         self.backend = backend or tsdb.config.backend
+        # Scan-phase latency digest, the analog of TsdbQuery.scanlatency
+        # (reference src/core/TsdbQuery.java:52,278).
+        from opentsdb_tpu.stats.collector import LatencyDigest
+        self.scan_latency = LatencyDigest()
 
     # ------------------------------------------------------------------
     # Planning: scan + span assembly + grouping
@@ -180,7 +184,10 @@ class QueryExecutor:
             raise BadRequestError(
                 "use distinct_tagv() / the /distinct endpoint for "
                 "cardinality queries")
+        import time as _time
+        t0 = _time.time()
         groups = self._find_spans(spec, start, end)
+        self.scan_latency.add((_time.time() - t0) * 1000)
         results = []
         for gkey in sorted(groups):
             spans = groups[gkey]
@@ -213,9 +220,18 @@ class QueryExecutor:
                 series.append((ts, vals))
         if not series:
             return (np.empty(0, np.int64), np.empty(0, np.float64))
-        interp = "step" if spec.rate else "lerp"
+        interp = self._interp(spec)
         return oracle.group_aggregate(series, spec.aggregator,
                                       interp=interp)
+
+    @staticmethod
+    def _interp(spec: QuerySpec) -> str:
+        """Group-stage gap policy: the zimsum/mimmin/mimmax family never
+        interpolates; rates hold the last value; everything else lerps
+        (reference SGIterator semantics, SpanGroup.java:702-784)."""
+        if not Aggregators.get(spec.aggregator).interpolates:
+            return "none"
+        return "step" if spec.rate else "lerp"
 
     # -- TPU kernel backend -------------------------------------------
 
@@ -250,7 +266,7 @@ class QueryExecutor:
             ts_pad[i, :n] = ts - base
             val_pad[i, :n] = vals
             counts[i] = n
-        interp = "step" if spec.rate else "lerp"
+        interp = self._interp(spec)
         if Aggregators.get(spec.aggregator).kind == "percentile":
             grid, out, gmask = self._tpu_quantile_grid(
                 ts_pad, val_pad, counts, spec, interp)

@@ -382,6 +382,8 @@ class TSDServer:
             return await self._query(q, parsed.query, params)
         if route == "/distinct":
             return await self._distinct(q)
+        if route == "/forecast":
+            return await self._forecast(q, params)
         if route == "/dropcaches":
             self.tsdb.drop_caches()
             return 200, "text/plain", b"Caches dropped.\n", {}
@@ -567,6 +569,132 @@ class TSDServer:
                            "distinct": n}).encode()
         return 200, "application/json", body, {}
 
+    async def _forecast(self, q, params) -> tuple:
+        """Model extension: Holt-Winters / EWMA forecasts + anomaly
+        bands over a query's result series (no reference analog — the
+        predictive layer on top of the /q pipeline). Params: start, end,
+        m= (must include a downsample to define the model's bucket
+        grid), horizon (future buckets, default 10), season (buckets,
+        default 0), alpha/beta/gamma, nsigma (default 3).
+        """
+        import numpy as np
+
+        if "start" not in q:
+            raise BadRequestError("Missing parameter: start")
+        now = int(time.time())
+        tz = q.get("tz")
+        start = timeparse.parse_date(q["start"], tz=tz, now=now)
+        end = timeparse.parse_date(q["end"], tz=tz, now=now) \
+            if q.get("end") else now
+        ms = params.get("m", [])
+        if not ms:
+            raise BadRequestError("Missing parameter: m")
+
+        def num(name, default, lo, hi, as_int=False):
+            try:
+                v = float(q.get(name, default))
+                if as_int:
+                    v = int(v)
+            except (ValueError, OverflowError):
+                raise BadRequestError(
+                    f"invalid '{name}' parameter") from None
+            if not (lo <= v <= hi):
+                raise BadRequestError(
+                    f"'{name}' out of range [{lo}, {hi}]")
+            return v
+
+        # season/horizon bound both memory (they size device arrays) and
+        # XLA recompiles (they're static shapes).
+        horizon = num("horizon", 10, 1, 10000, as_int=True)
+        season = num("season", 0, 0, 10000, as_int=True)
+        alpha = num("alpha", 0.3, 0.0, 1.0)
+        beta = num("beta", 0.1, 0.0, 1.0)
+        gamma = num("gamma", 0.1, 0.0, 1.0)
+        nsigma = num("nsigma", 3.0, 0.1, 1000.0)
+        model = q.get("model", "hw")
+        if model not in ("hw", "ewma"):
+            raise BadRequestError(f"unknown model: {model}")
+
+        loop = asyncio.get_running_loop()
+        results = []
+        interval = None
+        for m in ms:
+            parsed = parse_m(m)
+            if not parsed.downsample:
+                raise BadRequestError(
+                    "forecast queries need a downsample interval "
+                    "(e.g. m=sum:5m-avg:metric) to define the model grid")
+            if interval is None:
+                interval = parsed.downsample[0]
+            elif interval != parsed.downsample[0]:
+                raise BadRequestError(
+                    "all m= specs must share one downsample interval")
+            spec = QuerySpec(
+                metric=parsed.metric, tags=parsed.tags,
+                aggregator=parsed.aggregator, rate=parsed.rate,
+                downsample=parsed.downsample, counter=parsed.counter,
+                counter_max=parsed.counter_max,
+                reset_value=parsed.reset_value)
+            rs = await loop.run_in_executor(
+                self._pool, self.executor.run, spec, start, end)
+            results.extend(rs)
+
+        def compute():
+            from opentsdb_tpu.models import (anomaly_bands, ewma,
+                                             hw_forecast)
+
+            grid0 = start - start % interval
+            T = max((end - grid0) // interval + 1, 1)
+            S = max(len(results), 1)
+            vals = np.zeros((S, T), np.float32)
+            mask = np.zeros((S, T), bool)
+            for i, r in enumerate(results):
+                idx = ((np.asarray(r.timestamps) - grid0) //
+                       interval).astype(int)
+                ok = (idx >= 0) & (idx < T)
+                vals[i, idx[ok]] = np.asarray(r.values)[ok]
+                mask[i, idx[ok]] = True
+            if model == "ewma":
+                fitted = np.asarray(ewma(vals, mask, alpha))
+                level = fitted[:, -1]
+                fc = np.repeat(level[:, None], horizon, axis=1)
+                bands = None
+            else:
+                bands = {k: np.asarray(v) for k, v in anomaly_bands(
+                    vals, mask, alpha, beta, gamma, season,
+                    nsigma).items()}
+                fitted = bands["fitted"]
+                fc = np.asarray(hw_forecast(
+                    bands["level"], bands["trend"], bands["seasonal"],
+                    horizon=horizon, season_length=season, t_fitted=T))
+            future_ts = grid0 + (T + np.arange(horizon)) * interval
+            grid_ts = grid0 + np.arange(T) * interval
+            out = []
+            for i, r in enumerate(results):
+                entry = {
+                    "metric": r.metric, "tags": r.tags,
+                    "model": model,
+                    "fitted": {str(int(t)): float(v) for t, v, mk in
+                               zip(grid_ts, fitted[i], mask[i]) if mk},
+                    "forecast": {str(int(t)): float(v) for t, v in
+                                 zip(future_ts, fc[i])},
+                }
+                if bands is not None:
+                    entry["anomalies"] = [
+                        int(t) for t, a in zip(grid_ts, bands["anomaly"][i])
+                        if a]
+                    entry["upper"] = {
+                        str(int(t)): float(v) for t, v, mk in
+                        zip(grid_ts, bands["upper"][i], mask[i]) if mk}
+                    entry["lower"] = {
+                        str(int(t)): float(v) for t, v, mk in
+                        zip(grid_ts, bands["lower"][i], mask[i]) if mk}
+                out.append(entry)
+            return json.dumps(out).encode()
+
+        body = await loop.run_in_executor(self._pool, compute)
+        return 200, "application/json", body, {}
+
     # -- static files / home page --------------------------------------
 
     # Packaged web UI (the GWT-client replacement): used when no
@@ -634,6 +762,7 @@ class TSDServer:
         c.record("http.latency", self.http_latency, "type=all")
         c.record("http.latency", self.graph_latency, "type=graph")
         c.record("rpc.latency", self.put_latency, "type=put")
+        c.record("scan.latency", self.executor.scan_latency, "type=query")
         c.record("http.graph.requests", self.cache_hits, "cache=hit")
         c.record("http.graph.requests", self.cache_misses, "cache=miss")
         c.record("uptime", int(time.time()) - self.start_time)

@@ -33,19 +33,26 @@ class LatencyDigest:
     """
 
     def __init__(self, compression: int = 128) -> None:
+        import threading
+
         self._buf: list[float] = []
         self._compression = compression
         self._means: np.ndarray | None = None
         self._weights: np.ndarray | None = None
         self.count = 0
+        # add() runs on event-loop AND thread-pool threads (e.g. the
+        # query executor's scan digest); fold/read must not race.
+        self._lock = threading.Lock()
 
     def add(self, value: float) -> None:
-        self._buf.append(float(value))
-        self.count += 1
-        if len(self._buf) >= _FOLD_THRESHOLD:
-            self._fold()
+        with self._lock:
+            self._buf.append(float(value))
+            self.count += 1
+            if len(self._buf) >= _FOLD_THRESHOLD:
+                self._fold()
 
     def _fold(self) -> None:
+        # Caller must hold self._lock.
         if not self._buf:
             return
         new = np.asarray(self._buf, np.float64)
@@ -76,14 +83,15 @@ class LatencyDigest:
 
     def percentile(self, p: float) -> float:
         """p in [0, 100] (reference Histogram.percentile convention)."""
-        if self._means is None:
-            if not self._buf:
-                return 0.0
-            return float(np.percentile(np.asarray(self._buf), p))
-        self._fold()
-        m, w = self._means, self._weights
-        centers = (np.cumsum(w) - w / 2) / max(w.sum(), 1e-30)
-        return float(np.interp(p / 100.0, centers, m))
+        with self._lock:
+            if self._means is None:
+                if not self._buf:
+                    return 0.0
+                return float(np.percentile(np.asarray(self._buf), p))
+            self._fold()
+            m, w = self._means, self._weights
+            centers = (np.cumsum(w) - w / 2) / max(w.sum(), 1e-30)
+            return float(np.interp(p / 100.0, centers, m))
 
 
 class StatsCollector:

@@ -316,3 +316,43 @@ class TestHttp:
         assert no_start[0] == 400 and b"start" in no_start[2]
         assert no_m[0] == 400
         assert bad_agg[0] == 400 and b"aggregator" in bad_agg[2]
+
+
+class TestForecast:
+    def test_hw_forecast_endpoint(self, server_env):
+        """A linearly rising series forecasts onward with bands; the
+        injected spike is flagged as an anomaly."""
+        server, tsdb = server_env
+        ts = np.arange(BT, BT + 60 * 200, 60)
+        vals = np.arange(200) * 2.0 + 10.0
+        vals[150] += 500.0  # spike
+        tsdb.add_batch("m.trend", ts, vals, {"host": "a"})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/q".replace("/q", "/forecast") +
+                f"?start={BT}&end={BT + 60 * 200}"
+                f"&m=sum:1m-avg:m.trend&horizon=5&nsigma=6")
+
+        status, _, body = run_async(server, drive)
+        assert status == 200
+        out = json.loads(body)
+        assert len(out) == 1
+        fc = out[0]["forecast"]
+        assert len(fc) == 5
+        # Forecast continues the +2/min trend (loose tolerance).
+        last_fit = vals[199]
+        first_fc = list(fc.values())[0]
+        assert abs(first_fc - (last_fit + 2.0)) < 20.0
+        assert BT + 60 * 150 in out[0]["anomalies"]
+
+    def test_forecast_requires_downsample(self, server_env):
+        server, tsdb = server_env
+        tsdb.add_batch("m.x", np.array([BT + 1]), np.array([7]), {"a": "b"})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/forecast?start={BT}&m=sum:m.x")
+
+        status, _, body = run_async(server, drive)
+        assert status == 400
