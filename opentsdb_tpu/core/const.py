@@ -28,3 +28,9 @@ MAX_TIMESPAN = 3600
 
 # Width in bytes of every UID kind (metrics, tagk, tagv).
 UID_WIDTH = 3
+
+# The interpolation-free aggregator family and its underlying moment
+# reductions (query-language names from later OpenTSDB; the 1.1 reference
+# predates them). Canonical mapping — kernels, oracle, and the registry
+# all derive from this.
+NOLERP_AGGS = {"zimsum": "sum", "mimmin": "min", "mimmax": "max"}

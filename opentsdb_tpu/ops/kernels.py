@@ -73,8 +73,12 @@ def _segment_moments(vals: jnp.ndarray, seg: jnp.ndarray, valid: jnp.ndarray,
     return count, total, m2, mn, mx
 
 
+from opentsdb_tpu.core.const import NOLERP_AGGS
+
+
 def _finish(agg: str, count, total, m2, mn, mx):
     """Combine segment moments (m2 = centered sum of squares) into the agg."""
+    agg = NOLERP_AGGS.get(agg, agg)  # same reduction, different feed
     safe = jnp.maximum(count, 1.0)
     if agg == "sum":
         return total
@@ -223,7 +227,13 @@ def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
         .astype(jnp.int32)
 
     # Group stage: aggregate across series on the shared bucket grid.
-    filled, in_range = gap_fill(series_values, series_mask, num_buckets)
+    # The no-lerp family skips gap filling: a series only contributes
+    # where it actually has a bucket.
+    if agg_group in NOLERP_AGGS:
+        filled, in_range = series_values, series_mask
+    else:
+        filled, in_range = gap_fill(series_values, series_mask,
+                                    num_buckets)
     g_count, g_total, g_m2, _, g_mn, g_mx = group_moments(filled, in_range)
     group_values = _finish(agg_group, g_count, g_total, g_m2, g_mn, g_mx)
 
@@ -352,6 +362,10 @@ def series_contributions(ts: jnp.ndarray, vals: jnp.ndarray,
             t = (grid - x0).astype(jnp.float32) / dx
             interpd = y0 + t * (y1 - y0)
         elif interp == "step":
+            interpd = y0
+        elif interp == "none":
+            # zimsum/mimmin/mimmax: only exact samples contribute.
+            in_range = exact
             interpd = y0
         else:
             raise ValueError(f"unknown interp: {interp}")

@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from opentsdb_tpu.core.const import NOLERP_AGGS
+
 AGGS = ("sum", "min", "max", "avg", "dev", "count")
 
 
@@ -56,6 +58,10 @@ def agg_reduce(values: np.ndarray, agg: str) -> float:
         return float(np.sqrt(np.var(values)))  # population (M2/n)
     if agg == "count":
         return float(len(values))
+    if agg in NOLERP_AGGS:
+        # The interpolation-free family reduces like its base op; the
+        # difference is purely which values reach it (interp='none').
+        return agg_reduce(values, NOLERP_AGGS[agg])
     if len(agg) > 1 and agg[0] == "p" and agg[1:].isdigit():
         q = int(agg[1:]) / 10 ** len(agg[1:])
         return float(np.quantile(values, q))
@@ -176,6 +182,13 @@ def group_aggregate(series: list[tuple[np.ndarray, np.ndarray]], agg: str,
         elif interp == "step":
             idx = np.searchsorted(ts, x, side="right") - 1
             contrib[s, in_range] = vals[idx]
+        elif interp == "none":
+            # zimsum/mimmin/mimmax family: contribute only at own samples.
+            idx = np.searchsorted(ts, x)
+            exact = ts[np.minimum(idx, len(ts) - 1)] == x
+            sub = np.full(len(x), np.nan)
+            sub[exact] = vals[idx[exact]]
+            contrib[s, in_range] = sub
         else:
             raise ValueError(f"unknown interp: {interp}")
     out = np.empty(len(grid), dtype=np.float64)

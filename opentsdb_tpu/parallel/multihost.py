@@ -108,11 +108,14 @@ def hybrid_downsample_group(ts, vals, sid, valid, *, mesh,
     group_mask [B]).
     """
 
+    from opentsdb_tpu.ops.kernels import NOLERP_AGGS
+
     def shard_fn(ts, vals, sid, valid):
         ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
         n, total, m2, mean, mn, mx, any_real = _local_group_moments(
             ts, vals, sid, valid, num_series=series_per_shard,
-            num_buckets=num_buckets, interval=interval, agg_down=agg_down)
+            num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+            lerp=agg_group not in NOLERP_AGGS)
 
         def chan(axis, n, total, m2, mean):
             c_n = jax.lax.psum(n, axis)
@@ -133,17 +136,18 @@ def hybrid_downsample_group(ts, vals, sid, valid, *, mesh,
         g_any = jax.lax.pmax(h_any, HOST_AXIS) > 0
 
         safe = jnp.maximum(g_n, 1.0)
-        if agg_group == "sum":
+        op = NOLERP_AGGS.get(agg_group, agg_group)
+        if op == "sum":
             out = g_total
-        elif agg_group == "min":
+        elif op == "min":
             out = g_mn
-        elif agg_group == "max":
+        elif op == "max":
             out = g_mx
-        elif agg_group == "avg":
+        elif op == "avg":
             out = g_total / safe
-        elif agg_group == "dev":
+        elif op == "dev":
             out = jnp.sqrt(jnp.maximum(g_m2, 0.0) / safe)
-        elif agg_group == "count":
+        elif op == "count":
             out = g_n
         else:
             raise ValueError(f"unknown aggregator: {agg_group}")

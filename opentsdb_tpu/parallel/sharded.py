@@ -33,16 +33,21 @@ from opentsdb_tpu.parallel.mesh import SERIES_AXIS
 
 
 def _local_group_moments(ts, vals, sid, valid, *, num_series, num_buckets,
-                         interval, agg_down):
+                         interval, agg_down, lerp=True):
     """Per-chip: fused downsample + lerp-fill, returning partial group
     moments per bucket (count, total, M2-around-local-mean, local mean,
-    min, max, any-real-point)."""
+    min, max, any-real-point). ``lerp=False`` (the zimsum/mimmin/mimmax
+    family) skips gap filling — series contribute only where they have a
+    real bucket."""
     out = downsample_group(
         ts, vals, sid, valid, num_series=num_series,
         num_buckets=num_buckets, interval=interval, agg_down=agg_down,
         agg_group="sum")  # agg_group unused; we recompute moments below
-    filled, in_range = gap_fill(out["series_values"], out["series_mask"],
-                                num_buckets)
+    if lerp:
+        filled, in_range = gap_fill(out["series_values"],
+                                    out["series_mask"], num_buckets)
+    else:
+        filled, in_range = out["series_values"], out["series_mask"]
     n, total, m2, mean, mn, mx = group_moments(filled, in_range)
     return n, total, m2, mean, mn, mx, out["series_mask"].any(axis=0)
 
@@ -61,11 +66,14 @@ def sharded_downsample_group(ts, vals, sid, valid, *, mesh,
     replicated on every chip.
     """
 
+    from opentsdb_tpu.ops.kernels import NOLERP_AGGS
+
     def shard_fn(ts, vals, sid, valid):
         ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
         n, total, m2, mean, mn, mx, any_real = _local_group_moments(
             ts, vals, sid, valid, num_series=series_per_shard,
-            num_buckets=num_buckets, interval=interval, agg_down=agg_down)
+            num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+            lerp=agg_group not in NOLERP_AGGS)
         # Cross-chip exact moment combination (Chan et al.).
         g_n = jax.lax.psum(n, SERIES_AXIS)
         g_total = jax.lax.psum(total, SERIES_AXIS)
@@ -77,17 +85,18 @@ def sharded_downsample_group(ts, vals, sid, valid, *, mesh,
         g_any = jax.lax.pmax(any_real.astype(jnp.int32), SERIES_AXIS) > 0
 
         safe = jnp.maximum(g_n, 1.0)
-        if agg_group == "sum":
+        op = NOLERP_AGGS.get(agg_group, agg_group)
+        if op == "sum":
             out = g_total
-        elif agg_group == "min":
+        elif op == "min":
             out = g_mn
-        elif agg_group == "max":
+        elif op == "max":
             out = g_mx
-        elif agg_group == "avg":
+        elif op == "avg":
             out = g_total / safe
-        elif agg_group == "dev":
+        elif op == "dev":
             out = jnp.sqrt(jnp.maximum(g_m2, 0.0) / safe)
-        elif agg_group == "count":
+        elif op == "count":
             out = g_n
         else:
             raise ValueError(f"unknown aggregator: {agg_group}")

@@ -159,8 +159,14 @@ def timeshard_downsample_group(ts, vals, sid, valid, *, mesh,
         series_values = per[:-1].reshape(shape)
         series_mask = count[:-1].reshape(shape) > 0
 
-        filled, in_range = _cross_tile_gap_fill(
-            series_values, series_mask, d=d, bps=bps)
+        from opentsdb_tpu.ops.kernels import NOLERP_AGGS
+        if agg_group in NOLERP_AGGS:
+            # No-lerp family: no cross-tile carries needed either — a
+            # series contributes only where it has a real bucket.
+            filled, in_range = series_values, series_mask
+        else:
+            filled, in_range = _cross_tile_gap_fill(
+                series_values, series_mask, d=d, bps=bps)
         g_n, g_total, g_m2, _, g_mn, g_mx = group_moments(filled, in_range)
         group_values = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
         return group_values, series_mask.any(axis=0)

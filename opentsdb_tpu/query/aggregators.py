@@ -13,16 +13,22 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+from opentsdb_tpu.core.const import NOLERP_AGGS
+
 
 class AggSpec(NamedTuple):
     name: str
     kind: str          # 'moment' | 'percentile' | 'cardinality'
     quantile: float | None = None  # for kind == 'percentile'
+    lerp: bool = True  # interpolate group-stage gaps?
 
     @property
     def interpolates(self) -> bool:
-        """Whether group-stage gaps are lerped (all current kinds do)."""
-        return True
+        """Whether group-stage gaps are lerped. The zimsum/mimmin/mimmax
+        family doesn't: a series contributes only where it actually has
+        a sample (the "interpolation-free" aggregators OpenTSDB added
+        after the 1.1 reference; same query-language names)."""
+        return self.lerp
 
 
 class Aggregators:
@@ -54,6 +60,8 @@ class Aggregators:
 
 for _name in ("sum", "min", "max", "avg", "dev", "count"):
     Aggregators.set(_name, AggSpec(_name, "moment"))
+for _name in NOLERP_AGGS:
+    Aggregators.set(_name, AggSpec(_name, "moment", lerp=False))
 for _name, _q in (("p50", 0.50), ("p75", 0.75), ("p90", 0.90),
                   ("p95", 0.95), ("p99", 0.99), ("p999", 0.999)):
     Aggregators.set(_name, AggSpec(_name, "percentile", _q))

@@ -52,7 +52,8 @@ class TestMakeHybridMesh:
 
 class TestHybridDownsampleGroup:
     @pytest.mark.parametrize("agg_group", ["sum", "avg", "dev", "min",
-                                           "max", "count"])
+                                           "max", "count", "zimsum",
+                                           "mimmin"])
     def test_matches_unsharded(self, mesh, agg_group):
         series = [random_series(RNG.integers(10, 80)) for _ in range(24)]
         interval = 300

@@ -35,7 +35,8 @@ def mesh():
 
 class TestShardedDownsampleGroup:
     @pytest.mark.parametrize("agg_group", ["sum", "avg", "dev", "max",
-                                           "min", "count"])
+                                           "min", "count", "zimsum",
+                                           "mimmax"])
     def test_matches_oracle(self, mesh, agg_group):
         series = [random_series(RNG.integers(10, 80)) for _ in range(20)]
         interval = 300
@@ -51,7 +52,10 @@ class TestShardedDownsampleGroup:
             oracle.downsample(s[0], s[1], interval, "avg", mode="aligned",
                               bucket_ts="start")
             for s in series]
-        ots, ov = oracle.group_aggregate(per_series, agg_group)
+        interp = ("none" if agg_group in ("zimsum", "mimmax")
+                  else "lerp")
+        ots, ov = oracle.group_aggregate(per_series, agg_group,
+                                         interp=interp)
         np.testing.assert_array_equal(np.flatnonzero(gm) * interval, ots)
         np.testing.assert_allclose(gv[gm], ov, rtol=3e-5, atol=1e-3)
 

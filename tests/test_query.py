@@ -95,7 +95,8 @@ class TestPlanning:
 
 
 class TestDifferential:
-    @pytest.mark.parametrize("agg", ["sum", "avg", "max", "dev"])
+    @pytest.mark.parametrize("agg", ["sum", "avg", "max", "dev",
+                                     "zimsum", "mimmin", "mimmax"])
     def test_plain_aggregation(self, tsdb, agg):
         cpu, tpu = run_both(tsdb, QuerySpec("sys.cpu.user", {},
                                             aggregator=agg))
@@ -103,7 +104,7 @@ class TestDifferential:
         np.testing.assert_array_equal(c.timestamps, t.timestamps)
         np.testing.assert_allclose(t.values, c.values, rtol=5e-5, atol=1e-3)
 
-    @pytest.mark.parametrize("agg", ["sum", "avg"])
+    @pytest.mark.parametrize("agg", ["sum", "avg", "zimsum"])
     def test_downsample_group(self, tsdb, agg):
         spec = QuerySpec("sys.cpu.user", {"host": "*"}, aggregator=agg,
                          downsample=(600, "avg"))
@@ -205,3 +206,47 @@ class TestGrammar:
     def test_run_validates_range(self, tsdb):
         with pytest.raises(BadRequestError):
             QueryExecutor(tsdb).run(QuerySpec("sys.cpu.user", {}), BT, BT)
+
+
+class TestNoLerpFamily:
+    """zimsum/mimmin/mimmax: series contribute only at their own samples."""
+
+    @pytest.fixture
+    def sparse_tsdb(self):
+        t = TSDB(MemKVStore(), Config(auto_create_metrics=True),
+                 start_compaction_thread=False)
+        # Two hosts sampling at interleaved, never-coinciding times.
+        t.add_batch("m.z", np.array([BT, BT + 20, BT + 40]),
+                    np.array([10.0, 20.0, 30.0]), {"host": "a"})
+        t.add_batch("m.z", np.array([BT + 10, BT + 30]),
+                    np.array([100.0, 200.0]), {"host": "b"})
+        return t
+
+    def test_zimsum_never_interpolates(self, sparse_tsdb):
+        cpu, tpu = run_both(sparse_tsdb, QuerySpec("m.z", {},
+                                                   aggregator="zimsum"),
+                            start=BT, end=BT + 60)
+        for (r,) in (cpu, tpu):
+            np.testing.assert_array_equal(
+                r.timestamps, [BT, BT + 10, BT + 20, BT + 30, BT + 40])
+            # Exact point values only -- a lerping sum would add ~105 at
+            # BT+10 (host a lerps 15), zimsum reports the lone sample.
+            np.testing.assert_allclose(
+                r.values, [10.0, 100.0, 20.0, 200.0, 30.0])
+
+    def test_mimmin_mimmax(self, sparse_tsdb):
+        for agg, want in (("mimmin", [10.0, 100.0, 20.0, 200.0, 30.0]),
+                          ("mimmax", [10.0, 100.0, 20.0, 200.0, 30.0])):
+            cpu, tpu = run_both(sparse_tsdb, QuerySpec("m.z", {},
+                                                       aggregator=agg),
+                                start=BT, end=BT + 60)
+            for (r,) in (cpu, tpu):
+                np.testing.assert_allclose(r.values, want)
+
+    def test_sum_does_interpolate_for_contrast(self, sparse_tsdb):
+        cpu, _ = run_both(sparse_tsdb, QuerySpec("m.z", {},
+                                                 aggregator="sum"),
+                          start=BT, end=BT + 60)
+        (r,) = cpu
+        # At BT+10 host a lerps to 15 -> 115 total under plain sum.
+        assert abs(r.values[1] - 115.0) < 1e-6

@@ -59,6 +59,7 @@ def _reference(ts, vals, sid, num_series, agg_down, agg_group):
 
 @pytest.mark.parametrize("agg_down,agg_group", [
     ("avg", "sum"), ("sum", "avg"), ("max", "min"), ("avg", "dev"),
+    ("avg", "zimsum"), ("min", "mimmax"),
 ])
 def test_downsample_group_parity(mesh, agg_down, agg_group):
     ts, vals, sid = _flat_workload(5, 600)
