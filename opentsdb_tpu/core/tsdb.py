@@ -25,6 +25,7 @@ import numpy as np
 from opentsdb_tpu.core import codec, codec_np, tags as tags_mod
 from opentsdb_tpu.core.compaction import CompactionQueue
 from opentsdb_tpu.core.const import MAX_TIMESPAN
+from opentsdb_tpu.core.errors import PleaseThrottleError
 from opentsdb_tpu.storage.kv import KVStore
 from opentsdb_tpu.uid.uniqueid import UniqueId
 from opentsdb_tpu.utils.config import Config
@@ -153,17 +154,28 @@ class TSDB:
         cells = codec_np.encode_cells_multi(deltas, f_s, i_s, m_s,
                                             row_starts)
         tmpl = bytearray(self.row_key_for(metric, tag_map, 0))
+        batch = []
         for start_idx, (qual, val) in zip(row_starts, cells):
             codec.set_base_time(tmpl, int(base[start_idx]))
-            key = bytes(tmpl)
-            # Check row existence BEFORE the put: if the row already held
-            # cells, this batch makes it multi-cell and it must be queued
-            # so the per-batch compacted cells merge into one.
-            existed = self.store.has_row(self.table, key)
-            self.store.put(self.table, key, FAMILY, qual, val,
-                           durable=durable)
-            if existed and self.config.enable_compactions:
-                self.compactionq.add(key)
+            batch.append((bytes(tmpl), qual, val))
+        # Rows that already held cells BEFORE the put become multi-cell
+        # and must be queued so the per-batch compacted cells merge into
+        # one; put_many reports that per row in a single locked pass.
+        # A mid-batch throttle still queues the rows that DID apply.
+        try:
+            existed = self.store.put_many(self.table, FAMILY, batch,
+                                          durable=durable)
+        except PleaseThrottleError as e:
+            existed = getattr(e, "partial_existed", [])
+            if self.config.enable_compactions:
+                for (key, _, _), ex in zip(batch, existed):
+                    if ex:
+                        self.compactionq.add(key)
+            raise
+        if self.config.enable_compactions:
+            for (key, _, _), e in zip(batch, existed):
+                if e:
+                    self.compactionq.add(key)
         n = len(ts_s)
         self.datapoints_added += n
         return n

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from opentsdb_tpu.core.const import NOLERP_AGGS
+
 AGG_IDS = {"sum": 0, "min": 1, "max": 2, "avg": 3, "dev": 4, "count": 5}
 
 # Plain Python floats: creating jnp scalars at import time would
@@ -71,9 +73,6 @@ def _segment_moments(vals: jnp.ndarray, seg: jnp.ndarray, valid: jnp.ndarray,
     if extra is not None:
         return count, total, m2, mn, mx, sums[:, 2]
     return count, total, m2, mn, mx
-
-
-from opentsdb_tpu.core.const import NOLERP_AGGS
 
 
 def _finish(agg: str, count, total, m2, mn, mx):

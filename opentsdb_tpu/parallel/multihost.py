@@ -37,7 +37,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from opentsdb_tpu.core.const import NOLERP_AGGS
 from opentsdb_tpu.ops import sketches
+from opentsdb_tpu.ops.kernels import _finish
 from opentsdb_tpu.parallel.mesh import HOST_AXIS, SERIES_AXIS
 from opentsdb_tpu.parallel.sharded import _local_group_moments
 
@@ -108,8 +110,6 @@ def hybrid_downsample_group(ts, vals, sid, valid, *, mesh,
     group_mask [B]).
     """
 
-    from opentsdb_tpu.ops.kernels import NOLERP_AGGS
-
     def shard_fn(ts, vals, sid, valid):
         ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
         n, total, m2, mean, mn, mx, any_real = _local_group_moments(
@@ -135,22 +135,7 @@ def hybrid_downsample_group(ts, vals, sid, valid, *, mesh,
         g_mx = jax.lax.pmax(h_mx, HOST_AXIS)
         g_any = jax.lax.pmax(h_any, HOST_AXIS) > 0
 
-        safe = jnp.maximum(g_n, 1.0)
-        op = NOLERP_AGGS.get(agg_group, agg_group)
-        if op == "sum":
-            out = g_total
-        elif op == "min":
-            out = g_mn
-        elif op == "max":
-            out = g_mx
-        elif op == "avg":
-            out = g_total / safe
-        elif op == "dev":
-            out = jnp.sqrt(jnp.maximum(g_m2, 0.0) / safe)
-        elif op == "count":
-            out = g_n
-        else:
-            raise ValueError(f"unknown aggregator: {agg_group}")
+        out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
         return out[None], g_any[None]
 
     fn = jax.shard_map(

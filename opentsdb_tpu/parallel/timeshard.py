@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from opentsdb_tpu.core.const import NOLERP_AGGS
 from opentsdb_tpu.ops.kernels import (
     _finish,
     _flat_rate,
@@ -159,7 +160,6 @@ def timeshard_downsample_group(ts, vals, sid, valid, *, mesh,
         series_values = per[:-1].reshape(shape)
         series_mask = count[:-1].reshape(shape) > 0
 
-        from opentsdb_tpu.ops.kernels import NOLERP_AGGS
         if agg_group in NOLERP_AGGS:
             # No-lerp family: no cross-tile carries needed either — a
             # series contributes only where it has a real bucket.

@@ -74,6 +74,31 @@ class KVStore:
             value: bytes, durable: bool = True) -> None:
         raise NotImplementedError
 
+    def put_many(self, table: str, family: bytes,
+                 cells: list[tuple[bytes, bytes, bytes]],
+                 durable: bool = True) -> list[bool]:
+        """Write (key, qualifier, value) cells; returns, per cell, True
+        when the row holds other cells by the time this one lands —
+        either it existed before the batch, or an earlier cell of the
+        batch already hit it (both mean the caller must queue
+        compaction). On PleaseThrottleError mid-batch the exception's
+        ``partial_existed`` carries the flags for the cells that DID
+        apply. Default loops over put(); MemKVStore overrides with a
+        single-lock batch.
+        """
+        existed: list[bool] = []
+        seen: set[bytes] = set()
+        for key, qualifier, value in cells:
+            try:
+                prior = key in seen or self.has_row(table, key)
+                self.put(table, key, family, qualifier, value, durable)
+            except PleaseThrottleError as e:
+                e.partial_existed = existed
+                raise
+            existed.append(prior)
+            seen.add(key)
+        return existed
+
     def delete(self, table: str, key: bytes, family: bytes,
                qualifiers: list[bytes]) -> None:
         raise NotImplementedError
@@ -198,15 +223,18 @@ class MemKVStore(KVStore):
 
     def has_row(self, table: str, key: bytes) -> bool:
         with self._lock:
-            row = self._table(table).rows.get(key)
-            if row:
-                # Tombstones (None cells) only exist once a lower tier
-                # does; the pure-memtable hot ingest path stays O(1).
-                if self._sst is None and self._frozen is None:
-                    return True
-                if any(v is not None for v in row.values()):
-                    return True
-            return self._merged_row(table, key) is not None
+            return self._has_row_locked(table, key)
+
+    def _has_row_locked(self, table: str, key: bytes) -> bool:
+        row = self._table(table).rows.get(key)
+        if row:
+            # Tombstones (None cells) only exist once a lower tier
+            # does; the pure-memtable hot ingest path stays O(1).
+            if self._sst is None and self._frozen is None:
+                return True
+            if any(v is not None for v in row.values()):
+                return True
+        return self._merged_row(table, key) is not None
 
     def cell_count(self, table: str, key: bytes) -> int:
         with self._lock:
@@ -479,6 +507,49 @@ class MemKVStore(KVStore):
                 self._wal_append(_OP_PUT, table.encode(), key, family,
                                  qualifier, value)
             self._apply_put(table, key, family, qualifier, value)
+
+    def put_many(self, table: str, family: bytes,
+                 cells: list[tuple[bytes, bytes, bytes]],
+                 durable: bool = True) -> list[bool]:
+        """Batched put: one lock acquisition and one existence probe per
+        distinct key for the whole batch — the ingest hot path writes one
+        cell per row-hour, so per-call locking dominated before this.
+        Semantics identical to a put() loop (WAL order, throttle check
+        per new row, partial application if throttled mid-batch).
+        """
+        existed: list[bool] = []
+        tenc = table.encode()
+        with self._lock:
+            t = self._table(table)
+            rows = t.rows
+            # With no lower tiers the memtable is the whole truth, so
+            # existence is one dict probe (the default-config hot path).
+            pure_mem = self._sst is None and self._frozen is None
+            throttle = self.throttle_rows
+            wal = self._wal is not None and durable
+            for key, qualifier, value in cells:
+                row = rows.get(key)
+                if row is None:
+                    if throttle is not None and len(rows) >= throttle:
+                        err = PleaseThrottleError(
+                            f"table '{table}' holds >= {throttle} rows")
+                        err.partial_existed = existed
+                        raise err
+                    e = (False if pure_mem
+                         else self._has_row_locked(table, key))
+                else:
+                    e = True if pure_mem \
+                        else self._has_row_locked(table, key)
+                # WAL before any visible mutation, same as put().
+                if wal:
+                    self._wal_append(_OP_PUT, tenc, key, family,
+                                     qualifier, value)
+                if row is None:
+                    row = rows[key] = {}
+                    t.dirty = True
+                row[(family, qualifier)] = value
+                existed.append(e)
+        return existed
 
     def delete(self, table: str, key: bytes, family: bytes,
                qualifiers: list[bytes]) -> None:

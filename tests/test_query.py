@@ -215,11 +215,12 @@ class TestNoLerpFamily:
     def sparse_tsdb(self):
         t = TSDB(MemKVStore(), Config(auto_create_metrics=True),
                  start_compaction_thread=False)
-        # Two hosts sampling at interleaved, never-coinciding times.
+        # Two hosts sampling at interleaved times, coinciding only at
+        # BT+20 (where min/max must actually pick between 20 and 999).
         t.add_batch("m.z", np.array([BT, BT + 20, BT + 40]),
                     np.array([10.0, 20.0, 30.0]), {"host": "a"})
-        t.add_batch("m.z", np.array([BT + 10, BT + 30]),
-                    np.array([100.0, 200.0]), {"host": "b"})
+        t.add_batch("m.z", np.array([BT + 10, BT + 20, BT + 30]),
+                    np.array([100.0, 999.0, 200.0]), {"host": "b"})
         return t
 
     def test_zimsum_never_interpolates(self, sparse_tsdb):
@@ -232,11 +233,13 @@ class TestNoLerpFamily:
             # Exact point values only -- a lerping sum would add ~105 at
             # BT+10 (host a lerps 15), zimsum reports the lone sample.
             np.testing.assert_allclose(
-                r.values, [10.0, 100.0, 20.0, 200.0, 30.0])
+                r.values, [10.0, 100.0, 1019.0, 200.0, 30.0])
 
     def test_mimmin_mimmax(self, sparse_tsdb):
+        # At BT+20 both hosts have samples (20 vs 999), pinning min vs
+        # max; elsewhere a single exact sample must pass through.
         for agg, want in (("mimmin", [10.0, 100.0, 20.0, 200.0, 30.0]),
-                          ("mimmax", [10.0, 100.0, 20.0, 200.0, 30.0])):
+                          ("mimmax", [10.0, 100.0, 999.0, 200.0, 30.0])):
             cpu, tpu = run_both(sparse_tsdb, QuerySpec("m.z", {},
                                                        aggregator=agg),
                                 start=BT, end=BT + 60)
@@ -249,4 +252,4 @@ class TestNoLerpFamily:
                           start=BT, end=BT + 60)
         (r,) = cpu
         # At BT+10 host a lerps to 15 -> 115 total under plain sum.
-        assert abs(r.values[1] - 115.0) < 1e-6
+        assert abs(r.values[1] - 115.0) < 1e-4

@@ -157,3 +157,46 @@ class TestThrottle:
         # frees capacity again.
         kv.delete_row(T, b"a")
         kv.put(T, b"c", F, b"q", b"v")
+
+
+class TestPutMany:
+    def test_existed_flags_match_put_loop(self):
+        s = MemKVStore()
+        s.ensure_table("t")
+        s.put("t", b"k1", b"f", b"q0", b"v0")
+        existed = s.put_many("t", b"f", [
+            (b"k1", b"q1", b"v1"),   # pre-existing row
+            (b"k2", b"q1", b"v1"),   # new row
+            (b"k2", b"q2", b"v2"),   # repeat within batch
+        ])
+        assert existed == [True, False, True]
+        assert len(s.get("t", b"k1")) == 2
+        assert len(s.get("t", b"k2")) == 2
+
+    def test_mid_batch_throttle_reports_partial(self):
+        from opentsdb_tpu.core.errors import PleaseThrottleError
+        s = MemKVStore(throttle_rows=2)
+        s.ensure_table("t")
+        s.put("t", b"k1", b"f", b"q", b"v")
+        with pytest.raises(PleaseThrottleError) as ei:
+            s.put_many("t", b"f", [
+                (b"k1", b"q2", b"v"),   # existing row: applies
+                (b"k2", b"q", b"v"),    # second row: applies (reaches cap)
+                (b"k3", b"q", b"v"),    # third row: throttled
+            ])
+        assert ei.value.partial_existed == [True, False]
+        assert len(s.get("t", b"k1")) == 2
+        assert s.has_row("t", b"k2")
+        assert not s.has_row("t", b"k3")
+
+    def test_wal_replay_matches_put_loop(self, tmp_path):
+        wal = str(tmp_path / "wal.log")
+        s = MemKVStore(wal_path=wal)
+        s.ensure_table("t")
+        s.put_many("t", b"f", [(b"a", b"q1", b"v1"), (b"b", b"q1", b"v2"),
+                               (b"a", b"q2", b"v3")])
+        s.flush()
+        s2 = MemKVStore(wal_path=wal)
+        rows = lambda st: [c for r in st.scan("t", b"", b"\xff" * 8)
+                           for c in r]
+        assert rows(s2) == rows(s) and len(rows(s)) == 3
