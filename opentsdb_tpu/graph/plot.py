@@ -15,6 +15,32 @@ import io
 from datetime import datetime, timezone
 
 
+def x_format(span: int) -> str:
+    """Time-span-adaptive tick format (reference Plot.java:342-357)."""
+    if span < 2100:           # < 35m
+        return "%H:%M:%S"
+    if span < 86400:          # < 1d
+        return "%H:%M"
+    if span < 604800:         # < 1w
+        return "%a %H:%M"
+    return "%Y/%m/%d"
+
+
+def _new_figure(width: int, height: int, facecolor: str = "white"):
+    """Thread-safe figure construction via the object API: the server
+    renders in a multi-worker pool, and pyplot's global figure registry
+    is not thread-safe."""
+    import matplotlib
+    matplotlib.use("Agg")
+    from matplotlib.backends.backend_agg import FigureCanvasAgg
+    from matplotlib.figure import Figure
+
+    fig = Figure(figsize=(width / 100, height / 100), dpi=100,
+                 facecolor=facecolor)
+    FigureCanvasAgg(fig)
+    return fig
+
+
 class Plot:
     """Accumulates (label, timestamps, values) series and renders a PNG."""
 
@@ -46,94 +72,147 @@ class Plot:
         self.height = height
 
     def _x_format(self) -> str:
-        """Time-span-adaptive tick format (reference Plot.java:342-357)."""
-        span = self.end_time - self.start_time
-        if span < 2100:           # < 35m
-            return "%H:%M:%S"
-        if span < 86400:          # < 1d
-            return "%H:%M"
-        if span < 604800:         # < 1w
-            return "%a %H:%M"
-        return "%Y/%m/%d"
+        return x_format(self.end_time - self.start_time)
 
     def render(self) -> bytes:
-        import matplotlib
-        matplotlib.use("Agg")
         import matplotlib.dates as mdates
-        import matplotlib.pyplot as plt
 
         p = self.params
         fg = "#" + p["fgcolor"].lstrip("x") if "fgcolor" in p else "black"
         bg = "#" + p["bgcolor"].lstrip("x") if "bgcolor" in p else "white"
-        fig, ax = plt.subplots(
-            figsize=(self.width / 100, self.height / 100), dpi=100,
-            facecolor=bg)
+        fig = _new_figure(self.width, self.height, facecolor=bg)
+        ax = fig.add_subplot()
         ax.set_facecolor(bg)
         ax2 = None
-        try:
-            has_data = False
-            handles = []
-            for label, ts, vals, options in self.series:
-                if len(ts) == 0:
-                    continue
-                has_data = True
-                x = [datetime.fromtimestamp(int(t), tz=timezone.utc)
-                     for t in ts]
-                style = ("--" if "dashed" in options
-                         else ":" if "dotted" in options
-                         else "." if "points" in options else "-")
-                target = ax
-                if "x1y2" in options:
-                    if ax2 is None:
-                        ax2 = ax.twinx()
-                        ax2.set_facecolor(bg)
-                    target = ax2
-                handles += target.plot(x, vals, style, label=label,
-                                       linewidth=1)
-            if not has_data:
-                ax.text(0.5, 0.5, "No data", transform=ax.transAxes,
-                        ha="center", va="center", fontsize=20, color=fg)
-            if "title" in p:
-                ax.set_title(p["title"], color=fg)
-            if "ylabel" in p:
-                ax.set_ylabel(p["ylabel"], color=fg)
-            if "ylog" in p:
-                ax.set_yscale("log")
-            if "yrange" in p:
-                lo, _, hi = p["yrange"].strip("[]").partition(":")
-                ax.set_ylim(float(lo) if lo else None,
-                            float(hi) if hi else None)
-            if ax2 is not None:
-                if "y2label" in p:
-                    ax2.set_ylabel(p["y2label"], color=fg)
-                if "y2log" in p:
-                    ax2.set_yscale("log")
-                if "y2range" in p:
-                    lo, _, hi = p["y2range"].strip("[]").partition(":")
-                    ax2.set_ylim(float(lo) if lo else None,
-                                 float(hi) if hi else None)
-                ax2.tick_params(colors=fg)
-            if has_data:
-                ax.set_xlim(
-                    datetime.fromtimestamp(self.start_time, tz=timezone.utc),
-                    datetime.fromtimestamp(self.end_time, tz=timezone.utc))
-                ax.xaxis.set_major_formatter(
-                    mdates.DateFormatter(self._x_format(), tz=timezone.utc))
-            if has_data and "nokey" not in p and handles:
-                loc = {"out": "upper left", "top left": "upper left",
-                       "top right": "upper right",
-                       "bottom left": "lower left",
-                       "bottom right": "lower right"}.get(
-                           p.get("key", ""), "best")
-                # One combined legend even when series split across axes.
-                ax.legend(handles=handles, loc=loc, fontsize=8)
-            ax.tick_params(colors=fg)
-            for spine in ax.spines.values():
-                spine.set_color(fg)
-            ax.grid(True, alpha=0.3)
-            fig.autofmt_xdate()
-            buf = io.BytesIO()
-            fig.savefig(buf, format="png", facecolor=bg)
-            return buf.getvalue()
-        finally:
-            plt.close(fig)
+        has_data = False
+        handles = []
+        for label, ts, vals, options in self.series:
+            if len(ts) == 0:
+                continue
+            has_data = True
+            x = [datetime.fromtimestamp(int(t), tz=timezone.utc)
+                 for t in ts]
+            style = ("--" if "dashed" in options
+                     else ":" if "dotted" in options
+                     else "." if "points" in options else "-")
+            target = ax
+            if "x1y2" in options:
+                if ax2 is None:
+                    ax2 = ax.twinx()
+                    ax2.set_facecolor(bg)
+                target = ax2
+            handles += target.plot(x, vals, style, label=label,
+                                   linewidth=1)
+        if not has_data:
+            ax.text(0.5, 0.5, "No data", transform=ax.transAxes,
+                    ha="center", va="center", fontsize=20, color=fg)
+        if "title" in p:
+            ax.set_title(p["title"], color=fg)
+        if "ylabel" in p:
+            ax.set_ylabel(p["ylabel"], color=fg)
+        if "ylog" in p:
+            ax.set_yscale("log")
+        if "yrange" in p:
+            lo, _, hi = p["yrange"].strip("[]").partition(":")
+            ax.set_ylim(float(lo) if lo else None,
+                        float(hi) if hi else None)
+        if ax2 is not None:
+            if "y2label" in p:
+                ax2.set_ylabel(p["y2label"], color=fg)
+            if "y2log" in p:
+                ax2.set_yscale("log")
+            if "y2range" in p:
+                lo, _, hi = p["y2range"].strip("[]").partition(":")
+                ax2.set_ylim(float(lo) if lo else None,
+                             float(hi) if hi else None)
+            ax2.tick_params(colors=fg)
+        if has_data:
+            ax.set_xlim(
+                datetime.fromtimestamp(self.start_time, tz=timezone.utc),
+                datetime.fromtimestamp(self.end_time, tz=timezone.utc))
+            ax.xaxis.set_major_formatter(
+                mdates.DateFormatter(self._x_format(), tz=timezone.utc))
+        if has_data and "nokey" not in p and handles:
+            loc = {"out": "upper left", "top left": "upper left",
+                   "top right": "upper right",
+                   "bottom left": "lower left",
+                   "bottom right": "lower right"}.get(
+                       p.get("key", ""), "best")
+            # One combined legend even when series split across axes.
+            ax.legend(handles=handles, loc=loc, fontsize=8)
+        ax.tick_params(colors=fg)
+        for spine in ax.spines.values():
+            spine.set_color(fg)
+        ax.grid(True, alpha=0.3)
+        fig.autofmt_xdate()
+        buf = io.BytesIO()
+        fig.savefig(buf, format="png", facecolor=bg)
+        return buf.getvalue()
+
+
+def render_forecast_png(series, start: int, end_future: int,
+                        width: int = 1024, height: int = 768,
+                        title: str | None = None,
+                        params: dict | None = None) -> bytes:
+    """Render forecast results: observed points, fitted curve, confidence
+    band, forecast continuation, anomaly markers.
+
+    ``series`` is a list of dicts with keys label, obs_ts/obs (observed),
+    fit_ts/fit (fitted one-step-ahead), upper/lower (same grid as fit,
+    may be None), fc_ts/fc (future forecast), anom_ts/anom (anomalous
+    points). ``params`` honors the shared display options yrange / ylog /
+    nokey. No reference analog — the reference's graphs are purely
+    descriptive.
+    """
+    import matplotlib.dates as mdates
+
+    p = params or {}
+
+    def dt(ts):
+        return [datetime.fromtimestamp(int(t), tz=timezone.utc)
+                for t in ts]
+
+    fig = _new_figure(width, height)
+    ax = fig.add_subplot()
+    for i, s in enumerate(series):
+        color = f"C{i % 10}"
+        if len(s["obs_ts"]):
+            ax.plot(dt(s["obs_ts"]), s["obs"], ".", color=color,
+                    markersize=3, alpha=0.6)
+        if s.get("upper") is not None and len(s["fit_ts"]):
+            ax.fill_between(dt(s["fit_ts"]), s["lower"], s["upper"],
+                            color=color, alpha=0.12, linewidth=0)
+        if len(s["fit_ts"]):
+            ax.plot(dt(s["fit_ts"]), s["fit"], "-", color=color,
+                    linewidth=1, label=s["label"])
+        if len(s["fc_ts"]):
+            ax.plot(dt(s["fc_ts"]), s["fc"], "--", color=color,
+                    linewidth=1.4)
+        if len(s.get("anom_ts", ())):
+            ax.scatter(dt(s["anom_ts"]), s["anom"], marker="x",
+                       color="#a02c10", s=45, zorder=5,
+                       label="_nolegend_")
+    if series and any(len(s["fc_ts"]) for s in series):
+        first_fc = min(int(s["fc_ts"][0]) for s in series
+                       if len(s["fc_ts"]))
+        ax.axvline(datetime.fromtimestamp(first_fc, tz=timezone.utc),
+                   color="#888", linewidth=0.8, linestyle=":")
+    ax.set_xlim(datetime.fromtimestamp(start, tz=timezone.utc),
+                datetime.fromtimestamp(end_future, tz=timezone.utc))
+    ax.xaxis.set_major_formatter(mdates.DateFormatter(
+        x_format(max(end_future - start, 1)), tz=timezone.utc))
+    if "ylog" in p:
+        ax.set_yscale("log")
+    if "yrange" in p:
+        lo, _, hi = p["yrange"].strip("[]").partition(":")
+        ax.set_ylim(float(lo) if lo else None,
+                    float(hi) if hi else None)
+    if title:
+        ax.set_title(title)
+    if "nokey" not in p and any(len(s["fit_ts"]) for s in series):
+        ax.legend(loc="best", fontsize=8)
+    ax.grid(True, alpha=0.3)
+    fig.autofmt_xdate()
+    buf = io.BytesIO()
+    fig.savefig(buf, format="png")
+    return buf.getvalue()

@@ -669,6 +669,49 @@ class TSDServer:
                     horizon=horizon, season_length=season, t_fitted=T))
             future_ts = grid0 + (T + np.arange(horizon)) * interval
             grid_ts = grid0 + np.arange(T) * interval
+
+            if "png" in q:
+                from opentsdb_tpu.graph.plot import render_forecast_png
+
+                rseries = []
+                for i, r in enumerate(results):
+                    label = r.metric + (
+                        "{" + ",".join(f"{k}={v}" for k, v in
+                                       sorted(r.tags.items())) + "}"
+                        if r.tags else "")
+                    mk = mask[i]
+                    anom = (bands["anomaly"][i] if bands is not None
+                            else np.zeros(T, bool))
+                    rseries.append({
+                        "label": label,
+                        "obs_ts": grid_ts[mk], "obs": vals[i][mk],
+                        "fit_ts": grid_ts[mk], "fit": fitted[i][mk],
+                        "upper": (bands["upper"][i][mk]
+                                  if bands is not None else None),
+                        "lower": (bands["lower"][i][mk]
+                                  if bands is not None else None),
+                        "fc_ts": future_ts, "fc": fc[i],
+                        "anom_ts": grid_ts[anom], "anom": vals[i][anom],
+                    })
+                width, height = 1024, 768
+                if "wxh" in q:
+                    ws, _, hs = q["wxh"].partition("x")
+                    try:
+                        width, height = int(ws), int(hs)
+                    except ValueError:
+                        raise BadRequestError(
+                            f"invalid wxh parameter: {q['wxh']}") \
+                            from None
+                    if not (8 <= width <= 4096 and 8 <= height <= 4096):
+                        raise BadRequestError(
+                            f"invalid dimensions {q['wxh']}")
+                return render_forecast_png(
+                    rseries, start, int(future_ts[-1]),
+                    width=width, height=height, title=q.get("title"),
+                    params={k: v for k, v in q.items()
+                            if k in ("yrange", "ylog", "nokey")}), \
+                    "image/png"
+
             out = []
             for i, r in enumerate(results):
                 entry = {
@@ -690,10 +733,10 @@ class TSDServer:
                         str(int(t)): float(v) for t, v, mk in
                         zip(grid_ts, bands["lower"][i], mask[i]) if mk}
                 out.append(entry)
-            return json.dumps(out).encode()
+            return json.dumps(out).encode(), "application/json"
 
-        body = await loop.run_in_executor(self._pool, compute)
-        return 200, "application/json", body, {}
+        body, ctype = await loop.run_in_executor(self._pool, compute)
+        return 200, ctype, body, {}
 
     # -- static files / home page --------------------------------------
 

@@ -346,6 +346,21 @@ class TestForecast:
         assert abs(first_fc - (last_fit + 2.0)) < 20.0
         assert BT + 60 * 150 in out[0]["anomalies"]
 
+    def test_forecast_png(self, server_env):
+        server, tsdb = server_env
+        ts = np.arange(BT, BT + 60 * 120, 60)
+        tsdb.add_batch("m.trend", ts, np.arange(120) * 2.0, {"host": "a"})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/forecast?start={BT}&end={BT + 60 * 120}"
+                f"&m=sum:1m-avg:m.trend&horizon=10&png")
+
+        status, head, body = run_async(server, drive)
+        assert status == 200
+        assert b"image/png" in head
+        assert body[:8] == b"\x89PNG\r\n\x1a\n"
+
     def test_forecast_requires_downsample(self, server_env):
         server, tsdb = server_env
         tsdb.add_batch("m.x", np.array([BT + 1]), np.array([7]), {"a": "b"})
