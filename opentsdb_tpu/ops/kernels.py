@@ -173,6 +173,36 @@ def group_moments(filled: jnp.ndarray, in_range: jnp.ndarray):
 # Fused downsample + group-by (the hot query kernel)
 # ---------------------------------------------------------------------------
 
+def _series_stage(ts, vals, sid, valid, *, num_series, num_buckets,
+                  interval, agg_down, with_ts: bool):
+    """Shared per-(series, bucket) downsample stage: one fused segment
+    reduction producing series_values/series_mask [S, B] (and, when
+    ``with_ts``, per-bucket integer-mean member timestamps)."""
+    bucket = jnp.clip(ts // interval, 0, num_buckets - 1)
+    seg = jnp.where(valid, sid * num_buckets + bucket,
+                    num_series * num_buckets)
+    nseg = num_series * num_buckets + 1  # +1 trash segment for padding
+    if with_ts:
+        # Mean member timestamp rides the same fused reduction, relative
+        # to bucket start for f32 exactness.
+        rel = (ts - bucket * interval).astype(jnp.float32)
+        count, total, sumsq, mn, mx, rel_sum = _segment_moments(
+            vals, seg, valid, nseg, extra=rel)
+    else:
+        count, total, sumsq, mn, mx = _segment_moments(
+            vals, seg, valid, nseg)
+    per = _finish(agg_down, count, total, sumsq, mn, mx)
+    shape = (num_series, num_buckets)
+    series_values = per[:-1].reshape(shape)
+    series_mask = count[:-1].reshape(shape) > 0
+    if not with_ts:
+        return series_values, series_mask, None
+    mean_rel = jnp.floor(rel_sum / jnp.maximum(count, 1.0))
+    bucket_starts = (jnp.arange(num_buckets, dtype=jnp.int32) * interval)
+    series_ts = bucket_starts[None, :] + mean_rel[:-1].reshape(shape) \
+        .astype(jnp.int32)
+    return series_values, series_mask, series_ts
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_series", "num_buckets", "interval", "agg_down",
@@ -204,26 +234,10 @@ def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
     aggregation on the shared bucket grid = the lerp-free fast path
     (identical grids need no interpolation).
     """
-    bucket = ts // interval
-    bucket = jnp.clip(bucket, 0, num_buckets - 1)
-    seg = jnp.where(valid, sid * num_buckets + bucket, num_series * num_buckets)
-    nseg = num_series * num_buckets + 1  # +1 trash segment for padding
-
-    # Mean member timestamp rides the same fused reduction, relative to
-    # bucket start for f32 exactness.
-    rel = (ts - bucket * interval).astype(jnp.float32)
-    count, total, sumsq, mn, mx, rel_sum = _segment_moments(
-        vals, seg, valid, nseg, extra=rel)
-    per = _finish(agg_down, count, total, sumsq, mn, mx)
-    mean_rel = jnp.floor(rel_sum / jnp.maximum(count, 1.0))
-
-    shape = (num_series, num_buckets)
-    series_values = per[:-1].reshape(shape)
-    series_count = count[:-1].reshape(shape)
-    series_mask = series_count > 0
-    bucket_starts = (jnp.arange(num_buckets, dtype=jnp.int32) * interval)
-    series_ts = bucket_starts[None, :] + mean_rel[:-1].reshape(shape) \
-        .astype(jnp.int32)
+    series_values, series_mask, series_ts = _series_stage(
+        ts, vals, sid, valid, num_series=num_series,
+        num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+        with_ts=True)
 
     # Group stage: aggregate across series on the shared bucket grid.
     # The no-lerp family skips gap filling: a series only contributes
@@ -244,6 +258,62 @@ def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
         # Emit only buckets where some series has a real point (the union
         # grid); lerp-filled contributions never create grid points.
         "group_mask": series_mask.any(axis=0),
+    }
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_series", "num_groups", "num_buckets", "interval",
+                     "agg_down", "agg_group"))
+def downsample_multigroup(ts: jnp.ndarray, vals: jnp.ndarray,
+                          sid: jnp.ndarray, valid: jnp.ndarray,
+                          group_of_sid: jnp.ndarray, *, num_series: int,
+                          num_groups: int, num_buckets: int, interval: int,
+                          agg_down: str, agg_group: str):
+    """Fused downsample + group-by for MANY group-by buckets in ONE call.
+
+    The reference materializes one SpanGroup per distinct group-by tag
+    combination and iterates them sequentially (TsdbQuery.java:294-363);
+    a wide ``host=*`` query therefore costs G separate aggregations. Here
+    all G groups ride two segment reductions: per-(series, bucket)
+    downsample, then per-(group, bucket) moments with ``group_of_sid``
+    [S] mapping each series to its group.
+
+    Args as downsample_group, plus group_of_sid [S] int32 in
+    [0, num_groups). Returns dict with group_values / group_mask shaped
+    [G, B]. Semantics per group are identical to calling
+    downsample_group on that group's series alone.
+    """
+    series_values, series_mask, _ = _series_stage(
+        ts, vals, sid, valid, num_series=num_series,
+        num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+        with_ts=False)
+
+    if agg_group in NOLERP_AGGS:
+        filled, in_range = series_values, series_mask
+    else:
+        filled, in_range = gap_fill(series_values, series_mask,
+                                    num_buckets)
+
+    b_idx = jnp.arange(num_buckets, dtype=jnp.int32)
+    gb = group_of_sid[:, None] * num_buckets + b_idx[None, :]
+    gn = num_groups * num_buckets + 1
+    gseg = jnp.where(in_range, gb, num_groups * num_buckets).reshape(-1)
+    g_count, g_total, g_m2, g_mn, g_mx = _segment_moments(
+        filled.reshape(-1), gseg, in_range.reshape(-1), gn)
+    group_values = _finish(agg_group, g_count, g_total, g_m2, g_mn,
+                           g_mx)[:-1].reshape(num_groups, num_buckets)
+    # A group's bucket is emitted when some member series has a REAL
+    # point there (lerp fills never create grid points).
+    rseg = jnp.where(series_mask, gb,
+                     num_groups * num_buckets).reshape(-1)
+    real = jax.ops.segment_sum(
+        series_mask.reshape(-1).astype(jnp.int32), rseg, gn)[:-1]
+    return {
+        "group_values": group_values,
+        "group_mask": real.reshape(num_groups, num_buckets) > 0,
+        "series_values": series_values,
+        "series_mask": series_mask,
     }
 
 

@@ -130,8 +130,8 @@ class QueryExecutor:
 
         spans: dict[bytes, list] = {}
         span_tags: dict[bytes, dict[bytes, bytes]] = {}
-        for key, cols in self.tsdb.scan_rows(start_key, stop_key,
-                                             key_regexp=regexp):
+        for key, cols in self.tsdb.scan_columns(start_key, stop_key,
+                                                key_regexp=regexp):
             skey = codec.series_key(key)
             if skey not in spans:
                 spans[skey] = []
@@ -188,11 +188,22 @@ class QueryExecutor:
         t0 = _time.time()
         groups = self._find_spans(spec, start, end)
         self.scan_latency.add((_time.time() - t0) * 1000)
+        gkeys = sorted(groups)
+        # Wide group-bys on the TPU backend batch into ONE kernel call
+        # (two segment reductions for all groups) instead of G calls.
+        if (self.backend != "cpu" and len(gkeys) > 1 and spec.downsample
+                and not spec.rate and agg.kind == "moment"):
+            per_group = self._run_tpu_multigroup(
+                spec, [groups[k] for k in gkeys], start, end)
+        else:
+            per_group = None
         results = []
-        for gkey in sorted(groups):
+        for gi, gkey in enumerate(gkeys):
             spans = groups[gkey]
             tags, aggregated = self._group_tags(spans)
-            if self.backend == "cpu":
+            if per_group is not None:
+                ts, vals = per_group[gi]
+            elif self.backend == "cpu":
                 ts, vals = self._run_cpu(spec, spans, start)
             else:
                 ts, vals = self._run_tpu(spec, spans, start, end)
@@ -325,14 +336,7 @@ class QueryExecutor:
         interval, dsagg = spec.downsample
         qbase = start - start % interval
         num_buckets = (end - qbase) // interval + 1
-        ts = np.concatenate([sp.timestamps for sp in spans])
-        vals = np.concatenate([sp.values for sp in spans]).astype(
-            np.float32)
-        sid = np.concatenate([
-            np.full(len(sp.timestamps), i, np.int32)
-            for i, sp in enumerate(spans)])
-        rel = (ts - qbase).astype(np.int32)
-        valid = np.ones(len(rel), bool)
+        rel, vals, sid, valid = self._flatten_spans(spans, qbase)
         agg = Aggregators.get(spec.aggregator)
         out = kernels.downsample_group(
             rel, vals, sid, valid, num_series=len(spans),
@@ -352,6 +356,55 @@ class QueryExecutor:
         # Epoch-aligned bucket-start timestamps (see module docstring).
         grid_ts = np.flatnonzero(gmask).astype(np.int64) * interval + qbase
         return grid_ts, values.astype(np.float64)
+
+    @staticmethod
+    def _flatten_spans(spans: list[_Span], qbase: int):
+        """Spans -> one flat (rel_ts, vals, sid, valid) point stream."""
+        ts = np.concatenate([sp.timestamps for sp in spans])
+        vals = np.concatenate(
+            [sp.values for sp in spans]).astype(np.float32)
+        sid = np.concatenate([
+            np.full(len(sp.timestamps), i, np.int32)
+            for i, sp in enumerate(spans)])
+        rel = (ts - qbase).astype(np.int32)
+        return rel, vals, sid, np.ones(len(rel), bool)
+
+    def _run_tpu_multigroup(self, spec: QuerySpec,
+                            span_groups: list[list[_Span]],
+                            start: int, end: int):
+        """All group-by buckets in one fused kernel call.
+
+        Flattens every group's spans into one point stream with a
+        series->group map; downsample_multigroup runs the per-series and
+        per-group reductions for all G groups at once. Returns
+        [(grid_ts, values)] aligned with span_groups.
+        """
+        interval, dsagg = spec.downsample
+        qbase = start - start % interval
+        num_buckets = int((end - qbase) // interval + 1)
+
+        all_spans: list[_Span] = []
+        group_of_sid: list[int] = []
+        for gi, spans in enumerate(span_groups):
+            for sp in spans:
+                all_spans.append(sp)
+                group_of_sid.append(gi)
+        rel, vals, sid, valid = self._flatten_spans(all_spans, qbase)
+        out = kernels.downsample_multigroup(
+            rel, vals, sid, valid,
+            np.asarray(group_of_sid, np.int32),
+            num_series=len(all_spans), num_groups=len(span_groups),
+            num_buckets=num_buckets, interval=interval, agg_down=dsagg,
+            agg_group=spec.aggregator)
+        gv = np.asarray(out["group_values"])
+        gm = np.asarray(out["group_mask"])
+        results = []
+        for gi in range(len(span_groups)):
+            mask = gm[gi]
+            grid_ts = (np.flatnonzero(mask).astype(np.int64) * interval
+                       + qbase)
+            results.append((grid_ts, gv[gi][mask].astype(np.float64)))
+        return results
 
     # ------------------------------------------------------------------
     # Cardinality (distinct tag values)

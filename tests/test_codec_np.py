@@ -127,3 +127,68 @@ class TestSortDedup:
             codec_np.sort_dedup(
                 np.array([1, 1]), np.array([7.0, 7.0]), np.array([7, 7]),
                 np.array([False, True]))
+
+
+class TestDecodeCellsFlat:
+    def test_differential_vs_decode_cell(self):
+        """Random mixed cells: the flat batch decoder must agree with the
+        per-cell decoder bit for bit."""
+        rng = np.random.default_rng(9)
+        cells = []
+        for _ in range(60):
+            n = int(rng.integers(1, 40))
+            deltas = np.sort(rng.choice(3600, n, replace=False))
+            isf = rng.random(n) < 0.5
+            iv = rng.integers(-2**40, 2**40, n)
+            iv[~isf & (rng.random(n) < 0.5)] = rng.integers(-100, 100)
+            fv = rng.normal(0, 1e3, n)
+            fv = np.where(isf, fv, iv.astype(np.float64))
+            qual, val = codec_np.encode_cell(deltas, fv, iv, isf)
+            cells.append((qual, val, int(rng.integers(0, 2**31, 1)[0])
+                          // 3600 * 3600))
+        flat = codec_np.decode_cells_flat(
+            [c[0] for c in cells], [c[1] for c in cells],
+            np.asarray([c[2] for c in cells], np.int64))
+        ts, fv, iv, isf, cop = flat
+        off = 0
+        for ci, (qual, val, base) in enumerate(cells):
+            ref = codec_np.decode_cell(qual, val, base)
+            n = len(ref.timestamps)
+            sl = slice(off, off + n)
+            assert (cop[sl] == ci).all()
+            np.testing.assert_array_equal(ts[sl], ref.timestamps)
+            np.testing.assert_array_equal(iv[sl], ref.int_values)
+            np.testing.assert_array_equal(isf[sl], ref.is_float)
+            np.testing.assert_array_equal(fv[sl], ref.values)
+            off += n
+        assert off == len(ts)
+
+    def test_legacy_float_repair_single_cell(self):
+        # 8-byte float with 4 leading zeros and flag width 4 (legacy bug).
+        import struct
+        qual = struct.pack(">H", (5 << 4) | 0x8 | 0x3)
+        val = b"\x00\x00\x00\x00" + struct.pack(">f", 1.5)
+        ts, fv, iv, isf, cop = codec_np.decode_cells_flat(
+            [qual], [val], np.asarray([3600], np.int64))
+        assert ts[0] == 3605 and fv[0] == 1.5 and isf[0]
+
+    def test_corrupt_compacted_meta_raises(self):
+        import struct
+        qual = struct.pack(">HH", (1 << 4) | 0x3, (2 << 4) | 0x3)
+        val = struct.pack(">ff", 1.0, 2.0) + b"\x01"  # bad meta byte
+        with pytest.raises(IllegalDataError):
+            codec_np.decode_cells_flat([qual], [val],
+                                       np.asarray([0], np.int64))
+
+    def test_empty_batch(self):
+        out = codec_np.decode_cells_flat([], [], np.empty(0, np.int64))
+        assert all(len(a) == 0 for a in out)
+
+
+class TestDecodeCellsFlatCorruption:
+    def test_empty_compacted_value_raises_illegal(self):
+        import struct
+        qual = struct.pack(">HH", (1 << 4) | 0x3, (2 << 4) | 0x3)
+        with pytest.raises(IllegalDataError):
+            codec_np.decode_cells_flat([qual], [b""],
+                                       np.asarray([0], np.int64))

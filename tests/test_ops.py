@@ -226,3 +226,40 @@ class TestGroupInterpolate:
         ots, ov = oracle.group_aggregate(series, "sum", interp="step")
         np.testing.assert_array_equal(np.asarray(grid)[gm], ots)
         np.testing.assert_allclose(np.asarray(out)[gm], ov)
+
+
+class TestDownsampleMultigroup:
+    @pytest.mark.parametrize("agg_group", ["sum", "avg", "dev", "min",
+                                           "max", "count", "zimsum"])
+    def test_matches_per_group_kernel(self, agg_group):
+        rng = np.random.default_rng(17)
+        S, G, B, interval = 12, 4, 10, 60
+        n = 600
+        ts = rng.integers(0, B * interval, n).astype(np.int32)
+        vals = rng.normal(50, 10, n).astype(np.float32)
+        sid = rng.integers(0, S, n).astype(np.int32)
+        valid = rng.random(n) > 0.1
+        group_of_sid = rng.integers(0, G, S).astype(np.int32)
+
+        out = kernels.downsample_multigroup(
+            ts, vals, sid, valid, group_of_sid, num_series=S,
+            num_groups=G, num_buckets=B, interval=interval,
+            agg_down="avg", agg_group=agg_group)
+
+        for g in range(G):
+            members = np.flatnonzero(group_of_sid == g)
+            pick = np.isin(sid, members)
+            # Renumber member sids locally for the per-group call.
+            local = {s: i for i, s in enumerate(members)}
+            lsid = np.array([local.get(s, 0) for s in sid], np.int32)
+            ref = kernels.downsample_group(
+                ts, vals, lsid, valid & pick, num_series=max(len(members), 1),
+                num_buckets=B, interval=interval, agg_down="avg",
+                agg_group=agg_group)
+            np.testing.assert_array_equal(
+                np.asarray(out["group_mask"])[g],
+                np.asarray(ref["group_mask"]))
+            m = np.asarray(ref["group_mask"])
+            np.testing.assert_allclose(
+                np.asarray(out["group_values"])[g][m],
+                np.asarray(ref["group_values"])[m], rtol=2e-5, atol=1e-3)
