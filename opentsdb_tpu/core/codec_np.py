@@ -214,8 +214,12 @@ def decode_cells_flat(cell_quals: list[bytes], cell_vals: list[bytes],
         & (vlens == 8)
     if rep.any():
         pos = vstarts[rep, None] + np.arange(4)
-        if vbuf[pos.ravel()].any():
-            raise IllegalDataError("Corrupted floating point value")
+        lead = vbuf[pos.ravel()].reshape(-1, 4)
+        if lead.any():
+            ci = int(np.flatnonzero(rep)[int(lead.any(axis=1).argmax())])
+            raise IllegalDataError(
+                "Corrupted floating point value: "
+                f"{cell_vals[ci].hex()}")
         adj_vstart[rep] += 4
         adj_vlen[rep] -= 4
     widths = widths.copy()
@@ -238,16 +242,16 @@ def decode_cells_flat(cell_quals: list[bytes], cell_vals: list[bytes],
     np.cumsum(widths, out=gcum[1:])
     offsets = gcum[:-1] - gcum[first_pt][cell_of_point] \
         + adj_vstart[cell_of_point]
+    # Single cells can't mismatch: their one width was just set from the
+    # value length, so only compacted cells need the consumed check.
     consumed = gcum[first_pt + npts] - gcum[first_pt]
-    expect = np.where(multi, adj_vlen - 1, adj_vlen)
-    if (consumed != expect).any():
-        i = int(np.flatnonzero(consumed != expect)[0])
-        if multi[i]:
-            raise IllegalDataError(
-                f"Corrupted value: couldn't break down into individual "
-                f"values (consumed {int(consumed[i])} bytes, but was "
-                f"expecting to consume {int(expect[i])})")
-        raise IllegalDataError("single-cell value length mismatch")
+    bad = multi & (consumed != adj_vlen - 1)
+    if bad.any():
+        i = int(np.flatnonzero(bad)[0])
+        raise IllegalDataError(
+            f"Corrupted value: couldn't break down into individual "
+            f"values (consumed {int(consumed[i])} bytes, but was "
+            f"expecting to consume {int(adj_vlen[i] - 1)})")
 
     n = len(deltas)
     fvals = np.zeros(n, np.float64)

@@ -231,15 +231,17 @@ class TSDB:
         if cells is None:
             cells = self.store.get(self.table, key, FAMILY)
         base_ts = codec.parse_row_key(key).base_time
-        parts = [codec_np.decode_cell(c.qualifier, c.value, base_ts)
-                 for c in cells if len(c.qualifier) % 2 == 0 and c.qualifier]
-        if not parts:
+        kept = [c for c in cells
+                if len(c.qualifier) % 2 == 0 and c.qualifier]
+        if not kept:
             return codec.columns_concat([])
-        if len(parts) == 1:
-            return parts[0]  # compacted cells are sorted by construction
-        cat = codec.columns_concat(parts)
-        d, f, i, isf = codec_np.sort_dedup(
-            cat.timestamps, cat.values, cat.int_values, cat.is_float)
+        ts, f, i, isf, _ = codec_np.decode_cells_flat(
+            [c.qualifier for c in kept], [c.value for c in kept],
+            np.full(len(kept), base_ts, np.int64))
+        if len(kept) == 1:
+            # compacted cells are sorted by construction
+            return codec.Columns(ts, f, i, isf)
+        d, f, i, isf = codec_np.sort_dedup(ts, f, i, isf)
         return codec.Columns(d, f, i, isf)
 
     def scan_rows(self, start_key: bytes, stop_key: bytes,

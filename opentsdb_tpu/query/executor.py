@@ -335,12 +335,16 @@ class QueryExecutor:
         """The fused fast path: flat downsample + cross-series group."""
         interval, dsagg = spec.downsample
         qbase = start - start % interval
-        num_buckets = (end - qbase) // interval + 1
+        # Pad the static kernel shapes to power-of-two buckets: padded
+        # series/buckets hold no points, contribute nothing, and are
+        # trimmed by group_mask — but the jit cache stops keying on the
+        # exact (S, B) of every distinct query.
+        num_buckets = _pad_size(int((end - qbase) // interval + 1))
         rel, vals, sid, valid = self._flatten_spans(spans, qbase)
         agg = Aggregators.get(spec.aggregator)
         out = kernels.downsample_group(
-            rel, vals, sid, valid, num_series=len(spans),
-            num_buckets=int(num_buckets), interval=interval,
+            rel, vals, sid, valid, num_series=_pad_size(len(spans)),
+            num_buckets=num_buckets, interval=interval,
             agg_down=dsagg,
             agg_group=spec.aggregator if agg.kind == "moment" else "count")
         gmask = np.asarray(out["group_mask"])
@@ -381,7 +385,7 @@ class QueryExecutor:
         """
         interval, dsagg = spec.downsample
         qbase = start - start % interval
-        num_buckets = int((end - qbase) // interval + 1)
+        num_buckets = _pad_size(int((end - qbase) // interval + 1))
 
         all_spans: list[_Span] = []
         group_of_sid: list[int] = []
@@ -390,10 +394,17 @@ class QueryExecutor:
                 all_spans.append(sp)
                 group_of_sid.append(gi)
         rel, vals, sid, valid = self._flatten_spans(all_spans, qbase)
+        # Shapes padded to power-of-two buckets (see _tpu_downsample_group);
+        # padded series map to the last padded group and contribute
+        # nothing.
+        S = _pad_size(len(all_spans))
+        G = _pad_size(len(span_groups))
+        gmap = np.zeros(S, np.int32)
+        gmap[:len(group_of_sid)] = group_of_sid
+        gmap[len(group_of_sid):] = G - 1
         out = kernels.downsample_multigroup(
-            rel, vals, sid, valid,
-            np.asarray(group_of_sid, np.int32),
-            num_series=len(all_spans), num_groups=len(span_groups),
+            rel, vals, sid, valid, gmap,
+            num_series=S, num_groups=G,
             num_buckets=num_buckets, interval=interval, agg_down=dsagg,
             agg_group=spec.aggregator)
         gv = np.asarray(out["group_values"])

@@ -188,3 +188,44 @@ class TestLifecycle:
         tsdb.collect_stats(C())
         assert seen["datapoints.added"] == 1
         assert "uid.cache-size" in seen
+
+
+class TestScanColumns:
+    def test_matches_scan_rows_with_junk_cells(self, tsdb):
+        """Foreign (odd-qualifier / annotation-style) cells interleaved
+        with data cells must not shift any row's point slices."""
+        rng = np.random.default_rng(4)
+        for h in range(6):
+            ts = BT + np.sort(rng.choice(7200, 40, replace=False))
+            tsdb.add_batch("m.s", ts, rng.normal(0, 1, 40),
+                           {"host": f"h{h}"})
+        # Multi-cell row: second batch into an existing row-hour.
+        tsdb.add_batch("m.s", np.array([BT + 3599]), np.array([9.5]),
+                       {"host": "h0"})
+        # Junk cells: odd-length and empty qualifiers inside data rows.
+        key = tsdb.row_key_for("m.s", {"host": "h1"}, BT)
+        tsdb.store.put(tsdb.table, key, FAMILY, b"\x01\x02\x03", b"junk")
+        key2 = tsdb.row_key_for("m.s", {"host": "h3"}, BT)
+        tsdb.store.put(tsdb.table, key2, FAMILY, b"\x05", b"note")
+
+        lo, hi = b"", b"\xff" * 32
+        batched = tsdb.scan_columns(lo, hi)
+        streamed = list(tsdb.scan_rows(lo, hi))
+        assert len(batched) == len(streamed) > 0
+        for (bk, bc), (sk, sc) in zip(batched, streamed):
+            assert bk == sk
+            np.testing.assert_array_equal(bc.timestamps, sc.timestamps)
+            np.testing.assert_array_equal(bc.values, sc.values)
+            np.testing.assert_array_equal(bc.int_values, sc.int_values)
+            np.testing.assert_array_equal(bc.is_float, sc.is_float)
+
+    def test_row_of_only_junk_cells_is_empty(self, tsdb):
+        tsdb.add_point("m.j", BT + 1, 1, {"a": "b"})
+        key = tsdb.row_key_for("m.j", {"a": "b"}, BT)
+        tsdb.store.delete(tsdb.table, key, FAMILY,
+                          [c.qualifier for c in
+                           tsdb.store.get(tsdb.table, key, FAMILY)])
+        tsdb.store.put(tsdb.table, key, FAMILY, b"\x01", b"x")
+        out = tsdb.scan_columns(b"", b"\xff" * 32)
+        row = [c for k, c in out if k == key]
+        assert len(row) == 1 and len(row[0].timestamps) == 0
