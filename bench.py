@@ -277,6 +277,32 @@ def main() -> int:
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # Watchdog: device discovery blocks FOREVER if the TPU tunnel is
+        # wedged (e.g. a previous jit was killed mid-compile). Probe in a
+        # daemon thread; fall back to CPU so the bench always reports.
+        import threading
+        probe: list = []
+
+        def _probe():
+            try:
+                probe.append(jax.devices()[0])
+            except Exception as e:  # pragma: no cover - plugin-dependent
+                probe.append(e)
+
+        t = threading.Thread(target=_probe, daemon=True)
+        t.start()
+        t.join(timeout=180)
+        if not probe or isinstance(probe[0], Exception):
+            log("TPU device init unavailable (wedged tunnel?); "
+                "falling back to CPU — treat numbers as non-TPU")
+            # The hung probe thread keeps the axon backend init blocked;
+            # re-exec under a clean CPU-pinned process for correctness.
+            os.execvpe(sys.executable,
+                       [sys.executable, os.path.abspath(__file__)]
+                       + [a for a in sys.argv[1:] if a != "--cpu"]
+                       + ["--cpu"],
+                       dict(os.environ, JAX_PLATFORMS="cpu"))
     dev = jax.devices()[0]
     log(f"device: {dev}")
 
@@ -337,6 +363,9 @@ def main() -> int:
         "value": round(ing["batch_dps"]),
         "unit": "datapoints/s",
         "vs_baseline": round(ing["speedup"], 2),
+        # Which device actually ran: consumers must not record a CPU
+        # fallback (wedged-tunnel watchdog) as a TPU number.
+        "device": str(dev),
     }), flush=True)
     return 0
 

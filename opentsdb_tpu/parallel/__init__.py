@@ -5,11 +5,21 @@ servers (SURVEY.md §2.9). Here the analog is explicit: series are sharded
 across TPU chips over a ``jax.sharding.Mesh``; per-chip segment reductions
 produce partial aggregates that merge across ICI with ``psum``-family
 collectives; sketch states merge with ``pmax`` (HLL) / gather+recompress
-(t-digest). Time-axis sharding exchanges boundary carries between
-neighbors for rate/lerp correctness (the ring-attention analog for the
-time dimension, SURVEY.md §5.7).
+(t-digest). Time-axis sharding (timeshard) exchanges boundary carries
+between neighbors for rate/lerp correctness (the ring-attention analog
+for the time dimension, SURVEY.md §5.7); expert routing (expert) runs
+mixed aggregator families on device groups under one jit; hybrid
+ICI x DCN meshes (multihost) scale past one host with only
+compression-bounded partials crossing DCN.
 """
 
-from opentsdb_tpu.parallel.mesh import SERIES_AXIS, TIME_AXIS, make_mesh
+from opentsdb_tpu.parallel.mesh import (
+    EXPERT_AXIS,
+    HOST_AXIS,
+    SERIES_AXIS,
+    TIME_AXIS,
+    make_mesh,
+)
 
-__all__ = ["make_mesh", "SERIES_AXIS", "TIME_AXIS"]
+__all__ = ["make_mesh", "SERIES_AXIS", "TIME_AXIS", "EXPERT_AXIS",
+           "HOST_AXIS"]

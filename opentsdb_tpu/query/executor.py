@@ -394,9 +394,11 @@ class QueryExecutor:
                 all_spans.append(sp)
                 group_of_sid.append(gi)
         rel, vals, sid, valid = self._flatten_spans(all_spans, qbase)
-        # Shapes padded to power-of-two buckets (see _tpu_downsample_group);
-        # padded series map to the last padded group and contribute
-        # nothing.
+        # Shapes padded to power-of-two buckets (see
+        # _tpu_downsample_group). Padded series are assigned group G-1
+        # (possibly a REAL group when the count is already a power of
+        # two) — safe solely because padded series carry no points, so
+        # they contribute nothing wherever they land.
         S = _pad_size(len(all_spans))
         G = _pad_size(len(span_groups))
         gmap = np.zeros(S, np.int32)

@@ -45,6 +45,21 @@ def common_args(p: argparse.ArgumentParser) -> None:
 
 
 def make_tsdb(args, start_thread: bool = False) -> TSDB:
+    if getattr(args, "backend", None) == "cpu":
+        # Pin the JAX platform BEFORE any kernel import initializes the
+        # default backend: with --backend cpu nothing should ever touch
+        # an accelerator plugin (whose init can block when the device is
+        # held or its tunnel is wedged).
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as e:  # pragma: no cover - env-dependent
+            # Import failure is tolerable (pure-CPU oracle paths never
+            # need jax); a failed pin after backend init is NOT silent —
+            # the accelerator plugin might hang this process.
+            if not isinstance(e, ImportError):
+                LOG.warning("could not pin jax to CPU: %s", e)
     cfg = Config(
         table=args.table, uidtable=args.uidtable, wal_path=args.wal,
         backend=args.backend, auto_create_metrics=args.auto_metric)
@@ -197,11 +212,30 @@ def cmd_query(args) -> int:
     ex = QueryExecutor(tsdb)
     spec = QuerySpec(metric, tag_map, aggregator=agg, rate=rate,
                      downsample=downsample)
-    for r in ex.run(spec, start, end):
-        tag_str = " ".join(f"{k}={v}" for k, v in sorted(r.tags.items()))
-        for ts, v in zip(r.timestamps, r.values):
-            vs = str(int(v)) if float(v).is_integer() else repr(float(v))
-            print(f"{r.metric} {int(ts)} {vs} {tag_str}".rstrip())
+    results = ex.run(spec, start, end)
+    if getattr(args, "graph", None):
+        # CliQuery's --graph wrote gnuplot data files (:222-243); the
+        # matplotlib pipeline writes the finished PNG directly.
+        from opentsdb_tpu.graph.plot import Plot
+
+        plot = Plot(start, end)
+        for r in results:
+            label = r.metric + ("{" + ",".join(
+                f"{k}={v}" for k, v in sorted(r.tags.items())) + "}"
+                if r.tags else "")
+            plot.add(label, r.timestamps, r.values)
+        path = args.graph + ".png"
+        with open(path, "wb") as f:
+            f.write(plot.render())
+        print(f"wrote {path}")
+    else:
+        for r in results:
+            tag_str = " ".join(
+                f"{k}={v}" for k, v in sorted(r.tags.items()))
+            for ts, v in zip(r.timestamps, r.values):
+                vs = (str(int(v)) if float(v).is_integer()
+                      else repr(float(v)))
+                print(f"{r.metric} {int(ts)} {vs} {tag_str}".rstrip())
     tsdb.shutdown()
     return 0
 
@@ -462,6 +496,8 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("query", help="run a query")
     common_args(p)
+    p.add_argument("--graph", metavar="BASEPATH",
+                   help="write BASEPATH.png instead of printing ascii")
     p.add_argument("args", nargs="+")
     p.set_defaults(fn=cmd_query)
 

@@ -62,6 +62,18 @@ class TestImportQuery:
         assert len(out) == 2  # two 60s buckets
         assert out[0] == f"m.ds {BT} 2.5 a=b"
 
+    def test_query_graph_writes_png(self, tmp_path, wal, capsys):
+        f = write_datafile(tmp_path / "d.txt", [
+            f"m.g {BT + i * 10} {i} a=b" for i in range(12)
+        ])
+        main(["import", "--wal", wal, f])
+        capsys.readouterr()
+        base = str(tmp_path / "graph")
+        main(["query", "--wal", wal, "--graph", base,
+              str(BT), str(BT + 120), "sum", "m.g"])
+        png = (tmp_path / "graph.png").read_bytes()
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
 
 class TestScan:
     def test_scan_import_roundtrip(self, tmp_path, wal, capsys):
