@@ -67,9 +67,19 @@ class _Span(NamedTuple):
 
 
 class QueryExecutor:
-    def __init__(self, tsdb, backend: str | None = None) -> None:
+    def __init__(self, tsdb, backend: str | None = None,
+                 mesh=None) -> None:
+        """``mesh``: optional jax.sharding.Mesh. When set, fused
+        downsample queries distribute over it — series-sharded
+        (parallel.sharded) when a group has at least one series per
+        chip, time-sharded (parallel.timeshard) for long single-series
+        ranges — with psum/all-gather fan-in. Without a mesh every
+        kernel runs single-device (the reference's whole deployment
+        model is single-process per TSD; the mesh is this build's
+        scale-up axis)."""
         self.tsdb = tsdb
         self.backend = backend or tsdb.config.backend
+        self.mesh = mesh
         # Scan-phase latency digest, the analog of TsdbQuery.scanlatency
         # (reference src/core/TsdbQuery.java:52,278).
         from opentsdb_tpu.stats.collector import LatencyDigest
@@ -340,8 +350,13 @@ class QueryExecutor:
         # trimmed by group_mask — but the jit cache stops keying on the
         # exact (S, B) of every distinct query.
         num_buckets = _pad_size(int((end - qbase) // interval + 1))
-        rel, vals, sid, valid = self._flatten_spans(spans, qbase)
         agg = Aggregators.get(spec.aggregator)
+        if self.mesh is not None and agg.kind == "moment":
+            sharded = self._tpu_downsample_sharded(
+                spec, spans, qbase, interval, dsagg, num_buckets)
+            if sharded is not None:
+                return sharded
+        rel, vals, sid, valid = self._flatten_spans(spans, qbase)
         out = kernels.downsample_group(
             rel, vals, sid, valid, num_series=_pad_size(len(spans)),
             num_buckets=num_buckets, interval=interval,
@@ -360,6 +375,53 @@ class QueryExecutor:
         # Epoch-aligned bucket-start timestamps (see module docstring).
         grid_ts = np.flatnonzero(gmask).astype(np.int64) * interval + qbase
         return grid_ts, values.astype(np.float64)
+
+    def _tpu_downsample_sharded(self, spec: QuerySpec, spans: list[_Span],
+                                qbase: int, interval: int, dsagg: str,
+                                num_buckets: int):
+        """Distribute one group's fused downsample over self.mesh.
+
+        Series-parallel when the group has >= one series per chip
+        (zero-comm local downsample, psum group fan-in); time-parallel
+        for long ranges with few series (bucket-aligned tiles, edge-
+        summary carries). Returns (grid_ts, values) or None when neither
+        layout pays (the caller falls back to single-device).
+        """
+        from opentsdb_tpu.parallel.mesh import TIME_AXIS, Mesh
+        from opentsdb_tpu.parallel.sharded import (
+            pack_shards,
+            sharded_downsample_group,
+        )
+        from opentsdb_tpu.parallel.timeshard import (
+            pack_time_shards,
+            timeshard_downsample_group,
+        )
+
+        D = int(self.mesh.devices.size)
+        if len(spans) >= D:
+            series = [((sp.timestamps - qbase).astype(np.int64),
+                       sp.values) for sp in spans]
+            ts, vals, sid, valid, sps = pack_shards(series, D)
+            gv, gm = sharded_downsample_group(
+                ts, vals, sid, valid, mesh=self.mesh,
+                series_per_shard=_pad_size(sps), num_buckets=num_buckets,
+                interval=interval, agg_down=dsagg,
+                agg_group=spec.aggregator)
+        elif num_buckets >= 4 * D:
+            bps = -(-num_buckets // D)
+            rel, vals, sid, valid = self._flatten_spans(spans, qbase)
+            tsh = pack_time_shards(rel[valid], vals[valid], sid[valid], D,
+                                   interval, bps)
+            tmesh = Mesh(self.mesh.devices.reshape(-1), (TIME_AXIS,))
+            gv, gm = timeshard_downsample_group(
+                *tsh, mesh=tmesh, num_series=_pad_size(len(spans)),
+                buckets_per_shard=bps, interval=interval, agg_down=dsagg,
+                agg_group=spec.aggregator)
+        else:
+            return None
+        gm = np.asarray(gm)
+        grid_ts = np.flatnonzero(gm).astype(np.int64) * interval + qbase
+        return grid_ts, np.asarray(gv)[gm].astype(np.float64)
 
     @staticmethod
     def _flatten_spans(spans: list[_Span], qbase: int):

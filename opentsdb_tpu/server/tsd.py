@@ -73,7 +73,14 @@ _CONTENT_TYPES = {
 class TSDServer:
     def __init__(self, tsdb, executor: QueryExecutor | None = None) -> None:
         self.tsdb = tsdb
-        self.executor = executor or QueryExecutor(tsdb)
+        if executor is None:
+            mesh = None
+            if tsdb.config.mesh_devices > 1:
+                from opentsdb_tpu.parallel import make_mesh
+
+                mesh = make_mesh(tsdb.config.mesh_devices)
+            executor = QueryExecutor(tsdb, mesh=mesh)
+        self.executor = executor
         self.config = tsdb.config
         self._server: asyncio.AbstractServer | None = None
         self._shutdown = asyncio.Event()

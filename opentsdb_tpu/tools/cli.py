@@ -70,6 +70,7 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         cfg.cachedir = args.cachedir
         cfg.flush_interval = args.flush_interval
         cfg.checkpoint_interval = getattr(args, "checkpoint_interval", 0.0)
+        cfg.mesh_devices = getattr(args, "mesh_devices", 0)
     store = MemKVStore(wal_path=args.wal)
     return TSDB(store, cfg, start_compaction_thread=start_thread)
 
@@ -487,6 +488,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--checkpoint-interval", type=float, default=0.0,
                    help="seconds between sstable spills + WAL truncation "
                         "(0 disables; requires --wal)")
+    p.add_argument("--mesh-devices", type=int, default=0,
+                   help="shard fused queries over the first N local "
+                        "chips (0 = single-device)")
     p.set_defaults(fn=cmd_tsd)
 
     p = sub.add_parser("import", help="bulk import text files")

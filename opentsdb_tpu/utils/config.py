@@ -33,6 +33,9 @@ class Config:
 
     # compute backend: 'tpu' = jitted JAX kernels; 'cpu' = numpy oracle
     backend: str = "tpu"
+    # device mesh for distributed query execution: 0 = single-device;
+    # N>1 = shard fused downsample queries over the first N local chips
+    mesh_devices: int = 0
 
     # network
     port: int = 4242
