@@ -156,6 +156,98 @@ def gap_fill(series_values: jnp.ndarray, series_mask: jnp.ndarray,
     return jnp.where(in_range, filled, 0.0), in_range
 
 
+def bucket_rate(series_values: jnp.ndarray, series_mask: jnp.ndarray,
+                interval: int, counter_max=0.0, reset_value=0.0, *,
+                counter: bool = False, drop_resets: bool = False,
+                glob_offset=0, left_idx=None, left_val=None):
+    """Per-series rate of change on the shared bucket grid.
+
+    Each nonempty bucket's rate is its backward difference against the
+    series' previous nonempty bucket (bucket-start timestamps, so
+    dt = (b - prev_b) * interval) — the downsample-then-rate composition
+    the reference builds from iterators (SpanGroup.java:736-784 computes
+    rates from consecutive downsampled points). The first nonempty bucket
+    of a series yields no rate, matching oracle.rate.
+
+    The optional carry args serve the time-sharded path: ``left_idx`` [S]
+    is the series' nearest nonempty *global* bucket before this tile's
+    window (-1 = none) and ``left_val`` its value; a tile-first bucket
+    differences against that instead of having no predecessor.
+    ``glob_offset`` maps local bucket indices to global ones.
+
+    Returns (rates [S, B] float32, ok [S, B] bool).
+    """
+    S, B = series_values.shape
+    b_idx = jnp.arange(B, dtype=jnp.int32)
+    masked_idx = jnp.where(series_mask, b_idx[None, :], -1)
+    prev_incl = jax.lax.cummax(masked_idx, axis=1)
+    prev_excl = jnp.concatenate(
+        [jnp.full((S, 1), -1, jnp.int32), prev_incl[:, :-1]], axis=1)
+    has_local = prev_excl >= 0
+    p = jnp.clip(prev_excl, 0, B - 1)
+    prev_val = jnp.take_along_axis(series_values, p, axis=1)
+    prev_glob = glob_offset + prev_excl
+    if left_idx is not None:
+        use_carry = ~has_local & (left_idx[:, None] >= 0)
+        prev_glob = jnp.where(use_carry, left_idx[:, None], prev_glob)
+        prev_val = jnp.where(use_carry, left_val[:, None], prev_val)
+        has_prev = has_local | use_carry
+    else:
+        has_prev = has_local
+    glob = glob_offset + b_idx[None, :]
+    dt = jnp.maximum((glob - prev_glob).astype(jnp.float32) * interval,
+                     1e-9)
+    dv = series_values - prev_val
+    if counter:
+        dv = jnp.where(dv < 0, dv + counter_max, dv)
+    r = dv / dt
+    if drop_resets:
+        r = jnp.where(jnp.abs(r) > reset_value, 0.0, r)
+    ok = series_mask & has_prev
+    return jnp.where(ok, r, 0.0), ok
+
+
+def step_fill(series_values: jnp.ndarray, series_mask: jnp.ndarray,
+              num_buckets: int, *, left_idx=None, left_val=None,
+              right_idx=None):
+    """Last-value-hold fill of empty buckets (the rate counterpart of
+    gap_fill: rates step between points, SpanGroup.java:736-784 /
+    oracle.group_aggregate(interp='step')).
+
+    A series contributes its previous bucket's value in empty buckets
+    between its first and last nonempty ones, nothing outside. The carry
+    args serve the time-sharded path; unlike gap_fill, only presence and
+    the *left* value matter to a step hold (no distances, no right
+    value), so the global-index plumbing stops at the flags: ``left_idx``
+    [S] >= 0 means the series has a nonempty bucket on an earlier tile
+    with value ``left_val``; ``right_idx`` [S] < 2^31-1 means one exists
+    on a later tile. Returns (filled [S, B], in_range [S, B]).
+    """
+    b_idx = jnp.arange(num_buckets, dtype=jnp.int32)
+    prev_loc = jax.lax.cummax(
+        jnp.where(series_mask, b_idx[None, :], -1), axis=1)
+    next_loc = jax.lax.cummin(
+        jnp.where(series_mask, b_idx[None, :], num_buckets), axis=1,
+        reverse=True)
+    has_prev_loc = prev_loc >= 0
+    has_next_loc = next_loc < num_buckets
+    p = jnp.clip(prev_loc, 0, num_buckets - 1)
+    y0 = jnp.take_along_axis(series_values, p, axis=1)
+    if left_idx is None:
+        prev_ok = has_prev_loc
+        prev_val = y0
+    else:
+        prev_ok = has_prev_loc | (left_idx[:, None] >= 0)
+        prev_val = jnp.where(has_prev_loc, y0, left_val[:, None])
+    if right_idx is None:
+        next_ok = has_next_loc
+    else:
+        next_ok = has_next_loc | (right_idx[:, None] < _I32_BIG)
+    in_range = prev_ok & next_ok
+    filled = jnp.where(series_mask, series_values, prev_val)
+    return jnp.where(in_range, filled, 0.0), in_range
+
+
 def group_moments(filled: jnp.ndarray, in_range: jnp.ndarray):
     """Masked per-bucket moments across series (axis 0): count, total,
     centered M2, mean, min, max."""
@@ -206,11 +298,13 @@ def _series_stage(ts, vals, sid, valid, *, num_series, num_buckets,
 @functools.partial(
     jax.jit,
     static_argnames=("num_series", "num_buckets", "interval", "agg_down",
-                     "agg_group"))
+                     "agg_group", "rate", "counter", "drop_resets"))
 def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
                      valid: jnp.ndarray, *, num_series: int,
                      num_buckets: int, interval: int, agg_down: str,
-                     agg_group: str):
+                     agg_group: str, rate: bool = False,
+                     counter_max: float = 0.0, reset_value: float = 0.0,
+                     counter: bool = False, drop_resets: bool = False):
     """Downsample every series into aligned buckets, then aggregate across
     series — one fused computation.
 
@@ -233,17 +327,31 @@ def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
     oracle.downsample(mode='aligned', bucket_ts='avg'); cross-series
     aggregation on the shared bucket grid = the lerp-free fast path
     (identical grids need no interpolation).
+
+    ``rate=True`` inserts the rate stage between downsample and group
+    (reference pipeline order: SGIterator computes rates from consecutive
+    downsampled points, SpanGroup.java:736-784): series_values/series_mask
+    become the per-bucket rates and their validity (each series' first
+    nonempty bucket yields none), and the group stage step-fills instead
+    of lerping — all still one fused computation.
     """
     series_values, series_mask, series_ts = _series_stage(
         ts, vals, sid, valid, num_series=num_series,
         num_buckets=num_buckets, interval=interval, agg_down=agg_down,
         with_ts=True)
+    if rate:
+        series_values, series_mask = bucket_rate(
+            series_values, series_mask, interval, counter_max,
+            reset_value, counter=counter, drop_resets=drop_resets)
 
     # Group stage: aggregate across series on the shared bucket grid.
     # The no-lerp family skips gap filling: a series only contributes
-    # where it actually has a bucket.
+    # where it actually has a bucket. Rates step-hold; plain values lerp.
     if agg_group in NOLERP_AGGS:
         filled, in_range = series_values, series_mask
+    elif rate:
+        filled, in_range = step_fill(series_values, series_mask,
+                                     num_buckets)
     else:
         filled, in_range = gap_fill(series_values, series_mask,
                                     num_buckets)
@@ -256,7 +364,8 @@ def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
         "series_mask": series_mask,
         "group_values": group_values,
         # Emit only buckets where some series has a real point (the union
-        # grid); lerp-filled contributions never create grid points.
+        # grid); filled contributions never create grid points. With rate,
+        # "real" means a real rate (first points emit none).
         "group_mask": series_mask.any(axis=0),
     }
 
@@ -264,12 +373,16 @@ def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
 @functools.partial(
     jax.jit,
     static_argnames=("num_series", "num_groups", "num_buckets", "interval",
-                     "agg_down", "agg_group"))
+                     "agg_down", "agg_group", "rate", "counter",
+                     "drop_resets"))
 def downsample_multigroup(ts: jnp.ndarray, vals: jnp.ndarray,
                           sid: jnp.ndarray, valid: jnp.ndarray,
                           group_of_sid: jnp.ndarray, *, num_series: int,
                           num_groups: int, num_buckets: int, interval: int,
-                          agg_down: str, agg_group: str):
+                          agg_down: str, agg_group: str,
+                          rate: bool = False, counter_max: float = 0.0,
+                          reset_value: float = 0.0, counter: bool = False,
+                          drop_resets: bool = False):
     """Fused downsample + group-by for MANY group-by buckets in ONE call.
 
     The reference materializes one SpanGroup per distinct group-by tag
@@ -288,9 +401,16 @@ def downsample_multigroup(ts: jnp.ndarray, vals: jnp.ndarray,
         ts, vals, sid, valid, num_series=num_series,
         num_buckets=num_buckets, interval=interval, agg_down=agg_down,
         with_ts=False)
+    if rate:
+        series_values, series_mask = bucket_rate(
+            series_values, series_mask, interval, counter_max,
+            reset_value, counter=counter, drop_resets=drop_resets)
 
     if agg_group in NOLERP_AGGS:
         filled, in_range = series_values, series_mask
+    elif rate:
+        filled, in_range = step_fill(series_values, series_mask,
+                                     num_buckets)
     else:
         filled, in_range = gap_fill(series_values, series_mask,
                                     num_buckets)
@@ -443,6 +563,29 @@ def series_contributions(ts: jnp.ndarray, vals: jnp.ndarray,
 
     return jax.vmap(one_series)(ts, vals, counts)
 
+@jax.jit
+def union_grid(ts: jnp.ndarray, counts: jnp.ndarray):
+    """Deduplicated sorted union of S padded timestamp rows.
+
+    ts is [S, T] int32 left-aligned; counts [S]. Returns (grid [S*T]
+    int32, gmask [S*T] bool) with real entries compacted to the front —
+    the grid-construction half of group_interpolate, exposed separately
+    so percentile queries build the grid once and feed it straight to
+    series_contributions.
+    """
+    S, T = ts.shape
+    idx = jnp.arange(T)
+    row_valid = idx[None, :] < counts[:, None]
+    big = jnp.int32(2**31 - 1)
+    flat = jnp.where(row_valid, ts, big).reshape(-1)
+    sorted_ts = jnp.sort(flat)
+    first = jnp.concatenate([
+        jnp.array([True]), sorted_ts[1:] != sorted_ts[:-1]])
+    gmask = first & (sorted_ts != big)
+    order = jnp.argsort(~gmask, stable=True)
+    return sorted_ts[order], gmask[order]
+
+
 @functools.partial(jax.jit, static_argnames=("agg", "interp"))
 def group_interpolate(ts: jnp.ndarray, vals: jnp.ndarray,
                       counts: jnp.ndarray, *, agg: str,
@@ -461,23 +604,7 @@ def group_interpolate(ts: jnp.ndarray, vals: jnp.ndarray,
     own timestamps, interpolation elsewhere, nothing outside its
     [first, last] — reference SGIterator semantics (SpanGroup.java:370-796).
     """
-    S, T = ts.shape
-    idx = jnp.arange(T)
-    row_valid = idx[None, :] < counts[:, None]
-    big = jnp.int32(2**31 - 1)
-    ts_masked = jnp.where(row_valid, ts, big)
-
-    # Union grid: sort all timestamps, mark first occurrence of each value.
-    flat = ts_masked.reshape(-1)
-    sorted_ts = jnp.sort(flat)
-    first = jnp.concatenate([
-        jnp.array([True]), sorted_ts[1:] != sorted_ts[:-1]])
-    gmask = first & (sorted_ts != big)
-    # Compact real grid entries to the front (stable argsort of ~gmask).
-    order = jnp.argsort(~gmask, stable=True)
-    grid = sorted_ts[order]
-    gmask = gmask[order]
-
+    grid, gmask = union_grid(ts, counts)
     contrib, cmask = series_contributions(ts, vals, counts, grid,
                                           interp=interp)  # [S, G]
 

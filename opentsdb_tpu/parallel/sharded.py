@@ -27,41 +27,65 @@ from opentsdb_tpu.core.const import NOLERP_AGGS
 from opentsdb_tpu.ops import sketches
 from opentsdb_tpu.ops.kernels import (
     _finish,
+    _segment_moments,
     downsample_group,
     gap_fill,
     group_moments,
+    masked_quantile_axis0,
+    step_fill,
 )
 from opentsdb_tpu.parallel.mesh import SERIES_AXIS
 
 
-def _local_group_moments(ts, vals, sid, valid, *, num_series, num_buckets,
-                         interval, agg_down, lerp=True):
-    """Per-chip: fused downsample + lerp-fill, returning partial group
-    moments per bucket (count, total, M2-around-local-mean, local mean,
-    min, max, any-real-point). ``lerp=False`` (the zimsum/mimmin/mimmax
-    family) skips gap filling — series contribute only where they have a
-    real bucket."""
+def _local_filled(ts, vals, sid, valid, *, num_series, num_buckets,
+                  interval, agg_down, lerp=True, rate=False,
+                  counter_max=0.0, reset_value=0.0, counter=False,
+                  drop_resets=False):
+    """Per-chip: fused downsample [+ rate] + fill, returning each local
+    series' per-bucket contribution (filled [S, B], in_range [S, B]) plus
+    the any-real-point emission mask [B]. The fill policy mirrors the
+    single-device kernel: ``lerp=False`` (zimsum/mimmin/mimmax) none,
+    rates step-hold, plain values lerp. Rate is per-series, so it needs
+    no cross-chip exchange on the series-sharded layout."""
     out = downsample_group(
         ts, vals, sid, valid, num_series=num_series,
         num_buckets=num_buckets, interval=interval, agg_down=agg_down,
-        agg_group="sum")  # agg_group unused; we recompute moments below
-    if lerp:
-        filled, in_range = gap_fill(out["series_values"],
-                                    out["series_mask"], num_buckets)
+        agg_group="sum",  # agg_group unused; callers aggregate themselves
+        rate=rate, counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)
+    sv, sm = out["series_values"], out["series_mask"]
+    if not lerp:
+        filled, in_range = sv, sm
+    elif rate:
+        filled, in_range = step_fill(sv, sm, num_buckets)
     else:
-        filled, in_range = out["series_values"], out["series_mask"]
+        filled, in_range = gap_fill(sv, sm, num_buckets)
+    return filled, in_range, sm
+
+
+def _local_group_moments(ts, vals, sid, valid, **kw):
+    """Per-chip partial group moments per bucket (count, total,
+    M2-around-local-mean, local mean, min, max, any-real-point)."""
+    filled, in_range, sm = _local_filled(ts, vals, sid, valid, **kw)
     n, total, m2, mean, mn, mx = group_moments(filled, in_range)
-    return n, total, m2, mean, mn, mx, out["series_mask"].any(axis=0)
+    return n, total, m2, mean, mn, mx, sm.any(axis=0)
+
+
+_RATE_STATICS = ("rate", "counter", "drop_resets")
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "series_per_shard", "num_buckets", "interval",
-                     "agg_down", "agg_group"))
+                     "agg_down", "agg_group") + _RATE_STATICS)
 def sharded_downsample_group(ts, vals, sid, valid, *, mesh,
                              series_per_shard: int, num_buckets: int,
-                             interval: int, agg_down: str, agg_group: str):
-    """Fused downsample + cross-chip group aggregation.
+                             interval: int, agg_down: str, agg_group: str,
+                             rate: bool = False, counter_max: float = 0.0,
+                             reset_value: float = 0.0,
+                             counter: bool = False,
+                             drop_resets: bool = False):
+    """Fused downsample [+ rate] + cross-chip group aggregation.
 
     Args are [D, N_shard] stacked shards (sid local to each shard, in
     [0, series_per_shard)); returns (group_values [B], group_mask [B])
@@ -73,7 +97,9 @@ def sharded_downsample_group(ts, vals, sid, valid, *, mesh,
         n, total, m2, mean, mn, mx, any_real = _local_group_moments(
             ts, vals, sid, valid, num_series=series_per_shard,
             num_buckets=num_buckets, interval=interval, agg_down=agg_down,
-            lerp=agg_group not in NOLERP_AGGS)
+            lerp=agg_group not in NOLERP_AGGS, rate=rate,
+            counter_max=counter_max, reset_value=reset_value,
+            counter=counter, drop_resets=drop_resets)
         # Cross-chip exact moment combination (Chan et al.).
         g_n = jax.lax.psum(n, SERIES_AXIS)
         g_total = jax.lax.psum(total, SERIES_AXIS)
@@ -94,6 +120,127 @@ def sharded_downsample_group(ts, vals, sid, valid, *, mesh,
         out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
     group_values, group_mask = fn(ts, vals, sid, valid)
     # Every shard returned the identical replicated answer; take shard 0.
+    return group_values[0], group_mask[0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "series_per_shard", "num_buckets", "interval",
+                     "agg_down") + _RATE_STATICS)
+def sharded_downsample_quantile(ts, vals, sid, valid, q, *, mesh,
+                                series_per_shard: int, num_buckets: int,
+                                interval: int, agg_down: str,
+                                rate: bool = False,
+                                counter_max: float = 0.0,
+                                reset_value: float = 0.0,
+                                counter: bool = False,
+                                drop_resets: bool = False):
+    """Group-stage percentile across series, series-sharded over chips.
+
+    A per-bucket quantile doesn't decompose into psum-able moments, so
+    each chip computes its local series' per-bucket contributions (the
+    downsample [+ rate] + fill stages, all local), then ``all_gather``s
+    the [S_local, B] contribution block over the series axis — the same
+    collective shape ring-attention uses for K/V blocks — and every chip
+    sorts the full [S, B] column set locally. Exact (matches numpy
+    quantiles), unlike a t-digest merge; the gather moves S*B floats,
+    fine for query-sized B. ``q`` is a [K] array; returns
+    (values [K, B], group_mask [B]) replicated on every chip.
+    """
+
+    def shard_fn(ts, vals, sid, valid, q):
+        ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
+        filled, in_range, sm = _local_filled(
+            ts, vals, sid, valid, num_series=series_per_shard,
+            num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+            rate=rate, counter_max=counter_max, reset_value=reset_value,
+            counter=counter, drop_resets=drop_resets)
+        all_filled = jax.lax.all_gather(filled, SERIES_AXIS)
+        all_range = jax.lax.all_gather(in_range, SERIES_AXIS)
+        S = all_filled.shape[0] * all_filled.shape[1]
+        out = masked_quantile_axis0(
+            all_filled.reshape(S, -1), all_range.reshape(S, -1), q[0])
+        g_any = jax.lax.pmax(
+            sm.any(axis=0).astype(jnp.int32), SERIES_AXIS) > 0
+        return out[None], g_any[None]
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(SERIES_AXIS), P(SERIES_AXIS), P(SERIES_AXIS),
+                  P(SERIES_AXIS), P()),
+        out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
+    values, mask = fn(ts, vals, sid, valid, q[None])
+    return values[0], mask[0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "series_per_shard", "num_groups",
+                     "num_buckets", "interval", "agg_down",
+                     "agg_group") + _RATE_STATICS)
+def sharded_downsample_multigroup(ts, vals, sid, valid, gmap, *, mesh,
+                                  series_per_shard: int, num_groups: int,
+                                  num_buckets: int, interval: int,
+                                  agg_down: str, agg_group: str,
+                                  rate: bool = False,
+                                  counter_max: float = 0.0,
+                                  reset_value: float = 0.0,
+                                  counter: bool = False,
+                                  drop_resets: bool = False):
+    """Many group-by buckets, series-sharded over chips, in one call.
+
+    ``gmap`` [D, series_per_shard] maps each shard-local series to its
+    *global* group id in [0, num_groups); series of one group may land on
+    different chips. Each chip computes local per-(group, bucket) partial
+    moments, then the cross-chip combine is the exact pairwise (Chan)
+    moment merge per (group, bucket) cell — the multigroup analog of
+    sharded_downsample_group. Returns (group_values [G, B],
+    group_mask [G, B]) replicated on every chip.
+    """
+
+    def shard_fn(ts, vals, sid, valid, gmap):
+        ts, vals, sid, valid, gmap = (
+            x[0] for x in (ts, vals, sid, valid, gmap))
+        filled, in_range, sm = _local_filled(
+            ts, vals, sid, valid, num_series=series_per_shard,
+            num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+            lerp=agg_group not in NOLERP_AGGS, rate=rate,
+            counter_max=counter_max, reset_value=reset_value,
+            counter=counter, drop_resets=drop_resets)
+        # Local per-(group, bucket) partial moments via one fused segment
+        # reduction over the [S, B] contribution grid.
+        b_idx = jnp.arange(num_buckets, dtype=jnp.int32)
+        gb = gmap[:, None] * num_buckets + b_idx[None, :]
+        gn = num_groups * num_buckets + 1
+        gseg = jnp.where(in_range, gb,
+                         num_groups * num_buckets).reshape(-1)
+        flat_range = in_range.reshape(-1)
+        n, total, m2, mn, mx = _segment_moments(
+            filled.reshape(-1), gseg, flat_range, gn)
+        n, total, m2, mn, mx = (x[:-1] for x in (n, total, m2, mn, mx))
+        mean = total / jnp.maximum(n, 1.0)
+        # Chan et al. exact cross-chip moment combination per cell.
+        g_n = jax.lax.psum(n, SERIES_AXIS)
+        g_total = jax.lax.psum(total, SERIES_AXIS)
+        g_mean = g_total / jnp.maximum(g_n, 1.0)
+        g_m2 = jax.lax.psum(m2 + n * (mean - g_mean) ** 2, SERIES_AXIS)
+        g_mn = jax.lax.pmin(mn, SERIES_AXIS)
+        g_mx = jax.lax.pmax(mx, SERIES_AXIS)
+        out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
+        # Emission: a (group, bucket) is real when some member series has
+        # a real post-rate bucket there, on any chip.
+        rseg = jnp.where(sm, gb, num_groups * num_buckets).reshape(-1)
+        real = jax.ops.segment_sum(
+            sm.reshape(-1).astype(jnp.int32), rseg, gn)[:-1]
+        g_real = jax.lax.psum(real, SERIES_AXIS) > 0
+        shape = (num_groups, num_buckets)
+        return out.reshape(shape)[None], g_real.reshape(shape)[None]
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(SERIES_AXIS),) * 5,
+        out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
+    group_values, group_mask = fn(ts, vals, sid, valid, gmap)
     return group_values[0], group_mask[0]
 
 
@@ -139,8 +286,17 @@ def sharded_tdigest(values, valid, qs, *, mesh, compression: int = 128):
 # Host-side packing
 # ---------------------------------------------------------------------------
 
+def shard_placement(n_series: int, n_shards: int) -> list[tuple[int, int]]:
+    """(shard, local_id) for each series index under pack_shards'
+    round-robin placement — the single source of truth callers use to
+    build per-series side tables (e.g. the sharded multigroup's group
+    map) that must agree with the packing."""
+    return [(i % n_shards, i // n_shards) for i in range(n_series)]
+
+
 def pack_shards(series: list[tuple], n_shards: int):
-    """Partition [(ts, vals)] series round-robin into n stacked shards.
+    """Partition [(ts, vals)] series into n stacked shards per
+    shard_placement.
 
     Returns (ts, vals, sid, valid) as [D, N_shard] numpy arrays plus
     series_per_shard — ready for sharded_downsample_group.
@@ -148,8 +304,8 @@ def pack_shards(series: list[tuple], n_shards: int):
     import numpy as np
 
     blocks: list[list[tuple]] = [[] for _ in range(n_shards)]
-    for i, s in enumerate(series):
-        blocks[i % n_shards].append(s)
+    for (d, _), s in zip(shard_placement(len(series), n_shards), series):
+        blocks[d].append(s)
     series_per_shard = max(len(b) for b in blocks)
     n_shard = max(
         (sum(len(s[0]) for s in b) for b in blocks), default=1)

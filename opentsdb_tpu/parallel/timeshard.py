@@ -48,8 +48,11 @@ from opentsdb_tpu.ops.kernels import (
     _finish,
     _flat_rate,
     _segment_moments,
+    bucket_rate,
     gap_fill,
     group_moments,
+    masked_quantile_axis0,
+    step_fill,
 )
 from opentsdb_tpu.parallel.mesh import TIME_AXIS
 
@@ -72,14 +75,15 @@ def _local_edge_summary(series_values, series_mask, bps):
     return first_idx, first_val, last_idx, last_val
 
 
-def _cross_tile_gap_fill(series_values, series_mask, *, d, bps):
-    """gap_fill with lerp carries across tile boundaries.
+def _cross_tile_edges(series_values, series_mask, *, d, bps):
+    """Per-series cross-tile neighbor carries for tile ``d``.
 
-    ``d`` is this chip's index on the time axis. Publishes per-series edge
-    summaries, all_gathers them over TIME_AXIS, and fills local empty
-    buckets using the nearest nonempty bucket on *any* tile — identical
-    results to running ops.kernels.gap_fill on the unsharded [S, D*bps]
-    grid. Returns (filled [S, bps], in_range [S, bps]).
+    Publishes per-series edge summaries (first/last nonempty local bucket
+    + value), all_gathers them over TIME_AXIS, and locates each series'
+    nearest nonempty bucket on any *earlier* tile (left) and any *later*
+    tile (right). Returns (left_idx [S] global-or--1, left_val [S],
+    right_idx [S] global-or-2^31-1, right_val [S]) — the carry format
+    gap_fill / step_fill / bucket_rate consume.
     """
     first_i, first_v, last_i, last_v = _local_edge_summary(
         series_values, series_mask, bps)
@@ -114,7 +118,19 @@ def _cross_tile_gap_fill(series_values, series_mask, *, d, bps):
     rsel = jnp.argmin(rcand, axis=0)
     right_idx = jnp.take_along_axis(rcand, rsel[None, :], axis=0)[0]
     right_val = jnp.take_along_axis(all_first_v, rsel[None, :], axis=0)[0]
+    return left_idx, left_val, right_idx, right_val
 
+
+def _cross_tile_gap_fill(series_values, series_mask, *, d, bps):
+    """gap_fill with lerp carries across tile boundaries.
+
+    ``d`` is this chip's index on the time axis. Fills local empty
+    buckets using the nearest nonempty bucket on *any* tile — identical
+    results to running ops.kernels.gap_fill on the unsharded [S, D*bps]
+    grid. Returns (filled [S, bps], in_range [S, bps]).
+    """
+    left_idx, left_val, right_idx, right_val = _cross_tile_edges(
+        series_values, series_mask, d=d, bps=bps)
     # The scan+lerp itself is the shared unsharded kernel, windowed to
     # this tile's global index range with the carries as fallbacks.
     return gap_fill(series_values, series_mask, bps, glob_offset=d * bps,
@@ -125,11 +141,17 @@ def _cross_tile_gap_fill(series_values, series_mask, *, d, bps):
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "num_series", "buckets_per_shard", "interval",
-                     "agg_down", "agg_group"))
+                     "agg_down", "agg_group", "rate", "counter",
+                     "drop_resets"))
 def timeshard_downsample_group(ts, vals, sid, valid, *, mesh,
                                num_series: int, buckets_per_shard: int,
-                               interval: int, agg_down: str, agg_group: str):
-    """Fused downsample + group-by with the time axis sharded over chips.
+                               interval: int, agg_down: str, agg_group: str,
+                               rate: bool = False, counter_max: float = 0.0,
+                               reset_value: float = 0.0,
+                               counter: bool = False,
+                               drop_resets: bool = False,
+                               quantile: float | None = None):
+    """Fused downsample [+ rate] + group-by with the time axis sharded.
 
     Args:
       ts:    [D, N_tile] int32 *global* offsets from the query start.
@@ -139,6 +161,17 @@ def timeshard_downsample_group(ts, vals, sid, valid, *, mesh,
       valid: [D, N_tile] bool. Points of tile d must satisfy
              ts // (interval * buckets_per_shard) == d (the host packs
              this; see pack_time_shards).
+
+    ``rate=True`` inserts the per-series rate stage on the bucket grid:
+    each tile's first nonempty bucket differences against the series'
+    nearest nonempty bucket on an earlier tile, carried in via the edge
+    summaries — so sharded rates match the unsharded kernel exactly
+    (reference rate semantics: SpanGroup.java:736-784). ``quantile``
+    switches the group stage from moments to a per-bucket quantile
+    across series (pNN aggregators); buckets are tile-local, so once the
+    fill carries are exchanged the quantile itself needs no collective.
+    It is traced (None vs scalar keys the jit cache on structure only),
+    so p50/p90/p99 over one range share a single compilation.
 
     Returns (group_values [D*bps], group_mask [D*bps]) — the full bucket
     grid, concatenated across tiles by shard_map's output spec.
@@ -160,15 +193,37 @@ def timeshard_downsample_group(ts, vals, sid, valid, *, mesh,
         series_values = per[:-1].reshape(shape)
         series_mask = count[:-1].reshape(shape) > 0
 
-        if agg_group in NOLERP_AGGS:
+        if rate:
+            l_i, l_v, _, _ = _cross_tile_edges(
+                series_values, series_mask, d=d, bps=bps)
+            series_values, series_mask = bucket_rate(
+                series_values, series_mask, interval, counter_max,
+                reset_value, counter=counter, drop_resets=drop_resets,
+                glob_offset=d * bps, left_idx=l_i, left_val=l_v)
+
+        if agg_group in NOLERP_AGGS and quantile is None:
             # No-lerp family: no cross-tile carries needed either — a
             # series contributes only where it has a real bucket.
             filled, in_range = series_values, series_mask
+        elif rate:
+            # Rates step-hold; edges recomputed on the post-rate grid.
+            l_i, l_v, r_i, _ = _cross_tile_edges(
+                series_values, series_mask, d=d, bps=bps)
+            filled, in_range = step_fill(
+                series_values, series_mask, bps,
+                left_idx=l_i, left_val=l_v, right_idx=r_i)
         else:
             filled, in_range = _cross_tile_gap_fill(
                 series_values, series_mask, d=d, bps=bps)
-        g_n, g_total, g_m2, _, g_mn, g_mx = group_moments(filled, in_range)
-        group_values = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
+        if quantile is not None:
+            group_values = masked_quantile_axis0(
+                filled, in_range,
+                jnp.array([quantile], jnp.float32))[0]
+        else:
+            g_n, g_total, g_m2, _, g_mn, g_mx = group_moments(
+                filled, in_range)
+            group_values = _finish(agg_group, g_n, g_total, g_m2, g_mn,
+                                   g_mx)
         return group_values, series_mask.any(axis=0)
 
     fn = jax.shard_map(

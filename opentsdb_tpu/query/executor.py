@@ -202,7 +202,7 @@ class QueryExecutor:
         # Wide group-bys on the TPU backend batch into ONE kernel call
         # (two segment reductions for all groups) instead of G calls.
         if (self.backend != "cpu" and len(gkeys) > 1 and spec.downsample
-                and not spec.rate and agg.kind == "moment"):
+                and agg.kind == "moment"):
             per_group = self._run_tpu_multigroup(
                 spec, [groups[k] for k in gkeys], start, end)
         else:
@@ -258,19 +258,13 @@ class QueryExecutor:
 
     def _run_tpu(self, spec: QuerySpec, spans: list[_Span], start: int,
                  end: int):
-        if spec.downsample and not spec.rate:
+        if spec.downsample:
+            # Fused path covers rate too: the rate stage rides the same
+            # kernel on the shared bucket grid (no per-span host loops).
             return self._tpu_downsample_group(spec, spans, start, end)
-        # General path: optional per-span downsample, optional rate, then
-        # union-grid interpolation.
-        series = []
-        for sp in spans:
-            ts, vals = sp.timestamps, sp.values
-            if spec.downsample:
-                interval, dsagg = spec.downsample
-                ts, vals = oracle.downsample(ts, vals, interval, dsagg,
-                                             mode="aligned",
-                                             bucket_ts="start")
-            series.append((ts, vals))
+        # General (un-downsampled) path: optional rate, then union-grid
+        # interpolation, all on device.
+        series = [(sp.timestamps, sp.values) for sp in spans]
         if spec.rate:
             series = self._tpu_rate(series, spec)
             series = [s for s in series if len(s[0])]
@@ -300,14 +294,9 @@ class QueryExecutor:
                 np.asarray(out)[gmask].astype(np.float64))
 
     def _tpu_quantile_grid(self, ts_pad, val_pad, counts, spec, interp):
-        """Union-grid percentile: reuse group_interpolate's per-series
-        contributions via a count trick — run it once per nothing; instead
-        compute contributions with interp then quantile across series."""
-        # group_interpolate with agg='count' yields the grid and cmask
-        # implicitly; to get per-series contributions we rebuild them the
-        # same way here (small duplication, same jitted helpers).
-        grid, _, gmask = kernels.group_interpolate(
-            ts_pad, val_pad, counts, agg="count", interp=interp)
+        """Union-grid percentile: build the grid once, compute per-series
+        contributions with interp, then quantile across series."""
+        grid, gmask = kernels.union_grid(ts_pad, counts)
         q = Aggregators.get(spec.aggregator).quantile
         contrib, cmask = kernels.series_contributions(
             ts_pad, val_pad, counts, np.asarray(grid), interp=interp)
@@ -340,9 +329,19 @@ class QueryExecutor:
             out.append((ts[m], rates[m].astype(np.float64)))
         return out
 
+    def _rate_kw(self, spec: QuerySpec) -> dict:
+        """Static+traced rate args threaded into the fused kernels."""
+        return dict(
+            rate=spec.rate,
+            counter_max=spec.counter_max if spec.counter else 0.0,
+            reset_value=spec.reset_value or 0.0,
+            counter=spec.counter,
+            drop_resets=spec.reset_value is not None)
+
     def _tpu_downsample_group(self, spec: QuerySpec, spans: list[_Span],
                               start: int, end: int):
-        """The fused fast path: flat downsample + cross-series group."""
+        """The fused fast path: flat downsample [+ rate] + cross-series
+        group, one kernel call."""
         interval, dsagg = spec.downsample
         qbase = start - start % interval
         # Pad the static kernel shapes to power-of-two buckets: padded
@@ -351,7 +350,7 @@ class QueryExecutor:
         # exact (S, B) of every distinct query.
         num_buckets = _pad_size(int((end - qbase) // interval + 1))
         agg = Aggregators.get(spec.aggregator)
-        if self.mesh is not None and agg.kind == "moment":
+        if self.mesh is not None and agg.kind in ("moment", "percentile"):
             sharded = self._tpu_downsample_sharded(
                 spec, spans, qbase, interval, dsagg, num_buckets)
             if sharded is not None:
@@ -361,10 +360,14 @@ class QueryExecutor:
             rel, vals, sid, valid, num_series=_pad_size(len(spans)),
             num_buckets=num_buckets, interval=interval,
             agg_down=dsagg,
-            agg_group=spec.aggregator if agg.kind == "moment" else "count")
+            agg_group=spec.aggregator if agg.kind == "moment" else "count",
+            **self._rate_kw(spec))
         gmask = np.asarray(out["group_mask"])
         if agg.kind == "percentile":
-            filled, in_range = kernels.gap_fill(
+            # series_values/series_mask are the post-rate per-bucket
+            # signal when spec.rate; rates step-hold, plain values lerp.
+            fill = kernels.step_fill if spec.rate else kernels.gap_fill
+            filled, in_range = fill(
                 out["series_values"], out["series_mask"],
                 int(num_buckets))
             vals_g = kernels.masked_quantile_axis0(
@@ -379,34 +382,50 @@ class QueryExecutor:
     def _tpu_downsample_sharded(self, spec: QuerySpec, spans: list[_Span],
                                 qbase: int, interval: int, dsagg: str,
                                 num_buckets: int):
-        """Distribute one group's fused downsample over self.mesh.
+        """Distribute one group's fused downsample [+ rate] over self.mesh.
 
         Series-parallel when the group has >= one series per chip
-        (zero-comm local downsample, psum group fan-in); time-parallel
+        (zero-comm local downsample+rate, psum moment fan-in — or an
+        all_gather of per-bucket contributions for percentile group
+        aggregation, which doesn't decompose into moments); time-parallel
         for long ranges with few series (bucket-aligned tiles, edge-
-        summary carries). Returns (grid_ts, values) or None when neither
-        layout pays (the caller falls back to single-device).
+        summary carries for lerp, step-hold AND rate predecessors).
+        Returns (grid_ts, values) or None when neither layout pays (the
+        caller falls back to single-device).
         """
         from opentsdb_tpu.parallel.mesh import TIME_AXIS, Mesh
         from opentsdb_tpu.parallel.sharded import (
             pack_shards,
             sharded_downsample_group,
+            sharded_downsample_quantile,
         )
         from opentsdb_tpu.parallel.timeshard import (
             pack_time_shards,
             timeshard_downsample_group,
         )
 
+        agg = Aggregators.get(spec.aggregator)
+        rate_kw = self._rate_kw(spec)
         D = int(self.mesh.devices.size)
         if len(spans) >= D:
             series = [((sp.timestamps - qbase).astype(np.int64),
                        sp.values) for sp in spans]
             ts, vals, sid, valid, sps = pack_shards(series, D)
-            gv, gm = sharded_downsample_group(
-                ts, vals, sid, valid, mesh=self.mesh,
-                series_per_shard=_pad_size(sps), num_buckets=num_buckets,
-                interval=interval, agg_down=dsagg,
-                agg_group=spec.aggregator)
+            if agg.kind == "percentile":
+                gv, gm = sharded_downsample_quantile(
+                    ts, vals, sid, valid,
+                    np.array([agg.quantile], np.float32), mesh=self.mesh,
+                    series_per_shard=_pad_size(sps),
+                    num_buckets=num_buckets, interval=interval,
+                    agg_down=dsagg, **rate_kw)
+                gv = gv[0]
+            else:
+                gv, gm = sharded_downsample_group(
+                    ts, vals, sid, valid, mesh=self.mesh,
+                    series_per_shard=_pad_size(sps),
+                    num_buckets=num_buckets,
+                    interval=interval, agg_down=dsagg,
+                    agg_group=spec.aggregator, **rate_kw)
         elif num_buckets >= 4 * D:
             bps = -(-num_buckets // D)
             rel, vals, sid, valid = self._flatten_spans(spans, qbase)
@@ -416,7 +435,10 @@ class QueryExecutor:
             gv, gm = timeshard_downsample_group(
                 *tsh, mesh=tmesh, num_series=_pad_size(len(spans)),
                 buckets_per_shard=bps, interval=interval, agg_down=dsagg,
-                agg_group=spec.aggregator)
+                agg_group=(spec.aggregator if agg.kind == "moment"
+                           else "count"),
+                quantile=(agg.quantile if agg.kind == "percentile"
+                          else None), **rate_kw)
         else:
             return None
         gm = np.asarray(gm)
@@ -455,24 +477,30 @@ class QueryExecutor:
             for sp in spans:
                 all_spans.append(sp)
                 group_of_sid.append(gi)
-        rel, vals, sid, valid = self._flatten_spans(all_spans, qbase)
-        # Shapes padded to power-of-two buckets (see
-        # _tpu_downsample_group). Padded series are assigned group G-1
-        # (possibly a REAL group when the count is already a power of
-        # two) — safe solely because padded series carry no points, so
-        # they contribute nothing wherever they land.
-        S = _pad_size(len(all_spans))
         G = _pad_size(len(span_groups))
-        gmap = np.zeros(S, np.int32)
-        gmap[:len(group_of_sid)] = group_of_sid
-        gmap[len(group_of_sid):] = G - 1
-        out = kernels.downsample_multigroup(
-            rel, vals, sid, valid, gmap,
-            num_series=S, num_groups=G,
-            num_buckets=num_buckets, interval=interval, agg_down=dsagg,
-            agg_group=spec.aggregator)
-        gv = np.asarray(out["group_values"])
-        gm = np.asarray(out["group_mask"])
+        D = int(self.mesh.devices.size) if self.mesh is not None else 0
+        if D and len(all_spans) >= D:
+            gv, gm = self._multigroup_sharded(
+                spec, all_spans, group_of_sid, G, qbase, interval, dsagg,
+                num_buckets, D)
+        else:
+            rel, vals, sid, valid = self._flatten_spans(all_spans, qbase)
+            # Shapes padded to power-of-two buckets (see
+            # _tpu_downsample_group). Padded series are assigned group
+            # G-1 (possibly a REAL group when the count is already a
+            # power of two) — safe solely because padded series carry no
+            # points, so they contribute nothing wherever they land.
+            S = _pad_size(len(all_spans))
+            gmap = np.zeros(S, np.int32)
+            gmap[:len(group_of_sid)] = group_of_sid
+            gmap[len(group_of_sid):] = G - 1
+            out = kernels.downsample_multigroup(
+                rel, vals, sid, valid, gmap,
+                num_series=S, num_groups=G,
+                num_buckets=num_buckets, interval=interval, agg_down=dsagg,
+                agg_group=spec.aggregator, **self._rate_kw(spec))
+            gv = np.asarray(out["group_values"])
+            gm = np.asarray(out["group_mask"])
         results = []
         for gi in range(len(span_groups)):
             mask = gm[gi]
@@ -480,6 +508,36 @@ class QueryExecutor:
                        + qbase)
             results.append((grid_ts, gv[gi][mask].astype(np.float64)))
         return results
+
+    def _multigroup_sharded(self, spec: QuerySpec, all_spans: list[_Span],
+                            group_of_sid: list[int], G: int, qbase: int,
+                            interval: int, dsagg: str, num_buckets: int,
+                            D: int):
+        """Wide group-by over the mesh: series round-robin across chips
+        with a per-shard group map, psum per-(group, bucket) fan-in.
+        Fixes the single-device multigroup/mesh perf inversion (round-1
+        advisor finding)."""
+        from opentsdb_tpu.parallel.sharded import (
+            pack_shards,
+            shard_placement,
+            sharded_downsample_multigroup,
+        )
+        series = [((sp.timestamps - qbase).astype(np.int64), sp.values)
+                  for sp in all_spans]
+        ts, vals, sid, valid, sps = pack_shards(series, D)
+        sps_pad = _pad_size(sps)
+        # Group map laid out by the packing's own placement. Padded local
+        # series map to group G-1 — safe, they carry no points.
+        gmap = np.full((D, sps_pad), G - 1, np.int32)
+        for (d, local), g in zip(shard_placement(len(series), D),
+                                 group_of_sid):
+            gmap[d, local] = g
+        gv, gm = sharded_downsample_multigroup(
+            ts, vals, sid, valid, gmap, mesh=self.mesh,
+            series_per_shard=sps_pad, num_groups=G,
+            num_buckets=num_buckets, interval=interval, agg_down=dsagg,
+            agg_group=spec.aggregator, **self._rate_kw(spec))
+        return np.asarray(gv), np.asarray(gm)
 
     # ------------------------------------------------------------------
     # Cardinality (distinct tag values)

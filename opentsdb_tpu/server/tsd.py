@@ -82,6 +82,12 @@ class TSDServer:
             executor = QueryExecutor(tsdb, mesh=mesh)
         self.executor = executor
         self.config = tsdb.config
+        if self.config.cachedir:
+            # The /q disk cache writes <hash>.txt.tmp files here; create
+            # the directory up front so a fresh --cachedir works without
+            # operator mkdir (the reference requires a pre-existing dir,
+            # GraphHandler.java:335-346 — friendlier here).
+            os.makedirs(self.config.cachedir, exist_ok=True)
         self._server: asyncio.AbstractServer | None = None
         self._shutdown = asyncio.Event()
         self._pool = concurrent.futures.ThreadPoolExecutor(

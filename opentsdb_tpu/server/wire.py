@@ -201,11 +201,14 @@ def pipelined_ingest(tsdb, chunks, durable: bool = True,
 
     q: queue.Queue = queue.Queue(maxsize=max_queue)
     fail: list[BaseException] = []
+    cancelled = threading.Event()
 
     def producer():
         try:
             carry = b""
             for chunk in chunks:
+                if cancelled.is_set():
+                    return
                 buf = carry + chunk
                 batch = decode_puts(buf, use_native)
                 carry = buf[batch.consumed:]
@@ -221,12 +224,28 @@ def pipelined_ingest(tsdb, chunks, durable: bool = True,
     t.start()
     total = 0
     errors: list[str] = []
-    while (batch := q.get()) is not None:
-        errors += batch.errors  # parse errors, like the one-shot path
-        n, errs = ingest_batch(tsdb, batch, durable)
-        total += n
-        errors += errs
-    t.join()
+    batch = None
+    try:
+        while (batch := q.get()) is not None:
+            errors += batch.errors  # parse errors, like the one-shot path
+            n, errs = ingest_batch(tsdb, batch, durable)
+            total += n
+            errors += errs
+    finally:
+        # If ingest raised mid-stream the producer may be blocked on
+        # q.put (maxsize bound): tell it to stop consuming the stream,
+        # then drain until its None sentinel and join. The drain is
+        # time-bounded: a producer wedged *reading* the chunk source
+        # (stalled socket) can't observe the flag, and the consumer's
+        # exception must still propagate promptly — in that case the
+        # daemon thread is abandoned to die with the process.
+        cancelled.set()
+        while batch is not None:
+            try:
+                batch = q.get(timeout=1.0)
+            except queue.Empty:
+                break
+        t.join(timeout=5.0)
     if fail:
         raise fail[0]
     return total, errors

@@ -175,6 +175,11 @@ class MemKVStore(KVStore):
             for name in self._sst.tables():
                 self._table(name)
         if wal_path:
+            # Create the WAL's parent directory so a fresh --wal path
+            # works without operator mkdir (same courtesy as the /q
+            # cache dir).
+            parent = os.path.dirname(os.path.abspath(wal_path))
+            os.makedirs(parent, exist_ok=True)
             # A leftover <wal>.old means a crash interrupted a checkpoint:
             # replay it first (records older than everything in the WAL).
             old_path = wal_path + ".old"

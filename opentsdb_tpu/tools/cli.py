@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import gzip
 import logging
+import os
 import sys
 import time
 
@@ -45,11 +46,15 @@ def common_args(p: argparse.ArgumentParser) -> None:
 
 
 def make_tsdb(args, start_thread: bool = False) -> TSDB:
-    if getattr(args, "backend", None) == "cpu":
+    if (getattr(args, "backend", None) == "cpu"
+            or os.environ.get("JAX_PLATFORMS") == "cpu"):
         # Pin the JAX platform BEFORE any kernel import initializes the
         # default backend: with --backend cpu nothing should ever touch
         # an accelerator plugin (whose init can block when the device is
-        # held or its tunnel is wedged).
+        # held or its tunnel is wedged). An explicit JAX_PLATFORMS=cpu in
+        # the environment is honored for the kernel backend too — site
+        # customization modules can otherwise override the env var with
+        # an accelerator plugin after process start.
         try:
             import jax
 

@@ -121,3 +121,115 @@ class TestPackShards:
         assert ts.shape[0] == 2
         assert valid.sum() == 18
         assert sps == 2  # shard 0 got series 0 and 2
+
+
+class TestShardedRateAndQuantile:
+    """rate=True and percentile group stages, series-sharded: must match
+    the single-device fused kernel exactly (sharding is never a
+    semantics change)."""
+
+    def _flat(self, series):
+        fts = np.concatenate([s[0] for s in series]).astype(np.int32)
+        fvals = np.concatenate([s[1] for s in series]).astype(np.float32)
+        fsid = np.concatenate([
+            np.full(len(s[0]), i, np.int32)
+            for i, s in enumerate(series)])
+        return fts, fvals, fsid, np.ones(len(fts), bool)
+
+    @pytest.mark.parametrize("agg_group", ["sum", "avg", "dev"])
+    def test_sharded_rate_matches_single(self, mesh, agg_group):
+        series = [random_series(RNG.integers(20, 60)) for _ in range(16)]
+        interval, B = 600, 16
+        single = kernels.downsample_group(
+            *self._flat(series), num_series=16, num_buckets=B,
+            interval=interval, agg_down="avg", agg_group=agg_group,
+            rate=True)
+        ts, vals, sid, valid, sps = pack_shards(series, 8)
+        gv, gm = sharded_downsample_group(
+            ts, vals, sid, valid, mesh=mesh, series_per_shard=sps,
+            num_buckets=B, interval=interval, agg_down="avg",
+            agg_group=agg_group, rate=True)
+        gm, want_m = np.asarray(gm), np.asarray(single["group_mask"])
+        np.testing.assert_array_equal(gm, want_m)
+        np.testing.assert_allclose(
+            np.asarray(gv)[gm], np.asarray(single["group_values"])[gm],
+            rtol=1e-4, atol=1e-4)
+
+    def test_sharded_rate_counter_rollover(self, mesh):
+        series = [(np.array([0, 700, 1400]),
+                   np.array([250.0, 10.0, 20.0]))] * 8
+        interval, B = 600, 16
+        single = kernels.downsample_group(
+            *self._flat(series), num_series=8, num_buckets=B,
+            interval=interval, agg_down="avg", agg_group="sum",
+            rate=True, counter=True, counter_max=256.0)
+        ts, vals, sid, valid, sps = pack_shards(series, 8)
+        gv, gm = sharded_downsample_group(
+            ts, vals, sid, valid, mesh=mesh, series_per_shard=sps,
+            num_buckets=B, interval=interval, agg_down="avg",
+            agg_group="sum", rate=True, counter=True, counter_max=256.0)
+        gm = np.asarray(gm)
+        np.testing.assert_array_equal(gm, np.asarray(single["group_mask"]))
+        np.testing.assert_allclose(
+            np.asarray(gv)[gm], np.asarray(single["group_values"])[gm],
+            rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("rate", [False, True])
+    def test_sharded_quantile_matches_single(self, mesh, rate):
+        from opentsdb_tpu.parallel.sharded import (
+            sharded_downsample_quantile)
+        series = [random_series(RNG.integers(20, 60)) for _ in range(24)]
+        interval, B = 600, 16
+        single = kernels.downsample_group(
+            *self._flat(series), num_series=24, num_buckets=B,
+            interval=interval, agg_down="avg", agg_group="count",
+            rate=rate)
+        fill = kernels.step_fill if rate else kernels.gap_fill
+        filled, in_range = fill(single["series_values"],
+                                single["series_mask"], B)
+        want = np.asarray(kernels.masked_quantile_axis0(
+            filled, in_range, np.array([0.95], np.float32))[0])
+        want_m = np.asarray(single["group_mask"])
+
+        ts, vals, sid, valid, sps = pack_shards(series, 8)
+        gv, gm = sharded_downsample_quantile(
+            ts, vals, sid, valid, np.array([0.95], np.float32),
+            mesh=mesh, series_per_shard=sps, num_buckets=B,
+            interval=interval, agg_down="avg", rate=rate)
+        gm = np.asarray(gm)
+        np.testing.assert_array_equal(gm, want_m)
+        np.testing.assert_allclose(np.asarray(gv)[0][gm], want[gm],
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("rate", [False, True])
+    def test_sharded_multigroup_matches_single(self, mesh, rate):
+        from opentsdb_tpu.parallel.sharded import (
+            sharded_downsample_multigroup)
+        G, per_group = 4, 6  # 24 series in 4 groups
+        series = [random_series(RNG.integers(20, 60))
+                  for _ in range(G * per_group)]
+        gmap_flat = np.array([i % G for i in range(G * per_group)],
+                             np.int32)
+        interval, B = 600, 16
+        fts, fvals, fsid, fvalid = self._flat(series)
+        single = kernels.downsample_multigroup(
+            fts, fvals, fsid, fvalid, gmap_flat, num_series=G * per_group,
+            num_groups=G, num_buckets=B, interval=interval,
+            agg_down="avg", agg_group="dev", rate=rate)
+
+        from opentsdb_tpu.parallel.sharded import shard_placement
+        ts, vals, sid, valid, sps = pack_shards(series, 8)
+        gmap = np.zeros((8, sps), np.int32)
+        for (d, local), g in zip(shard_placement(len(series), 8),
+                                 gmap_flat):
+            gmap[d, local] = g
+        gv, gm = sharded_downsample_multigroup(
+            ts, vals, sid, valid, gmap, mesh=mesh, series_per_shard=sps,
+            num_groups=G, num_buckets=B, interval=interval,
+            agg_down="avg", agg_group="dev", rate=rate)
+        gm = np.asarray(gm)
+        np.testing.assert_array_equal(
+            gm, np.asarray(single["group_mask"]))
+        np.testing.assert_allclose(
+            np.asarray(gv)[gm], np.asarray(single["group_values"])[gm],
+            rtol=1e-4, atol=1e-3)

@@ -174,3 +174,80 @@ def test_rate_counter_rollover(mesh):
     got = _collect_sharded_rates(sh[0], sh[2], sh[3], rates, ok)
     assert got[(0, 300)] == pytest.approx((10 + 256 - 250) / 300.0, rel=1e-5)
     assert got[(0, 700)] == pytest.approx(10.0 / 400.0, rel=1e-5)
+
+
+@pytest.mark.parametrize("agg_group", ["sum", "avg", "dev"])
+def test_downsample_rate_parity(mesh, agg_group):
+    """rate=True: sharded bucket rates (cross-tile predecessors carried
+    in) must equal the unsharded fused kernel's."""
+    ts, vals, sid = _flat_workload(5, 600, seed=9)
+    valid = np.ones(len(ts), bool)
+    out = downsample_group(
+        ts, vals, sid, valid, num_series=5, num_buckets=NUM_BUCKETS,
+        interval=INTERVAL, agg_down="avg", agg_group=agg_group, rate=True)
+    want_v = np.asarray(out["group_values"])
+    want_m = np.asarray(out["group_mask"])
+
+    sh = pack_time_shards(ts, vals, sid, D, INTERVAL, BPS)
+    got_v, got_m = timeshard_downsample_group(
+        *sh, mesh=mesh, num_series=5, buckets_per_shard=BPS,
+        interval=INTERVAL, agg_down="avg", agg_group=agg_group, rate=True)
+    got_v, got_m = np.asarray(got_v), np.asarray(got_m)
+
+    np.testing.assert_array_equal(got_m, want_m)
+    np.testing.assert_allclose(got_v[want_m], want_v[want_m],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_downsample_rate_carry_over_empty_tiles(mesh):
+    """A series' first bucket in a late tile rates against its last
+    bucket many tiles back."""
+    ts = np.array([30, SPAN - 100], np.int32)    # tiles 0 and 7
+    vals = np.array([10.0, 20.0], np.float32)
+    sid = np.zeros(2, np.int32)
+    valid = np.ones(2, bool)
+    out = downsample_group(
+        ts, vals, sid, valid, num_series=1, num_buckets=NUM_BUCKETS,
+        interval=INTERVAL, agg_down="avg", agg_group="sum", rate=True)
+    want_v = np.asarray(out["group_values"])
+    want_m = np.asarray(out["group_mask"])
+    assert want_m.sum() == 1  # only the second bucket has a rate
+
+    sh = pack_time_shards(ts, vals, sid, D, INTERVAL, BPS)
+    got_v, got_m = timeshard_downsample_group(
+        *sh, mesh=mesh, num_series=1, buckets_per_shard=BPS,
+        interval=INTERVAL, agg_down="avg", agg_group="sum", rate=True)
+    np.testing.assert_array_equal(np.asarray(got_m), want_m)
+    np.testing.assert_allclose(np.asarray(got_v)[want_m], want_v[want_m],
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("rate", [False, True])
+def test_downsample_quantile_parity(mesh, rate):
+    """Percentile group stage: per-bucket quantile across series is
+    tile-local once fill carries are exchanged."""
+    from opentsdb_tpu.ops.kernels import (
+        gap_fill, masked_quantile_axis0, step_fill)
+
+    ts, vals, sid = _flat_workload(6, 700, seed=13)
+    valid = np.ones(len(ts), bool)
+    out = downsample_group(
+        ts, vals, sid, valid, num_series=6, num_buckets=NUM_BUCKETS,
+        interval=INTERVAL, agg_down="avg", agg_group="count", rate=rate)
+    fill = step_fill if rate else gap_fill
+    filled, in_range = fill(out["series_values"], out["series_mask"],
+                            NUM_BUCKETS)
+    want_v = np.asarray(masked_quantile_axis0(
+        filled, in_range, np.array([0.95], np.float32))[0])
+    want_m = np.asarray(out["group_mask"])
+
+    sh = pack_time_shards(ts, vals, sid, D, INTERVAL, BPS)
+    got_v, got_m = timeshard_downsample_group(
+        *sh, mesh=mesh, num_series=6, buckets_per_shard=BPS,
+        interval=INTERVAL, agg_down="avg", agg_group="count",
+        rate=rate, quantile=0.95)
+    got_v, got_m = np.asarray(got_v), np.asarray(got_m)
+
+    np.testing.assert_array_equal(got_m, want_m)
+    np.testing.assert_allclose(got_v[want_m], want_v[want_m],
+                               rtol=1e-4, atol=1e-4)
