@@ -254,15 +254,43 @@ class TSDB:
 
     def scan_columns(self, start_key: bytes, stop_key: bytes,
                      key_regexp: bytes | None = None,
-                     ) -> list[tuple[bytes, codec.Columns]]:
-        """Batched scan decode: same rows as scan_rows, but every cell of
-        the whole range decodes in ONE vectorized pass
+                     batch_cells: int = 1 << 16,
+                     ) -> Iterator[tuple[bytes, codec.Columns]]:
+        """Batched scan decode: same rows as scan_rows, but cells decode
+        in vectorized passes of ~``batch_cells`` cells
         (codec_np.decode_cells_flat) — the query read hot path, where
-        per-row decode overhead would otherwise dominate wide scans."""
+        per-row decode overhead would otherwise dominate wide scans.
+        Yields per row at row-aligned batch boundaries, so peak memory
+        holds one batch of raw bytes + its decoded arrays, not the whole
+        range's (scan_rows-style streaming with the vectorized win)."""
         rows: list[tuple[bytes, int]] = []
         quals: list[bytes] = []
         vals: list[bytes] = []
         bases: list[int] = []
+
+        def decode_batch():
+            ts, f, i, isf, cop = codec_np.decode_cells_flat(
+                quals, vals, np.asarray(bases, np.int64))
+            starts = np.zeros(len(quals) + 1, np.int64)
+            if len(quals):
+                np.cumsum(np.bincount(cop, minlength=len(quals)),
+                          out=starts[1:])
+            out = []
+            ci = 0
+            for key, ncells in rows:
+                a, b = int(starts[ci]), int(starts[ci + ncells])
+                ci += ncells
+                if ncells > 1:
+                    d, ff, ii, mm = codec_np.sort_dedup(
+                        ts[a:b], f[a:b], i[a:b], isf[a:b])
+                    cols = codec.Columns(d, ff, ii, mm)
+                else:
+                    cols = codec.Columns(ts[a:b], f[a:b], i[a:b],
+                                         isf[a:b])
+                out.append((key, cols))
+            rows.clear(), quals.clear(), vals.clear(), bases.clear()
+            return out
+
         for cells in self.store.scan(self.table, start_key, stop_key,
                                      family=FAMILY, key_regexp=key_regexp):
             key = cells[0].key
@@ -277,25 +305,10 @@ class TSDB:
                 bases.append(base)
                 kept += 1
             rows.append((key, kept))
-        ts, f, i, isf, cop = codec_np.decode_cells_flat(
-            quals, vals, np.asarray(bases, np.int64))
-        starts = np.zeros(len(quals) + 1, np.int64)
-        if len(quals):
-            np.cumsum(np.bincount(cop, minlength=len(quals)),
-                      out=starts[1:])
-        out = []
-        ci = 0
-        for key, ncells in rows:
-            a, b = int(starts[ci]), int(starts[ci + ncells])
-            ci += ncells
-            if ncells > 1:
-                d, ff, ii, mm = codec_np.sort_dedup(
-                    ts[a:b], f[a:b], i[a:b], isf[a:b])
-                cols = codec.Columns(d, ff, ii, mm)
-            else:
-                cols = codec.Columns(ts[a:b], f[a:b], i[a:b], isf[a:b])
-            out.append((key, cols))
-        return out
+            if len(quals) >= batch_cells:
+                yield from decode_batch()
+        if rows:
+            yield from decode_batch()
 
     # ------------------------------------------------------------------
     # Suggest / admin / lifecycle

@@ -102,15 +102,17 @@ def holt_winters(values: jnp.ndarray, mask: jnp.ndarray,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("horizon", "season_length", "t_fitted"))
+    jax.jit, static_argnames=("horizon", "season_length"))
 def hw_forecast(level: jnp.ndarray, trend: jnp.ndarray,
                 seasonal: jnp.ndarray, *, horizon: int,
-                season_length: int = 0, t_fitted: int = 0) -> jnp.ndarray:
+                season_length: int = 0, t_fitted=0) -> jnp.ndarray:
     """h-step-ahead forecasts [S, horizon] from final Holt-Winters state.
 
     ``t_fitted`` is the number of steps holt_winters consumed (its T):
     seasonal slots are stored by absolute step index mod m, so future
-    step t_fitted + h reads slot (t_fitted + h) % m.
+    step t_fitted + h reads slot (t_fitted + h) % m. It is traced (a
+    dynamic gather), so queries over different spans share one compile;
+    callers bound recompiles fully by also padding ``horizon``.
     """
     h = jnp.arange(1, horizon + 1, dtype=jnp.float32)
     base = level[:, None] + trend[:, None] * h[None, :]

@@ -655,12 +655,19 @@ class TSDServer:
         def compute():
             from opentsdb_tpu.models import (anomaly_bands, ewma,
                                              hw_forecast)
+            from opentsdb_tpu.query.executor import _pad_size
 
             grid0 = start - start % interval
             T = max((end - grid0) // interval + 1, 1)
             S = max(len(results), 1)
-            vals = np.zeros((S, T), np.float32)
-            mask = np.zeros((S, T), bool)
+            # Pad the model shapes to powers of two: masked tail buckets
+            # and empty padded series carry the scan state through
+            # unchanged, so results are identical — but every distinct
+            # query span stops triggering an XLA recompile of the
+            # smoothing scan (the same _pad_size discipline as /q).
+            Tp, Sp = _pad_size(T), _pad_size(S)
+            vals = np.zeros((Sp, Tp), np.float32)
+            mask = np.zeros((Sp, Tp), bool)
             for i, r in enumerate(results):
                 idx = ((np.asarray(r.timestamps) - grid0) //
                        interval).astype(int)
@@ -668,7 +675,7 @@ class TSDServer:
                 vals[i, idx[ok]] = np.asarray(r.values)[ok]
                 mask[i, idx[ok]] = True
             if model == "ewma":
-                fitted = np.asarray(ewma(vals, mask, alpha))
+                fitted = np.asarray(ewma(vals, mask, alpha))[:S, :T]
                 level = fitted[:, -1]
                 fc = np.repeat(level[:, None], horizon, axis=1)
                 bands = None
@@ -676,10 +683,16 @@ class TSDServer:
                 bands = {k: np.asarray(v) for k, v in anomaly_bands(
                     vals, mask, alpha, beta, gamma, season,
                     nsigma).items()}
-                fitted = bands["fitted"]
                 fc = np.asarray(hw_forecast(
                     bands["level"], bands["trend"], bands["seasonal"],
-                    horizon=horizon, season_length=season, t_fitted=T))
+                    horizon=_pad_size(horizon), season_length=season,
+                    t_fitted=T))[:S, :horizon]
+                grid_keys = ("fitted", "upper", "lower", "sigma",
+                             "anomaly")
+                bands = {k: (v[:S, :T] if k in grid_keys else v[:S])
+                         for k, v in bands.items()}
+                fitted = bands["fitted"]
+            vals, mask = vals[:S, :T], mask[:S, :T]
             future_ts = grid0 + (T + np.arange(horizon)) * interval
             grid_ts = grid0 + np.arange(T) * interval
 

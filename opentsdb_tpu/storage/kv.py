@@ -127,21 +127,112 @@ class KVStore:
         raise NotImplementedError
 
 
+def _merge_unique(a: list[bytes], b: list[bytes]) -> list[bytes]:
+    """Merge two sorted unique lists into one, dropping cross-duplicates
+    (a key deleted and re-inserted can appear in both runs)."""
+    out: list[bytes] = []
+    i = j = 0
+    na, nb = len(a), len(b)
+    while i < na and j < nb:
+        ka, kb = a[i], b[j]
+        if ka < kb:
+            out.append(ka)
+            i += 1
+        elif kb < ka:
+            out.append(kb)
+            j += 1
+        else:
+            out.append(ka)
+            i += 1
+            j += 1
+    out.extend(a[i:])
+    out.extend(b[j:])
+    return out
+
+
 class _Table:
-    __slots__ = ("rows", "sorted_keys", "dirty", "row_tombs")
+    """Row storage + an incremental sorted key index.
+
+    The index is a sqrt-decomposition over two sorted runs: ``base``
+    (large, rebuilt rarely) and ``delta`` (small, absorbing recent
+    inserts), plus an unsorted ``pending`` set for brand-new keys.
+    Inserts are O(1) (set add); a scan absorbs pending into delta
+    (O(P log P + D)) and folds delta into base only when delta outgrows
+    ~sqrt(base) — so interleaved put/scan traffic no longer pays the old
+    O(rows log rows) full re-sort per scan (the dashboard-poll +
+    continuous-ingest hot pattern; fills the role of the LSM memtable
+    index in front of HBase's store files, reference
+    TsdbQuery.java:240-285 scan hot loop). Runs may carry stale (deleted)
+    keys; readers filter on ``k in rows`` and a purge rewrites the runs
+    when stale entries dominate.
+    """
+
+    __slots__ = ("rows", "base", "delta", "pending", "stale", "row_tombs")
 
     def __init__(self) -> None:
         # Cell value None = tombstone masking a spilled sstable cell.
         self.rows: dict[bytes, dict[tuple[bytes, bytes], bytes | None]] = {}
-        self.sorted_keys: list[bytes] = []
-        self.dirty = False  # sorted_keys is stale
+        self.base: list[bytes] = []
+        self.delta: list[bytes] = []
+        self.pending: set[bytes] = set()
+        self.stale = 0  # deleted keys still present in base/delta
         self.row_tombs: set[bytes] = set()  # whole-row masks over the sstable
 
-    def index(self) -> list[bytes]:
-        if self.dirty:
-            self.sorted_keys = sorted(self.rows)
-            self.dirty = False
-        return self.sorted_keys
+    def note_insert(self, key: bytes) -> None:
+        self.pending.add(key)
+
+    def note_delete(self) -> None:
+        self.stale += 1
+
+    def _absorb(self) -> None:
+        """Fold pending inserts into delta; compact when thresholds hit.
+        Caller holds the store lock."""
+        if self.pending:
+            new = sorted(self.pending)
+            self.pending.clear()
+            self.delta = _merge_unique(self.delta, new) if self.delta \
+                else new
+        if len(self.delta) ** 2 > max(len(self.base), 64):
+            self.base = _merge_unique(self.base, self.delta)
+            self.delta = []
+        if self.stale * 2 > len(self.base) + len(self.delta):
+            rows = self.rows
+            self.base = [k for k in self.base if k in rows]
+            self.delta = [k for k in self.delta if k in rows]
+            self.stale = 0
+
+    def range_keys(self, start: bytes, stop: bytes | None) -> list[bytes]:
+        """Sorted live keys in [start, stop); stop falsy = to the end.
+        Merge-iterates the two runs, skipping stale keys and
+        cross-duplicates. Caller holds the store lock."""
+        self._absorb()
+        a, b = self.base, self.delta
+        i, j = bisect_left(a, start), bisect_left(b, start)
+        ahi = bisect_left(a, stop) if stop else len(a)
+        bhi = bisect_left(b, stop) if stop else len(b)
+        rows = self.rows
+        out: list[bytes] = []
+        while i < ahi and j < bhi:
+            ka, kb = a[i], b[j]
+            if ka < kb:
+                k = ka
+                i += 1
+            elif kb < ka:
+                k = kb
+                j += 1
+            else:
+                k = ka
+                i += 1
+                j += 1
+            if k in rows:
+                out.append(k)
+        for k in a[i:ahi]:
+            if k in rows:
+                out.append(k)
+        for k in b[j:bhi]:
+            if k in rows:
+                out.append(k)
+        return out
 
 
 # WAL opcodes
@@ -442,7 +533,8 @@ class MemKVStore(KVStore):
                         merged.update(live.rows.get(k, {}))
                         live.rows[k] = merged
                     live.row_tombs |= ft.row_tombs
-                    live.dirty = True
+                    for k in ft.rows:
+                        live.note_insert(k)
                 self._frozen = None
             raise
 
@@ -464,7 +556,7 @@ class MemKVStore(KVStore):
         row = t.rows.get(key)
         if row is None:
             row = t.rows[key] = {}
-            t.dirty = True
+            t.note_insert(key)
         row[(family, qualifier)] = value
 
     def _apply_delete(self, table: str, key: bytes, family: bytes,
@@ -477,7 +569,7 @@ class MemKVStore(KVStore):
             if not spilled:
                 return
             row = t.rows[key] = {}
-            t.dirty = True
+            t.note_insert(key)
         for q in qualifiers:
             if spilled:
                 row[(family, q)] = None  # tombstone masks the sstable cell
@@ -485,12 +577,12 @@ class MemKVStore(KVStore):
                 row.pop((family, q), None)
         if not row:
             del t.rows[key]
-            t.dirty = True
+            t.note_delete()
 
     def _apply_delete_row(self, table: str, key: bytes) -> None:
         t = self._table(table)
         if t.rows.pop(key, None) is not None:
-            t.dirty = True
+            t.note_delete()
         if self._lower_tier_has(t, table, key):
             t.row_tombs.add(key)
 
@@ -551,7 +643,7 @@ class MemKVStore(KVStore):
                                      qualifier, value)
                 if row is None:
                     row = rows[key] = {}
-                    t.dirty = True
+                    t.note_insert(key)
                 row[(family, qualifier)] = value
                 existed.append(e)
         return existed
@@ -597,17 +689,11 @@ class MemKVStore(KVStore):
         pattern = re.compile(key_regexp, re.S) if key_regexp else None
         with self._lock:
             t = self._table(table)
-            index = t.index()
-            lo = bisect_left(index, start)
-            hi = bisect_left(index, stop) if stop else len(index)
-            keys = index[lo:hi]
+            keys = t.range_keys(start, stop)
             ft = self._frozen.get(table) if self._frozen else None
             extra = set()
             if ft is not None:
-                fidx = ft.index()
-                flo = bisect_left(fidx, start)
-                fhi = bisect_left(fidx, stop) if stop else len(fidx)
-                extra.update(k for k in fidx[flo:fhi]
+                extra.update(k for k in ft.range_keys(start, stop)
                              if k not in t.rows and k not in t.row_tombs)
             if self._sst is not None:
                 extra.update(

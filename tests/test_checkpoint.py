@@ -258,7 +258,8 @@ class TestCheckpoint:
                     merged.update(live.rows.get(k, {}))
                     live.rows[k] = merged
                 live.row_tombs |= ft.row_tombs
-                live.dirty = True
+                for k in ft.rows:
+                    live.note_insert(k)
             store._frozen = None
         store.checkpoint()
         assert store.get(T, b"fresh")[0].value == b"new"

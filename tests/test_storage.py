@@ -200,3 +200,54 @@ class TestPutMany:
         rows = lambda st: [c for r in st.scan("t", b"", b"\xff" * 8)
                            for c in r]
         assert rows(s2) == rows(s) and len(rows(s)) == 3
+
+
+class TestIncrementalIndex:
+    """The two-run incremental key index must behave exactly like a full
+    re-sort on every scan, under any interleaving of puts, deletes, and
+    scans (including delete + re-insert, which can leave a key in both
+    runs)."""
+
+    def test_interleaved_put_scan_delete_differential(self):
+        import random
+        rng = random.Random(17)
+        store = MemKVStore()
+        live = {}
+        keys = [f"k{i:04d}".encode() for i in range(400)]
+        for step in range(2000):
+            op = rng.random()
+            k = rng.choice(keys)
+            if op < 0.55:
+                store.put(T, k, F, b"q", b"v%d" % step)
+                live[k] = b"v%d" % step
+            elif op < 0.75 and live:
+                dk = rng.choice(sorted(live))
+                store.delete_row(T, dk)
+                del live[dk]
+            else:
+                lo = rng.choice(keys)
+                hi = rng.choice(keys)
+                if lo > hi:
+                    lo, hi = hi, lo
+                got = [cells[0].key for cells in store.scan(T, lo, hi)]
+                want = sorted(kk for kk in live if lo <= kk < hi)
+                assert got == want, f"step {step}"
+        got = [cells[0].key for cells in store.scan(T, b"", b"\xff" * 8)]
+        assert got == sorted(live)
+
+    def test_absorb_bounds_work_scale(self):
+        """A scan after a small insert burst must not touch the big base
+        run (the delta stays small) — the incremental guarantee."""
+        store = MemKVStore()
+        for i in range(5000):
+            store.put(T, b"base%05d" % i, F, b"q", b"v")
+        t = store._tables[T]
+        list(store.scan(T, b"", b"\xff" * 8))  # absorb everything
+        base_id = id(t.base)
+        assert len(t.base) == 5000 and not t.delta and not t.pending
+        # A handful of new keys: absorbed into delta, base untouched.
+        for i in range(5):
+            store.put(T, b"new%02d" % i, F, b"q", b"v")
+        list(store.scan(T, b"zzz", b"\xff" * 8))
+        assert id(t.base) == base_id  # no O(N) rebuild for 5 inserts
+        assert len(t.delta) == 5

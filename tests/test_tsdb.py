@@ -209,7 +209,7 @@ class TestScanColumns:
         tsdb.store.put(tsdb.table, key2, FAMILY, b"\x05", b"note")
 
         lo, hi = b"", b"\xff" * 32
-        batched = tsdb.scan_columns(lo, hi)
+        batched = list(tsdb.scan_columns(lo, hi))
         streamed = list(tsdb.scan_rows(lo, hi))
         assert len(batched) == len(streamed) > 0
         for (bk, bc), (sk, sc) in zip(batched, streamed):
@@ -226,6 +226,31 @@ class TestScanColumns:
                           [c.qualifier for c in
                            tsdb.store.get(tsdb.table, key, FAMILY)])
         tsdb.store.put(tsdb.table, key, FAMILY, b"\x01", b"x")
-        out = tsdb.scan_columns(b"", b"\xff" * 32)
+        out = list(tsdb.scan_columns(b"", b"\xff" * 32))
         row = [c for k, c in out if k == key]
         assert len(row) == 1 and len(row[0].timestamps) == 0
+
+
+def test_scan_columns_bounded_batches():
+    """batch_cells=1 forces a decode per row; results must match the
+    one-shot decode (streaming is a memory bound, not a semantics
+    change)."""
+    from opentsdb_tpu.storage.kv import MemKVStore
+    from opentsdb_tpu.utils.config import Config
+    from opentsdb_tpu.core.tsdb import TSDB
+
+    t = TSDB(MemKVStore(), Config(auto_create_metrics=True),
+             start_compaction_thread=False)
+    rng = np.random.default_rng(2)
+    for h in ("a", "b", "c"):
+        n = 50
+        ts = np.sort(rng.choice(7200, n, replace=False)) + BT
+        t.add_batch("m.batch", ts, rng.normal(0, 1, n), {"h": h})
+    lo, hi = b"", b"\xff" * 32
+    one_shot = list(t.scan_columns(lo, hi))
+    per_row = list(t.scan_columns(lo, hi, batch_cells=1))
+    assert len(one_shot) == len(per_row) > 0
+    for (ak, ac), (bk, bc) in zip(one_shot, per_row):
+        assert ak == bk
+        np.testing.assert_array_equal(ac.timestamps, bc.timestamps)
+        np.testing.assert_array_equal(ac.values, bc.values)
