@@ -50,6 +50,72 @@ class TSDB:
         self._lock = threading.Lock()
         # ingest stats
         self.datapoints_added = 0
+        # Streaming sketch state (stats/livesketch.py): loaded from the
+        # checkpoint snapshot when one exists (then re-folding only the
+        # WAL-replayed memtable), else rebuilt from a full storage scan.
+        self.sketches = None
+        if self.config.enable_sketches:
+            self._init_sketches()
+
+    # ------------------------------------------------------------------
+    # Streaming sketches
+    # ------------------------------------------------------------------
+
+    def _sketch_path(self) -> str | None:
+        wal = getattr(self.store, "_wal_path", None)
+        return wal + ".sketches" if wal else None
+
+    def _init_sketches(self) -> None:
+        import os as _os
+
+        from opentsdb_tpu.stats.livesketch import LiveSketches
+
+        path = self._sketch_path()
+        cfg = self.config
+        if path and _os.path.exists(path):
+            self.sketches = LiveSketches.load(
+                path, flush_points=cfg.sketch_flush_points)
+            # The snapshot covers the sstable tier (committed in the
+            # checkpoint window, before the WAL truncation); the live
+            # memtable holds the WAL-replayed post-checkpoint writes —
+            # re-fold only those, reading rows WITHOUT tier merging so
+            # spilled cells aren't folded twice.
+            keys = getattr(self.store, "memtable_keys", None)
+            cells = getattr(self.store, "memtable_cells", None)
+            if keys is not None and cells is not None:
+                self._refold(
+                    (k, self.read_row(k, cells(self.table, k, FAMILY)))
+                    for k in keys(self.table))
+                return
+        else:
+            self.sketches = LiveSketches(
+                compression=cfg.sketch_compression,
+                hll_p=cfg.sketch_hll_p,
+                flush_points=cfg.sketch_flush_points)
+            if not getattr(self.store, "memtable_keys", None):
+                return
+        # No snapshot (or unknown store shape): rebuild from everything.
+        self._refold(self.scan_columns(b"", b"\xff" * 64))
+
+    def _refold(self, rows) -> None:
+        for key, cols in rows:
+            if len(cols.timestamps) == 0:
+                continue
+            pr = codec.parse_row_key(key)
+            self.sketches.observe(
+                codec.series_key(key), cols.values,
+                [(pr.metric_uid, k, v) for k, v in pr.tag_uids])
+        self.sketches.flush()
+
+    def _observe(self, series_key: bytes, metric_uid: bytes,
+                 pairs: list[tuple[bytes, bytes]],
+                 values: np.ndarray) -> None:
+        """Ingest-side sketch fold; callers pass the UIDs they already
+        resolved (no row-key re-parse on the hot path)."""
+        if self.sketches is None:
+            return
+        self.sketches.observe(
+            series_key, values, [(metric_uid, k, v) for k, v in pairs])
 
     # ------------------------------------------------------------------
     # Row-key construction
@@ -69,16 +135,26 @@ class TSDB:
         pairs.sort()
         return pairs
 
-    def row_key_for(self, metric: str, tag_map: dict[str, str],
-                    base_ts: int, create_metric: bool | None = None,
-                    create_tags: bool = True) -> bytes:
+    def _row_parts(self, metric: str, tag_map: dict[str, str],
+                   create_metric: bool | None = None,
+                   create_tags: bool = True,
+                   ) -> tuple[bytes, list[tuple[bytes, bytes]]]:
+        """(metric_uid, sorted tag UID pairs) for a series — the resolved
+        parts row_key_for assembles, exposed so the write path can reuse
+        them (sketch folds) without re-parsing the key it just built."""
         tags_mod.check_metric_and_tags(metric, tag_map)
         if create_metric is None:
             create_metric = self.config.auto_create_metrics
         metric_uid = (self.metrics.get_or_create_id(metric) if create_metric
                       else self.metrics.get_id(metric))
-        return codec.row_key(metric_uid, base_ts,
-                             self.resolve_tags(tag_map, create_tags))
+        return metric_uid, self.resolve_tags(tag_map, create_tags)
+
+    def row_key_for(self, metric: str, tag_map: dict[str, str],
+                    base_ts: int, create_metric: bool | None = None,
+                    create_tags: bool = True) -> bytes:
+        metric_uid, pairs = self._row_parts(metric, tag_map,
+                                            create_metric, create_tags)
+        return codec.row_key(metric_uid, base_ts, pairs)
 
     # ------------------------------------------------------------------
     # Write path
@@ -99,12 +175,15 @@ class TSDB:
         else:
             buf, flags = codec.encode_long(value)
         base_ts = codec.base_time(timestamp)
-        row = self.row_key_for(metric, tag_map, base_ts)
+        metric_uid, pairs = self._row_parts(metric, tag_map)
+        row = codec.row_key(metric_uid, base_ts, pairs)
         qual = codec.encode_qualifier(timestamp - base_ts, flags)
         self.store.put(self.table, row, FAMILY, qual, buf, durable=durable)
         if self.config.enable_compactions:
             self.compactionq.add(row)
         self.datapoints_added += 1
+        self._observe(codec.series_key(row), metric_uid, pairs,
+                      np.asarray([value], np.float64))
 
     def add_batch(self, metric: str, timestamps: np.ndarray,
                   values: np.ndarray, tag_map: dict[str, str],
@@ -153,7 +232,8 @@ class TSDB:
             ([0], np.flatnonzero(np.diff(base)) + 1))
         cells = codec_np.encode_cells_multi(deltas, f_s, i_s, m_s,
                                             row_starts)
-        tmpl = bytearray(self.row_key_for(metric, tag_map, 0))
+        metric_uid, pairs = self._row_parts(metric, tag_map)
+        tmpl = bytearray(codec.row_key(metric_uid, 0, pairs))
         batch = []
         for start_idx, (qual, val) in zip(row_starts, cells):
             codec.set_base_time(tmpl, int(base[start_idx]))
@@ -178,6 +258,10 @@ class TSDB:
                     self.compactionq.add(key)
         n = len(ts_s)
         self.datapoints_added += n
+        # Sketch fold covers fully applied batches only (a throttled
+        # batch raised above); values as stored, floats and ints alike.
+        self._observe(codec.series_key(batch[0][0]), metric_uid, pairs,
+                      f_s)
         return n
 
     # ------------------------------------------------------------------
@@ -336,12 +420,29 @@ class TSDB:
     def checkpoint(self) -> int:
         """Spill memtable state to the sstable tier and truncate the WAL
         (the TPU build's checkpoint/resume story, SURVEY §5.4). Returns
-        rows spilled, 0 when the store is non-persistent."""
+        rows spilled, 0 when the store is non-persistent.
+
+        The sketch snapshot commits BEFORE the storage spill: the spill
+        truncates the WAL, so committing after would mean a crash in
+        between loses every fold since the previous snapshot (nothing
+        left to replay). Committing first over-covers instead — a crash
+        before the spill leaves a snapshot that already includes the
+        still-replayable memtable, and recovery's re-fold double-counts
+        it: exact for HLLs (register max is idempotent), within sketch
+        tolerance for digests (the tradeoff the module doc accepts)."""
+        path = self._sketch_path()
+        if self.sketches is not None and path:
+            self.sketches.save(path)
         ckpt = getattr(self.store, "checkpoint", None)
         return ckpt() if ckpt else 0
 
     def shutdown(self) -> None:
         self.compactionq.shutdown()
+        if self.sketches is not None and self._sketch_path():
+            # Spill + snapshot in one window: the snapshot's coverage
+            # contract (== the sstable tier) must hold on the next boot,
+            # where the replayed memtable is re-folded on top of it.
+            self.checkpoint()
         self.store.flush()
         close = getattr(self.store, "close", None)
         if close:
@@ -362,3 +463,6 @@ class TSDB:
         collector.record("compaction.deleted_cells", cq.deleted_cells)
         collector.record("compaction.errors", cq.errors)
         collector.record("compaction.queue.size", len(cq))
+        if self.sketches is not None:
+            collector.record("sketches.series",
+                             self.sketches.series_count())

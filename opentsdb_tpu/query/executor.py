@@ -91,10 +91,14 @@ class QueryExecutor:
 
     def _build_regexp(self, exact: list[tuple[bytes, bytes]],
                       group_bys: list[tuple[bytes, list[bytes] | None]],
+                      prefix: int = UID_WIDTH + TIMESTAMP_BYTES,
                       ) -> bytes | None:
         """Row-key regexp over raw UID bytes, merged in tagk-id order.
 
-        Parity: reference TsdbQuery.createAndSetFilter (:433-492)."""
+        Parity: reference TsdbQuery.createAndSetFilter (:433-492).
+        ``prefix`` is the byte count before the tag pairs — row keys
+        carry base-time bytes after the metric; series keys (sketch
+        directory) don't, so they pass UID_WIDTH."""
         if not exact and not group_bys:
             return None
         tagsize = 2 * UID_WIDTH
@@ -109,7 +113,7 @@ class QueryExecutor:
                 frag = b"(?:" + alts + b")"
             items.append((k, frag))
         items.sort(key=lambda kv: kv[0])
-        buf = b"(?s)^.{%d}" % (UID_WIDTH + TIMESTAMP_BYTES)
+        buf = b"(?s)^.{%d}" % prefix
         for _, frag in items:
             buf += b"(?:.{%d})*" % tagsize + frag
         buf += b"(?:.{%d})*$" % tagsize
@@ -538,6 +542,68 @@ class QueryExecutor:
             num_buckets=num_buckets, interval=interval, agg_down=dsagg,
             agg_group=spec.aggregator, **self._rate_kw(spec))
         return np.asarray(gv), np.asarray(gm)
+
+    # ------------------------------------------------------------------
+    # Streaming-sketch queries (no storage rescan)
+    # ------------------------------------------------------------------
+
+    def _sketch_series(self, metric: str, tags: dict[str, str],
+                       ) -> list[bytes]:
+        """Series keys with sketch state matching metric + tag filter —
+        selected from the sketch slot directory, not a storage scan. The
+        same UID regexp as the scan path, minus the base-time bytes."""
+        metric_uid = self.tsdb.metrics.get_id(metric)
+        exact, group_bys = [], []
+        for name, value in tags.items():
+            k = self.tsdb.tagk.get_id(name)
+            if value == "*":
+                group_bys.append((k, None))
+            elif "|" in value:
+                group_bys.append(
+                    (k, [self.tsdb.tagv.get_id(v)
+                         for v in value.split("|")]))
+            else:
+                exact.append((k, self.tsdb.tagv.get_id(value)))
+        regexp = self._build_regexp(exact, group_bys, prefix=UID_WIDTH)
+        pattern = re.compile(regexp, re.S) if regexp else None
+        return [k for k in self.tsdb.sketches.series_keys()
+                if k.startswith(metric_uid)
+                and (pattern is None or pattern.match(k))]
+
+    def sketch_quantiles(self, metric: str, tags: dict[str, str],
+                         qs: list[float]) -> dict:
+        """All-time quantiles of the matching series' merged streaming
+        t-digests (the Histogram.java-replacement path: answered from
+        device-resident state updated at ingest, no storage rescan;
+        staleness bounded by LiveSketches.flush_points and zeroed by the
+        flush inside quantile()). Not range-filtered: digests cover each
+        series' full ingested history."""
+        sk = self.tsdb.sketches
+        if sk is None:
+            raise BadRequestError(
+                "streaming sketches are disabled (enable_sketches)")
+        keys = self._sketch_series(metric, tags)
+        out = sk.quantile(keys, np.asarray(qs, np.float32))
+        if out is None:
+            raise BadRequestError(
+                f"no sketch state for metric {metric} with those tags")
+        return {"metric": metric, "series": len(keys),
+                "quantiles": {f"{q:g}": float(v)
+                              for q, v in zip(qs, out)}}
+
+    def sketch_distinct(self, metric: str, tagk: str) -> int | None:
+        """Streaming distinct-tagv estimate from the per-(metric, tagk)
+        HLL registers; None when the pair has no sketch state (fall back
+        to the scan path). All-time, like the digests."""
+        sk = self.tsdb.sketches
+        if sk is None:
+            return None
+        from opentsdb_tpu.core.errors import NoSuchUniqueName
+        try:
+            return sk.distinct(self.tsdb.metrics.get_id(metric),
+                               self.tsdb.tagk.get_id(tagk))
+        except NoSuchUniqueName:
+            return None
 
     # ------------------------------------------------------------------
     # Cardinality (distinct tag values)

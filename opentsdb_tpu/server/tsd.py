@@ -395,6 +395,8 @@ class TSDServer:
             return await self._query(q, parsed.query, params)
         if route == "/distinct":
             return await self._distinct(q)
+        if route == "/sketch":
+            return await self._sketch(q)
         if route == "/forecast":
             return await self._forecast(q, params)
         if route == "/dropcaches":
@@ -563,10 +565,29 @@ class TSDServer:
         return plot.render()
 
     async def _distinct(self, q) -> tuple:
-        """Cardinality extension: distinct values of one tag key."""
-        for req in ("metric", "tagk", "start"):
+        """Cardinality extension: distinct values of one tag key.
+
+        Without ``start`` (or with ``stream`` set), answered from the
+        streaming per-(metric, tagk) HLL registers updated at ingest —
+        all-time, no storage rescan, staleness bounded by the sketch
+        flush threshold. With a time range, the scan-based path runs.
+        """
+        for req in ("metric", "tagk"):
             if req not in q:
                 raise BadRequestError(f"Missing parameter: {req}")
+        loop = asyncio.get_running_loop()
+        if "stream" in q or "start" not in q:
+            n = await loop.run_in_executor(
+                self._pool, self.executor.sketch_distinct, q["metric"],
+                q["tagk"])
+            if n is None:
+                raise BadRequestError(
+                    f"no streaming sketch state for metric {q['metric']}"
+                    f" / tagk {q['tagk']} (pass start= for a scan)")
+            body = json.dumps({
+                "metric": q["metric"], "tagk": q["tagk"], "distinct": n,
+                "source": "stream"}).encode()
+            return 200, "application/json", body, {}
         now = int(time.time())
         start = timeparse.parse_date(q["start"], now=now)
         end = timeparse.parse_date(q["end"], now=now) if "end" in q else now
@@ -574,13 +595,53 @@ class TSDServer:
         if "tags" in q and q["tags"]:
             for t in q["tags"].split(","):
                 tags_mod.parse(tag_map, t)
-        loop = asyncio.get_running_loop()
         n = await loop.run_in_executor(
             self._pool, self.executor.distinct_tagv, q["metric"], tag_map,
             q["tagk"], start, end)
         body = json.dumps({"metric": q["metric"], "tagk": q["tagk"],
-                           "distinct": n}).encode()
+                           "distinct": n, "source": "scan"}).encode()
         return 200, "application/json", body, {}
+
+    async def _sketch(self, q) -> tuple:
+        """Streaming-quantile extension: all-time percentiles of the
+        matching series' merged t-digests, answered from device-resident
+        sketch state with no storage rescan (the Histogram.java
+        streaming-stats replacement). Params: ``m=metric{tag=v,...}``
+        (no aggregator prefix) and ``q=p50,p99`` (or 0.5,0.99).
+        """
+        if "m" not in q:
+            raise BadRequestError("Missing parameter: m")
+        expr = q["m"]
+        tag_map: dict[str, str] = {}
+        try:
+            metric = tags_mod.parse_with_metric(expr, tag_map)
+        except ValueError as e:
+            raise BadRequestError(str(e)) from None
+        qs = []
+        for part in q.get("q", "p50,p95,p99").split(","):
+            part = part.strip()
+            try:
+                if part.startswith("p") and part[1:].isdigit():
+                    d = part[1:]
+                    # p5 -> 0.05, p99 -> 0.99 (whole percent); three or
+                    # more digits use the aggregator-registry spelling
+                    # where digits follow the decimal point: p999 ->
+                    # 0.999 (so "p100" is 0.100, not the maximum — ask
+                    # for q=1.0 explicitly).
+                    qs.append(int(d) / 100 if len(d) <= 2
+                              else int(d) / 10 ** len(d))
+                else:
+                    qs.append(float(part))
+            except ValueError:
+                raise BadRequestError(
+                    f"bad quantile: {part}") from None
+            if not 0.0 <= qs[-1] <= 1.0:
+                raise BadRequestError(f"quantile out of range: {part}")
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(
+            self._pool, self.executor.sketch_quantiles, metric, tag_map,
+            qs)
+        return 200, "application/json", json.dumps(out).encode(), {}
 
     async def _forecast(self, q, params) -> tuple:
         """Model extension: Holt-Winters / EWMA forecasts + anomaly

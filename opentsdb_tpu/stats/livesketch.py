@@ -1,0 +1,348 @@
+"""Device-resident streaming sketch state, folded in at ingest.
+
+The reference's only streaming statistic is the fixed-bucket latency
+Histogram (reference src/stats/Histogram.java:38), and distinct-value
+questions require materializing every group at query time. Per the north
+star (BASELINE.json) this layer replaces both with mergeable sketches that
+live in device memory (HBM on TPU) and are updated as data arrives:
+
+- one t-digest per series (value distribution -> p50/p95/p99 without a
+  storage rescan),
+- one HyperLogLog register bank per (metric, tag key) pair (distinct tag
+  values, e.g. "how many hosts report sys.cpu.user").
+
+Design (SURVEY.md §5.4, §7.4):
+
+- **Fixed-shape stacks.** All digests live in two [C, K] arrays
+  (means/weights), all HLLs in one [C, 2^p] int32 array; C doubles on
+  demand. One extra trash row absorbs padded scatter indices, so every
+  update is a single fixed-shape jitted call regardless of how many
+  sketches it touches.
+- **Buffered folding with a staleness bound.** ``observe()`` appends to a
+  host-side buffer (O(1), no device work on the ingest hot path);
+  ``flush()`` folds the whole buffer in one vmapped kernel per sketch
+  kind. Queries flush first, so answers are exact as of the query; the
+  buffer is also flushed whenever it holds ``flush_points`` points, which
+  bounds the un-folded backlog (the staleness bound) at all times.
+- **Mergeability across chips.** States merge by elementwise max (HLL)
+  and concatenate+recompress (t-digest) — ``merge_from`` for host-side
+  fan-in; on a mesh the same merges ride pmax / all_gather
+  (parallel/sharded.py sharded_hll_distinct, sharded_tdigest).
+- **Checkpoint/resume.** ``save``/``load`` snapshot the device state to
+  host .npz; TSDB.checkpoint writes the snapshot in the same window as
+  the storage spill, so on crash recovery the snapshot covers exactly
+  the sstable tier and re-folding the WAL-replayed memtable restores the
+  rest. HLL recovery is exact under replay (register max is idempotent);
+  t-digest recovery is approximate if a crash lands inside the
+  checkpoint-commit window (a bounded double-fold) — acceptable for a
+  sketch, and the tests pin the tolerance.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opentsdb_tpu.ops import sketches
+
+_PAD_MIN = 8
+
+
+def _pad(n: int) -> int:
+    size = _PAD_MIN
+    while size < n:
+        size *= 2
+    return size
+
+
+class LiveSketches:
+    """Streaming sketch store; thread-safe (one lock around buffer+state).
+
+    ``compression``: t-digest centroid budget per series (K).
+    ``hll_p``: per-(metric, tagk) register count exponent (2^p int32).
+    ``flush_points``: buffered-point bound before an automatic fold.
+    """
+
+    def __init__(self, compression: int = 128, hll_p: int = 12,
+                 flush_points: int = 65536) -> None:
+        self.compression = compression
+        self.hll_p = hll_p
+        self.flush_points = flush_points
+        self._lock = threading.RLock()
+        # slot maps: key -> row in the device stacks
+        self._td_slots: dict[bytes, int] = {}
+        self._hll_slots: dict[tuple[bytes, bytes], int] = {}
+        # device stacks ([capacity(+1 trash implied by scatter clamp), ...])
+        self._td_means = jnp.zeros((_PAD_MIN, compression), jnp.float32)
+        self._td_weights = jnp.zeros((_PAD_MIN, compression), jnp.float32)
+        self._hll_regs = jnp.zeros((_PAD_MIN, 1 << hll_p), jnp.int32)
+        # host-side buffers
+        self._td_buf: dict[int, list[np.ndarray]] = {}
+        self._hll_buf: dict[int, set[int]] = {}
+        self._buffered = 0
+
+    # -- slot management ---------------------------------------------------
+
+    def _td_slot(self, series_key: bytes) -> int:
+        slot = self._td_slots.get(series_key)
+        if slot is None:
+            slot = len(self._td_slots)
+            self._td_slots[series_key] = slot
+            if slot >= self._td_means.shape[0]:
+                grow = self._td_means.shape[0]
+                pad = jnp.zeros((grow, self.compression), jnp.float32)
+                self._td_means = jnp.concatenate([self._td_means, pad])
+                self._td_weights = jnp.concatenate([self._td_weights, pad])
+        return slot
+
+    def _hll_slot(self, metric_uid: bytes, tagk_uid: bytes) -> int:
+        key = (metric_uid, tagk_uid)
+        slot = self._hll_slots.get(key)
+        if slot is None:
+            slot = len(self._hll_slots)
+            self._hll_slots[key] = slot
+            if slot >= self._hll_regs.shape[0]:
+                grow = self._hll_regs.shape[0]
+                self._hll_regs = jnp.concatenate([
+                    self._hll_regs,
+                    jnp.zeros((grow, 1 << self.hll_p), jnp.int32)])
+        return slot
+
+    # -- ingest-side API ---------------------------------------------------
+
+    def observe(self, series_key: bytes, values: np.ndarray,
+                tag_uids: list[tuple[bytes, bytes, bytes]]) -> None:
+        """Record one series batch: ``values`` fold into the series
+        digest; each (metric_uid, tagk_uid, tagv_uid) folds the tag value
+        into the pair's HLL. O(1) host work; device folding is deferred
+        to flush()."""
+        with self._lock:
+            if len(values):
+                self._td_buf.setdefault(
+                    self._td_slot(series_key), []).append(
+                        np.asarray(values, np.float32))
+                self._buffered += len(values)
+            for metric_uid, tagk_uid, tagv_uid in tag_uids:
+                slot = self._hll_slot(metric_uid, tagk_uid)
+                self._hll_buf.setdefault(slot, set()).add(
+                    int.from_bytes(tagv_uid, "big"))
+            if self._buffered >= self.flush_points:
+                self._flush_locked()
+
+    def flush(self) -> None:
+        """Fold every buffered observation into the device state."""
+        with self._lock:
+            self._flush_locked()
+
+    # Fold-batch bounds: chunk long series to _MAX_CHUNK values and cap
+    # a fold call at _MAX_FOLD_CELLS dense cells, so flush memory is
+    # O(total buffered points), never (series x longest-series) — one
+    # hot series can't blow the padding up for a thousand cold ones.
+    _MAX_CHUNK = 4096
+    _MAX_FOLD_CELLS = 1 << 22
+
+    def _fold_td_group(self, group: list[tuple[int, np.ndarray]],
+                       P: int) -> None:
+        S = _pad(len(group))
+        batch = np.zeros((S, P), np.float32)
+        valid = np.zeros((S, P), bool)
+        # Padded rows scatter out of bounds and are dropped.
+        idx = np.full(S, self._td_means.shape[0], np.int32)
+        for r, (s, v) in enumerate(group):
+            batch[r, :len(v)] = v
+            valid[r, :len(v)] = True
+            idx[r] = s
+        self._td_means, self._td_weights = _fold_tdigests(
+            self._td_means, self._td_weights, jnp.asarray(idx),
+            jnp.asarray(batch), jnp.asarray(valid),
+            compression=self.compression)
+
+    def _flush_locked(self) -> None:
+        if self._td_buf:
+            # Per-slot chunk queues; each round folds at most one chunk
+            # per slot (scatter indices must be unique within a fold),
+            # bucketed by padded length to bound padding waste and the
+            # number of distinct jit shapes.
+            queues: dict[int, list[np.ndarray]] = {}
+            for s, chunks in self._td_buf.items():
+                v = np.concatenate(chunks)
+                queues[s] = [v[off:off + self._MAX_CHUNK]
+                             for off in range(0, len(v),
+                                              self._MAX_CHUNK)]
+            while queues:
+                by_p: dict[int, list] = {}
+                for s in sorted(queues):
+                    v = queues[s].pop(0)
+                    by_p.setdefault(_pad(len(v)), []).append((s, v))
+                queues = {s: q for s, q in queues.items() if q}
+                for P, plist in sorted(by_p.items()):
+                    rows = max(self._MAX_FOLD_CELLS // P, 1)
+                    for i in range(0, len(plist), rows):
+                        self._fold_td_group(plist[i:i + rows], P)
+            self._td_buf.clear()
+        if self._hll_buf:
+            slots = sorted(self._hll_buf)
+            uids = [np.fromiter(self._hll_buf[s], np.int32)
+                    for s in slots]
+            H = _pad(len(slots))
+            U = _pad(max(len(u) for u in uids))
+            items = np.zeros((H, U), np.int32)
+            valid = np.zeros((H, U), bool)
+            for i, u in enumerate(uids):
+                items[i, :len(u)] = u
+                valid[i, :len(u)] = True
+            idx = np.full(H, self._hll_regs.shape[0], np.int32)
+            idx[:len(slots)] = slots
+            self._hll_regs = _fold_hlls(
+                self._hll_regs, jnp.asarray(idx), jnp.asarray(items),
+                jnp.asarray(valid), p=self.hll_p)
+            self._hll_buf.clear()
+        self._buffered = 0
+
+    # -- query-side API ----------------------------------------------------
+
+    def distinct(self, metric_uid: bytes, tagk_uid: bytes) -> int | None:
+        """Streaming distinct-tagv estimate; None when the pair was never
+        ingested. Flushes first, so the answer is current."""
+        with self._lock:
+            slot = self._hll_slots.get((metric_uid, tagk_uid))
+            if slot is None:
+                return None
+            self._flush_locked()
+            return int(round(float(
+                sketches.hll_estimate(self._hll_regs[slot]))))
+
+    def quantile(self, series_keys: list[bytes], q) -> np.ndarray | None:
+        """Quantiles of the merged all-time distribution of the given
+        series (one digest concatenate+recompress). None when no listed
+        series has sketch state. ``q`` scalar or [K]; returns [K]."""
+        with self._lock:
+            slots = [self._td_slots[k] for k in series_keys
+                     if k in self._td_slots]
+            if not slots:
+                return None
+            self._flush_locked()
+            S = _pad(len(slots))
+            idx = np.zeros(S, np.int32)
+            idx[:len(slots)] = slots
+            valid = np.zeros(S, bool)
+            valid[:len(slots)] = True
+            out = _merged_quantile(
+                self._td_means, self._td_weights, jnp.asarray(idx),
+                jnp.asarray(valid),
+                jnp.atleast_1d(jnp.asarray(q, jnp.float32)),
+                compression=self.compression)
+            return np.asarray(out)
+
+    def series_count(self) -> int:
+        return len(self._td_slots)
+
+    def series_keys(self) -> list[bytes]:
+        """All series with sketch state — the slot map doubles as a
+        series directory, so sketch queries select series without any
+        storage scan."""
+        with self._lock:
+            return list(self._td_slots)
+
+    # -- merge / checkpoint ------------------------------------------------
+
+    def merge_from(self, other: "LiveSketches") -> None:
+        """Fold another store's state in (multi-chip / multi-host fan-in:
+        each shard folds its own series locally, the query side merges —
+        register max for HLL, centroid recompress for digests; the mesh
+        form of the same merges is parallel/sharded.py)."""
+        with self._lock, other._lock:
+            other._flush_locked()
+            self._flush_locked()
+            for key, oslot in other._td_slots.items():
+                slot = self._td_slot(key)
+                m, w = sketches.tdigest_merge(
+                    self._td_means[slot], self._td_weights[slot],
+                    other._td_means[oslot], other._td_weights[oslot],
+                    compression=self.compression)
+                self._td_means = self._td_means.at[slot].set(m)
+                self._td_weights = self._td_weights.at[slot].set(w)
+            for key, oslot in other._hll_slots.items():
+                slot = self._hll_slot(*key)
+                self._hll_regs = self._hll_regs.at[slot].set(
+                    jnp.maximum(self._hll_regs[slot],
+                                other._hll_regs[oslot]))
+
+    def save(self, path: str) -> None:
+        """Snapshot device state to a host .npz (atomic via tmp+rename)."""
+        with self._lock:
+            self._flush_locked()
+            td_keys = sorted(self._td_slots, key=self._td_slots.get)
+            hll_keys = sorted(self._hll_slots, key=self._hll_slots.get)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez(
+                    f,
+                    td_keys=np.array(td_keys, dtype=object),
+                    hll_metric=np.array([k[0] for k in hll_keys],
+                                        dtype=object),
+                    hll_tagk=np.array([k[1] for k in hll_keys],
+                                      dtype=object),
+                    td_means=np.asarray(self._td_means),
+                    td_weights=np.asarray(self._td_weights),
+                    hll_regs=np.asarray(self._hll_regs),
+                    meta=np.array([self.compression, self.hll_p]))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str, flush_points: int = 65536) -> "LiveSketches":
+        z = np.load(path, allow_pickle=True)
+        compression, hll_p = (int(x) for x in z["meta"])
+        self = cls(compression=compression, hll_p=hll_p,
+                   flush_points=flush_points)
+        self._td_means = jnp.asarray(z["td_means"])
+        self._td_weights = jnp.asarray(z["td_weights"])
+        self._hll_regs = jnp.asarray(z["hll_regs"])
+        self._td_slots = {bytes(k): i for i, k in enumerate(z["td_keys"])}
+        self._hll_slots = {
+            (bytes(m), bytes(t)): i
+            for i, (m, t) in enumerate(zip(z["hll_metric"], z["hll_tagk"]))}
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Jitted batch folds (fixed shapes; cached per (stack, batch) padded size)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("compression",))
+def _fold_tdigests(means, weights, idx, batch, valid, *, compression):
+    """Gather rows at idx, fold each row's batch, scatter back. Padded
+    idx entries point one past the stack and scatter with mode='drop';
+    their gathers clamp to the last row but the result is discarded."""
+    m_rows = means[jnp.clip(idx, 0, means.shape[0] - 1)]
+    w_rows = weights[jnp.clip(idx, 0, means.shape[0] - 1)]
+    new_m, new_w = jax.vmap(
+        lambda m, w, v, ok: sketches.tdigest_add(
+            m, w, v, ok, compression=compression))(
+                m_rows, w_rows, batch, valid)
+    return (means.at[idx].set(new_m, mode="drop"),
+            weights.at[idx].set(new_w, mode="drop"))
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def _fold_hlls(regs, idx, items, valid, *, p):
+    rows = regs[jnp.clip(idx, 0, regs.shape[0] - 1)]
+    new = jax.vmap(
+        lambda r, it, ok: sketches.hll_add(r, it, ok, p=p))(
+            rows, items, valid)
+    return regs.at[idx].max(new, mode="drop")
+
+
+@functools.partial(jax.jit, static_argnames=("compression",))
+def _merged_quantile(means, weights, idx, valid, q, *, compression):
+    m = jnp.where(valid[:, None], means[idx], 0.0).reshape(-1)
+    w = jnp.where(valid[:, None], weights[idx], 0.0).reshape(-1)
+    mm, ww = sketches._compress(m, w, compression=compression)
+    return sketches.tdigest_quantile(mm, ww, q)

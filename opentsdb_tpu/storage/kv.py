@@ -306,6 +306,27 @@ class MemKVStore(KVStore):
         with self._lock:
             self._table(table)
 
+    def memtable_keys(self, table: str) -> list[bytes]:
+        """Row keys in the live memtable only (excludes spilled tiers).
+        After crash recovery this is exactly the WAL-replayed set — what
+        a checkpoint-snapshot consumer (TSDB sketch rebuild) must re-fold
+        on top of its snapshot."""
+        with self._lock:
+            return list(self._table(table).rows)
+
+    def memtable_cells(self, table: str, key: bytes,
+                       family: bytes | None = None) -> list[Cell]:
+        """Live-memtable cells of one row, WITHOUT merging spilled tiers
+        (tombstones excluded). The recovery re-fold reads rows through
+        this so cells already covered by the sketch snapshot (sstable
+        tier) are not folded twice."""
+        with self._lock:
+            row = self._table(table).rows.get(key)
+            if not row:
+                return []
+            return [Cell(key, f, q, v) for (f, q), v in row.items()
+                    if v is not None and (family is None or f == family)]
+
     def row_count(self, table: str) -> int:
         with self._lock:
             t = self._table(table)

@@ -31,6 +31,14 @@ class Config:
     compaction_max_concurrent_flushes: int = 10_000
     compaction_flush_speed: int = 2
 
+    # streaming sketches: device-resident per-series t-digests and
+    # per-(metric, tagk) HyperLogLogs folded in at ingest (north star;
+    # replaces the reference's Histogram.java streaming-stats role)
+    enable_sketches: bool = True
+    sketch_compression: int = 128       # t-digest centroids per series
+    sketch_hll_p: int = 12              # 2^p registers per (metric, tagk)
+    sketch_flush_points: int = 65536    # staleness bound (buffered points)
+
     # compute backend: 'tpu' = jitted JAX kernels; 'cpu' = numpy oracle
     backend: str = "tpu"
     # device mesh for distributed query execution: 0 = single-device;

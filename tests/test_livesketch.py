@@ -1,0 +1,261 @@
+"""Streaming sketch state: ingest-time folding, queries without rescan,
+checkpoint/crash recovery, and multi-store merges.
+
+Accuracy is pinned against exact numpy oracles (ops.sketches oracles);
+recovery tests assert the sketch answers survive a crash-replay cycle
+within sketch tolerance (HLL exactly: register max is idempotent).
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.stats.livesketch import LiveSketches
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+RNG = np.random.default_rng(23)
+
+
+class TestLiveSketchesUnit:
+    def test_quantile_accuracy_single_series(self):
+        sk = LiveSketches(flush_points=1000)
+        vals = RNG.normal(100.0, 15.0, 20_000)
+        for chunk in np.split(vals, 20):
+            sk.observe(b"series-a", chunk, [])
+        got = sk.quantile([b"series-a"], [0.5, 0.95, 0.99])
+        want = np.quantile(vals, [0.5, 0.95, 0.99])
+        np.testing.assert_allclose(got, want, rtol=0.02)
+
+    def test_quantile_merges_series(self):
+        sk = LiveSketches()
+        a = RNG.normal(0.0, 1.0, 5000)
+        b = RNG.normal(50.0, 1.0, 5000)
+        sk.observe(b"s-a", a, [])
+        sk.observe(b"s-b", b, [])
+        got = sk.quantile([b"s-a", b"s-b"], [0.5])
+        want = np.quantile(np.concatenate([a, b]), 0.5)
+        assert abs(float(got[0]) - want) < 2.0
+        # Single-series query sees only its own distribution.
+        got_a = sk.quantile([b"s-a"], [0.5])
+        assert abs(float(got_a[0]) - np.quantile(a, 0.5)) < 0.1
+
+    def test_quantile_unknown_series_is_none(self):
+        sk = LiveSketches()
+        assert sk.quantile([b"nope"], [0.5]) is None
+
+    def test_distinct_accuracy(self):
+        sk = LiveSketches()
+        n = 5000
+        uids = RNG.choice(100_000, size=n, replace=False)
+        for u in uids:
+            sk.observe(b"", np.empty(0),
+                       [(b"\x00\x00\x01", b"\x00\x00\x02",
+                         int(u).to_bytes(3, "big"))])
+        est = sk.distinct(b"\x00\x00\x01", b"\x00\x00\x02")
+        assert abs(est - n) / n < 0.05
+        assert sk.distinct(b"\x00\x00\x09", b"\x00\x00\x02") is None
+
+    def test_distinct_idempotent_refold(self):
+        """Re-observing the same tag values never changes the estimate —
+        the property crash-replay recovery relies on."""
+        sk = LiveSketches()
+        tv = [int(u).to_bytes(3, "big") for u in range(500)]
+        for v in tv:
+            sk.observe(b"", np.empty(0), [(b"m1", b"k1", v)])
+        before = sk.distinct(b"m1", b"k1")
+        for v in tv:
+            sk.observe(b"", np.empty(0), [(b"m1", b"k1", v)])
+        assert sk.distinct(b"m1", b"k1") == before
+
+    def test_auto_flush_bounds_buffer(self):
+        sk = LiveSketches(flush_points=100)
+        for i in range(30):
+            sk.observe(b"s", RNG.normal(0, 1, 10), [])
+        # >= 3 automatic flushes happened; backlog stays under the bound.
+        assert sk._buffered < 100
+        assert float(np.asarray(sk._td_weights).sum()) >= 200
+
+    def test_many_series_slot_growth(self):
+        sk = LiveSketches()
+        for i in range(100):
+            sk.observe(b"s%03d" % i, np.full(5, float(i)), [])
+        sk.flush()
+        assert sk.series_count() == 100
+        got = sk.quantile([b"s%03d" % 7], [0.5])
+        np.testing.assert_allclose(got, [7.0], atol=0.01)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        sk = LiveSketches()
+        vals = RNG.normal(10, 2, 3000)
+        sk.observe(b"sr", vals, [(b"m1", b"k1", b"v01"),
+                                 (b"m1", b"k1", b"v02")])
+        path = str(tmp_path / "s.npz")
+        sk.save(path)
+        sk2 = LiveSketches.load(path)
+        np.testing.assert_allclose(
+            sk2.quantile([b"sr"], [0.5]), sk.quantile([b"sr"], [0.5]))
+        assert sk2.distinct(b"m1", b"k1") == sk.distinct(b"m1", b"k1") == 2
+
+    def test_merge_from(self):
+        """Multi-chip/host fan-in: each shard folds its own data, the
+        query side merges (register max / centroid recompress)."""
+        a, b = LiveSketches(), LiveSketches()
+        va = RNG.normal(0, 1, 4000)
+        vb = RNG.normal(0, 1, 4000)
+        a.observe(b"s", va, [(b"m", b"k", b"v01")])
+        b.observe(b"s", vb, [(b"m", b"k", b"v02")])
+        a.merge_from(b)
+        want = np.quantile(np.concatenate([va, vb]), 0.9)
+        got = a.quantile([b"s"], [0.9])
+        assert abs(float(got[0]) - want) < 0.1
+        assert a.distinct(b"m", b"k") == 2
+
+
+class TestTSDBIntegration:
+    def _tsdb(self, wal=None):
+        return TSDB(MemKVStore(wal_path=wal),
+                    Config(auto_create_metrics=True),
+                    start_compaction_thread=False)
+
+    def test_ingest_folds_sketches(self):
+        t = self._tsdb()
+        for h in range(20):
+            ts = BT + np.arange(100) * 30
+            t.add_batch("sys.cpu", ts, RNG.normal(50, 10, 100),
+                        {"host": f"h{h:02d}", "dc": "east"})
+        from opentsdb_tpu.query.executor import QueryExecutor
+        ex = QueryExecutor(t)
+        # distinct host from HLL state, no scan
+        assert ex.sketch_distinct("sys.cpu", "host") == 20
+        assert ex.sketch_distinct("sys.cpu", "dc") == 1
+        assert ex.sketch_distinct("sys.cpu", "rack") is None
+        # p99 over all series from digest state
+        out = ex.sketch_quantiles("sys.cpu", {}, [0.5, 0.99])
+        assert out["series"] == 20
+        assert 45 < out["quantiles"]["0.5"] < 55
+        # tag-filtered
+        one = ex.sketch_quantiles("sys.cpu", {"host": "h03"}, [0.5])
+        assert one["series"] == 1
+
+    def test_add_point_folds_too(self):
+        t = self._tsdb()
+        for i in range(50):
+            t.add_point("m.p", BT + i, float(i), {"h": "x"})
+        from opentsdb_tpu.query.executor import QueryExecutor
+        out = QueryExecutor(t).sketch_quantiles("m.p", {}, [0.5])
+        assert abs(out["quantiles"]["0.5"] - 24.5) < 2.0
+
+    def test_clean_restart_recovers_sketches(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        t = self._tsdb(wal)
+        vals = RNG.normal(75, 5, 2000)
+        for chunk in np.split(vals, 10):
+            t.add_batch("m.r", BT + np.arange(200) * 5, chunk,
+                        {"host": "a"})
+        before = t.sketches.quantile(
+            list(t.sketches.series_keys()), [0.9])
+        t.shutdown()
+
+        t2 = self._tsdb(wal)
+        after = t2.sketches.quantile(
+            list(t2.sketches.series_keys()), [0.9])
+        np.testing.assert_allclose(after, before, rtol=1e-6)
+
+    def test_crash_recovery_no_snapshot(self, tmp_path):
+        """Crash before any checkpoint: full rebuild from the WAL-replayed
+        memtable matches the pre-crash state (same data, same folds)."""
+        wal = str(tmp_path / "wal")
+        t = self._tsdb(wal)
+        for h in range(8):
+            t.add_batch("m.c", BT + np.arange(100) * 7,
+                        RNG.normal(30, 3, 100), {"host": f"h{h}"})
+        t.store.flush()
+        before_q = t.sketches.quantile(
+            list(t.sketches.series_keys()), [0.5, 0.99])
+        # simulate crash: no shutdown/checkpoint, just reopen the WAL
+        t2 = self._tsdb(wal)
+        from opentsdb_tpu.query.executor import QueryExecutor
+        assert QueryExecutor(t2).sketch_distinct("m.c", "host") == 8
+        after_q = t2.sketches.quantile(
+            list(t2.sketches.series_keys()), [0.5, 0.99])
+        np.testing.assert_allclose(after_q, before_q, rtol=0.05)
+
+    def test_crash_after_checkpoint_refolds_tail(self, tmp_path):
+        """Checkpoint, ingest more, crash: snapshot covers the spilled
+        tier; the WAL-replayed tail re-folds on top. HLL estimates are
+        exact through recovery; digests within tolerance."""
+        wal = str(tmp_path / "wal")
+        t = self._tsdb(wal)
+        for h in range(5):
+            t.add_batch("m.k", BT + np.arange(50) * 9,
+                        RNG.normal(10, 1, 50), {"host": f"pre{h}"})
+        assert t.checkpoint() > 0
+        for h in range(5, 9):
+            t.add_batch("m.k", BT + 3600 + np.arange(50) * 9,
+                        RNG.normal(20, 1, 50), {"host": f"post{h}"})
+        t.store.flush()
+        # crash (no shutdown); reopen
+        t2 = self._tsdb(wal)
+        from opentsdb_tpu.query.executor import QueryExecutor
+        ex = QueryExecutor(t2)
+        assert ex.sketch_distinct("m.k", "host") == 9
+        out = ex.sketch_quantiles("m.k", {}, [0.5])
+        # 250 pre points ~N(10), 200 post ~N(20): median in between
+        assert 9 < out["quantiles"]["0.5"] < 21
+        assert out["series"] == 9
+
+    def test_sketches_disabled(self):
+        t = TSDB(MemKVStore(), Config(auto_create_metrics=True,
+                                      enable_sketches=False),
+                 start_compaction_thread=False)
+        t.add_point("m", BT, 1, {"a": "b"})
+        assert t.sketches is None
+        from opentsdb_tpu.core.errors import BadRequestError
+        from opentsdb_tpu.query.executor import QueryExecutor
+        ex = QueryExecutor(t)
+        assert ex.sketch_distinct("m", "a") is None
+        with pytest.raises(BadRequestError):
+            ex.sketch_quantiles("m", {}, [0.5])
+
+
+class TestFlushChunking:
+    def test_hot_series_among_cold_ones(self):
+        """One series buffering far more points than _MAX_CHUNK while
+        many series buffer a handful: the round/bucket fold must stay
+        exact-ish (chunks fold sequentially into the same digest) and
+        never build a dense (series x hot-length) matrix."""
+        sk = LiveSketches(flush_points=10**9)  # no auto-flush
+        hot = RNG.normal(200.0, 10.0, 3 * sk._MAX_CHUNK + 17)
+        sk.observe(b"hot", hot, [])
+        for i in range(50):
+            sk.observe(b"c%02d" % i, RNG.normal(float(i), 0.1, 3), [])
+        sk.flush()
+        got = sk.quantile([b"hot"], [0.5, 0.99])
+        want = np.quantile(hot, [0.5, 0.99])
+        np.testing.assert_allclose(got, want, rtol=0.02)
+        got_c = sk.quantile([b"c07"], [0.5])
+        np.testing.assert_allclose(got_c, [7.0], atol=0.2)
+
+    def test_checkpoint_then_crash_does_not_lose_folds(self, tmp_path):
+        """Snapshot commits before the WAL truncation: killing the store
+        right after checkpoint still leaves a snapshot covering all
+        pre-checkpoint data (the failure mode was an empty-memtable +
+        stale-snapshot recovery)."""
+        from opentsdb_tpu.core.tsdb import TSDB
+        wal = str(tmp_path / "wal")
+        t = TSDB(MemKVStore(wal_path=wal),
+                 Config(auto_create_metrics=True),
+                 start_compaction_thread=False)
+        for h in range(6):
+            t.add_batch("m.w", BT + np.arange(40) * 11,
+                        RNG.normal(5, 1, 40), {"host": f"h{h}"})
+        t.checkpoint()  # spills memtable, truncates WAL
+        # crash immediately (no shutdown): memtable empty on reopen
+        t2 = TSDB(MemKVStore(wal_path=wal),
+                  Config(auto_create_metrics=True),
+                  start_compaction_thread=False)
+        from opentsdb_tpu.query.executor import QueryExecutor
+        assert QueryExecutor(t2).sketch_distinct("m.w", "host") == 6
+        assert t2.sketches.series_count() == 6

@@ -371,3 +371,40 @@ class TestForecast:
 
         status, _, body = run_async(server, drive)
         assert status == 400
+
+
+class TestSketchEndpoints:
+    def test_streaming_distinct_and_quantile(self, server_env):
+        server, tsdb = server_env
+        rng = np.random.default_rng(4)
+        for h in range(12):
+            tsdb.add_batch("net.io", BT + np.arange(60) * 10,
+                           rng.normal(40, 5, 60), {"host": f"h{h:02d}"})
+
+        async def drive(port):
+            # /distinct without start => streaming HLL source
+            st, _, body = await http_get(
+                port, "/distinct?metric=net.io&tagk=host")
+            assert st == 200
+            d = json.loads(body)
+            assert d["distinct"] == 12 and d["source"] == "stream"
+            # with a range => scan source, same answer
+            st, _, body = await http_get(
+                port, f"/distinct?metric=net.io&tagk=host&start={BT}")
+            d2 = json.loads(body)
+            assert d2["source"] == "scan" and d2["distinct"] == 12
+            # /sketch quantiles, all series and tag-filtered
+            st, _, body = await http_get(
+                port, "/sketch?m=net.io&q=p50,p99")
+            assert st == 200
+            s = json.loads(body)
+            assert s["series"] == 12
+            assert 35 < s["quantiles"]["0.5"] < 45
+            st, _, body = await http_get(
+                port, "/sketch?m=net.io%7Bhost=h03%7D&q=0.5")
+            assert json.loads(body)["series"] == 1
+            # unknown metric => 400, not a scan
+            st, _, _ = await http_get(port, "/sketch?m=no.such")
+            assert st == 400
+
+        run_async(server, drive)
