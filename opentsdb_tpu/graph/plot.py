@@ -150,6 +150,32 @@ class Plot:
         return buf.getvalue()
 
 
+def render_error_png(message: str, width: int = 591,
+                     height: int = 362) -> bytes:
+    """Render an error message as a PNG.
+
+    Parity: reference HttpQuery.sendAsPNG (HttpQuery.java:432) — errors
+    on graph requests render as images so a browser ``<img>`` tag
+    embedding /q?...&png shows the failure instead of a broken icon.
+    (The reference shells out to gnuplot for this; here it's the same
+    in-process Agg path as every other graph.)
+    """
+    import io
+    import textwrap
+
+    fig = _new_figure(width, height, facecolor="#fff6f6")
+    ax = fig.add_subplot(111)
+    ax.set_axis_off()
+    wrapped = "\n".join(textwrap.wrap(message, width=60)[:12])
+    ax.text(0.5, 0.6, "Request failed", ha="center", va="center",
+            fontsize=14, color="#aa2222", weight="bold")
+    ax.text(0.5, 0.45, wrapped, ha="center", va="top", fontsize=9,
+            color="#333333", family="monospace", wrap=True)
+    buf = io.BytesIO()
+    fig.savefig(buf, format="png")
+    return buf.getvalue()
+
+
 def render_forecast_png(series, start: int, end_future: int,
                         width: int = 1024, height: int = 768,
                         title: str | None = None,

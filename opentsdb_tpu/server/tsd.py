@@ -175,45 +175,85 @@ class TSDServer:
 
     async def _handle_telnet(self, first: bytes, reader, writer) -> None:
         buf = first
-        while not self._shutdown.is_set():
-            nl = buf.find(b"\n")
-            if nl < 0:
-                if len(buf) > MAX_BUFFER:
-                    raise ValueError("frame length exceeds buffer limit")
-                chunk = await reader.read(1 << 16)
-                if not chunk:
-                    break
-                buf += chunk
-                continue
-            # Bulk fast path: a pipelined burst of puts decodes natively
-            # into columnar arrays and lands through add_batch — this is
-            # how the 1M dps/s target is met (SURVEY.md §7). One scan
-            # finds the longest prefix of complete put lines; anything
-            # after it falls to the per-line command path below.
-            if buf.startswith(b"put ") and buf.find(b"\n", nl + 1) >= 0:
-                prefix_len = _put_prefix_len(buf)
-                if prefix_len > nl + 1:
-                    chunk, buf = buf[:prefix_len], buf[prefix_len:]
-                    self._bulk_puts(chunk, writer)
-                    await writer.drain()
+        # Per-connection two-stage ingest pipeline (SURVEY §2.9 PP row):
+        # chunk N's decode runs in the pool while chunk N-1's ingest is
+        # still applying — the server-loop form of wire.pipelined_ingest.
+        # ``pending`` is the newest chunk's in-order ingest task,
+        # ``older`` the one before it; awaiting ``older`` before
+        # spawning a third bounds the pipeline (and its buffered bytes)
+        # at two chunks in flight — socket backpressure does the rest.
+        pending: asyncio.Task | None = None
+        older: asyncio.Task | None = None
+        try:
+            while not self._shutdown.is_set():
+                nl = buf.find(b"\n")
+                if nl < 0:
+                    if len(buf) > MAX_BUFFER:
+                        raise ValueError(
+                            "frame length exceeds buffer limit")
+                    chunk = await reader.read(1 << 16)
+                    if not chunk:
+                        break
+                    buf += chunk
                     continue
-            line, buf = buf[:nl], buf[nl + 1:]
-            if len(line) > MAX_LINE:
-                raise ValueError(f"frame length exceeds {MAX_LINE}")
-            words = tags_mod.split_string(
-                line.decode("utf-8", "replace").rstrip("\r"))
-            if not words:
-                continue
-            self.telnet_rpcs += 1
-            if not await self._telnet_command(words, writer):
-                return
+                # Bulk fast path: a pipelined burst of puts decodes
+                # natively into columnar arrays and lands through
+                # add_batch — this is how the 1M dps/s target is met
+                # (SURVEY.md §7). One scan finds the longest prefix of
+                # complete put lines; anything after it falls to the
+                # per-line command path below.
+                if buf.startswith(b"put ") and buf.find(b"\n", nl + 1) >= 0:
+                    prefix_len = _put_prefix_len(buf)
+                    if prefix_len > nl + 1:
+                        chunk, buf = buf[:prefix_len], buf[prefix_len:]
+                        if older is not None:
+                            await older
+                        older, pending = pending, asyncio.create_task(
+                            self._bulk_puts_pipelined(
+                                chunk, pending, writer))
+                        continue
+                # Ordering: bulk results (error lines, stats) land
+                # before any later single-line command executes.
+                if pending is not None:
+                    await pending
+                    pending = older = None
+                line, buf = buf[:nl], buf[nl + 1:]
+                if len(line) > MAX_LINE:
+                    raise ValueError(f"frame length exceeds {MAX_LINE}")
+                words = tags_mod.split_string(
+                    line.decode("utf-8", "replace").rstrip("\r"))
+                if not words:
+                    continue
+                self.telnet_rpcs += 1
+                if not await self._telnet_command(words, writer):
+                    return
+        finally:
+            # Retrieve both tasks (even on error paths) so no exception
+            # is left unawaited; the first failure propagates.
+            tasks = [t for t in (older, pending) if t is not None]
+            if tasks:
+                results = await asyncio.gather(*tasks,
+                                               return_exceptions=True)
+                for r in results:
+                    if isinstance(r, BaseException):
+                        raise r
 
-    def _bulk_puts(self, chunk: bytes, writer) -> None:
+    async def _bulk_puts_pipelined(self, chunk: bytes,
+                                   prev: asyncio.Task | None,
+                                   writer) -> None:
+        """Stage A (decode) runs immediately in the pool — overlapping
+        the previous chunk's stage B — then awaits ``prev`` so ingest
+        and error reporting stay in arrival order."""
         from opentsdb_tpu.server import wire
 
         t0 = time.time()
-        batch = wire.decode_puts(chunk)
-        n, series_errors = wire.ingest_batch(self.tsdb, batch)
+        loop = asyncio.get_running_loop()
+        batch = await loop.run_in_executor(
+            self._pool, wire.decode_puts, chunk)
+        if prev is not None:
+            await prev
+        n, series_errors = await loop.run_in_executor(
+            self._pool, wire.ingest_batch, self.tsdb, batch)
         self.telnet_rpcs += n + len(batch.errors)
         self.requests_put += n + len(batch.errors)
         for err in batch.errors:
@@ -231,6 +271,7 @@ class TSDServer:
                 self.illegal_arguments_put += 1
                 writer.write(f"put: illegal argument: {err}\n".encode())
         self.put_latency.add((time.time() - t0) * 1000)
+        await writer.drain()
 
     async def _telnet_command(self, words: list[str], writer) -> bool:
         """Dispatch one telnet command; False closes the connection."""
@@ -297,43 +338,108 @@ class TSDServer:
     # HTTP protocol
     # ------------------------------------------------------------------
 
+    # HTTP request bounds (the telnet path's MAX_BUFFER analog).
+    MAX_HEADER_BYTES = 65536
+    MAX_BODY_BYTES = 1 << 20
+
     async def _handle_http(self, first: bytes, reader, writer) -> None:
+        """Persistent-connection HTTP loop.
+
+        Parity: reference HttpQuery.java:471-530 keeps HTTP/1.1
+        connections alive between requests; :432 renders errors on graph
+        requests as PNG so browser <img> embeds show the failure. Bounds:
+        headers capped at MAX_HEADER_BYTES, bodies at MAX_BODY_BYTES
+        (413) — the read path never buffers unbounded client data.
+        """
         data = first
-        while b"\r\n\r\n" not in data and b"\n\n" not in data:
-            chunk = await reader.read(4096)
-            if not chunk:
+        while not self._shutdown.is_set():
+            while b"\r\n\r\n" not in data:
+                chunk = await reader.read(4096)
+                if not chunk:
+                    return
+                data = data + chunk
+                if len(data) > self.MAX_HEADER_BYTES:
+                    await self._http_respond(
+                        writer, 431, "text/plain",
+                        b"Request Header Fields Too Large\n", {}, False)
+                    return
+            head, _, data = data.partition(b"\r\n\r\n")
+            lines = head.decode("latin-1").split("\r\n")
+            try:
+                method, target, version = lines[0].split(" ", 2)
+            except ValueError:
                 return
-            data = data + chunk
-            if len(data) > 65536:
+            headers = {}
+            for ln in lines[1:]:
+                k, _, v = ln.partition(":")
+                headers[k.strip().lower()] = v.strip()
+            # Drain (and bound) the request body so the next request on
+            # the connection parses from a clean boundary.
+            try:
+                clen = int(headers.get("content-length", "0") or "0")
+            except ValueError:
                 return
-        head, _, _body = data.partition(b"\r\n\r\n")
-        lines = head.decode("latin-1").split("\r\n")
-        try:
-            method, target, _version = lines[0].split(" ", 2)
-        except ValueError:
-            return
-        t0 = time.time()
-        try:
-            status, ctype, body, extra = await self._route(method, target)
-        except BadRequestError as e:
-            status, ctype, extra = e.status, "text/plain", {}
-            body = f"{e}\n".encode()
-        except NoSuchUniqueName as e:
-            status, ctype, body, extra = 400, "text/plain", \
-                f"{e}\n".encode(), {}
-        except Exception as e:
-            self.exceptions_caught += 1
-            LOG.exception("HTTP error on %s", target)
-            status, ctype, body, extra = 500, "text/plain", \
-                f"Internal Server Error: {e}\n".encode(), {}
-        self.http_latency.add((time.time() - t0) * 1000)
+            if clen > self.MAX_BODY_BYTES:
+                await self._http_respond(
+                    writer, 413, "text/plain",
+                    b"Payload Too Large\n", {}, False)
+                return
+            while len(data) < clen:
+                chunk = await reader.read(1 << 16)
+                if not chunk:
+                    return
+                data += chunk
+            data = data[clen:]
+            keep = (version.strip().upper() == "HTTP/1.1"
+                    and headers.get("connection", "").lower() != "close")
+
+            t0 = time.time()
+            try:
+                status, ctype, body, extra = await self._route(method,
+                                                               target)
+            except BadRequestError as e:
+                status, extra = e.status, {}
+                ctype, body = self._error_body(target, str(e))
+            except NoSuchUniqueName as e:
+                status, extra = 400, {}
+                ctype, body = self._error_body(target, str(e))
+            except Exception as e:
+                self.exceptions_caught += 1
+                LOG.exception("HTTP error on %s", target)
+                status, extra = 500, {}
+                ctype, body = self._error_body(
+                    target, f"Internal Server Error: {e}")
+            self.http_latency.add((time.time() - t0) * 1000)
+            await self._http_respond(writer, status, ctype, body, extra,
+                                     keep)
+            if not keep:
+                return
+
+    def _error_body(self, target: str, message: str) -> tuple[str, bytes]:
+        """Error payload; PNG-rendered for graph requests so <img>
+        embeds show the failure (reference HttpQuery.java:432)."""
+        parsed = urllib.parse.urlsplit(target)
+        if parsed.path == "/q" and "png" in urllib.parse.parse_qs(
+                parsed.query, keep_blank_values=True):
+            try:
+                from opentsdb_tpu.graph.plot import render_error_png
+                return "image/png", render_error_png(message)
+            except Exception:  # fall back to text on render failure
+                pass
+        return "text/plain", f"{message}\n".encode()
+
+    async def _http_respond(self, writer, status: int, ctype: str,
+                            body: bytes, extra: dict,
+                            keep: bool) -> None:
         reason = {200: "OK", 304: "Not Modified", 400: "Bad Request",
                   404: "Not Found", 405: "Method Not Allowed",
+                  413: "Payload Too Large",
+                  431: "Request Header Fields Too Large",
                   500: "Internal Server Error"}.get(status, "OK")
         hdrs = [f"HTTP/1.1 {status} {reason}",
                 f"Content-Type: {ctype}",
                 f"Content-Length: {len(body)}",
-                "Connection: close"]
+                f"Connection: {'keep-alive' if keep else 'close'}"]
         for k, v in extra.items():
             hdrs.append(f"{k}: {v}")
         writer.write(("\r\n".join(hdrs) + "\r\n\r\n").encode() + body)

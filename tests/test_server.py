@@ -46,13 +46,35 @@ async def telnet(port, lines, read_bytes=0, wait=0.05):
 
 async def http_get(port, target):
     reader, writer = await asyncio.open_connection("127.0.0.1", port)
-    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n"
+                 "Connection: close\r\n\r\n".encode())
     await writer.drain()
     data = await reader.read()
     writer.close()
     head, _, body = data.partition(b"\r\n\r\n")
     status = int(head.split(b" ", 2)[1])
     return status, head, body
+
+
+async def read_http_response(reader):
+    """One response framed by Content-Length (keep-alive safe)."""
+    head = b""
+    while b"\r\n\r\n" not in head:
+        chunk = await reader.read(4096)
+        assert chunk, "connection closed mid-response"
+        head += chunk
+    head, _, body = head.partition(b"\r\n\r\n")
+    clen = 0
+    for ln in head.split(b"\r\n")[1:]:
+        k, _, v = ln.partition(b":")
+        if k.strip().lower() == b"content-length":
+            clen = int(v)
+    while len(body) < clen:
+        chunk = await reader.read(1 << 16)
+        assert chunk, "connection closed mid-body"
+        body += chunk
+    status = int(head.split(b" ", 2)[1])
+    return status, head, body[:clen], body[clen:]
 
 
 def run_async(server, coro_fn):
@@ -406,5 +428,82 @@ class TestSketchEndpoints:
             # unknown metric => 400, not a scan
             st, _, _ = await http_get(port, "/sketch?m=no.such")
             assert st == 400
+
+        run_async(server, drive)
+
+
+class TestHttpKeepAlive:
+    def test_pipelined_requests_one_connection(self, server_env):
+        server, tsdb = server_env
+        tsdb.add_point("m.ka", BT + 1, 7, {"h": "x"})
+
+        async def drive(port):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            # Two requests back-to-back on one connection.
+            writer.write(b"GET /version HTTP/1.1\r\nHost: x\r\n\r\n"
+                         b"GET /aggregators HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            st1, head1, body1, rest = await read_http_response(reader)
+            assert st1 == 200 and b"keep-alive" in head1.lower()
+            # Second response arrives on the SAME connection.
+            reader._buffer = bytearray(rest) + reader._buffer \
+                if rest else reader._buffer
+            st2, head2, body2, _ = await read_http_response(reader)
+            assert st2 == 200 and b"sum" in body2
+            # Connection: close is honored and ends the connection.
+            writer.write(b"GET /version HTTP/1.1\r\nHost: x\r\n"
+                         b"Connection: close\r\n\r\n")
+            await writer.drain()
+            st3, head3, _, _ = await read_http_response(reader)
+            assert st3 == 200 and b"close" in head3.lower()
+            assert await reader.read() == b""
+            writer.close()
+
+        run_async(server, drive)
+
+    def test_http10_closes(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            writer.write(b"GET /version HTTP/1.0\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            data = await reader.read()  # EOF: server closed
+            assert b"200" in data.split(b"\r\n")[0]
+            writer.close()
+
+        run_async(server, drive)
+
+    def test_body_size_bound(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            writer.write(b"GET /version HTTP/1.1\r\nHost: x\r\n"
+                         b"Content-Length: 9999999\r\n\r\n")
+            await writer.drain()
+            data = await reader.read()
+            assert b"413" in data.split(b"\r\n")[0]
+            writer.close()
+
+        run_async(server, drive)
+
+    def test_png_error_page_for_graph_requests(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            # unknown metric on a png graph request -> PNG error body
+            st, head, body = await http_get(
+                port, f"/q?start={BT}&m=sum:no.such.metric&png")
+            assert st == 400
+            assert b"image/png" in head.lower()
+            assert body.startswith(b"\x89PNG")
+            # same error without png stays text
+            st, head, body = await http_get(
+                port, f"/q?start={BT}&m=sum:no.such.metric")
+            assert st == 400 and b"text/plain" in head.lower()
 
         run_async(server, drive)
