@@ -51,6 +51,12 @@ class Plot:
         self.params: dict[str, str] = {}
         self.width = 1024
         self.height = 768
+        # After render(): the axes' data-area bbox in PNG pixel coords
+        # (x0, y0, x1, y1; origin top-left), or None when "No data".
+        # The web UI maps drag-zoom pixels to timestamps with this — the
+        # matplotlib-era answer to the GWT client's hardcoded gnuplot
+        # margins (reference src/tsd/client/QueryUi.java drag-zoom).
+        self.plot_area: tuple[int, int, int, int] | None = None
 
     def add(self, label: str, timestamps, values,
             options: str = "") -> None:
@@ -147,6 +153,15 @@ class Plot:
         fig.autofmt_xdate()
         buf = io.BytesIO()
         fig.savefig(buf, format="png", facecolor=bg)
+        if has_data:
+            # savefig drew the figure, so the axes' window extent is
+            # final. Window coords are origin bottom-left; PNG pixels
+            # are origin top-left.
+            ext = ax.get_window_extent()
+            self.plot_area = (int(ext.x0), int(self.height - ext.y1),
+                              int(ext.x1), int(self.height - ext.y0))
+        else:
+            self.plot_area = None
         return buf.getvalue()
 
 

@@ -557,7 +557,13 @@ class TSDServer:
             ctype = ("image/png" if cache_path.endswith(".png")
                      else "text/plain" if cache_path.endswith(".txt")
                      else "application/json")
-            return 200, ctype, body, {}
+            extra = {}
+            try:  # drag-zoom headers survive cache hits via a sidecar
+                with open(cache_path + ".meta") as f:
+                    extra = json.load(f)
+            except (OSError, ValueError):
+                pass
+            return 200, ctype, body, extra
         self.cache_misses += 1
 
         loop = asyncio.get_running_loop()
@@ -579,6 +585,7 @@ class TSDServer:
             results.extend(rs)
             result_opts.extend([os_[mi] if mi < len(os_) else ""] * len(rs))
 
+        extra: dict = {}
         if "ascii" in q:
             body = self._ascii_output(results).encode()
             ctype = "text/plain"
@@ -587,7 +594,7 @@ class TSDServer:
             ctype = "application/json"
         else:
             t0 = time.time()
-            body = await loop.run_in_executor(
+            body, extra = await loop.run_in_executor(
                 self._pool, self._render_png, results, start, end, q,
                 result_opts)
             self.graph_latency.add((time.time() - t0) * 1000)
@@ -597,7 +604,11 @@ class TSDServer:
             with open(tmp, "wb") as f:
                 f.write(body)
             os.replace(tmp, cache_path)
-        return 200, ctype, body, {}
+            if extra:
+                with open(cache_path + ".meta.tmp", "w") as f:
+                    json.dump(extra, f)
+                os.replace(cache_path + ".meta.tmp", cache_path + ".meta")
+        return 200, ctype, body, extra
 
     def _cache_path(self, query_string: str, q) -> str | None:
         if self.config.cachedir is None or "nocache" in q:
@@ -649,7 +660,7 @@ class TSDServer:
         } for r in results]
 
     def _render_png(self, results, start, end, q,
-                    result_opts=None) -> bytes:
+                    result_opts=None) -> tuple[bytes, dict]:
         plot = Plot(start, end)
         if "wxh" in q:
             w, _, h = q["wxh"].partition("x")
@@ -668,7 +679,15 @@ class TSDServer:
                     f"{k}={v}" for k, v in sorted(r.tags.items())) + "}"
             plot.add(label, r.timestamps, r.values,
                      result_opts[i] if result_opts else "")
-        return plot.render()
+        body = plot.render()
+        # Pixel->time mapping headers for the web UI's drag-zoom: the
+        # axes bbox in PNG pixels plus the plotted time range. (The GWT
+        # client hardcodes gnuplot's margins for this; we report the
+        # real bbox instead.)
+        hdrs = {"X-Time-Range": f"{int(start)},{int(end)}"}
+        if plot.plot_area is not None:
+            hdrs["X-Plot-Area"] = ",".join(map(str, plot.plot_area))
+        return body, hdrs
 
     async def _distinct(self, q) -> tuple:
         """Cardinality extension: distinct values of one tag key.

@@ -1,0 +1,194 @@
+"""One-off TPU microbenchmarks driving the round-2 kernel redesign.
+
+Usage: python scripts/tpu_probe.py [section ...]
+Sections: h2d scatter scan onehot pallas hll tiny (default: all).
+
+Times, on the real chip:
+  h2d     host->device transfer bandwidth (the axon tunnel tax),
+  scatter XLA segment_sum at query shapes (N=10M, nseg=S*B),
+  scan    a sorted-segment segmented-scan alternative,
+  onehot  a per-series one-hot matmul (padded [S, T] layout),
+  pallas  pallas_segment_sum vs XLA across nseg (the 4096 break-even),
+  hll     hll_add (scatter) cost,
+  tiny    bare dispatch round-trip latency.
+
+Findings land in BENCH_DETAILS / module docstrings; this script is a
+diagnostic, not part of the test suite.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def t(fn, *args, repeats=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    sections = set(sys.argv[1:]) or {"h2d", "scatter", "scan", "onehot",
+                                     "pallas", "hll", "tiny"}
+    dev = jax.devices()[0]
+    print(f"device: {dev}", flush=True)
+
+    S, B, T = 10_000, 169, 1000
+    N = S * T
+    nseg = S * B + 1
+    rng = np.random.default_rng(0)
+
+    # Flat sorted-by-(sid, ts) workload like the bench's.
+    sid = np.repeat(np.arange(S, dtype=np.int32), T)
+    rel = np.tile((np.arange(T) * (7 * 86400 // T)).astype(np.int32), S)
+    vals = rng.normal(100, 10, N).astype(np.float32)
+    bucket = np.clip(rel // 3600, 0, B - 1)
+    seg = (sid * B + bucket).astype(np.int32)
+    valid = np.ones(N, bool)
+
+    if "h2d" in sections:
+        for name, arr in [("vals 40MB", vals),
+                          ("all ~130MB", (rel, vals, sid, seg))]:
+            dt = t(lambda a=arr: jax.device_put(a))
+            nbytes = (sum(x.nbytes for x in arr)
+                      if isinstance(arr, tuple) else arr.nbytes)
+            print(f"h2d {name}: {dt*1e3:.1f} ms "
+                  f"({nbytes/dt/1e9:.2f} GB/s)", flush=True)
+
+    d_vals = jax.device_put(vals)
+    d_seg = jax.device_put(seg)
+    feats = np.stack([valid.astype(np.float32), vals,
+                      rel.astype(np.float32)], axis=1)
+    d_feats = jax.device_put(feats)
+
+    if "scatter" in sections:
+        @jax.jit
+        def seg_sum(v, s):
+            return jax.ops.segment_sum(v, s, nseg)
+
+        print(f"segment_sum scatter [N={N}, nseg={nseg}]: "
+              f"{t(seg_sum, d_vals, d_seg)*1e3:.1f} ms "
+              f"(checksum {float(seg_sum(d_vals, d_seg).sum()):.6g})",
+              flush=True)
+
+        @jax.jit
+        def seg_sum3(f, s):
+            return jax.ops.segment_sum(f, s, nseg)
+
+        print(f"segment_sum scatter 3-feat: "
+              f"{t(seg_sum3, d_feats, d_seg)*1e3:.1f} ms", flush=True)
+
+        @jax.jit
+        def seg_minmax(v, s):
+            return (jax.ops.segment_min(v, s, nseg),
+                    jax.ops.segment_max(v, s, nseg))
+
+        print(f"segment_min+max: "
+              f"{t(seg_minmax, d_vals, d_seg)*1e3:.1f} ms", flush=True)
+
+    if "scan" in sections:
+        @jax.jit
+        def seg_sum_scan(f, s):
+            first = jnp.concatenate([jnp.array([True]), s[1:] != s[:-1]])
+
+            def op(a, b):
+                af, av = a
+                bf, bv = b
+                return af | bf, jnp.where(bf[..., None], bv, av + bv)
+
+            _, scanned = jax.lax.associative_scan(op, (first, f), axis=0)
+            ends = jnp.searchsorted(
+                s, jnp.arange(nseg, dtype=jnp.int32), side="right") - 1
+            ok = (ends >= 0) & (s[jnp.clip(ends, 0, N - 1)]
+                                == jnp.arange(nseg))
+            return jnp.where(ok[:, None],
+                             scanned[jnp.clip(ends, 0, N - 1)], 0.0)
+
+        print(f"segmented-scan+gather 3-feat: "
+              f"{t(seg_sum_scan, d_feats, d_seg)*1e3:.1f} ms", flush=True)
+        a = np.asarray(jax.jit(
+            lambda f, s: jax.ops.segment_sum(f, s, nseg))(d_feats, d_seg))
+        b = np.asarray(seg_sum_scan(d_feats, d_seg))
+        print(f"  max abs diff vs scatter: {np.abs(a-b).max():.3e}",
+              flush=True)
+
+    if "onehot" in sections:
+        vals2 = vals.reshape(S, T)
+        bucket2 = bucket.reshape(S, T).astype(np.int32)
+        d_vals2 = jax.device_put(vals2)
+        d_bucket2 = jax.device_put(bucket2)
+        Bp = 256
+
+        @jax.jit
+        def onehot_ds(v, bk):
+            def body(c):
+                vc, bc = c
+                oh = (bc[:, :, None] ==
+                      jnp.arange(Bp, dtype=jnp.int32)[None, None, :]
+                      ).astype(jnp.bfloat16)
+                return jnp.einsum("st,stb->sb", vc.astype(jnp.bfloat16),
+                                  oh, preferred_element_type=jnp.float32)
+
+            CH = 500
+            vcs = v.reshape(S // CH, CH, T)
+            bcs = bk.reshape(S // CH, CH, T)
+            return jax.lax.map(body, (vcs, bcs))
+
+        print(f"one-hot matmul [S,T]->[S,B] bf16: "
+              f"{t(onehot_ds, d_vals2, d_bucket2)*1e3:.1f} ms",
+              flush=True)
+
+    if "pallas" in sections:
+        sys.path.insert(0, ".")
+        from opentsdb_tpu.ops.pallas_kernels import pallas_segment_sum
+        Nsw = 1 << 20
+        vsw = rng.normal(size=(Nsw, 3)).astype(np.float32)
+        for nsg in (256, 1024, 4096, 16384):
+            ssw = np.sort(rng.integers(0, nsg, Nsw)).astype(np.int32)
+            dv, ds = jax.device_put(vsw), jax.device_put(ssw)
+            tp = t(functools.partial(pallas_segment_sum,
+                                     num_segments=nsg), dv, ds)
+            f = jax.jit(lambda v, s, n=nsg: jax.ops.segment_sum(v, s, n))
+            tx = t(f, dv, ds)
+            print(f"nseg={nsg:6d}: pallas {tp*1e3:7.2f} ms | "
+                  f"xla scatter {tx*1e3:7.2f} ms", flush=True)
+
+    if "hll" in sections:
+        sys.path.insert(0, ".")
+        from opentsdb_tpu.ops import sketches
+        items = rng.integers(0, 1 << 24, 4_000_000).astype(np.int32)
+        ok = np.ones(len(items), bool)
+        di, dk = jax.device_put(items), jax.device_put(ok)
+
+        @jax.jit
+        def hll(i, k):
+            return sketches.hll_add(sketches.hll_init(), i, k)
+
+        print(f"hll_add 4M items: {t(hll, di, dk)*1e3:.1f} ms",
+              flush=True)
+
+    if "tiny" in sections:
+        @jax.jit
+        def tiny(x):
+            return x + 1
+
+        dx = jax.device_put(np.float32(1))
+        print(f"tiny dispatch round-trip: "
+              f"{t(tiny, dx, repeats=20)*1e6:.0f} us", flush=True)
+
+
+if __name__ == "__main__":
+    main()

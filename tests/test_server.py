@@ -208,6 +208,48 @@ class TestHttp:
         assert b"image/png" in head
         assert body[:8] == b"\x89PNG\r\n\x1a\n"
 
+    def test_query_png_zoom_headers(self, server_env):
+        """PNG responses carry X-Plot-Area/X-Time-Range so the web UI
+        can map drag-zoom pixels to timestamps; the area must lie inside
+        the image and the range must echo the query window."""
+        server, tsdb = server_env
+        tsdb.add_batch("m.x", np.arange(BT, BT + 600, 60),
+                       np.arange(10.0), {"a": "b"})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/q?start={BT}&end={BT + 600}&m=sum:m.x"
+                      f"&wxh=400x300&nocache")
+
+        status, head, body = run_async(server, drive)
+        assert status == 200
+        hdrs = dict(
+            ln.decode().split(": ", 1)
+            for ln in head.split(b"\r\n")[1:] if b": " in ln)
+        assert hdrs["X-Time-Range"] == f"{BT},{BT + 600}"
+        x0, y0, x1, y1 = map(int, hdrs["X-Plot-Area"].split(","))
+        assert 0 <= x0 < x1 <= 400
+        assert 0 <= y0 < y1 <= 300
+
+    def test_query_png_zoom_headers_survive_cache(self, server_env):
+        """Cache hits re-serve the drag-zoom headers via the sidecar."""
+        server, tsdb = server_env
+        tsdb.add_batch("m.x", np.array([BT + 1]), np.array([7.0]),
+                       {"a": "b"})
+        target = f"/q?start={BT}&end={BT + 10}&m=sum:m.x"
+
+        async def drive(port):
+            first = await http_get(port, target)
+            second = await http_get(port, target)
+            return first, second
+
+        (s1, h1, _), (s2, h2, _) = run_async(server, drive)
+        assert s1 == s2 == 200
+        assert server.cache_hits == 1
+        for head in (h1, h2):
+            assert b"X-Plot-Area: " in head
+            assert f"X-Time-Range: {BT},{BT + 10}".encode() in head
+
     def test_query_cache(self, server_env):
         server, tsdb = server_env
         tsdb.add_batch("m.x", np.array([BT + 1]), np.array([7]),

@@ -122,6 +122,36 @@ class TestSuggest:
             uid.get_or_create_id(f"m{i:02d}")
         assert len(uid.suggest("m")) == 25
 
+    def test_large_shared_prefix_set_scans_prefix_range_only(self, uid, kv):
+        """Round-1 gap: suggest over a large UID set with shared
+        prefixes must ride the [prefix, prefix+1) scan range (reference
+        UniqueId.java:367-406) rather than filtering a full-table scan,
+        and still cap at 25 in order."""
+        for i in range(200):
+            uid.get_or_create_id(f"sys.cpu.{i:03d}")
+        for i in range(200):
+            uid.get_or_create_id(f"zapp.{i:03d}")
+
+        calls = []
+        orig_scan = kv.scan
+
+        def spy_scan(table, start, stop, **kw):
+            calls.append((start, stop))
+            return orig_scan(table, start, stop, **kw)
+
+        try:
+            kv.scan = spy_scan
+            got = uid.suggest("sys.cpu.1")
+        finally:
+            kv.scan = orig_scan
+        assert got == [f"sys.cpu.1{i:02d}" for i in range(25)]
+        # The scan range is the prefix window, not the whole keyspace.
+        assert calls == [(b"sys.cpu.1", b"sys.cpu.2")]
+
+    def test_prefix_ending_in_0xff_is_open_ended(self, uid):
+        uid.get_or_create_id("a\xffb")
+        assert uid.suggest("a\xff") == ["a\xffb"]
+
 
 class TestRename:
     def test_rename(self, uid):
