@@ -161,73 +161,110 @@ def _time_device(fn, *args, repeats=5, **kw):
     return out, float(np.median(times))
 
 
-def bench_downsample(series, base, span, interval=3600,
-                     agg_down="avg", agg_group="sum", rate=False,
-                     oracle_series_cap=64):
-    from opentsdb_tpu.ops import kernels, oracle
+def build_query_tsdb(series, base):
+    """Ingest the query workload into a TSDB whose device-resident hot
+    window (storage/devstore.py) mirrors it into HBM — the steady-state
+    serving shape: data lives next to the compute, queries upload only
+    an [S]-sized group map."""
+    from opentsdb_tpu.core.tsdb import TSDB
+    from opentsdb_tpu.storage.kv import MemKVStore
+    from opentsdb_tpu.utils.config import Config
 
-    rel, vals, sid, valid = _flat(series, base)
+    tsdb = TSDB(MemKVStore(),
+                Config(auto_create_metrics=True, enable_sketches=False),
+                start_compaction_thread=False)
+    for i, (ts, vals) in enumerate(series):
+        tsdb.add_batch("bench.query", ts, vals, {"host": f"h{i}"})
+    if tsdb.devwindow is not None:
+        tsdb.devwindow.flush()
+    return tsdb
+
+
+def _time_query(executor, spec, start, end, repeats=5):
+    """Median wall time of one executor query (first call warms jit +
+    the directory plan cache, like any dashboard's steady state)."""
+    executor.run(spec, start, end)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        executor.run(spec, start, end)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def bench_queries(tsdb, series, base, span, interval=3600):
+    """Configs 1-3 end to end: QuerySpec -> executor -> fused kernels on
+    the device-resident window. Returns per-config dicts with the
+    resident (steady-state) time, plus one cold scan-path time (storage
+    scan + host decode + device upload) for config 1 so the architecture
+    delta is on the record."""
+    from opentsdb_tpu.ops import oracle
+    from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+
+    ex = QueryExecutor(tsdb, backend="tpu")
+    start, end = base, base + span
     S = len(series)
-    B = span // interval + 1
 
-    if rate:
-        def run(rel, vals, sid, valid):
-            r, ok = kernels.flat_rate(rel, vals, sid, valid)
-            return kernels.downsample_group(
-                rel, r, sid, ok, num_series=S, num_buckets=B,
-                interval=interval, agg_down=agg_down, agg_group=agg_group)
-    else:
-        def run(rel, vals, sid, valid):
-            return kernels.downsample_group(
-                rel, vals, sid, valid, num_series=S, num_buckets=B,
-                interval=interval, agg_down=agg_down, agg_group=agg_group)
+    out = {}
+    c1 = QuerySpec("bench.query", {}, "sum", downsample=(interval, "avg"))
+    out["c1_resident_s"] = _time_query(ex, c1, start, end)
+    hits = tsdb.devwindow.window_hits if tsdb.devwindow else 0
 
-    out, dev_t = _time_device(run, rel, vals, sid, valid)
+    c2 = QuerySpec("bench.query", {}, "sum", rate=True,
+                   downsample=(interval, "avg"))
+    out["c2_resident_s"] = _time_query(ex, c2, start, end)
 
-    # Oracle on a series subset, scaled (it is O(S) per bucket too).
-    cap = min(S, oracle_series_cap)
+    c3 = [QuerySpec("bench.query", {}, q, downsample=(interval, "avg"))
+          for q in ("p50", "p95", "p99")]
+    for spec in c3:  # warm jit + plan cache, like _time_query
+        ex.run(spec, start, end)
+    t0 = time.perf_counter()
+    for spec in c3:
+        ex.run(spec, start, end)
+    out["c3_resident_s"] = time.perf_counter() - t0
+    out["window_hits"] = ((tsdb.devwindow.window_hits - hits + 1)
+                          if tsdb.devwindow else 0)
+
+    # Cold path once: disable the window so config 1 runs the full
+    # scan -> decode -> upload -> kernel pipeline.
+    dw, tsdb.devwindow = tsdb.devwindow, None
+    try:
+        t0 = time.perf_counter()
+        ex.run(c1, start, end)
+        out["c1_cold_scan_s"] = time.perf_counter() - t0
+    finally:
+        tsdb.devwindow = dw
+
+    # Oracle projections on a series subset, scaled (it is O(S) too).
+    cap = min(S, 64)
     t0 = time.perf_counter()
     per = []
     for ts, v in series[:cap]:
-        t, w = ts, v.astype(np.float64)
-        if rate:
-            t, w = oracle.rate(t, w)
-        t, w = oracle.downsample(t, w, interval, agg_down,
-                                 mode="aligned", bucket_ts="start")
-        per.append((t, w))
-    oracle.group_aggregate(per, agg_group)
-    oracle_t = (time.perf_counter() - t0) * (S / cap)
-    return dev_t, oracle_t
+        t_, w = oracle.downsample(ts, v.astype(np.float64), interval,
+                                  "avg", mode="aligned",
+                                  bucket_ts="start")
+        per.append((t_, w))
+    oracle.group_aggregate(per, "sum")
+    out["c1_oracle_s"] = (time.perf_counter() - t0) * (S / cap)
 
-
-def bench_percentile(series, base, span, interval=3600):
-    from opentsdb_tpu.ops import kernels, oracle
-
-    rel, vals, sid, valid = _flat(series, base)
-    S = len(series)
-    B = span // interval + 1
-
-    def run(rel, vals, sid, valid):
-        out = kernels.downsample_group(
-            rel, vals, sid, valid, num_series=S, num_buckets=B,
-            interval=interval, agg_down="avg", agg_group="count")
-        filled, in_range = kernels.gap_fill(
-            out["series_values"], out["series_mask"], B)
-        qs = kernels.masked_quantile_axis0(
-            filled, in_range, np.array([0.5, 0.95, 0.99], np.float32))
-        return qs
-
-    out, dev_t = _time_device(run, rel, vals, sid, valid)
-
-    cap = min(S, 64)
     t0 = time.perf_counter()
-    per = [oracle.downsample(t, v.astype(np.float64), interval, "avg",
+    per = []
+    for ts, v in series[:cap]:
+        t_, w = oracle.rate(ts, v.astype(np.float64))
+        t_, w = oracle.downsample(t_, w, interval, "avg",
+                                  mode="aligned", bucket_ts="start")
+        per.append((t_, w))
+    oracle.group_aggregate(per, "sum")
+    out["c2_oracle_s"] = (time.perf_counter() - t0) * (S / cap)
+
+    t0 = time.perf_counter()
+    per = [oracle.downsample(ts, v.astype(np.float64), interval, "avg",
                              mode="aligned", bucket_ts="start")
-           for t, v in series[:cap]]
+           for ts, v in series[:cap]]
     for agg in ("p50", "p95", "p99"):
         oracle.group_aggregate(per, agg)
-    oracle_t = (time.perf_counter() - t0) * (S / cap)
-    return dev_t, oracle_t
+    out["c3_oracle_s"] = (time.perf_counter() - t0) * (S / cap)
+    return out
 
 
 def bench_cardinality(n_items: int):
@@ -275,6 +312,14 @@ def main() -> int:
         subprocess.run(["make", "-C", native_dir], capture_output=True)
 
     import jax
+    # Persistent compilation cache: compiles survive process restarts,
+    # so the watchdog re-exec and repeat bench runs skip the 20-40 s
+    # first-compile tax.
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/jax_comp"))
+    except Exception:
+        pass
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     else:
@@ -324,27 +369,35 @@ def main() -> int:
                                 args.span, seed=1)
     npoints = sum(len(s[0]) for s in series)
     details["query_points"] = npoints
+    log("ingesting query workload (device-resident window) ...")
+    t0 = time.perf_counter()
+    qtsdb = build_query_tsdb(series, base)
+    log(f"  ingested {npoints:,} points in {time.perf_counter()-t0:.1f} s")
 
-    log("config 1: sum 1h-avg downsample ...")
-    d1, o1 = bench_downsample(series, base, args.span)
+    q = bench_queries(qtsdb, series, base, args.span)
+    details["queries"] = q
+    log(f"config 1: sum 1h-avg downsample (end-to-end query) ...\n"
+        f"  resident {q['c1_resident_s']*1e3:.1f} ms | cold scan path "
+        f"{q['c1_cold_scan_s']:.2f} s | oracle(projected) "
+        f"{q['c1_oracle_s']:.2f} s | "
+        f"{q['c1_oracle_s']/q['c1_resident_s']:.0f}x")
+    log(f"config 2: rate+sum through downsampler ...\n"
+        f"  resident {q['c2_resident_s']*1e3:.1f} ms | oracle(projected) "
+        f"{q['c2_oracle_s']:.2f} s | "
+        f"{q['c2_oracle_s']/q['c2_resident_s']:.0f}x")
+    log(f"config 3: p50/p95/p99 over group ...\n"
+        f"  resident {q['c3_resident_s']*1e3:.1f} ms | oracle(projected) "
+        f"{q['c3_oracle_s']:.2f} s | "
+        f"{q['c3_oracle_s']/q['c3_resident_s']:.0f}x")
+    d1, o1 = q["c1_resident_s"], q["c1_oracle_s"]
     details["downsample_sum"] = {"device_s": d1, "oracle_s": o1,
-                                "speedup": o1 / d1}
-    log(f"  device {d1 * 1000:.1f} ms | oracle(projected) {o1:.2f} s | "
-        f"{o1 / d1:.0f}x")
-
-    log("config 2: rate+sum through downsampler ...")
-    d2, o2 = bench_downsample(series, base, args.span, rate=True)
-    details["rate_sum"] = {"device_s": d2, "oracle_s": o2,
-                           "speedup": o2 / d2}
-    log(f"  device {d2 * 1000:.1f} ms | oracle(projected) {o2:.2f} s | "
-        f"{o2 / d2:.0f}x")
-
-    log("config 3: p50/p95/p99 over group ...")
-    d3, o3 = bench_percentile(series, base, args.span)
-    details["percentiles"] = {"device_s": d3, "oracle_s": o3,
-                              "speedup": o3 / d3}
-    log(f"  device {d3 * 1000:.1f} ms | oracle(projected) {o3:.2f} s | "
-        f"{o3 / d3:.0f}x")
+                                 "speedup": o1 / d1}
+    details["rate_sum"] = {"device_s": q["c2_resident_s"],
+                           "oracle_s": q["c2_oracle_s"],
+                           "speedup": q["c2_oracle_s"]/q["c2_resident_s"]}
+    details["percentiles"] = {"device_s": q["c3_resident_s"],
+                              "oracle_s": q["c3_oracle_s"],
+                              "speedup": q["c3_oracle_s"]/q["c3_resident_s"]}
 
     log("config 4: HLL distinct ...")
     n_items = min(npoints, 4_000_000)

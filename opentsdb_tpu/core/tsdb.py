@@ -56,6 +56,39 @@ class TSDB:
         self.sketches = None
         if self.config.enable_sketches:
             self._init_sketches()
+        # Device-resident columnar hot window (storage/devstore.py):
+        # ingest mirrors into HBM so queries skip the host->device
+        # upload. CPU-oracle deployments skip it (nothing to upload to).
+        self.devwindow = None
+        if self.config.device_window and self.config.backend != "cpu":
+            from opentsdb_tpu.storage.devstore import DeviceWindow
+
+            self.devwindow = DeviceWindow(
+                staging_points=self.config.device_window_staging,
+                max_points=self.config.device_window_points)
+            self._warm_devwindow()
+
+    def _warm_devwindow(self) -> None:
+        """Mirror pre-existing storage (WAL-replayed memtable + sstable
+        tiers) into the device window so it covers history from before
+        this process started, not just new ingest.
+
+        Corrupt storage (conflicting duplicates — IllegalDataError, the
+        fsck signal) disables the window outright: a partially-warmed
+        window would claim coverage it doesn't have, and fsck must be
+        able to run against exactly this data."""
+        from opentsdb_tpu.core.errors import IllegalDataError
+
+        try:
+            for key, cols in self.scan_columns(b"", b"\xff" * 64):
+                if len(cols.timestamps) == 0:
+                    continue
+                pr = codec.parse_row_key(key)
+                self.devwindow.append(pr.metric_uid,
+                                      codec.series_key(key),
+                                      cols.timestamps, cols.values)
+        except IllegalDataError:
+            self.devwindow = None
 
     # ------------------------------------------------------------------
     # Streaming sketches
@@ -182,8 +215,13 @@ class TSDB:
         if self.config.enable_compactions:
             self.compactionq.add(row)
         self.datapoints_added += 1
-        self._observe(codec.series_key(row), metric_uid, pairs,
+        skey = codec.series_key(row)
+        self._observe(skey, metric_uid, pairs,
                       np.asarray([value], np.float64))
+        if self.devwindow is not None:
+            self.devwindow.append(metric_uid, skey,
+                                  np.asarray([timestamp], np.int64),
+                                  np.asarray([value], np.float32))
 
     def add_batch(self, metric: str, timestamps: np.ndarray,
                   values: np.ndarray, tag_map: dict[str, str],
@@ -251,6 +289,13 @@ class TSDB:
                 for (key, _, _), ex in zip(batch, existed):
                     if ex:
                         self.compactionq.add(key)
+            # Rows that DID apply are now in storage but will never be
+            # appended to the device window (this raise skips it), and a
+            # later retry of the batch would fail its monotonicity check
+            # anyway — drop the metric's window so queries fall back to
+            # the scan path instead of silently serving a partial view.
+            if self.devwindow is not None:
+                self.devwindow.invalidate(metric_uid)
             raise
         if self.config.enable_compactions:
             for (key, _, _), e in zip(batch, existed):
@@ -260,8 +305,11 @@ class TSDB:
         self.datapoints_added += n
         # Sketch fold covers fully applied batches only (a throttled
         # batch raised above); values as stored, floats and ints alike.
-        self._observe(codec.series_key(batch[0][0]), metric_uid, pairs,
-                      f_s)
+        skey = codec.series_key(batch[0][0])
+        self._observe(skey, metric_uid, pairs, f_s)
+        if self.devwindow is not None:
+            self.devwindow.append(metric_uid, skey, ts_s,
+                                  f_s.astype(np.float32))
         return n
 
     # ------------------------------------------------------------------
@@ -466,3 +514,5 @@ class TSDB:
         if self.sketches is not None:
             collector.record("sketches.series",
                              self.sketches.series_count())
+        if self.devwindow is not None:
+            self.devwindow.collect_stats(collector)

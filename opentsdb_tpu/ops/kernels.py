@@ -40,38 +40,59 @@ _POS_INF = float("inf")
 # Masked segment reductions
 # ---------------------------------------------------------------------------
 
+# Which per-segment statistics each aggregator's _finish needs. count is
+# always computed (it doubles as the bucket-nonempty mask); the rest are
+# gated off it so e.g. a sum query issues 1-2 [N] reductions, not 5. On
+# TPU this matters enormously: XLA lowers a rank-1 f32 segment_sum to an
+# HBM-speed sorted scatter (~0.1 ms for 10M points on v5e), while
+# feature-stacked [N, K] scatters and segment_min/max run 100-1000x
+# slower (measured, scripts/tpu_probe.py) — so the kernel issues one
+# flat reduction per needed statistic and nothing else.
+_AGG_NEEDS = {"sum": frozenset({"sum"}), "min": frozenset({"min"}),
+              "max": frozenset({"max"}), "avg": frozenset({"sum"}),
+              "dev": frozenset({"sum", "m2"}),
+              "count": frozenset()}
+
+
+def _needs(agg: str) -> frozenset:
+    return _AGG_NEEDS[NOLERP_AGGS.get(agg, agg)]
+
+
 def _segment_moments(vals: jnp.ndarray, seg: jnp.ndarray, valid: jnp.ndarray,
-                     num_segments: int, extra: jnp.ndarray | None = None):
+                     num_segments: int, extra: jnp.ndarray | None = None,
+                     need: frozenset = frozenset({"sum", "m2", "min",
+                                                  "max"})):
     """Per-segment count, sum, centered-M2, min, max over masked points.
 
     The second moment is centered (two-pass: mean first, then
     sum((x-mean)^2)) — the naive E[x^2]-E[x]^2 form cancels catastrophically
     in float32 when stddev << |mean|.
 
-    ``extra`` is an optional [N] feature co-summed in the same fused
-    reduction and returned as a sixth output — downsample_group passes
-    bucket-relative timestamps so count/total/rel ride one kernel launch.
-    The sums route through ops.pallas_kernels.segment_sum_features (MXU
-    one-hot matmul on TPU, XLA segment_sum elsewhere).
+    ``need`` gates which statistics are materialized (see _AGG_NEEDS);
+    un-needed ones return None. ``extra`` is an optional [N] feature
+    summed the same way and returned as a sixth output — downsample_group
+    passes bucket-relative timestamps through it.
     """
-    from opentsdb_tpu.ops.pallas_kernels import segment_sum_features
-
-    v = jnp.where(valid, vals, 0.0)
-    feats = [valid.astype(jnp.float32), v]
+    count = jax.ops.segment_sum(valid.astype(jnp.float32), seg,
+                                num_segments)
+    total = m2 = mn = mx = extra_sum = None
+    if "sum" in need or "m2" in need:
+        total = jax.ops.segment_sum(jnp.where(valid, vals, 0.0), seg,
+                                    num_segments)
+    if "m2" in need:
+        mean = total / jnp.maximum(count, 1.0)
+        centered = jnp.where(valid, vals - mean[seg], 0.0)
+        m2 = jax.ops.segment_sum(centered * centered, seg, num_segments)
+    if "min" in need:
+        mn = jax.ops.segment_min(jnp.where(valid, vals, _POS_INF), seg,
+                                 num_segments)
+    if "max" in need:
+        mx = jax.ops.segment_max(jnp.where(valid, vals, _NEG_INF), seg,
+                                 num_segments)
     if extra is not None:
-        feats.append(jnp.where(valid, extra, 0.0))
-    sums = segment_sum_features(jnp.stack(feats, axis=1), seg, num_segments)
-    count, total = sums[:, 0], sums[:, 1]
-    mean = total / jnp.maximum(count, 1.0)
-    centered = jnp.where(valid, vals - mean[seg], 0.0)
-    m2 = segment_sum_features((centered * centered)[:, None], seg,
-                              num_segments)[:, 0]
-    mn = jax.ops.segment_min(jnp.where(valid, vals, _POS_INF), seg,
-                             num_segments)
-    mx = jax.ops.segment_max(jnp.where(valid, vals, _NEG_INF), seg,
-                             num_segments)
-    if extra is not None:
-        return count, total, m2, mn, mx, sums[:, 2]
+        extra_sum = jax.ops.segment_sum(jnp.where(valid, extra, 0.0), seg,
+                                        num_segments)
+        return count, total, m2, mn, mx, extra_sum
     return count, total, m2, mn, mx
 
 
@@ -262,6 +283,91 @@ def group_moments(filled: jnp.ndarray, in_range: jnp.ndarray):
 
 
 # ---------------------------------------------------------------------------
+# Device-window helpers (storage/devstore.py query path)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def window_mask(rel_ts: jnp.ndarray, sid: jnp.ndarray, valid: jnp.ndarray,
+                include: jnp.ndarray, lo, hi, shift):
+    """Range + series filter over resident columns, entirely on device:
+    keeps points of included series with epoch-relative timestamps in
+    [lo, hi], rebased to ``shift`` (the query's bucket-aligned base).
+    Returns (query-relative ts [N] int32, valid [N])."""
+    ok = valid & include[sid] & (rel_ts >= lo) & (rel_ts <= hi)
+    return rel_ts - shift, ok
+
+
+@functools.partial(jax.jit, static_argnames=("num_series",))
+def series_presence(sid: jnp.ndarray, valid: jnp.ndarray, *,
+                    num_series: int):
+    """Which series have any valid point ([S] bool) — one flat
+    reduction; the devwindow path uses it to match the scan path's
+    "series with no in-range points don't exist" semantics."""
+    return jax.ops.segment_sum(
+        valid.astype(jnp.float32), sid, num_series) > 0
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_series", "num_groups", "num_buckets", "interval",
+                     "agg_down", "agg_group", "quantile", "rate", "counter",
+                     "drop_resets"))
+def window_query(rel_ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
+                 valid_in: jnp.ndarray, include: jnp.ndarray,
+                 gmap: jnp.ndarray, lo, hi, shift, q, *, num_series: int,
+                 num_groups: int, num_buckets: int, interval: int,
+                 agg_down: str, agg_group: str, quantile: bool = False,
+                 rate: bool = False, counter_max: float = 0.0,
+                 reset_value: float = 0.0, counter: bool = False,
+                 drop_resets: bool = False):
+    """The whole resident-window query in ONE jit: range/series masking,
+    fused downsample [+ rate] + group aggregation (all groups at once),
+    and series presence. Fusing matters beyond kernel launches: on a
+    remote-device transport (the axon tunnel), a large jit OUTPUT fed
+    into the NEXT jit pays an N-proportional per-hop cost (measured
+    ~85 ms per 64 MB intermediate), so mask -> downsample -> group as
+    separate calls costs seconds at 10M points while this single
+    program runs in ~1 ms. Only small results cross the boundary:
+
+    Returns (group_values [G, B], group_mask [G, B], presence [S]);
+    with ``quantile`` (single-group percentile queries) group_values is
+    [1, B] quantiles of ``q`` and gmap is ignored.
+    """
+    rel_q, ok = window_mask(rel_ts, sid, valid_in, include, lo, hi,
+                            shift)
+    presence = series_presence(sid, ok, num_series=num_series)
+    rate_kw = dict(rate=rate, counter_max=counter_max,
+                   reset_value=reset_value, counter=counter,
+                   drop_resets=drop_resets)
+    if quantile:
+        out = downsample_group(
+            rel_q, vals, sid, ok, num_series=num_series,
+            num_buckets=num_buckets, interval=interval,
+            agg_down=agg_down, agg_group="count", **rate_kw)
+        fill = step_fill if rate else gap_fill
+        filled, in_range = fill(out["series_values"],
+                                out["series_mask"], num_buckets)
+        gv = masked_quantile_axis0(filled, in_range, q)[:1]
+        gm = out["group_mask"][None]
+    elif num_groups == 1:
+        out = downsample_group(
+            rel_q, vals, sid, ok, num_series=num_series,
+            num_buckets=num_buckets, interval=interval,
+            agg_down=agg_down, agg_group=agg_group, **rate_kw)
+        gv = out["group_values"][None]
+        gm = out["group_mask"][None]
+    else:
+        out = downsample_multigroup(
+            rel_q, vals, sid, ok, gmap, num_series=num_series,
+            num_groups=num_groups, num_buckets=num_buckets,
+            interval=interval, agg_down=agg_down, agg_group=agg_group,
+            **rate_kw)
+        gv = out["group_values"]
+        gm = out["group_mask"]
+    return gv, gm, presence
+
+
+# ---------------------------------------------------------------------------
 # Fused downsample + group-by (the hot query kernel)
 # ---------------------------------------------------------------------------
 
@@ -274,15 +380,16 @@ def _series_stage(ts, vals, sid, valid, *, num_series, num_buckets,
     seg = jnp.where(valid, sid * num_buckets + bucket,
                     num_series * num_buckets)
     nseg = num_series * num_buckets + 1  # +1 trash segment for padding
+    need = _needs(agg_down)
     if with_ts:
-        # Mean member timestamp rides the same fused reduction, relative
+        # Mean member timestamp rides the same reduction pass, relative
         # to bucket start for f32 exactness.
         rel = (ts - bucket * interval).astype(jnp.float32)
         count, total, sumsq, mn, mx, rel_sum = _segment_moments(
-            vals, seg, valid, nseg, extra=rel)
+            vals, seg, valid, nseg, extra=rel, need=need)
     else:
         count, total, sumsq, mn, mx = _segment_moments(
-            vals, seg, valid, nseg)
+            vals, seg, valid, nseg, need=need)
     per = _finish(agg_down, count, total, sumsq, mn, mx)
     shape = (num_series, num_buckets)
     series_values = per[:-1].reshape(shape)
@@ -420,7 +527,8 @@ def downsample_multigroup(ts: jnp.ndarray, vals: jnp.ndarray,
     gn = num_groups * num_buckets + 1
     gseg = jnp.where(in_range, gb, num_groups * num_buckets).reshape(-1)
     g_count, g_total, g_m2, g_mn, g_mx = _segment_moments(
-        filled.reshape(-1), gseg, in_range.reshape(-1), gn)
+        filled.reshape(-1), gseg, in_range.reshape(-1), gn,
+        need=_needs(agg_group))
     group_values = _finish(agg_group, g_count, g_total, g_m2, g_mn,
                            g_mx)[:-1].reshape(num_groups, num_buckets)
     # A group's bucket is emitted when some member series has a REAL

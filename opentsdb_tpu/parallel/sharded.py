@@ -27,6 +27,7 @@ from opentsdb_tpu.core.const import NOLERP_AGGS
 from opentsdb_tpu.ops import sketches
 from opentsdb_tpu.ops.kernels import (
     _finish,
+    _needs,
     _segment_moments,
     downsample_group,
     gap_fill,
@@ -215,17 +216,26 @@ def sharded_downsample_multigroup(ts, vals, sid, valid, gmap, *, mesh,
         gseg = jnp.where(in_range, gb,
                          num_groups * num_buckets).reshape(-1)
         flat_range = in_range.reshape(-1)
+        need = _needs(agg_group)
         n, total, m2, mn, mx = _segment_moments(
-            filled.reshape(-1), gseg, flat_range, gn)
-        n, total, m2, mn, mx = (x[:-1] for x in (n, total, m2, mn, mx))
-        mean = total / jnp.maximum(n, 1.0)
-        # Chan et al. exact cross-chip moment combination per cell.
+            filled.reshape(-1), gseg, flat_range, gn, need=need)
+        n, total, m2, mn, mx = (
+            None if x is None else x[:-1] for x in (n, total, m2, mn, mx))
+        # Chan et al. exact cross-chip moment combination per cell; each
+        # statistic combines only when the aggregator needs it.
         g_n = jax.lax.psum(n, SERIES_AXIS)
-        g_total = jax.lax.psum(total, SERIES_AXIS)
-        g_mean = g_total / jnp.maximum(g_n, 1.0)
-        g_m2 = jax.lax.psum(m2 + n * (mean - g_mean) ** 2, SERIES_AXIS)
-        g_mn = jax.lax.pmin(mn, SERIES_AXIS)
-        g_mx = jax.lax.pmax(mx, SERIES_AXIS)
+        g_total = g_m2 = g_mn = g_mx = None
+        if total is not None:
+            g_total = jax.lax.psum(total, SERIES_AXIS)
+        if m2 is not None:
+            mean = total / jnp.maximum(n, 1.0)
+            g_mean = g_total / jnp.maximum(g_n, 1.0)
+            g_m2 = jax.lax.psum(m2 + n * (mean - g_mean) ** 2,
+                                SERIES_AXIS)
+        if mn is not None:
+            g_mn = jax.lax.pmin(mn, SERIES_AXIS)
+        if mx is not None:
+            g_mx = jax.lax.pmax(mx, SERIES_AXIS)
         out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
         # Emission: a (group, bucket) is real when some member series has
         # a real post-rate bucket there, on any chip.

@@ -47,6 +47,7 @@ from opentsdb_tpu.core.const import NOLERP_AGGS
 from opentsdb_tpu.ops.kernels import (
     _finish,
     _flat_rate,
+    _needs,
     _segment_moments,
     bucket_rate,
     gap_fill,
@@ -187,7 +188,8 @@ def timeshard_downsample_group(ts, vals, sid, valid, *, mesh,
         bucket = jnp.clip(local // interval, 0, bps - 1)
         seg = jnp.where(valid, sid * bps + bucket, num_series * bps)
         nseg = num_series * bps + 1
-        count, total, m2, mn, mx = _segment_moments(vals, seg, valid, nseg)
+        count, total, m2, mn, mx = _segment_moments(
+            vals, seg, valid, nseg, need=_needs(agg_down))
         per = _finish(agg_down, count, total, m2, mn, mx)
         shape = (num_series, bps)
         series_values = per[:-1].reshape(shape)

@@ -119,14 +119,11 @@ class QueryExecutor:
         buf += b"(?:.{%d})*$" % tagsize
         return buf
 
-    def _find_spans(self, spec: QuerySpec, start: int, end: int):
-        """Scan matching rows into per-series columnar spans, grouped by
-        the distinct combinations of group-by tag values."""
-        metric_uid = self.tsdb.metrics.get_id(spec.metric)
-
+    def _tag_filters(self, tags: dict[str, str]):
+        """Resolve a tag-filter map to UID-level (exact, group_bys)."""
         exact: list[tuple[bytes, bytes]] = []
         group_bys: list[tuple[bytes, list[bytes] | None]] = []
-        for name, value in spec.tags.items():
+        for name, value in tags.items():
             k = self.tsdb.tagk.get_id(name)
             if value == "*":
                 group_bys.append((k, None))
@@ -135,6 +132,13 @@ class QueryExecutor:
                 group_bys.append((k, vals))
             else:
                 exact.append((k, self.tsdb.tagv.get_id(value)))
+        return exact, group_bys
+
+    def _find_spans(self, spec: QuerySpec, start: int, end: int):
+        """Scan matching rows into per-series columnar spans, grouped by
+        the distinct combinations of group-by tag values."""
+        metric_uid = self.tsdb.metrics.get_id(spec.metric)
+        exact, group_bys = self._tag_filters(spec.tags)
         group_by_keys = sorted(k for k, _ in group_bys)
 
         start_key = metric_uid + _u32(codec.base_time(max(start, 0)))
@@ -198,6 +202,9 @@ class QueryExecutor:
             raise BadRequestError(
                 "use distinct_tagv() / the /distinct endpoint for "
                 "cardinality queries")
+        dev = self._run_devwindow(spec, start, end, agg)
+        if dev is not None:
+            return dev
         import time as _time
         t0 = _time.time()
         groups = self._find_spans(spec, start, end)
@@ -224,6 +231,137 @@ class QueryExecutor:
             results.append(QueryResult(
                 spec.metric, tags, aggregated, ts, vals))
         return results
+
+    # -- device-resident window path ----------------------------------
+
+    def _run_devwindow(self, spec: QuerySpec, start: int, end: int,
+                       agg) -> list[QueryResult] | None:
+        """Serve the query from the device-resident hot window
+        (storage/devstore.py) when it exactly covers [start, end]: no
+        storage scan, no host->device point upload — the host only
+        filters the series directory and uploads an [S]-sized group map.
+        Returns None to fall back to the scan path (CPU backend,
+        un-downsampled queries, dirty/evicted windows, unknown UIDs,
+        multi-group percentiles)."""
+        dw = getattr(self.tsdb, "devwindow", None)
+        if (dw is None or self.backend == "cpu" or self.mesh is not None
+                or not spec.downsample
+                or agg.kind not in ("moment", "percentile")):
+            return None
+        from opentsdb_tpu.core.errors import NoSuchUniqueName
+        try:
+            metric_uid = self.tsdb.metrics.get_id(spec.metric)
+            exact, group_bys = self._tag_filters(spec.tags)
+        except NoSuchUniqueName:
+            return None  # scan path raises the canonical error
+        cols = dw.columns(metric_uid, start, end)
+        if cols is None:
+            return None
+        groups, named = self._devwindow_groups(
+            metric_uid, cols, exact, group_bys)
+        if not groups:
+            return []
+        if agg.kind == "percentile" and len(groups) > 1:
+            return None
+
+        interval, dsagg = spec.downsample
+        qbase = start - start % interval
+        num_buckets = _pad_size(int((end - qbase) // interval + 1))
+        S_all = len(cols.series_keys)
+        S_pad = _pad_size(S_all)
+        gkeys = sorted(groups)
+        G = _pad_size(len(gkeys))
+        include = np.zeros(S_pad, bool)
+        gmap = np.full(S_pad, G - 1, np.int32)
+        for gi, gkey in enumerate(gkeys):
+            for sid in groups[gkey]:
+                include[sid] = True
+                gmap[sid] = gi
+        imin, imax = -(2**31), 2**31 - 1
+        # One fused jit for the whole query: on a remote-device
+        # transport, chaining separate kernels pays an N-proportional
+        # cost per large intermediate (see kernels.window_query).
+        gv, gm, presence = kernels.window_query(
+            cols.rel_ts, cols.values, cols.sid, cols.valid, include,
+            gmap,
+            np.int32(min(max(start - cols.epoch, imin), imax)),
+            np.int32(min(max(end - cols.epoch, imin), imax)),
+            np.int32(min(max(qbase - cols.epoch, imin), imax)),
+            np.array([agg.quantile if agg.kind == "percentile" else 0.0],
+                     np.float32),
+            num_series=S_pad, num_groups=(1 if len(gkeys) == 1 else G),
+            num_buckets=num_buckets, interval=interval, agg_down=dsagg,
+            agg_group=(spec.aggregator if agg.kind == "moment"
+                       else "count"),
+            quantile=agg.kind == "percentile", **self._rate_kw(spec))
+        gv, gm = np.asarray(gv), np.asarray(gm)
+        # Series with no in-range points must not shape group labels or
+        # emit empty groups — match the scan path, which never sees
+        # them. (Pre-rate presence: computed from the raw in-range
+        # mask, like the scan path's "series exists".)
+        has_points = np.asarray(presence)
+        results = []
+        for gi, gkey in enumerate(gkeys):
+            live = [sid for sid in groups[gkey] if has_points[sid]]
+            if not live:
+                continue
+            spans = [_Span(cols.series_keys[sid], named[sid], None, None)
+                     for sid in live]
+            tags, aggregated = self._group_tags(spans)
+            mask = gm[gi]
+            grid_ts = (np.flatnonzero(mask).astype(np.int64) * interval
+                       + qbase)
+            results.append(QueryResult(
+                spec.metric, tags, aggregated, grid_ts,
+                gv[gi][mask].astype(np.float64)))
+        return results
+
+    def _devwindow_groups(self, metric_uid: bytes, cols, exact,
+                          group_bys):
+        """Filter + group the window's series directory on host UIDs.
+
+        Returns ({group_key_tuple: [sid]}, {sid: named_tags}); cached per
+        (metric, filter) until the directory grows."""
+        fkey = (metric_uid,
+                tuple(sorted(exact)),
+                tuple(sorted((k, tuple(v) if v else None)
+                             for k, v in group_bys)))
+        cache = getattr(self, "_dw_plan_cache", None)
+        if cache is None:
+            cache = self._dw_plan_cache = {}
+        hit = cache.get(fkey)
+        if hit is not None and hit[0] == cols.generation:
+            return hit[1], hit[2]
+        group_by_keys = sorted(k for k, _ in group_bys)
+        want = dict(exact)
+        gb = {k: (set(v) if v else None) for k, v in group_bys}
+        groups: dict[tuple, list[int]] = {}
+        named: dict[int, dict[str, str]] = {}
+        w = UID_WIDTH
+        for sid, skey in enumerate(cols.series_keys):
+            pairs = [(skey[i:i + w], skey[i + w:i + 2 * w])
+                     for i in range(w, len(skey), 2 * w)]
+            tag_uids = dict(pairs)
+            ok = all(tag_uids.get(k) == v for k, v in want.items())
+            if ok:
+                for k, allowed in gb.items():
+                    v = tag_uids.get(k)
+                    if v is None or (allowed is not None
+                                     and v not in allowed):
+                        ok = False
+                        break
+            if not ok:
+                continue
+            groups.setdefault(
+                tuple(tag_uids.get(k, b"") for k in group_by_keys),
+                []).append(sid)
+            named[sid] = {
+                self.tsdb.tagk.get_name(k): self.tsdb.tagv.get_name(v)
+                for k, v in pairs}
+        if len(cache) > 128:
+            cache.clear()
+        cache[fkey] = (cols.generation, groups, named)
+        return groups, named
 
     # -- CPU oracle backend -------------------------------------------
 

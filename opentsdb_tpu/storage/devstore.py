@@ -1,0 +1,354 @@
+"""Device-resident columnar hot window — queries without host→device upload.
+
+Measured motivation (scripts/tpu_probe.py on the real v5e): the fused query
+kernels run at HBM speed (~1 ms for 10M points) but moving those points to
+the device costs seconds — host→device bandwidth is the entire query cost.
+The reference never faces this because its compute sits where its data is
+(Java heap over HBase scans); a TPU-native design has to put the data where
+the compute is instead. This module keeps the recent ingest window's flat
+columns (rel-timestamp, value, series-id) resident in device HBM, appended
+as data arrives, so the steady-state dashboard query touches the host only
+for the series directory and the tiny [S]-sized group maps.
+
+Design:
+
+- **Per-metric windows.** Each metric holds a host-side series directory
+  (series_key -> dense sid, the group-by/tag-filter substrate) and a list
+  of immutable device chunks; a query concatenates the chunks ON DEVICE
+  (HBM-to-HBM, no transfer) and caches the result until the next flush.
+- **Host staging.** ``append`` is O(1) host work (numpy refs into a list);
+  chunks upload in ``staging_points``-sized batches, padded to powers of
+  two so jit shapes repeat. One upload per ~million points amortizes the
+  slow host link at ingest time, once, instead of per query.
+- **Exactness, not cache-maybe.** The window only serves a query when its
+  answer is guaranteed byte-identical to the storage scan path:
+  - per-series timestamps must be strictly monotone across appends (the
+    overwhelmingly common collector pattern); an out-of-order or rewritten
+    timestamp marks the metric dirty and queries fall back to the scan
+    path (``dirty_fallbacks`` counts them);
+  - evicting old chunks advances ``complete_from``; queries reaching
+    before it fall back.
+  - deletes/fsck rewrites call ``invalidate``.
+- **Sizing.** ~12 B/point device-side: the 1B-point north-star workload is
+  ~12 GB — within one v5e chip's 16 GB HBM, which is exactly the design
+  point (BASELINE.json: 1B points, single chip serving).
+
+No reference analog: HBase scans are the reference's only read path
+(src/core/TsdbQuery.java:240-285); this is the TPU-era replacement for
+"the data lives next to the compute".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import NamedTuple
+
+import numpy as np
+
+
+def _pad_pow2(n: int, lo: int = 1024) -> int:
+    size = lo
+    while size < n:
+        size *= 2
+    return size
+
+
+class DevColumns(NamedTuple):
+    """One metric's resident window, ready for the fused kernels."""
+    rel_ts: object          # [N] int32 device, seconds since ``epoch``
+    values: object          # [N] float32 device
+    sid: object             # [N] int32 device
+    valid: object           # [N] bool device (padding mask)
+    epoch: int              # int64 base the rel timestamps offset from
+    series_keys: list       # sid -> series_key bytes
+    generation: int         # bumps when the directory grows
+
+
+class _MetricWindow:
+    __slots__ = ("sids", "keys", "last_ts", "epoch", "chunks",
+                 "staged_ts", "staged_vals", "staged_sid", "staged_n",
+                 "dirty", "complete_from", "concat", "generation",
+                 "device_points")
+
+    def __init__(self) -> None:
+        self.sids: dict[bytes, int] = {}
+        self.keys: list[bytes] = []
+        self.last_ts: list[int] = []
+        self.epoch: int | None = None
+        self.chunks: list[dict] = []      # ts/vals/sid device + n/max_ts
+        self.staged_ts: list[np.ndarray] = []
+        self.staged_vals: list[np.ndarray] = []
+        self.staged_sid: list[np.ndarray] = []
+        self.staged_n = 0
+        self.dirty = False
+        self.complete_from: int | None = None  # None = since forever
+        self.concat: DevColumns | None = None
+        self.generation = 0
+        self.device_points = 0
+
+
+class DeviceWindow:
+    """Thread-safe store of per-metric device-resident columns."""
+
+    def __init__(self, staging_points: int = 1 << 20,
+                 max_points: int = 1 << 26,
+                 background: bool = True) -> None:
+        self.staging_points = staging_points
+        self.max_points = max_points
+        self.background = background
+        self._lock = threading.RLock()
+        self._metrics: dict[bytes, _MetricWindow] = {}
+        # Background uploader: host->device copies of staged chunks run
+        # off the ingest thread (the tunnel/PCIe copy otherwise blocks
+        # ingest for its full duration). Bounded queue = backpressure;
+        # single worker = chunk order (and so per-series time order in
+        # the concatenated window) is preserved.
+        import queue as _queue
+
+        self._pending: _queue.Queue = _queue.Queue(maxsize=2)
+        self._uploader: threading.Thread | None = None
+        # Global residency accounting: max_points caps the SUM across
+        # metrics (the HBM budget is per chip, not per metric); chunks
+        # carry an upload sequence number so eviction picks the oldest
+        # chunk fleet-wide.
+        self._total_points = 0
+        self._seq = 0
+        # stats
+        self.appended_points = 0
+        self.evicted_points = 0
+        self.dirty_fallbacks = 0
+        self.window_hits = 0
+        self.window_misses = 0
+
+    # -- ingest side ---------------------------------------------------
+
+    def append(self, metric_uid: bytes, series_key: bytes,
+               timestamps: np.ndarray, values: np.ndarray) -> None:
+        """Record one series batch (timestamps int64 sorted ascending,
+        values float64/float32). O(1) host work plus a device upload
+        every ``staging_points`` points."""
+        n = len(timestamps)
+        if n == 0:
+            return
+        with self._lock:
+            mw = self._metrics.get(metric_uid)
+            if mw is None:
+                mw = self._metrics[metric_uid] = _MetricWindow()
+            if mw.dirty:
+                return
+            sid = mw.sids.get(series_key)
+            if sid is None:
+                sid = len(mw.keys)
+                mw.sids[series_key] = sid
+                mw.keys.append(series_key)
+                mw.last_ts.append(-1)
+                mw.generation += 1
+            if int(timestamps[0]) <= mw.last_ts[sid]:
+                # Out-of-order or rewritten timestamp: correctness now
+                # needs storage's dedup/overwrite semantics. Mark the
+                # metric dirty and free its device state — every query
+                # falls back to the scan path from here on.
+                self._mark_dirty(mw)
+                return
+            mw.last_ts[sid] = int(timestamps[-1])
+            if mw.epoch is None:
+                mw.epoch = int(timestamps[0])
+            mw.staged_ts.append(np.asarray(timestamps, np.int64))
+            mw.staged_vals.append(np.asarray(values, np.float32))
+            mw.staged_sid.append(np.full(n, sid, np.int32))
+            mw.staged_n += n
+            self.appended_points += n
+            work = (self._take_staged(mw)
+                    if mw.staged_n >= self.staging_points else None)
+        # The bounded put happens OUTSIDE _lock: the uploader takes the
+        # lock to append finished chunks, so blocking on a full queue
+        # while holding it would deadlock.
+        if work is not None:
+            self._submit(work)
+
+    def _take_staged(self, mw: _MetricWindow):
+        """Swap the staged batch out (caller holds _lock); the returned
+        work item is submitted outside the lock."""
+        if mw.staged_n == 0:
+            return None
+        batch = (mw.staged_ts, mw.staged_vals, mw.staged_sid,
+                 mw.staged_n)
+        mw.staged_ts, mw.staged_vals, mw.staged_sid = [], [], []
+        mw.staged_n = 0
+        return (mw, batch)
+
+    def _submit(self, work) -> None:
+        """Queue one (mw, batch) for the uploader thread, or upload
+        inline when background=False. Must be called without _lock."""
+        if not self.background:
+            self._upload(*work)
+            return
+        if self._uploader is None:
+            with self._lock:
+                if self._uploader is None:
+                    self._uploader = threading.Thread(
+                        target=self._upload_loop, daemon=True,
+                        name="devwindow-uploader")
+                    self._uploader.start()
+        self._pending.put(work)
+
+    def _upload_loop(self) -> None:
+        while True:
+            mw, batch = self._pending.get()
+            try:
+                self._upload(mw, batch)
+            except Exception:  # pragma: no cover - device failure
+                mw.dirty = True  # window no longer complete: fall back
+            finally:
+                self._pending.task_done()
+
+    def _upload(self, mw: _MetricWindow, batch) -> None:
+        """Upload one staged batch as a padded immutable chunk."""
+        import jax
+
+        staged_ts, staged_vals, staged_sid, _ = batch
+        ts = np.concatenate(staged_ts)
+        rel64 = ts - mw.epoch
+        if (rel64 > 2**31 - 1).any() or (rel64 < -(2**31)).any():
+            # >68 years from the metric's epoch: the int32 rel column
+            # would wrap silently. Fall back rather than mis-bucket.
+            with self._lock:
+                self._mark_dirty(mw)
+            return
+        rel = rel64.astype(np.int32)
+        vals = np.concatenate(staged_vals)
+        sid = np.concatenate(staged_sid)
+        n = len(rel)
+        pad = _pad_pow2(n)
+        if pad != n:
+            rel = np.pad(rel, (0, pad - n))
+            vals = np.pad(vals, (0, pad - n))
+            sid = np.pad(sid, (0, pad - n))
+        valid = np.arange(pad) < n
+        chunk = {
+            "ts": jax.device_put(rel), "vals": jax.device_put(vals),
+            "sid": jax.device_put(sid), "valid": jax.device_put(valid),
+            "n": n, "pad": pad,
+            "min_ts": int(ts.min()), "max_ts": int(ts.max()),
+        }
+        with self._lock:
+            if mw.dirty:  # marked dirty while we were copying
+                return
+            chunk["seq"] = self._seq
+            self._seq += 1
+            mw.chunks.append(chunk)
+            mw.device_points += n
+            self._total_points += n
+            mw.concat = None
+            # Evict the globally-oldest chunks past the (per-chip, NOT
+            # per-metric) budget. complete_from of the owning metric
+            # advances past everything the evicted chunk could cover.
+            while self._total_points > self.max_points:
+                victim = min(
+                    (m for m in self._metrics.values() if m.chunks),
+                    key=lambda m: m.chunks[0]["seq"], default=None)
+                if victim is None or (victim is mw
+                                      and len(mw.chunks) == 1):
+                    break  # never evict the chunk just added
+                old = victim.chunks.pop(0)
+                victim.device_points -= old["n"]
+                self._total_points -= old["n"]
+                self.evicted_points += old["n"]
+                victim.concat = None
+                nxt = old["max_ts"] + 1
+                if (victim.complete_from is None
+                        or nxt > victim.complete_from):
+                    victim.complete_from = nxt
+
+    def flush(self) -> None:
+        """Upload every metric's staged points and wait for the
+        uploader to drain (query-side barrier)."""
+        with self._lock:
+            work = [w for w in map(self._take_staged,
+                                   self._metrics.values()) if w]
+        for w in work:
+            self._submit(w)
+        self._pending.join()
+
+    def invalidate(self, metric_uid: bytes | None = None) -> None:
+        """Mark window state unusable after storage mutations the append
+        stream didn't see (deletes, fsck --fix rewrites, mid-batch
+        throttles). The mark is sticky — popping the window instead
+        would let the next append recreate one that claims coverage
+        since forever while storage holds data it never saw."""
+        with self._lock:
+            targets = (list(self._metrics.values()) if metric_uid is None
+                       else filter(None, [self._metrics.get(metric_uid)]))
+            for mw in targets:
+                self._mark_dirty(mw)
+
+    def _mark_dirty(self, mw: _MetricWindow) -> None:
+        """Sticky fallback mark + free the metric's device/staging state.
+        Caller holds _lock."""
+        mw.dirty = True
+        mw.chunks.clear()
+        mw.concat = None
+        mw.staged_ts.clear()
+        mw.staged_vals.clear()
+        mw.staged_sid.clear()
+        mw.staged_n = 0
+        self._total_points -= mw.device_points
+        mw.device_points = 0
+
+    # -- query side ----------------------------------------------------
+
+    def columns(self, metric_uid: bytes, start: int,
+                end: int) -> DevColumns | None:
+        """The metric's resident columns when they exactly cover
+        [start, end]; None means the caller must use the scan path."""
+        with self._lock:
+            mw = self._metrics.get(metric_uid)
+            if mw is None:
+                self.window_misses += 1
+                return None
+            work = self._take_staged(mw)
+        # Submit + drain OUTSIDE the lock (the uploader takes the lock
+        # to append chunks); then re-check under the lock — the drain
+        # can mark dirty (upload failure) or advance complete_from.
+        if work is not None:
+            self._submit(work)
+        self._pending.join()
+        with self._lock:
+            if mw.dirty:
+                self.dirty_fallbacks += 1
+                return None
+            if mw.complete_from is not None and start < mw.complete_from:
+                self.window_misses += 1
+                return None
+            if not mw.chunks:
+                self.window_misses += 1
+                return None
+            if mw.concat is None or mw.concat.generation != mw.generation:
+                import jax.numpy as jnp
+
+                mw.concat = DevColumns(
+                    rel_ts=jnp.concatenate(
+                        [c["ts"] for c in mw.chunks]),
+                    values=jnp.concatenate(
+                        [c["vals"] for c in mw.chunks]),
+                    sid=jnp.concatenate([c["sid"] for c in mw.chunks]),
+                    valid=jnp.concatenate(
+                        [c["valid"] for c in mw.chunks]),
+                    epoch=mw.epoch, series_keys=list(mw.keys),
+                    generation=mw.generation)
+            self.window_hits += 1
+            return mw.concat
+
+    # -- observability -------------------------------------------------
+
+    def collect_stats(self, collector) -> None:
+        collector.record("devwindow.points.appended", self.appended_points)
+        collector.record("devwindow.points.evicted", self.evicted_points)
+        collector.record("devwindow.hits", self.window_hits)
+        collector.record("devwindow.misses", self.window_misses)
+        collector.record("devwindow.dirty_fallbacks", self.dirty_fallbacks)
+        with self._lock:
+            collector.record("devwindow.metrics", len(self._metrics))
+            collector.record(
+                "devwindow.points.resident",
+                sum(mw.device_points for mw in self._metrics.values()))

@@ -68,6 +68,10 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
     cfg = Config(
         table=args.table, uidtable=args.uidtable, wal_path=args.wal,
         backend=args.backend, auto_create_metrics=args.auto_metric)
+    # The device-resident hot window serves long-lived query traffic;
+    # one-shot tools (import/scan/fsck/uid/query) would only pay its
+    # warm-up scan and uploads to throw them away on exit.
+    cfg.device_window = hasattr(args, "port")
     if hasattr(args, "port"):
         cfg.port = args.port
         cfg.bind = args.bind

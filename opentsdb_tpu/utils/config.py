@@ -39,6 +39,13 @@ class Config:
     sketch_hll_p: int = 12              # 2^p registers per (metric, tagk)
     sketch_flush_points: int = 65536    # staleness bound (buffered points)
 
+    # device-resident columnar hot window (storage/devstore.py): recent
+    # ingest kept in device HBM so steady-state queries skip the
+    # host->device upload (the measured query bottleneck on real TPU)
+    device_window: bool = True
+    device_window_staging: int = 1 << 20   # points per upload chunk
+    device_window_points: int = 1 << 26    # resident budget (~12 B/point)
+
     # compute backend: 'tpu' = jitted JAX kernels; 'cpu' = numpy oracle
     backend: str = "tpu"
     # device mesh for distributed query execution: 0 = single-device;

@@ -8,12 +8,16 @@ import, hence the env mutation at conftest import time.
 import os
 
 # Override unconditionally: the ambient environment pins JAX_PLATFORMS=axon
-# (the real TPU tunnel), which tests must never use.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+# (the real TPU tunnel), which tests must never use — except under
+# RUN_TPU_TESTS=1, which runs ONLY the @pytest.mark.tpu hardware tests
+# against the real chip (single-tenant: don't run alongside bench.py).
+_TPU_RUN = bool(os.environ.get("RUN_TPU_TESTS"))
+if not _TPU_RUN:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 # Pytest plugins (jaxtyping, typeguard, ...) import jax before this file
 # runs, so the env mutation alone may be too late for jax.config's cached
@@ -21,4 +25,28 @@ if "xla_force_host_platform_device_count" not in _flags:
 # (before any computation) still forces the virtual CPU mesh.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_RUN:
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: requires a real TPU chip (run with RUN_TPU_TESTS=1; "
+        "excluded from the default CPU suite)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if _TPU_RUN:
+        # Hardware session: run ONLY the tpu-marked tests.
+        skip = pytest.mark.skip(reason="CPU test (hardware-only session)")
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip)
+        return
+    skip = pytest.mark.skip(reason="needs real TPU (set RUN_TPU_TESTS=1)")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)

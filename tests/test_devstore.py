@@ -1,0 +1,260 @@
+"""Device-resident hot window (storage/devstore.py + executor path).
+
+The window must be invisible semantically: every query it serves must be
+byte-identical (grids) / float32-identical (values) to the storage scan
+path, and anything it cannot guarantee (out-of-order writes, evicted
+ranges, un-downsampled queries) must fall back rather than approximate.
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.storage.devstore import DeviceWindow
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+
+
+@pytest.fixture
+def tsdb():
+    t = TSDB(MemKVStore(), Config(auto_create_metrics=True,
+                                  enable_sketches=False),
+             start_compaction_thread=False)
+    yield t
+    t.compactionq.shutdown()
+
+
+def _load(tsdb, series=12, points=200, span=7200, metric="m.cpu"):
+    rng = np.random.default_rng(7)
+    for i in range(series):
+        ts = BT + np.sort(rng.choice(span, points, replace=False))
+        tsdb.add_batch(metric, ts, rng.normal(100, 10, points),
+                       {"host": f"h{i}", "dc": "east" if i % 2 else "west"})
+
+
+def _compare(tsdb, spec, start=BT, end=BT + 7200, expect_hit=True):
+    ex = QueryExecutor(tsdb, backend="tpu")
+    h0 = tsdb.devwindow.window_hits
+    got = ex.run(spec, start, end)
+    hit = tsdb.devwindow.window_hits > h0
+    assert hit == expect_hit, f"window hit={hit}, wanted {expect_hit}"
+    dw, tsdb.devwindow = tsdb.devwindow, None
+    try:
+        want = ex.run(spec, start, end)
+    finally:
+        tsdb.devwindow = dw
+    assert len(got) == len(want)
+    for a, b in zip(got, want):
+        assert a.tags == b.tags
+        assert a.aggregated_tags == b.aggregated_tags
+        np.testing.assert_array_equal(a.timestamps, b.timestamps)
+        np.testing.assert_allclose(a.values, b.values, rtol=1e-5,
+                                   atol=1e-5)
+    return got
+
+
+class TestScanPathParity:
+    @pytest.mark.parametrize("spec", [
+        QuerySpec("m.cpu", {}, "sum", downsample=(600, "avg")),
+        QuerySpec("m.cpu", {"host": "*"}, "avg", downsample=(600, "sum")),
+        QuerySpec("m.cpu", {"dc": "east"}, "max", downsample=(300, "max")),
+        QuerySpec("m.cpu", {"host": "h1|h2"}, "dev",
+                  downsample=(600, "avg")),
+        QuerySpec("m.cpu", {}, "sum", rate=True, downsample=(600, "avg")),
+        QuerySpec("m.cpu", {}, "sum", rate=True, counter=True,
+                  counter_max=2.0**32, downsample=(600, "avg")),
+        QuerySpec("m.cpu", {}, "p95", downsample=(600, "avg")),
+        QuerySpec("m.cpu", {"host": "*"}, "zimsum",
+                  downsample=(600, "sum")),
+        QuerySpec("m.cpu", {"dc": "*", "host": "h3"}, "min",
+                  downsample=(600, "min")),
+    ], ids=lambda s: f"{s.aggregator}-{'rate' if s.rate else 'plain'}-"
+                     f"{len(s.tags)}tags")
+    def test_equals_scan_path(self, tsdb, spec):
+        _load(tsdb)
+        _compare(tsdb, spec)
+
+    def test_partial_range(self, tsdb):
+        """A sub-range query: range masking on device must match the
+        scan path's [start, end] span trim."""
+        _load(tsdb)
+        _compare(tsdb, QuerySpec("m.cpu", {}, "sum",
+                                 downsample=(300, "avg")),
+                 start=BT + 1800, end=BT + 5400)
+
+    def test_series_outside_range_do_not_shape_labels(self, tsdb):
+        """A series with no points in the queried range must not appear
+        in group labels (scan-path semantics: it is never seen)."""
+        _load(tsdb, series=3, span=3600)
+        # h9 exists only in hour 2
+        tsdb.add_batch("m.cpu", BT + 7200 + np.arange(10) * 60,
+                       np.arange(10.0), {"host": "h9", "dc": "west"})
+        _compare(tsdb, QuerySpec("m.cpu", {}, "sum",
+                                 downsample=(600, "avg")),
+                 start=BT, end=BT + 3600)
+        _compare(tsdb, QuerySpec("m.cpu", {"host": "*"}, "sum",
+                                 downsample=(600, "avg")),
+                 start=BT, end=BT + 3600)
+
+    def test_no_matching_series_empty(self, tsdb):
+        _load(tsdb, series=2)
+        # 'h9' exists as a tag value (other metric) but no m.cpu series
+        # carries it -> empty result, window hit, no scan.
+        tsdb.add_batch("m.other", BT + np.arange(5) * 60,
+                       np.arange(5.0), {"host": "h9", "dc": "east"})
+        ex = QueryExecutor(tsdb, backend="tpu")
+        h0 = tsdb.devwindow.window_hits
+        out = ex.run(QuerySpec("m.cpu", {"host": "h9"}, "sum",
+                               downsample=(600, "avg")), BT, BT + 7200)
+        assert out == []
+        assert tsdb.devwindow.window_hits > h0
+
+
+class TestFallbacks:
+    def test_undownsampled_falls_back(self, tsdb):
+        _load(tsdb, series=2)
+        _compare(tsdb, QuerySpec("m.cpu", {}, "sum"), expect_hit=False)
+
+    def test_out_of_order_write_marks_dirty(self, tsdb):
+        _load(tsdb, series=2)
+        # rewrite an old timestamp for h0
+        tsdb.add_point("m.cpu", BT + 1, 42.0,
+                       {"host": "h0", "dc": "west"})
+        assert tsdb.devwindow._metrics[
+            tsdb.metrics.get_id("m.cpu")].dirty
+        _compare(tsdb, QuerySpec("m.cpu", {}, "sum",
+                                 downsample=(600, "avg")),
+                 expect_hit=False)
+        assert tsdb.devwindow.dirty_fallbacks >= 1
+
+    def test_eviction_advances_coverage(self, tsdb):
+        dw = DeviceWindow(staging_points=100, max_points=250)
+        tsdb.devwindow = dw
+        muid = b"\x00\x00\x01"
+        for hour in range(5):
+            dw.append(muid, b"skey",
+                      BT + hour * 3600 + np.arange(100, dtype=np.int64),
+                      np.ones(100, np.float32))
+        dw.flush()
+        assert dw.evicted_points > 0
+        mw = dw._metrics[muid]
+        assert mw.complete_from is not None
+        # A query reaching before complete_from must miss...
+        assert dw.columns(muid, BT, BT + 5 * 3600) is None
+        # ...and one inside the kept window must hit.
+        assert dw.columns(muid, mw.complete_from, BT + 5 * 3600) is not None
+
+    def test_eviction_budget_is_global_across_metrics(self, tsdb):
+        """max_points caps the SUM across metrics (the HBM budget is
+        per chip): many metrics must not each claim a full budget."""
+        dw = DeviceWindow(staging_points=100, max_points=350,
+                          background=False)
+        for m in range(4):
+            dw.append(bytes([0, 0, m]), b"sk",
+                      BT + np.arange(100, dtype=np.int64),
+                      np.ones(100, np.float32))
+            dw.flush()
+        assert dw._total_points <= 350
+        assert dw.evicted_points >= 50
+        # the first metric's window lost its chunk -> coverage advanced
+        assert dw._metrics[bytes([0, 0, 0])].complete_from is not None
+
+    def test_mid_batch_throttle_invalidates_window(self, tsdb):
+        """Rows applied before a PleaseThrottleError never reach the
+        window; serving from it afterwards would silently drop them."""
+        from opentsdb_tpu.core.errors import PleaseThrottleError
+
+        _load(tsdb, series=2)
+        muid = tsdb.metrics.get_id("m.cpu")
+        orig = tsdb.store.put_many
+
+        def throttling(*a, **k):
+            e = PleaseThrottleError("full")
+            e.partial_existed = []
+            raise e
+
+        tsdb.store.put_many = throttling
+        try:
+            with pytest.raises(PleaseThrottleError):
+                tsdb.add_batch("m.cpu",
+                               BT + 90000 + np.arange(5, dtype=np.int64),
+                               np.arange(5.0), {"host": "h0",
+                                                "dc": "west"})
+        finally:
+            tsdb.store.put_many = orig
+        assert tsdb.devwindow.columns(muid, BT, BT + 7200) is None
+
+    def test_timespan_beyond_int32_marks_dirty(self, tsdb):
+        """>68 years from the metric's epoch would wrap the int32 rel
+        column; the window must fall back, not mis-bucket."""
+        dw = DeviceWindow(staging_points=10, background=False)
+        muid = b"\x00\x00\x07"
+        dw.append(muid, b"sk", np.arange(20, dtype=np.int64),
+                  np.ones(20, np.float32))
+        dw.append(muid, b"sk",
+                  np.int64(2**31) + 100 + np.arange(20, dtype=np.int64),
+                  np.ones(20, np.float32))
+        dw.flush()
+        assert dw._metrics[muid].dirty
+        assert dw.columns(muid, 0, 2**31 + 200) is None
+
+    def test_invalidate_drops_metric(self, tsdb):
+        _load(tsdb, series=2)
+        muid = tsdb.metrics.get_id("m.cpu")
+        assert tsdb.devwindow.columns(muid, BT, BT + 7200) is not None
+        tsdb.devwindow.invalidate(muid)
+        assert tsdb.devwindow.columns(muid, BT, BT + 7200) is None
+
+    def test_mesh_executor_skips_window(self, tsdb):
+        _load(tsdb, series=2)
+        ex = QueryExecutor(tsdb, backend="tpu", mesh=object())
+        assert ex._run_devwindow(
+            QuerySpec("m.cpu", {}, "sum", downsample=(600, "avg")),
+            BT, BT + 7200, __import__(
+                "opentsdb_tpu.query.aggregators",
+                fromlist=["Aggregators"]).Aggregators.get("sum")) is None
+
+
+class TestWarmup:
+    def test_warm_from_existing_storage(self, tmp_path):
+        """A restarted TSDB (WAL replay) must re-cover pre-existing data
+        so the window serves history from before the process started."""
+        from opentsdb_tpu.storage.kv import MemKVStore
+
+        cfg = Config(auto_create_metrics=True, enable_sketches=False,
+                     wal_path=str(tmp_path / "wal"))
+        t1 = TSDB(MemKVStore(wal_path=cfg.wal_path), cfg,
+                  start_compaction_thread=False)
+        _load(t1, series=3)
+        t1.shutdown()
+
+        t2 = TSDB(MemKVStore(wal_path=cfg.wal_path), cfg,
+                  start_compaction_thread=False)
+        try:
+            _compare(t2, QuerySpec("m.cpu", {"host": "*"}, "sum",
+                                   downsample=(600, "avg")))
+        finally:
+            t2.compactionq.shutdown()
+
+
+class TestStats:
+    def test_counters_flow(self, tsdb):
+        _load(tsdb, series=2)
+        ex = QueryExecutor(tsdb, backend="tpu")
+        ex.run(QuerySpec("m.cpu", {}, "sum", downsample=(600, "avg")),
+               BT, BT + 7200)
+        lines = []
+
+        class C:
+            def record(self, name, value, tag=None):
+                lines.append((name, value))
+
+        tsdb.collect_stats(C())
+        names = {n for n, _ in lines}
+        assert "devwindow.points.appended" in names
+        assert "devwindow.hits" in names
+        appended = dict(lines)["devwindow.points.appended"]
+        assert appended == 2 * 200
