@@ -225,6 +225,20 @@ def bench_queries(tsdb, series, base, span, interval=3600):
     out["window_hits"] = ((tsdb.devwindow.window_hits - hits + 1)
                           if tsdb.devwindow else 0)
 
+    # Roofline accounting: the fused query kernel is HBM-bound — its
+    # working set is one read of the resident columns (ts+val+sid+valid
+    # = 13 B/point) plus the [S, B] grid intermediates. Achieved GB/s =
+    # bytes / resident time, against the chip's peak HBM bandwidth
+    # (v5e ~819 GB/s) — says how far from the memory roof each config
+    # lands.
+    from opentsdb_tpu.query.executor import _pad_size
+    n_dev = sum(len(s[0]) for s in series)
+    grid_cells = _pad_size(S) * _pad_size(span // interval + 1)
+    bytes_moved = n_dev * 13 + 3 * grid_cells * 4  # cols + S*B grids
+    out["bytes_moved"] = bytes_moved
+    out["c1_achieved_gbps"] = bytes_moved / out["c1_resident_s"] / 1e9
+    out["c2_achieved_gbps"] = bytes_moved / out["c2_resident_s"] / 1e9
+
     # Cold path once: disable the window so config 1 runs the full
     # scan -> decode -> upload -> kernel pipeline.
     dw, tsdb.devwindow = tsdb.devwindow, None
@@ -380,7 +394,8 @@ def main() -> int:
         f"  resident {q['c1_resident_s']*1e3:.1f} ms | cold scan path "
         f"{q['c1_cold_scan_s']:.2f} s | oracle(projected) "
         f"{q['c1_oracle_s']:.2f} s | "
-        f"{q['c1_oracle_s']/q['c1_resident_s']:.0f}x")
+        f"{q['c1_oracle_s']/q['c1_resident_s']:.0f}x | "
+        f"{q['c1_achieved_gbps']:.0f} GB/s of ~819 peak")
     log(f"config 2: rate+sum through downsampler ...\n"
         f"  resident {q['c2_resident_s']*1e3:.1f} ms | oracle(projected) "
         f"{q['c2_oracle_s']:.2f} s | "

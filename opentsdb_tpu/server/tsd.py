@@ -40,6 +40,17 @@ from opentsdb_tpu.query.grammar import parse_m
 from opentsdb_tpu.server import logbuffer
 from opentsdb_tpu.stats.collector import LatencyDigest, StatsCollector
 from opentsdb_tpu.utils import timeparse
+from typing import NamedTuple
+
+
+class HttpRequest(NamedTuple):
+    """What an HttpRpc handler sees (the reference's HttpQuery analog,
+    src/tsd/HttpQuery.java, reduced to the parsed request surface)."""
+    method: str
+    path: str
+    q: dict                    # last-value-wins query params
+    params: dict               # full multi-value query params
+    query_string: str
 
 LOG = logging.getLogger(__name__)
 
@@ -109,6 +120,7 @@ class TSDServer:
         self.cache_hits = 0
         self.cache_misses = 0
         self.start_time = int(time.time())
+        self._register_default_commands()
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -273,33 +285,78 @@ class TSDServer:
         self.put_latency.add((time.time() - t0) * 1000)
         await writer.drain()
 
+    # ------------------------------------------------------------------
+    # Command registries (the reference's TelnetRpc/HttpRpc SPIs,
+    # src/tsd/TelnetRpc.java:22 / HttpRpc.java:20 / RpcHandler.java
+    # :66-103 — but as plain dicts a deployment can extend at runtime).
+    # ------------------------------------------------------------------
+
+    def register_telnet(self, command: str, handler) -> None:
+        """Register ``handler(words, writer) -> bool | None`` for a
+        telnet command; returning False closes the connection."""
+        self.telnet_commands[command] = handler
+
+    def register_http(self, route: str, handler) -> None:
+        """Register ``async handler(req) -> (status, ctype, body,
+        headers)`` for an exact path (no trailing slash)."""
+        self.http_routes[route] = handler
+
+    def _register_default_commands(self) -> None:
+        self.telnet_commands = {
+            "put": self._telnet_put,
+            "version": lambda words, writer: writer.write(
+                self._version_text().encode()),
+            "stats": lambda words, writer: writer.write(
+                ("\n".join(self._collect_stats()) + "\n").encode()),
+            "help": lambda words, writer: writer.write((
+                "available commands: "
+                + " ".join(sorted(self.telnet_commands))
+                + "\n").encode()),
+            "exit": lambda words, writer: False,
+            "dropcaches": self._cmd_dropcaches,
+            "diediedie": self._cmd_diediedie,
+        }
+        self.http_routes = {
+            "/": self._http_home,
+            "/aggregators": self._http_aggregators,
+            "/version": self._http_version,
+            "/stats": self._http_stats,
+            "/logs": self._http_logs,
+            "/suggest": lambda req: self._suggest(req.q),
+            "/q": lambda req: self._query(req.q, req.query_string,
+                                          req.params),
+            "/distinct": lambda req: self._distinct(req.q),
+            "/sketch": lambda req: self._sketch(req.q),
+            "/forecast": lambda req: self._forecast(req.q, req.params),
+            "/dropcaches": self._http_dropcaches,
+            "/diediedie": self._http_diediedie,
+            "/favicon.ico": self._http_favicon,
+        }
+
+    def _cmd_dropcaches(self, words, writer):
+        self.tsdb.drop_caches()
+        writer.write(b"Caches dropped.\n")
+
+    def _cmd_diediedie(self, words, writer):
+        writer.write(b"Cleaning up and exiting now.\n")
+        self.request_shutdown()
+        return False
+
     async def _telnet_command(self, words: list[str], writer) -> bool:
         """Dispatch one telnet command; False closes the connection."""
-        cmd = words[0]
-        if cmd == "put":
-            self._telnet_put(words, writer)
-        elif cmd == "version":
-            writer.write(self._version_text().encode())
-        elif cmd == "stats":
-            writer.write(("\n".join(self._collect_stats()) + "\n").encode())
-        elif cmd == "help":
-            writer.write((
-                "available commands: put stats dropcaches version help "
-                "exit diediedie\n").encode())
-        elif cmd == "exit":
-            return False
-        elif cmd == "dropcaches":
-            self.tsdb.drop_caches()
-            writer.write(b"Caches dropped.\n")
-        elif cmd == "diediedie":
-            writer.write(b"Cleaning up and exiting now.\n")
-            self.request_shutdown()
-            return False
-        else:
+        handler = self.telnet_commands.get(words[0])
+        if handler is None:
             self.rpcs_unknown += 1
-            writer.write(f"unknown command: {cmd}\n".encode())
+            writer.write(f"unknown command: {words[0]}\n".encode())
+            await writer.drain()
+            return True
+        out = handler(words, writer)
+        if asyncio.iscoroutine(out):
+            out = await out
+        # Per-command backpressure: a slow reader pipelining commands
+        # must throttle the loop, not grow the transport buffer.
         await writer.drain()
-        return True
+        return out is not False
 
     def _telnet_put(self, words: list[str], writer) -> None:
         """Parity: reference PutDataPointRpc.importDataPoint (:93-123)."""
@@ -455,67 +512,73 @@ class TSDServer:
         if path.startswith("/s/") or path == "/s":
             return self._static_file(path[2:].lstrip("/"))
         route = path.rstrip("/") or "/"
-        if route == "/":
-            # Serve the query UI (reference: HomePage bootstraps the GWT
-            # client, RpcHandler.java:304-317) with its no-cache header so
-            # UI updates take effect immediately.
-            status, ctype, body, hdrs = self._static_file("index.html")
-            if status == 200:
-                # Force no-cache whatever the file's source (an operator
-                # staticroot copy would otherwise carry the year-long /s
-                # header).
-                return (status, ctype, body,
-                        dict(hdrs, **{"Cache-Control": "no-cache"}))
-            return (200, "text/html; charset=UTF-8",
-                    self._homepage().encode(), {})
-        if route == "/aggregators":
+        handler = self.http_routes.get(route)
+        if handler is None:
+            self.rpcs_unknown += 1
+            return 404, "text/plain", b"Page Not Found\n", {}
+        req = HttpRequest(method=method, path=path, q=q, params=params,
+                          query_string=parsed.query)
+        out = handler(req)
+        if asyncio.iscoroutine(out):
+            out = await out
+        return out
+
+    # -- built-in HTTP handlers ----------------------------------------
+
+    def _http_home(self, req) -> tuple:
+        # Serve the query UI (reference: HomePage bootstraps the GWT
+        # client, RpcHandler.java:304-317) with a no-cache header so UI
+        # updates take effect immediately (an operator staticroot copy
+        # would otherwise carry the year-long /s header).
+        status, ctype, body, hdrs = self._static_file("index.html")
+        if status == 200:
+            return (status, ctype, body,
+                    dict(hdrs, **{"Cache-Control": "no-cache"}))
+        return (200, "text/html; charset=UTF-8",
+                self._homepage().encode(), {})
+
+    def _http_aggregators(self, req) -> tuple:
+        return (200, "application/json",
+                json.dumps(Aggregators.available()).encode(), {})
+
+    def _http_version(self, req) -> tuple:
+        if "json" in req.q:
+            info = dict(build_data(), start_time=self.start_time)
             return (200, "application/json",
-                    json.dumps(Aggregators.available()).encode(), {})
-        if route == "/version":
-            if "json" in q:
-                info = dict(build_data(), start_time=self.start_time)
-                return (200, "application/json",
-                        json.dumps(info).encode(), {})
-            return 200, "text/plain", self._version_text().encode(), {}
-        if route == "/stats":
-            lines = self._collect_stats()
-            if "json" in q:
-                return (200, "application/json",
-                        json.dumps(lines).encode(), {})
-            return 200, "text/plain", ("\n".join(lines) + "\n").encode(), {}
-        if route == "/logs":
-            logbuffer_lines = self.log_ring.formatted()
-            if "level" in q:
-                try:
-                    logbuffer.set_level(q["level"])
-                except ValueError as e:
-                    raise BadRequestError(str(e)) from None
-            if "json" in q:
-                return (200, "application/json",
-                        json.dumps(logbuffer_lines).encode(), {})
-            return (200, "text/plain",
-                    ("\n".join(logbuffer_lines) + "\n").encode(), {})
-        if route == "/suggest":
-            return self._suggest(q)
-        if route == "/q":
-            return await self._query(q, parsed.query, params)
-        if route == "/distinct":
-            return await self._distinct(q)
-        if route == "/sketch":
-            return await self._sketch(q)
-        if route == "/forecast":
-            return await self._forecast(q, params)
-        if route == "/dropcaches":
-            self.tsdb.drop_caches()
-            return 200, "text/plain", b"Caches dropped.\n", {}
-        if route == "/diediedie":
-            self.request_shutdown()
-            return (200, "text/html; charset=UTF-8",
-                    b"Cleaning up and exiting now.\n", {})
-        if route == "/favicon.ico":
-            return 404, "text/plain", b"", {}
-        self.rpcs_unknown += 1
-        return 404, "text/plain", b"Page Not Found\n", {}
+                    json.dumps(info).encode(), {})
+        return 200, "text/plain", self._version_text().encode(), {}
+
+    def _http_stats(self, req) -> tuple:
+        lines = self._collect_stats()
+        if "json" in req.q:
+            return (200, "application/json",
+                    json.dumps(lines).encode(), {})
+        return 200, "text/plain", ("\n".join(lines) + "\n").encode(), {}
+
+    def _http_logs(self, req) -> tuple:
+        logbuffer_lines = self.log_ring.formatted()
+        if "level" in req.q:
+            try:
+                logbuffer.set_level(req.q["level"])
+            except ValueError as e:
+                raise BadRequestError(str(e)) from None
+        if "json" in req.q:
+            return (200, "application/json",
+                    json.dumps(logbuffer_lines).encode(), {})
+        return (200, "text/plain",
+                ("\n".join(logbuffer_lines) + "\n").encode(), {})
+
+    def _http_dropcaches(self, req) -> tuple:
+        self.tsdb.drop_caches()
+        return 200, "text/plain", b"Caches dropped.\n", {}
+
+    def _http_diediedie(self, req) -> tuple:
+        self.request_shutdown()
+        return (200, "text/html; charset=UTF-8",
+                b"Cleaning up and exiting now.\n", {})
+
+    def _http_favicon(self, req) -> tuple:
+        return 404, "text/plain", b"", {}
 
     def _suggest(self, q) -> tuple:
         kind = q.get("type", "metrics")

@@ -549,3 +549,48 @@ class TestHttpKeepAlive:
             assert st == 400 and b"text/plain" in head.lower()
 
         run_async(server, drive)
+
+
+class TestRpcRegistry:
+    """The TelnetRpc/HttpRpc SPI analog: deployments extend the command
+    registries at runtime (reference src/tsd/TelnetRpc.java:22,
+    HttpRpc.java:20 — there via interface implementations wired into
+    RpcHandler's maps)."""
+
+    def test_register_telnet_command(self, server_env):
+        server, _ = server_env
+        server.register_telnet(
+            "ping", lambda words, writer: writer.write(
+                f"pong {' '.join(words[1:])}\n".encode()))
+
+        async def drive(port):
+            return await telnet(port, ["ping a b"], read_bytes=64)
+
+        assert run_async(server, drive) == b"pong a b\n"
+
+    def test_register_http_route(self, server_env):
+        server, _ = server_env
+
+        async def whoami(req):
+            return (200, "application/json",
+                    json.dumps({"path": req.path,
+                                "q": req.q}).encode(), {})
+
+        server.register_http("/whoami", whoami)
+
+        async def drive(port):
+            return await http_get(port, "/whoami?x=1")
+
+        status, _, body = run_async(server, drive)
+        assert status == 200
+        assert json.loads(body) == {"path": "/whoami", "q": {"x": "1"}}
+
+    def test_help_lists_registered_commands(self, server_env):
+        server, _ = server_env
+        server.register_telnet("ping", lambda w, wr: None)
+
+        async def drive(port):
+            return await telnet(port, ["help"], read_bytes=256)
+
+        out = run_async(server, drive).decode()
+        assert "ping" in out and "put" in out and "diediedie" in out

@@ -57,7 +57,10 @@ class TestLogsLevelParam:
             try:
                 reader, writer = await asyncio.open_connection(
                     "127.0.0.1", server.port)
-                writer.write(b"GET /logs?level=bogus HTTP/1.1\r\n\r\n")
+                # Connection: close — the server keeps HTTP/1.1
+                # connections alive, so a bare read-to-EOF would hang.
+                writer.write(b"GET /logs?level=bogus HTTP/1.1\r\n"
+                             b"Connection: close\r\n\r\n")
                 await writer.drain()
                 data = await reader.read()
                 writer.close()
