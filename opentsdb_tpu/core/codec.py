@@ -215,6 +215,13 @@ def parse_row_key(key: bytes) -> ParsedRowKey:
     return ParsedRowKey(metric, base_ts, tuple(tags))
 
 
+def key_base_time(key: bytes) -> int:
+    """Just the base-time field of a row key — the scan hot loop calls
+    this per row, where parse_row_key's full tag-tuple build would be
+    ~3x the row's entire decode budget."""
+    return _UINT32.unpack(key[UID_WIDTH:UID_WIDTH + TIMESTAMP_BYTES])[0]
+
+
 def series_key(key: bytes) -> bytes:
     """The row key minus its base-time bytes: identifies one time series.
 

@@ -362,7 +362,7 @@ class TSDB:
         """Decode one row (possibly multi-cell) into sorted columnar arrays."""
         if cells is None:
             cells = self.store.get(self.table, key, FAMILY)
-        base_ts = codec.parse_row_key(key).base_time
+        base_ts = codec.key_base_time(key)
         kept = [c for c in cells
                 if len(c.qualifier) % 2 == 0 and c.qualifier]
         if not kept:
@@ -426,7 +426,7 @@ class TSDB:
         for cells in self.store.scan(self.table, start_key, stop_key,
                                      family=FAMILY, key_regexp=key_regexp):
             key = cells[0].key
-            base = codec.parse_row_key(key).base_time
+            base = codec.key_base_time(key)
             kept = 0
             for c in cells:
                 if len(c.qualifier) % 2 != 0 or not c.qualifier:
