@@ -210,9 +210,18 @@ class QueryExecutor:
         groups = self._find_spans(spec, start, end)
         self.scan_latency.add((_time.time() - t0) * 1000)
         gkeys = sorted(groups)
+        # Ranges wider than int32 seconds (>68 years, e.g. start=0
+        # "all-time" against year-2106 timestamps) would wrap the int32
+        # rel-timestamp offsets the kernels use; the float64 oracle
+        # serves them instead (they are rare and scan-bound anyway).
+        use_cpu = self.backend == "cpu"
+        if not use_cpu:
+            qbase = (start - start % spec.downsample[0]
+                     if spec.downsample else start)
+            use_cpu = end - qbase > 2**31 - 1
         # Wide group-bys on the TPU backend batch into ONE kernel call
         # (two segment reductions for all groups) instead of G calls.
-        if (self.backend != "cpu" and len(gkeys) > 1 and spec.downsample
+        if (not use_cpu and len(gkeys) > 1 and spec.downsample
                 and agg.kind == "moment"):
             per_group = self._run_tpu_multigroup(
                 spec, [groups[k] for k in gkeys], start, end)
@@ -224,7 +233,7 @@ class QueryExecutor:
             tags, aggregated = self._group_tags(spans)
             if per_group is not None:
                 ts, vals = per_group[gi]
-            elif self.backend == "cpu":
+            elif use_cpu:
                 ts, vals = self._run_cpu(spec, spans, start)
             else:
                 ts, vals = self._run_tpu(spec, spans, start, end)
@@ -248,6 +257,15 @@ class QueryExecutor:
                 or not spec.downsample
                 or agg.kind not in ("moment", "percentile")):
             return None
+        interval, dsagg = spec.downsample
+        qbase = start - start % interval
+        imin, imax = -(2**31), 2**31 - 1
+        # Rebased in-range timestamps span up to end - qbase; past int32
+        # they would wrap in the kernels. Checked BEFORE touching the
+        # window: dw.columns() forces a staged upload + drain, wasted on
+        # a query that can never be served from it.
+        if end - qbase > imax:
+            return None
         from opentsdb_tpu.core.errors import NoSuchUniqueName
         try:
             metric_uid = self.tsdb.metrics.get_id(spec.metric)
@@ -264,8 +282,14 @@ class QueryExecutor:
         if agg.kind == "percentile" and len(groups) > 1:
             return None
 
-        interval, dsagg = spec.downsample
-        qbase = start - start % interval
+        # The shift (qbase - epoch) participates in arithmetic on device
+        # (rel_ts - shift in window_mask) — unlike lo/hi, which are
+        # comparison-only and clamp safely. If it doesn't fit in int32
+        # (e.g. an all-time query against a metric whose epoch is past
+        # 2^31), fall back to the scan path rather than silently
+        # mis-bucketing (devstore's exact-or-fall-back contract).
+        if not imin <= qbase - cols.epoch <= imax:
+            return None
         num_buckets = _pad_size(int((end - qbase) // interval + 1))
         S_all = len(cols.series_keys)
         S_pad = _pad_size(S_all)
@@ -277,7 +301,6 @@ class QueryExecutor:
             for sid in groups[gkey]:
                 include[sid] = True
                 gmap[sid] = gi
-        imin, imax = -(2**31), 2**31 - 1
         # One fused jit for the whole query: on a remote-device
         # transport, chaining separate kernels pays an N-proportional
         # cost per large intermediate (see kernels.window_query).

@@ -68,7 +68,7 @@ class _MetricWindow:
     __slots__ = ("sids", "keys", "last_ts", "epoch", "chunks",
                  "staged_ts", "staged_vals", "staged_sid", "staged_n",
                  "dirty", "complete_from", "concat", "generation",
-                 "device_points")
+                 "device_points", "inflight")
 
     def __init__(self) -> None:
         self.sids: dict[bytes, int] = {}
@@ -85,6 +85,7 @@ class _MetricWindow:
         self.concat: DevColumns | None = None
         self.generation = 0
         self.device_points = 0
+        self.inflight = 0               # taken-but-not-uploaded batches
 
 
 class DeviceWindow:
@@ -107,6 +108,10 @@ class DeviceWindow:
 
         self._pending: _queue.Queue = _queue.Queue(maxsize=2)
         self._uploader: threading.Thread | None = None
+        # Per-metric upload completion: queries wait only for THEIR
+        # metric's in-flight batches, not the whole queue (joining the
+        # global queue couples query latency to unrelated ingest bursts).
+        self._cond = threading.Condition(self._lock)
         # Global residency accounting: max_points caps the SUM across
         # metrics (the HBM budget is per chip, not per metric); chunks
         # carry an upload sequence number so eviction picks the oldest
@@ -168,20 +173,38 @@ class DeviceWindow:
 
     def _take_staged(self, mw: _MetricWindow):
         """Swap the staged batch out (caller holds _lock); the returned
-        work item is submitted outside the lock."""
+        work item is submitted outside the lock. The upload sequence
+        number is assigned HERE, under the lock, so racing producers
+        can't enqueue a metric's batches out of time order (_upload
+        inserts by seq; eviction relies on chunks[0] being oldest)."""
         if mw.staged_n == 0:
             return None
         batch = (mw.staged_ts, mw.staged_vals, mw.staged_sid,
                  mw.staged_n)
         mw.staged_ts, mw.staged_vals, mw.staged_sid = [], [], []
         mw.staged_n = 0
-        return (mw, batch)
+        mw.inflight += 1
+        seq = self._seq
+        self._seq += 1
+        return (mw, batch, seq)
+
+    def _run_upload(self, work) -> None:
+        """Execute one upload on the calling thread with full failure
+        handling (dirty-mark under the lock) and completion signalling.
+        Must be called without _lock."""
+        try:
+            self._upload(*work)
+        except Exception:  # pragma: no cover - device failure
+            with self._lock:
+                self._mark_dirty(work[0])
+        finally:
+            self._upload_done(work[0])
 
     def _submit(self, work) -> None:
-        """Queue one (mw, batch) for the uploader thread, or upload
+        """Queue one (mw, batch, seq) for the uploader thread, or upload
         inline when background=False. Must be called without _lock."""
         if not self.background:
-            self._upload(*work)
+            self._run_upload(work)
             return
         if self._uploader is None:
             with self._lock:
@@ -194,15 +217,22 @@ class DeviceWindow:
 
     def _upload_loop(self) -> None:
         while True:
-            mw, batch = self._pending.get()
+            work = self._pending.get()
             try:
-                self._upload(mw, batch)
-            except Exception:  # pragma: no cover - device failure
-                mw.dirty = True  # window no longer complete: fall back
+                # _run_upload dirty-marks under the lock on failure: a
+                # bare flag write would leave resident chunks counting
+                # toward _total_points forever (a dead window holding
+                # HBM and forcing eviction of healthy metrics).
+                self._run_upload(work)
             finally:
                 self._pending.task_done()
 
-    def _upload(self, mw: _MetricWindow, batch) -> None:
+    def _upload_done(self, mw: _MetricWindow) -> None:
+        with self._cond:
+            mw.inflight -= 1
+            self._cond.notify_all()
+
+    def _upload(self, mw: _MetricWindow, batch, seq: int) -> None:
         """Upload one staged batch as a padded immutable chunk."""
         import jax
 
@@ -228,15 +258,22 @@ class DeviceWindow:
         chunk = {
             "ts": jax.device_put(rel), "vals": jax.device_put(vals),
             "sid": jax.device_put(sid), "valid": jax.device_put(valid),
-            "n": n, "pad": pad,
+            "n": n, "pad": pad, "seq": seq,
             "min_ts": int(ts.min()), "max_ts": int(ts.max()),
         }
         with self._lock:
             if mw.dirty:  # marked dirty while we were copying
                 return
-            chunk["seq"] = self._seq
-            self._seq += 1
-            mw.chunks.append(chunk)
+            # Insert in seq order (assigned at _take_staged time). Two
+            # things can land out of order here: racing producers whose
+            # _pending.put() (outside the lock) inverts their take
+            # order, and a query-side inline upload (columns()) racing
+            # the background worker. Eviction relies on chunks[0] being
+            # the metric's oldest.
+            pos = len(mw.chunks)
+            while pos > 0 and mw.chunks[pos - 1]["seq"] > seq:
+                pos -= 1
+            mw.chunks.insert(pos, chunk)
             mw.device_points += n
             self._total_points += n
             mw.concat = None
@@ -307,12 +344,21 @@ class DeviceWindow:
                 self.window_misses += 1
                 return None
             work = self._take_staged(mw)
-        # Submit + drain OUTSIDE the lock (the uploader takes the lock
+        # Upload + drain OUTSIDE the lock (the uploader takes the lock
         # to append chunks); then re-check under the lock — the drain
         # can mark dirty (upload failure) or advance complete_from.
+        # The query's own staged batch uploads INLINE on this thread
+        # (not via _submit): the bounded queue may be full of other
+        # metrics' uploads, and blocking a query on those would couple
+        # its latency to unrelated ingest bursts. Then wait on THIS
+        # metric's in-flight count, not the global queue. Residual
+        # coupling: a batch of this metric already sitting in the queue
+        # still drains FIFO behind whatever is ahead of it.
         if work is not None:
-            self._submit(work)
-        self._pending.join()
+            self._run_upload(work)
+        with self._cond:
+            while mw.inflight > 0:
+                self._cond.wait()
         with self._lock:
             if mw.dirty:
                 self.dirty_fallbacks += 1

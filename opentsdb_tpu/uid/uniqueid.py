@@ -25,6 +25,11 @@ NAME_FAMILY = b"name"
 MAXID_ROW = b"\x00"
 MAX_ATTEMPTS_ASSIGN_ID = 3
 MAX_SUGGESTIONS = 25
+# Bound on cache entries opportunistically added by suggest/grep scans:
+# an admin grep over a huge UID set must not permanently bloat the
+# daemon's caches (lookup-path entries stay unbounded by design — they
+# are sized by the series the daemon actually serves).
+SCAN_CACHE_MAX = 65536
 
 KINDS = ("metrics", "tagk", "tagv")
 
@@ -169,8 +174,12 @@ class UniqueId:
                 if c.qualifier == self._kindb:
                     name = c.key.decode("iso-8859-1")
                     uid = c.value
-                    self._id_cache.setdefault(name, uid)
-                    self._name_cache.setdefault(uid, name)
+                    # Opportunistic cache warm, bounded: unbounded
+                    # setdefault here let one large grep permanently
+                    # grow both dicts (round-2 advisor finding).
+                    if len(self._id_cache) < SCAN_CACHE_MAX:
+                        self._id_cache.setdefault(name, uid)
+                        self._name_cache.setdefault(uid, name)
                     out.append(name)
                     if len(out) >= limit:
                         return out

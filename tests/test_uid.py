@@ -152,6 +152,23 @@ class TestSuggest:
         uid.get_or_create_id("a\xffb")
         assert uid.suggest("a\xff") == ["a\xffb"]
 
+    def test_scan_cache_population_is_bounded(self, uid, monkeypatch):
+        """An admin grep/suggest over a large UID set must not
+        permanently grow the caches past the scan bound (round-2
+        advisor finding: unbounded setdefault per scanned name)."""
+        from opentsdb_tpu.uid import uniqueid as uid_mod
+
+        for i in range(60):
+            uid.get_or_create_id(f"bulk.{i:03d}")
+        uid.drop_caches()
+        monkeypatch.setattr(uid_mod, "SCAN_CACHE_MAX", 10)
+        assert len(uid.suggest("bulk", limit=60)) == 60
+        # id cache stops at the bound; name cache tracks it.
+        assert len(uid._id_cache) <= 10
+        assert len(uid._name_cache) <= 10
+        # lookups still work (straight from storage) and cache normally
+        assert uid.get_id("bulk.042") is not None
+
 
 class TestRename:
     def test_rename(self, uid):
