@@ -220,9 +220,10 @@ class QueryExecutor:
                      if spec.downsample else start)
             use_cpu = end - qbase > 2**31 - 1
         # Wide group-bys on the TPU backend batch into ONE kernel call
-        # (two segment reductions for all groups) instead of G calls.
+        # (two segment reductions for all groups — or the grouped radix
+        # select for percentiles) instead of G calls.
         if (not use_cpu and len(gkeys) > 1 and spec.downsample
-                and agg.kind == "moment"):
+                and agg.kind in ("moment", "percentile")):
             per_group = self._run_tpu_multigroup(
                 spec, [groups[k] for k in gkeys], start, end)
         else:
@@ -251,7 +252,7 @@ class QueryExecutor:
         filters the series directory and uploads an [S]-sized group map.
         Returns None to fall back to the scan path (CPU backend,
         un-downsampled queries, dirty/evicted windows, unknown UIDs,
-        multi-group percentiles)."""
+        out-of-int32 epochs/ranges)."""
         dw = getattr(self.tsdb, "devwindow", None)
         if (dw is None or self.backend == "cpu" or self.mesh is not None
                 or not spec.downsample
@@ -279,8 +280,6 @@ class QueryExecutor:
             metric_uid, cols, exact, group_bys)
         if not groups:
             return []
-        if agg.kind == "percentile" and len(groups) > 1:
-            return None
 
         # The shift (qbase - epoch) participates in arithmetic on device
         # (rel_ts - shift in window_mask) — unlike lo/hi, which are
@@ -301,22 +300,52 @@ class QueryExecutor:
             for sid in groups[gkey]:
                 include[sid] = True
                 gmap[sid] = gi
-        # One fused jit for the whole query: on a remote-device
-        # transport, chaining separate kernels pays an N-proportional
-        # cost per large intermediate (see kernels.window_query).
-        gv, gm, presence = kernels.window_query(
-            cols.rel_ts, cols.values, cols.sid, cols.valid, include,
-            gmap,
-            np.int32(min(max(start - cols.epoch, imin), imax)),
-            np.int32(min(max(end - cols.epoch, imin), imax)),
-            np.int32(min(max(qbase - cols.epoch, imin), imax)),
-            np.array([agg.quantile if agg.kind == "percentile" else 0.0],
-                     np.float32),
-            num_series=S_pad, num_groups=(1 if len(gkeys) == 1 else G),
-            num_buckets=num_buckets, interval=interval, agg_down=dsagg,
-            agg_group=(spec.aggregator if agg.kind == "moment"
-                       else "count"),
-            quantile=agg.kind == "percentile", **self._rate_kw(spec))
+        lo32 = np.int32(min(max(start - cols.epoch, imin), imax))
+        hi32 = np.int32(min(max(end - cols.epoch, imin), imax))
+        shift32 = np.int32(qbase - cols.epoch)
+        ngroups = 1 if len(gkeys) == 1 else G
+        rate_kw = self._rate_kw(spec)
+        if agg.kind == "percentile":
+            # p50/p95/p99 dashboard panels differ only in q: cache the
+            # heavy stage (masking + per-series downsample + fill) as
+            # DEVICE-resident arrays and run only the quantile select
+            # per panel. The intermediates never cross the transport,
+            # so the split costs one extra dispatch, not a transfer.
+            fkey = (dw.instance_id, metric_uid, cols.version,
+                    tuple(sorted(exact)),
+                    tuple(sorted((k, tuple(v) if v else None)
+                                 for k, v in group_bys)),
+                    start, end, interval, dsagg,
+                    tuple(sorted(rate_kw.items())))
+            cache = getattr(self, "_dw_stage_cache", None)
+            if cache is None:
+                cache = self._dw_stage_cache = {}
+            stage = cache.get(fkey)
+            if stage is None:
+                stage = kernels.window_quantile_stage(
+                    cols.rel_ts, cols.values, cols.sid, cols.valid,
+                    include, lo32, hi32, shift32, num_series=S_pad,
+                    num_buckets=num_buckets, interval=interval,
+                    agg_down=dsagg, **rate_kw)
+                if len(cache) >= 4:  # a handful of HBM-sized stages
+                    cache.clear()
+                cache[fkey] = stage
+            filled, in_range, series_mask, presence = stage
+            gv, gm = kernels.window_quantile_apply(
+                filled, in_range, series_mask, gmap,
+                np.array([agg.quantile], np.float32),
+                num_groups=ngroups)
+        else:
+            # One fused jit for the whole query: on a remote-device
+            # transport, chaining separate kernels pays an
+            # N-proportional cost per large intermediate (see
+            # kernels.window_query).
+            gv, gm, presence = kernels.window_query(
+                cols.rel_ts, cols.values, cols.sid, cols.valid, include,
+                gmap, lo32, hi32, shift32,
+                num_series=S_pad, num_groups=ngroups,
+                num_buckets=num_buckets, interval=interval,
+                agg_down=dsagg, agg_group=spec.aggregator, **rate_kw)
         gv, gm = np.asarray(gv), np.asarray(gm)
         # Series with no in-range points must not shape group labels or
         # emit empty groups — match the scan path, which never sees
@@ -643,8 +672,9 @@ class QueryExecutor:
                 all_spans.append(sp)
                 group_of_sid.append(gi)
         G = _pad_size(len(span_groups))
+        agg = Aggregators.get(spec.aggregator)
         D = int(self.mesh.devices.size) if self.mesh is not None else 0
-        if D and len(all_spans) >= D:
+        if D and len(all_spans) >= D and agg.kind == "moment":
             gv, gm = self._multigroup_sharded(
                 spec, all_spans, group_of_sid, G, qbase, interval, dsagg,
                 num_buckets, D)
@@ -659,11 +689,20 @@ class QueryExecutor:
             gmap = np.zeros(S, np.int32)
             gmap[:len(group_of_sid)] = group_of_sid
             gmap[len(group_of_sid):] = G - 1
-            out = kernels.downsample_multigroup(
-                rel, vals, sid, valid, gmap,
-                num_series=S, num_groups=G,
-                num_buckets=num_buckets, interval=interval, agg_down=dsagg,
-                agg_group=spec.aggregator, **self._rate_kw(spec))
+            if agg.kind == "percentile":
+                out = kernels.downsample_multigroup_quantile(
+                    rel, vals, sid, valid, gmap,
+                    np.array([agg.quantile], np.float32),
+                    num_series=S, num_groups=G, num_buckets=num_buckets,
+                    interval=interval, agg_down=dsagg,
+                    **self._rate_kw(spec))
+            else:
+                out = kernels.downsample_multigroup(
+                    rel, vals, sid, valid, gmap,
+                    num_series=S, num_groups=G,
+                    num_buckets=num_buckets, interval=interval,
+                    agg_down=dsagg, agg_group=spec.aggregator,
+                    **self._rate_kw(spec))
             gv = np.asarray(out["group_values"])
             gm = np.asarray(out["group_mask"])
         results = []

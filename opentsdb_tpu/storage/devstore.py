@@ -62,6 +62,8 @@ class DevColumns(NamedTuple):
     epoch: int              # int64 base the rel timestamps offset from
     series_keys: list       # sid -> series_key bytes
     generation: int         # bumps when the directory grows
+    version: int            # bumps on ANY data change (new/evicted
+    #                         chunks) — derived-result cache key
 
 
 class _MetricWindow:
@@ -91,9 +93,16 @@ class _MetricWindow:
 class DeviceWindow:
     """Thread-safe store of per-metric device-resident columns."""
 
+    _instances = 0
+
     def __init__(self, staging_points: int = 1 << 20,
                  max_points: int = 1 << 26,
                  background: bool = True) -> None:
+        # Process-unique instance token: DevColumns.version counters
+        # restart at 0 in a replacement window, so derived-result caches
+        # key on (instance_id, version) to survive window swaps.
+        DeviceWindow._instances += 1
+        self.instance_id = DeviceWindow._instances
         self.staging_points = staging_points
         self.max_points = max_points
         self.background = background
@@ -118,6 +127,7 @@ class DeviceWindow:
         # chunk fleet-wide.
         self._total_points = 0
         self._seq = 0
+        self._concat_version = 0
         # stats
         self.appended_points = 0
         self.evicted_points = 0
@@ -372,6 +382,7 @@ class DeviceWindow:
             if mw.concat is None or mw.concat.generation != mw.generation:
                 import jax.numpy as jnp
 
+                self._concat_version += 1
                 mw.concat = DevColumns(
                     rel_ts=jnp.concatenate(
                         [c["ts"] for c in mw.chunks]),
@@ -381,7 +392,8 @@ class DeviceWindow:
                     valid=jnp.concatenate(
                         [c["valid"] for c in mw.chunks]),
                     epoch=mw.epoch, series_keys=list(mw.keys),
-                    generation=mw.generation)
+                    generation=mw.generation,
+                    version=self._concat_version)
             self.window_hits += 1
             return mw.concat
 

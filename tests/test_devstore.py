@@ -67,6 +67,9 @@ class TestScanPathParity:
         QuerySpec("m.cpu", {}, "sum", rate=True, counter=True,
                   counter_max=2.0**32, downsample=(600, "avg")),
         QuerySpec("m.cpu", {}, "p95", downsample=(600, "avg")),
+        QuerySpec("m.cpu", {"host": "*"}, "p95", downsample=(600, "avg")),
+        QuerySpec("m.cpu", {"dc": "*"}, "p50", rate=True,
+                  downsample=(600, "avg")),
         QuerySpec("m.cpu", {"host": "*"}, "zimsum",
                   downsample=(600, "sum")),
         QuerySpec("m.cpu", {"dc": "*", "host": "h3"}, "min",

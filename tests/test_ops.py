@@ -263,3 +263,102 @@ class TestDownsampleMultigroup:
             np.testing.assert_allclose(
                 np.asarray(out["group_values"])[g][m],
                 np.asarray(ref["group_values"])[m], rtol=2e-5, atol=1e-3)
+
+
+class TestMaskedQuantile:
+    """The radix-select quantile must match numpy bit-for-bit-ish
+    (float32 rank statistics are exact; only the lerp between ranks is
+    float arithmetic)."""
+
+    def test_matches_numpy_linear_interpolation(self):
+        rng = np.random.default_rng(3)
+        S, B = 64, 17
+        vals = rng.normal(0, 100, (S, B)).astype(np.float32)
+        vals[rng.random((S, B)) < 0.2] *= -1          # negatives
+        dup = rng.random((S, B)) < 0.3                # duplicates
+        vals[dup] = np.round(vals[dup])
+        mask = rng.random((S, B)) < 0.7
+        mask[:, 3] = False                            # empty column
+        mask[:, 5] = False
+        mask[0, 5] = True                             # single-valid column
+        q = np.array([0.0, 0.25, 0.5, 0.95, 1.0], np.float32)
+        got = np.asarray(kernels.masked_quantile_axis0(vals, mask, q))
+        for ki, qi in enumerate(q):
+            for b in range(B):
+                col = vals[:, b][mask[:, b]]
+                want = np.quantile(col.astype(np.float64), qi) if len(col) \
+                    else 0.0
+                np.testing.assert_allclose(got[ki, b], want, rtol=1e-5,
+                                           atol=1e-5)
+
+    def test_exact_ranks_with_heavy_duplicates(self):
+        vals = np.array([[1.0], [1.0], [1.0], [2.0], [5.0]], np.float32)
+        mask = np.ones((5, 1), bool)
+        got = np.asarray(kernels.masked_quantile_axis0(
+            vals, mask, np.array([0.5, 0.75], np.float32)))
+        np.testing.assert_allclose(got[:, 0], [1.0, 2.0])
+
+    def test_negative_zero_and_sign_boundary(self):
+        vals = np.array([[-2.0], [-0.0], [0.0], [3.0]], np.float32)
+        mask = np.ones((4, 1), bool)
+        got = np.asarray(kernels.masked_quantile_axis0(
+            vals, mask, np.array([0.0, 1.0, 0.5], np.float32)))
+        np.testing.assert_allclose(got[:, 0], [-2.0, 3.0, 0.0])
+
+
+class TestMultigroupQuantile:
+    """The fused multigroup percentile must equal running the
+    single-group kernels on each group's series alone."""
+
+    def _flat_groups(self, seed=0, groups=(5, 3, 1), B=16, interval=600):
+        rng = np.random.default_rng(seed)
+        ts_l, val_l, sid_l, gmap = [], [], [], []
+        sid = 0
+        for gi, nser in enumerate(groups):
+            for _ in range(nser):
+                n = int(rng.integers(10, 40))
+                ts_l.append(rng.integers(0, B * interval, n).astype(np.int32))
+                val_l.append(rng.normal(50, 15, n).astype(np.float32))
+                sid_l.append(np.full(n, sid, np.int32))
+                gmap.append(gi)
+                sid += 1
+        S = 16  # padded series count (>= sum(groups)=9)
+        G = 4   # padded group count (>= 3)
+        gm = np.full(S, G - 1, np.int32)
+        gm[:len(gmap)] = gmap
+        ts = np.concatenate(ts_l)
+        vals = np.concatenate(val_l)
+        sids = np.concatenate(sid_l)
+        valid = np.ones(len(ts), bool)
+        return ts, vals, sids, valid, gm, list(gmap), S, G, B, interval
+
+    @pytest.mark.parametrize("rate", [False, True])
+    def test_matches_per_group_path(self, rate):
+        ts, vals, sids, valid, gm, gmap, S, G, B, interval = \
+            self._flat_groups()
+        q = np.array([0.9], np.float32)
+        out = kernels.downsample_multigroup_quantile(
+            ts, vals, sids, valid, gm, q, num_series=S, num_groups=G,
+            num_buckets=B, interval=interval, agg_down="avg", rate=rate)
+        gv = np.asarray(out["group_values"])
+        gmask = np.asarray(out["group_mask"])
+        for gi in range(3):
+            members = [s for s, g in enumerate(gmap) if g == gi]
+            # renumber this group's series 0..k and run the single-group
+            # kernels on them alone
+            remap = {s: i for i, s in enumerate(members)}
+            sel = np.isin(sids, members)
+            lsid = np.array([remap[s] for s in sids[sel]], np.int32)
+            single = kernels.downsample_group(
+                ts[sel], vals[sel], lsid, valid[sel],
+                num_series=16, num_buckets=B, interval=interval,
+                agg_down="avg", agg_group="count", rate=rate)
+            fill = kernels.step_fill if rate else kernels.gap_fill
+            filled, in_range = fill(single["series_values"],
+                                    single["series_mask"], B)
+            want = np.asarray(kernels.masked_quantile_axis0(
+                filled, in_range, q))[0]
+            wmask = np.asarray(single["group_mask"])
+            np.testing.assert_array_equal(gmask[gi], wmask)
+            np.testing.assert_allclose(gv[gi][wmask], want[wmask],
+                                       rtol=1e-5, atol=1e-5)

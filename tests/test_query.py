@@ -153,6 +153,45 @@ class TestDifferential:
         np.testing.assert_allclose(t.values, c.values, rtol=5e-3,
                                    atol=0.5)
 
+    def _check_groups(self, cpu, got, n=3):
+        assert len(cpu) == len(got) == n
+        for c, t in zip(cpu, got):
+            assert c.tags == t.tags
+            np.testing.assert_array_equal(c.timestamps, t.timestamps)
+            np.testing.assert_allclose(t.values, c.values, rtol=5e-3,
+                                       atol=0.5)
+
+    def test_percentile_group_by_fused(self, tsdb):
+        """host=* percentile rides ONE fused kernel call on both the
+        devwindow and scan paths (round-2 verdict item 4: it used to
+        fall back to a per-group loop) and must match the float64
+        oracle per group."""
+        spec = QuerySpec("sys.cpu.user", {"host": "*"}, aggregator="p95",
+                         downsample=(600, "avg"))
+        cpu, tpu = run_both(tsdb, spec)  # devwindow serves the tpu leg
+        self._check_groups(cpu, tpu)
+        # Scan path: the fused multigroup quantile kernel.
+        dw, tsdb.devwindow = tsdb.devwindow, None
+        try:
+            scan = QueryExecutor(tsdb, backend="tpu").run(
+                spec, BT, BT + 7200)
+        finally:
+            tsdb.devwindow = dw
+        self._check_groups(cpu, scan)
+
+    def test_rate_percentile_group_by_fused(self, tsdb):
+        spec = QuerySpec("sys.cpu.user", {"host": "*"}, aggregator="p90",
+                         rate=True, downsample=(600, "avg"))
+        cpu, tpu = run_both(tsdb, spec)
+        self._check_groups(cpu, tpu)
+        dw, tsdb.devwindow = tsdb.devwindow, None
+        try:
+            scan = QueryExecutor(tsdb, backend="tpu").run(
+                spec, BT, BT + 7200)
+        finally:
+            tsdb.devwindow = dw
+        self._check_groups(cpu, scan)
+
 
 class TestCardinality:
     def test_distinct_tagv(self, tsdb):
