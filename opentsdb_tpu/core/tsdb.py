@@ -24,7 +24,8 @@ import numpy as np
 
 from opentsdb_tpu.core import codec, codec_np, tags as tags_mod
 from opentsdb_tpu.core.compaction import CompactionQueue
-from opentsdb_tpu.core.const import MAX_TIMESPAN
+from opentsdb_tpu.core.const import (MAX_TIMESPAN, TIMESTAMP_BYTES,
+                                     UID_WIDTH)
 from opentsdb_tpu.core.errors import PleaseThrottleError
 from opentsdb_tpu.storage.kv import KVStore
 from opentsdb_tpu.uid.uniqueid import UniqueId
@@ -271,11 +272,17 @@ class TSDB:
         cells = codec_np.encode_cells_multi(deltas, f_s, i_s, m_s,
                                             row_starts)
         metric_uid, pairs = self._row_parts(metric, tag_map)
-        tmpl = bytearray(codec.row_key(metric_uid, 0, pairs))
-        batch = []
-        for start_idx, (qual, val) in zip(row_starts, cells):
-            codec.set_base_time(tmpl, int(base[start_idx]))
-            batch.append((bytes(tmpl), qual, val))
+        tmpl = bytes(codec.row_key(metric_uid, 0, pairs))
+        # All row keys in one vectorized pass: broadcast the template,
+        # stamp the base-time bytes, slice per row. The per-row
+        # struct.pack + bytearray copy loop was ~15% of batch ingest.
+        L = len(tmpl)
+        keys = np.tile(np.frombuffer(tmpl, np.uint8), (len(cells), 1))
+        keys[:, UID_WIDTH:UID_WIDTH + TIMESTAMP_BYTES] = (
+            base[row_starts].astype(">u4").view(np.uint8).reshape(-1, 4))
+        kb = keys.tobytes()
+        batch = [(kb[i * L:(i + 1) * L], q, v)
+                 for i, (q, v) in enumerate(cells)]
         # Rows that already held cells BEFORE the put become multi-cell
         # and must be queued so the per-batch compacted cells merge into
         # one; put_many reports that per row in a single locked pass.
@@ -305,11 +312,14 @@ class TSDB:
         self.datapoints_added += n
         # Sketch fold covers fully applied batches only (a throttled
         # batch raised above); values as stored, floats and ints alike.
+        # One float32 conversion shared by both consumers (the digests
+        # quantize to f32 anyway; the window stores f32).
         skey = codec.series_key(batch[0][0])
-        self._observe(skey, metric_uid, pairs, f_s)
-        if self.devwindow is not None:
-            self.devwindow.append(metric_uid, skey, ts_s,
-                                  f_s.astype(np.float32))
+        if self.sketches is not None or self.devwindow is not None:
+            f32 = f_s.astype(np.float32)
+            self._observe(skey, metric_uid, pairs, f32)
+            if self.devwindow is not None:
+                self.devwindow.append(metric_uid, skey, ts_s, f32)
         return n
 
     # ------------------------------------------------------------------

@@ -26,6 +26,37 @@ def x_format(span: int) -> str:
     return "%Y/%m/%d"
 
 
+def _smooth_xy(ts, vals, n_sub: int = 8):
+    """Cubic smoothing of a line series — the matplotlib stand-in for
+    gnuplot's ``smooth csplines``/``bezier`` plot option (reference
+    Plot.java:233-336 forwards the query's ``smooth`` param to the plot
+    command). Piecewise cubic Hermite with finite-difference tangents
+    (Catmull-Rom-style), ``n_sub`` samples per segment; gnuplot's
+    variant names all map to this one curve (documented departure)."""
+    import numpy as np
+
+    t = np.asarray(ts, np.float64)
+    v = np.asarray(vals, np.float64)
+    if len(t) < 3 or len(t) > 10_000:  # nothing to smooth / too dense
+        return t, v
+    m = np.empty_like(v)
+    m[1:-1] = (v[2:] - v[:-2]) / np.maximum(t[2:] - t[:-2], 1e-9)
+    m[0] = (v[1] - v[0]) / max(t[1] - t[0], 1e-9)
+    m[-1] = (v[-1] - v[-2]) / max(t[-1] - t[-2], 1e-9)
+    u = np.linspace(0, 1, n_sub, endpoint=False)[None, :]
+    t0, t1 = t[:-1, None], t[1:, None]
+    v0, v1 = v[:-1, None], v[1:, None]
+    m0, m1 = m[:-1, None], m[1:, None]
+    h = t1 - t0
+    h00 = 2 * u**3 - 3 * u**2 + 1
+    h10 = u**3 - 2 * u**2 + u
+    h01 = -2 * u**3 + 3 * u**2
+    h11 = u**3 - u**2
+    st = (t0 + u * h).ravel()
+    sv = (h00 * v0 + h10 * h * m0 + h01 * v1 + h11 * h * m1).ravel()
+    return np.append(st, t[-1]), np.append(sv, v[-1])
+
+
 def _new_figure(width: int, height: int, facecolor: str = "white"):
     """Thread-safe figure construction via the object API: the server
     renders in a multi-worker pool, and pyplot's global figure registry
@@ -96,11 +127,13 @@ class Plot:
             if len(ts) == 0:
                 continue
             has_data = True
-            x = [datetime.fromtimestamp(int(t), tz=timezone.utc)
-                 for t in ts]
             style = ("--" if "dashed" in options
                      else ":" if "dotted" in options
                      else "." if "points" in options else "-")
+            if "smooth" in p and style != ".":
+                ts, vals = _smooth_xy(ts, vals)
+            x = [datetime.fromtimestamp(float(t), tz=timezone.utc)
+                 for t in ts]
             target = ax
             if "x1y2" in options:
                 if ax2 is None:

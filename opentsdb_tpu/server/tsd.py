@@ -734,7 +734,8 @@ class TSDServer:
                     f"invalid wxh parameter: {q['wxh']}") from None
         plot.set_params({k: v for k, v in q.items() if k in (
             "title", "ylabel", "yrange", "ylog", "key", "nokey",
-            "bgcolor", "fgcolor", "y2label", "y2range", "y2log")})
+            "bgcolor", "fgcolor", "y2label", "y2range", "y2log",
+            "smooth")})
         for i, r in enumerate(results):
             label = r.metric
             if r.tags:

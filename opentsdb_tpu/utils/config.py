@@ -37,7 +37,14 @@ class Config:
     enable_sketches: bool = True
     sketch_compression: int = 128       # t-digest centroids per series
     sketch_hll_p: int = 12              # 2^p registers per (metric, tagk)
-    sketch_flush_points: int = 65536    # staleness bound (buffered points)
+    # Buffered points before an automatic background fold. Large on
+    # purpose: fold cost per point falls with batch size (each series'
+    # chunk amortizes one K-centroid merge sort), and the bound is NOT a
+    # query-staleness bound — queries drain the buffer first, so answers
+    # are always exact as of the query. It only caps fold burstiness and
+    # the redundant re-fold window after a crash (checkpoint + WAL
+    # replay re-folds whatever was buffered).
+    sketch_flush_points: int = 1 << 20
 
     # device-resident columnar hot window (storage/devstore.py): recent
     # ingest kept in device HBM so steady-state queries skip the

@@ -208,6 +208,24 @@ class TestHttp:
         assert b"image/png" in head
         assert body[:8] == b"\x89PNG\r\n\x1a\n"
 
+    def test_query_png_smooth_param(self, server_env):
+        """The reference's gnuplot `smooth` query param round-trips
+        (Plot.java:233-336 forwards it to the plot command); here it
+        selects the cubic-smoothed line renderer."""
+        server, tsdb = server_env
+        tsdb.add_batch("m.x", np.arange(BT, BT + 600, 60),
+                       np.array([0, 9, 1, 8, 2, 7, 3, 6, 4, 5],
+                                float), {"a": "b"})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/q?start={BT}&end={BT + 600}&m=sum:m.x"
+                      f"&smooth=csplines&nocache")
+
+        status, head, body = run_async(server, drive)
+        assert status == 200
+        assert body[:8] == b"\x89PNG\r\n\x1a\n"
+
     def test_query_png_zoom_headers(self, server_env):
         """PNG responses carry X-Plot-Area/X-Time-Range so the web UI
         can map drag-zoom pixels to timestamps; the area must lie inside
@@ -594,3 +612,24 @@ class TestRpcRegistry:
 
         out = run_async(server, drive).decode()
         assert "ping" in out and "put" in out and "diediedie" in out
+
+
+class TestSmoothCurve:
+    """gnuplot-`smooth` stand-in: cubic Hermite resampling."""
+
+    def test_smooth_passes_through_knots(self):
+        from opentsdb_tpu.graph.plot import _smooth_xy
+        ts = np.array([0, 10, 20, 30], float)
+        vals = np.array([0.0, 5.0, 5.0, 0.0])
+        st, sv = _smooth_xy(ts, vals)
+        assert len(st) > len(ts)
+        assert (np.diff(st) > 0).all()
+        for t, v in zip(ts, vals):
+            i = int(np.argmin(np.abs(st - t)))
+            assert abs(st[i] - t) < 1e-9
+            assert abs(sv[i] - v) < 1e-9
+
+    def test_short_series_pass_through(self):
+        from opentsdb_tpu.graph.plot import _smooth_xy
+        st, sv = _smooth_xy(np.array([0.0, 1.0]), np.array([1.0, 2.0]))
+        assert len(st) == 2
