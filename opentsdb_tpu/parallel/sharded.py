@@ -33,6 +33,7 @@ from opentsdb_tpu.ops.kernels import (
     gap_fill,
     group_moments,
     masked_quantile_axis0,
+    masked_quantile_groups,
     step_fill,
 )
 from opentsdb_tpu.parallel.mesh import SERIES_AXIS
@@ -73,6 +74,21 @@ def _local_group_moments(ts, vals, sid, valid, **kw):
 
 
 _RATE_STATICS = ("rate", "counter", "drop_resets")
+
+
+def _multigroup_emission(sm, gmap, num_groups: int, num_buckets: int):
+    """Cross-chip (group, bucket) emission mask: True where some member
+    series has a real post-rate bucket there, on any chip. Shared by the
+    moment and percentile multigroup kernels so the emission invariant
+    has exactly one implementation. Runs inside shard_map."""
+    b_idx = jnp.arange(num_buckets, dtype=jnp.int32)
+    gb = gmap[:, None] * num_buckets + b_idx[None, :]
+    gn = num_groups * num_buckets + 1
+    rseg = jnp.where(sm, gb, num_groups * num_buckets).reshape(-1)
+    real = jax.ops.segment_sum(
+        sm.reshape(-1).astype(jnp.int32), rseg, gn)[:-1]
+    g_real = jax.lax.psum(real, SERIES_AXIS) > 0
+    return g_real.reshape(num_groups, num_buckets)
 
 
 @functools.partial(
@@ -237,20 +253,62 @@ def sharded_downsample_multigroup(ts, vals, sid, valid, gmap, *, mesh,
         if mx is not None:
             g_mx = jax.lax.pmax(mx, SERIES_AXIS)
         out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
-        # Emission: a (group, bucket) is real when some member series has
-        # a real post-rate bucket there, on any chip.
-        rseg = jnp.where(sm, gb, num_groups * num_buckets).reshape(-1)
-        real = jax.ops.segment_sum(
-            sm.reshape(-1).astype(jnp.int32), rseg, gn)[:-1]
-        g_real = jax.lax.psum(real, SERIES_AXIS) > 0
+        g_real = _multigroup_emission(sm, gmap, num_groups, num_buckets)
         shape = (num_groups, num_buckets)
-        return out.reshape(shape)[None], g_real.reshape(shape)[None]
+        return out.reshape(shape)[None], g_real[None]
 
     fn = jax.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(SERIES_AXIS),) * 5,
         out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
     group_values, group_mask = fn(ts, vals, sid, valid, gmap)
+    return group_values[0], group_mask[0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "series_per_shard", "num_groups",
+                     "num_buckets", "interval", "agg_down")
+    + _RATE_STATICS)
+def sharded_downsample_multigroup_quantile(
+        ts, vals, sid, valid, gmap, q, *, mesh, series_per_shard: int,
+        num_groups: int, num_buckets: int, interval: int, agg_down: str,
+        rate: bool = False, counter_max: float = 0.0,
+        reset_value: float = 0.0, counter: bool = False,
+        drop_resets: bool = False):
+    """Many group-by buckets with a PERCENTILE group stage, series-
+    sharded over chips — the quantile sibling of
+    sharded_downsample_multigroup. Per-(group, bucket) quantiles don't
+    decompose into psum-able moments, so each chip computes its local
+    series' per-bucket contributions, then all_gathers the [S_local, B]
+    blocks AND the group map over the series axis and runs the grouped
+    radix select (ops.kernels.masked_quantile_groups) on the full set —
+    the same gather shape as sharded_downsample_quantile. Returns
+    (group_values [G, B] for q[0], group_mask [G, B]) replicated."""
+
+    def shard_fn(ts, vals, sid, valid, gmap, q):
+        ts, vals, sid, valid, gmap = (
+            x[0] for x in (ts, vals, sid, valid, gmap))
+        filled, in_range, sm = _local_filled(
+            ts, vals, sid, valid, num_series=series_per_shard,
+            num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+            rate=rate, counter_max=counter_max, reset_value=reset_value,
+            counter=counter, drop_resets=drop_resets)
+        all_filled = jax.lax.all_gather(filled, SERIES_AXIS)
+        all_range = jax.lax.all_gather(in_range, SERIES_AXIS)
+        all_gmap = jax.lax.all_gather(gmap, SERIES_AXIS).reshape(-1)
+        S = all_filled.shape[0] * all_filled.shape[1]
+        gv = masked_quantile_groups(
+            all_filled.reshape(S, -1), all_range.reshape(S, -1),
+            all_gmap, q[0], num_groups=num_groups)[0]
+        g_real = _multigroup_emission(sm, gmap, num_groups, num_buckets)
+        return gv[None], g_real[None]
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(SERIES_AXIS),) * 5 + (P(),),
+        out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
+    group_values, group_mask = fn(ts, vals, sid, valid, gmap, q[None])
     return group_values[0], group_mask[0]
 
 

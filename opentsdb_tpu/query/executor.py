@@ -674,7 +674,7 @@ class QueryExecutor:
         G = _pad_size(len(span_groups))
         agg = Aggregators.get(spec.aggregator)
         D = int(self.mesh.devices.size) if self.mesh is not None else 0
-        if D and len(all_spans) >= D and agg.kind == "moment":
+        if D and len(all_spans) >= D:
             gv, gm = self._multigroup_sharded(
                 spec, all_spans, group_of_sid, G, qbase, interval, dsagg,
                 num_buckets, D)
@@ -718,13 +718,15 @@ class QueryExecutor:
                             interval: int, dsagg: str, num_buckets: int,
                             D: int):
         """Wide group-by over the mesh: series round-robin across chips
-        with a per-shard group map, psum per-(group, bucket) fan-in.
+        with a per-shard group map; psum per-(group, bucket) fan-in for
+        moments, all_gather + grouped radix select for percentiles.
         Fixes the single-device multigroup/mesh perf inversion (round-1
         advisor finding)."""
         from opentsdb_tpu.parallel.sharded import (
             pack_shards,
             shard_placement,
             sharded_downsample_multigroup,
+            sharded_downsample_multigroup_quantile,
         )
         series = [((sp.timestamps - qbase).astype(np.int64), sp.values)
                   for sp in all_spans]
@@ -736,11 +738,21 @@ class QueryExecutor:
         for (d, local), g in zip(shard_placement(len(series), D),
                                  group_of_sid):
             gmap[d, local] = g
-        gv, gm = sharded_downsample_multigroup(
-            ts, vals, sid, valid, gmap, mesh=self.mesh,
-            series_per_shard=sps_pad, num_groups=G,
-            num_buckets=num_buckets, interval=interval, agg_down=dsagg,
-            agg_group=spec.aggregator, **self._rate_kw(spec))
+        agg = Aggregators.get(spec.aggregator)
+        if agg.kind == "percentile":
+            gv, gm = sharded_downsample_multigroup_quantile(
+                ts, vals, sid, valid, gmap,
+                np.array([agg.quantile], np.float32), mesh=self.mesh,
+                series_per_shard=sps_pad, num_groups=G,
+                num_buckets=num_buckets, interval=interval,
+                agg_down=dsagg, **self._rate_kw(spec))
+        else:
+            gv, gm = sharded_downsample_multigroup(
+                ts, vals, sid, valid, gmap, mesh=self.mesh,
+                series_per_shard=sps_pad, num_groups=G,
+                num_buckets=num_buckets, interval=interval,
+                agg_down=dsagg, agg_group=spec.aggregator,
+                **self._rate_kw(spec))
         return np.asarray(gv), np.asarray(gm)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,230 @@
+"""North-star scale run: ingest toward 1B points on this host and
+record what the system actually does at that size (VERDICT r02 item 3).
+
+Measures, and writes to BENCH_SCALE.json:
+- ingest wall time + dps at scale (full system: sketches + devwindow),
+- peak RSS and the host ceiling that set the final size,
+- WAL size, checkpoint (memtable -> sstable spill) duration + size,
+- device-window residency/eviction behavior under the max_points
+  budget (appended vs evicted vs resident, coverage start),
+- steady-state resident query latency INSIDE the kept window,
+- cold scan-path latency over a 1-day range (storage scan + decode),
+- streaming sketch quantile latency over all series.
+
+Run:  python scripts/bench_scale.py [--points 1000000000] [--cpu]
+The default TSDB config is used (the system as shipped), with a WAL on
+disk so durability costs are included.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def rss_gb() -> float:
+    with open("/proc/self/status") as f:
+        for ln in f:
+            if ln.startswith("VmRSS"):
+                return int(ln.split()[1]) / (1 << 20)
+    return 0.0
+
+
+def du(path: str) -> int:
+    total = 0
+    for root, _, files in os.walk(path):
+        for fn in files:
+            try:
+                total += os.path.getsize(os.path.join(root, fn))
+            except OSError:
+                pass
+    return total
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=int, default=1_000_000_000)
+    ap.add_argument("--series", type=int, default=2_000)
+    ap.add_argument("--span", type=int, default=365 * 86400)
+    ap.add_argument("--chunk", type=int, default=100_000,
+                    help="points per add_batch call")
+    ap.add_argument("--rss-cap-gb", type=float, default=100.0)
+    ap.add_argument("--workdir", default="/tmp/tsdb_scale")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/jax_comp"))
+    except Exception:
+        pass
+    dev = jax.devices()[0]
+    log(f"device: {dev}")
+
+    from opentsdb_tpu.core.tsdb import TSDB
+    from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+    from opentsdb_tpu.storage.kv import MemKVStore
+    from opentsdb_tpu.utils.config import Config
+
+    shutil.rmtree(args.workdir, ignore_errors=True)
+    os.makedirs(args.workdir)
+    wal = os.path.join(args.workdir, "wal")
+    cfg = Config(auto_create_metrics=True, wal_path=wal)
+    tsdb = TSDB(MemKVStore(wal_path=wal), cfg,
+                start_compaction_thread=False)
+
+    base = 1356998400
+    pps = max(args.points // args.series, 1)     # points per series
+    step = max(args.span // pps, 1)
+    rng = np.random.default_rng(7)
+
+    out = {"device": str(dev), "target_points": args.points,
+           "series": args.series, "span_s": args.span,
+           "points_per_series": pps, "step_s": step,
+           "host": {"cores": os.cpu_count(),
+                    "ram_gb": round(os.sysconf("SC_PAGE_SIZE")
+                                    * os.sysconf("SC_PHYS_PAGES")
+                                    / (1 << 30))}}
+
+    total = 0
+    peak_rss = 0.0
+    ceiling = None
+    t_ingest = time.perf_counter()
+    last_log = t_ingest
+    for si in range(args.series):
+        tags = {"host": f"h{si:04d}"}
+        # Monotone jittered timestamps, chunked through add_batch.
+        for off in range(0, pps, args.chunk):
+            n = min(args.chunk, pps - off)
+            ts = (base + (off + np.arange(n, dtype=np.int64)) * step
+                  + rng.integers(0, max(step - 1, 1)))
+            vals = (np.cumsum(rng.normal(0, 1, n).astype(np.float32))
+                    + 100.0)
+            total += tsdb.add_batch("scale.metric", ts, vals, tags)
+        if si % 50 == 0 or si == args.series - 1:
+            now = time.perf_counter()
+            r = rss_gb()
+            peak_rss = max(peak_rss, r)
+            if now - last_log > 30 or si == args.series - 1:
+                log(f"  series {si + 1}/{args.series}: {total:,} pts, "
+                    f"{total / (now - t_ingest):,.0f} dps, "
+                    f"rss {r:.1f} GB")
+                last_log = now
+            if r > args.rss_cap_gb:
+                ceiling = f"RSS {r:.1f} GB > cap {args.rss_cap_gb} GB"
+                log(f"  stopping early: {ceiling}")
+                break
+    if tsdb.devwindow is not None:
+        tsdb.devwindow.flush()
+    if tsdb.sketches is not None:
+        tsdb.sketches.flush()
+    ingest_s = time.perf_counter() - t_ingest
+    peak_rss = max(peak_rss, rss_gb())
+    out["ingest"] = {"points": total, "wall_s": round(ingest_s, 1),
+                     "dps": round(total / ingest_s),
+                     "peak_rss_gb": round(peak_rss, 1),
+                     "ceiling": ceiling or "target reached"}
+    out["wal_bytes"] = os.path.getsize(wal) if os.path.exists(wal) else 0
+    log(f"ingested {total:,} in {ingest_s:,.0f}s "
+        f"({total/ingest_s:,.0f} dps), wal "
+        f"{out['wal_bytes']/(1<<30):.2f} GB")
+
+    # Device-window behavior under the budget.
+    dw = tsdb.devwindow
+    if dw is not None:
+        muid = tsdb.metrics.get_id("scale.metric")
+        mw = dw._metrics.get(muid)
+        out["devwindow"] = {
+            "max_points_budget": dw.max_points,
+            "appended": dw.appended_points,
+            "evicted": dw.evicted_points,
+            "resident": dw._total_points,
+            "complete_from": (mw.complete_from if mw else None),
+            "coverage_tail_s": (
+                None if mw is None or mw.complete_from is None
+                else base + pps * step - mw.complete_from),
+            "dirty": bool(mw.dirty) if mw else None,
+        }
+        log(f"devwindow: {out['devwindow']}")
+
+    # Queries at scale.
+    ex = QueryExecutor(tsdb, backend="tpu")
+    end = base + pps * step
+    q = {}
+    if dw is not None and (mw := dw._metrics.get(muid)) is not None \
+            and not mw.dirty:
+        rstart = mw.complete_from if mw.complete_from else base
+        spec = QuerySpec("scale.metric", {}, "sum",
+                         downsample=(3600, "avg"))
+        ex.run(spec, rstart, end)  # warm
+        t0 = time.perf_counter()
+        ex.run(spec, rstart, end)
+        q["resident_sum_s"] = time.perf_counter() - t0
+        p95 = QuerySpec("scale.metric", {}, "p95",
+                        downsample=(3600, "avg"))
+        ex.run(p95, rstart, end)
+        t0 = time.perf_counter()
+        ex.run(p95, rstart, end)
+        q["resident_p95_s"] = time.perf_counter() - t0
+        q["resident_range_s"] = end - rstart
+        q["resident_hits"] = dw.window_hits
+    # Cold scan path over one day.
+    dwx, tsdb.devwindow = tsdb.devwindow, None
+    try:
+        spec = QuerySpec("scale.metric", {}, "sum",
+                         downsample=(3600, "avg"))
+        t0 = time.perf_counter()
+        r = ex.run(spec, end - 86400, end)
+        q["cold_scan_1day_s"] = time.perf_counter() - t0
+        q["cold_scan_1day_points"] = int(
+            86400 // step * min(args.series, si + 1))
+    finally:
+        tsdb.devwindow = dwx
+    # Streaming sketch quantiles over every series.
+    if tsdb.sketches is not None:
+        ex.sketch_quantiles("scale.metric", {}, [0.5, 0.99])
+        t0 = time.perf_counter()
+        ex.sketch_quantiles("scale.metric", {}, [0.5, 0.99])
+        q["sketch_quantile_s"] = time.perf_counter() - t0
+    out["queries"] = {k: (round(v, 4) if isinstance(v, float) else v)
+                      for k, v in q.items()}
+    log(f"queries: {out['queries']}")
+
+    # Checkpoint: memtable -> sstable spill + WAL truncation.
+    t0 = time.perf_counter()
+    rows = tsdb.checkpoint()
+    out["checkpoint"] = {
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "rows_spilled": rows,
+        "dir_bytes": du(args.workdir),
+        "wal_bytes_after": (os.path.getsize(wal)
+                            if os.path.exists(wal) else 0),
+    }
+    log(f"checkpoint: {out['checkpoint']}")
+
+    with open(os.path.join(REPO, "BENCH_SCALE.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({"points": total,
+                      "dps": round(total / ingest_s),
+                      "device": str(dev)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -447,6 +447,43 @@ class TestMeshedRatePercentile:
             "net.bytes", {"host": "*"}, aggregator="avg", rate=True,
             downsample=(600, "avg")), mesh)
 
+    def test_multigroup_sharded_percentile(self, wide_tsdb, mesh):
+        # host=* percentile over the mesh: all_gather + grouped radix
+        # select (16 groups of 1 series -> per-group p95 == that
+        # series' own filled buckets, checked against single-device).
+        self._both(wide_tsdb, QuerySpec(
+            "net.bytes", {"host": "*"}, aggregator="p95",
+            downsample=(600, "avg")), mesh)
+
+    def test_multigroup_sharded_rate_percentile(self, wide_tsdb, mesh):
+        self._both(wide_tsdb, QuerySpec(
+            "net.bytes", {"host": "*"}, aggregator="p50", rate=True,
+            downsample=(600, "avg")), mesh)
+
+    @pytest.fixture(scope="class")
+    def multimember_tsdb(self):
+        """4 groups x 4 member series — members scatter across the 8
+        chips under round-robin packing, so the cross-chip grouped
+        quantile merge (gathered gmap alignment) is actually exercised
+        (1-member groups degenerate to per-series values)."""
+        t = TSDB(MemKVStore(), Config(auto_create_metrics=True),
+                 start_compaction_thread=False)
+        rng = np.random.default_rng(13)
+        for dc in range(4):
+            for h in range(4):
+                n = int(rng.integers(80, 140))
+                ts = np.sort(rng.choice(7200, size=n, replace=False)) + BT
+                t.add_batch("app.lat", ts, rng.normal(40 + 10 * dc, 6, n),
+                            {"dc": f"d{dc}", "host": f"h{dc}{h}"})
+        return t
+
+    @pytest.mark.parametrize("agg,rate", [("p95", False), ("p50", True)])
+    def test_multigroup_sharded_percentile_multimember(
+            self, multimember_tsdb, mesh, agg, rate):
+        self._both(multimember_tsdb, QuerySpec(
+            "app.lat", {"dc": "*"}, aggregator=agg, rate=rate,
+            downsample=(600, "avg")), mesh)
+
     def test_time_sharded_rate_long_range(self, mesh):
         t = TSDB(MemKVStore(), Config(auto_create_metrics=True),
                  start_compaction_thread=False)
