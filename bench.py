@@ -667,7 +667,7 @@ def main() -> int:
     def roof(key):
         if peak is None:
             return ""
-        return (f" | {q[f'{key}_achieved_gbps']:.0f} GB/s of "
+        return (f" | {q[f'{key}_achieved_gbps']:.2f} GB/s of "
                 f"{peak:.0f} peak")
 
     log(f"config 1: sum 1h-avg downsample (end-to-end query) ...\n"

@@ -31,6 +31,19 @@ if not _TPU_RUN:
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _require_real_tpu():
+    """Under RUN_TPU_TESTS=1, fail loudly if JAX silently resolved to
+    CPU (unset JAX_PLATFORMS, dead tunnel): otherwise every parity test
+    compares CPU-vs-CPU and the hardware gate passes vacuously."""
+    if _TPU_RUN:
+        platform = jax.devices()[0].platform
+        assert platform == "tpu", (
+            f"RUN_TPU_TESTS=1 but default backend is {platform!r} — "
+            "no real TPU; refusing to record a vacuous hardware pass")
+    yield
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
