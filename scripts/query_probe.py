@@ -1,0 +1,80 @@
+"""Targeted resident-query latency probe (the tunnel-hop experiment).
+
+Builds the bench query workload at a reduced size, then times the
+executor's devwindow path per config — fast enough to iterate on the
+dispatch/transfer structure without a full bench.py run. Prints a JSON
+line per measurement.
+
+Usage: python scripts/query_probe.py [--series N] [--points N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--series", type=int, default=10_000)
+    ap.add_argument("--points", type=int, default=1_000)
+    ap.add_argument("--span", type=int, default=7 * 86400)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU platform (the ambient "
+                         "sitecustomize overrides JAX_PLATFORMS=cpu, so "
+                         "the env var alone does NOT keep this off the "
+                         "single-tenant chip)")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.cache/jax_comp"))
+    dev = jax.devices()[0]
+    print(f"device: {dev}", file=sys.stderr)
+
+    import bench
+    from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+
+    base, series = bench.gen_workload(args.series, args.points, args.span,
+                                      seed=1)
+    t0 = time.perf_counter()
+    tsdb = bench.build_query_tsdb(series, base)
+    print(f"ingested {sum(len(s[0]) for s in series):,} points in "
+          f"{time.perf_counter()-t0:.1f} s", file=sys.stderr)
+
+    ex = QueryExecutor(tsdb, backend="tpu")
+    start, end = base, base + args.span
+    specs = {
+        "c1_sum": QuerySpec("bench.query", {}, "sum",
+                            downsample=(3600, "avg")),
+        "c2_rate": QuerySpec("bench.query", {}, "sum", rate=True,
+                             downsample=(3600, "avg")),
+        "c3_p95": QuerySpec("bench.query", {}, "p95",
+                            downsample=(3600, "avg")),
+        "c3_grouped": QuerySpec("bench.query", {"host": "*"}, "p95",
+                                downsample=(3600, "avg")),
+    }
+    out = {"device": str(dev)}
+    for name, spec in specs.items():
+        ex.run(spec, start, end)          # warm jit + plan caches
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            ex.run(spec, start, end)
+            times.append(time.perf_counter() - t0)
+        out[name + "_ms"] = round(float(np.median(times)) * 1e3, 1)
+    print(json.dumps(out))
+    tsdb.shutdown()
+
+
+if __name__ == "__main__":
+    main()
