@@ -297,66 +297,145 @@ def window_mask(rel_ts: jnp.ndarray, sid: jnp.ndarray, valid: jnp.ndarray,
     return rel_ts - shift, ok
 
 
-@functools.partial(jax.jit, static_argnames=("num_series",))
-def series_presence(sid: jnp.ndarray, valid: jnp.ndarray, *,
-                    num_series: int):
-    """Which series have any valid point ([S] bool) — one flat
-    reduction; the devwindow path uses it to match the scan path's
-    "series with no in-range points don't exist" semantics."""
-    return jax.ops.segment_sum(
-        valid.astype(jnp.float32), sid, num_series) > 0
+def _window_series_stage(rel_ts, vals, sid, valid_in, lo, hi, shift, *,
+                         num_series, num_buckets, interval, agg_down,
+                         rate=False, counter_max=0.0, reset_value=0.0,
+                         counter=False, drop_resets=False):
+    """The heavy, FILTER-INDEPENDENT half of any resident-window query:
+    range masking + per-series downsample [+ rate] over the N resident
+    points. No include mask, no gap fill, no grouping — so ONE cached
+    device-resident stage serves every panel over the same (metric,
+    range, interval, downsample): different tag filters, group-bys,
+    group aggregators, moments AND quantiles all reuse it, paying only
+    the [S, B]-sized apply per query. On a remote-device transport this
+    is the difference between ~N-scatter cost per panel and ~one
+    dispatch per panel (the devwindow serving pattern; the quantile
+    path proved it first, this generalizes it to moments).
 
-
-def _window_stage(rel_ts, vals, sid, valid_in, include, lo, hi, shift, *,
-                  num_series, num_buckets, interval, agg_down,
-                  rate=False, counter_max=0.0, reset_value=0.0,
-                  counter=False, drop_resets=False):
-    """Shared heavy half of a resident-window percentile query: range/
-    series masking + per-series downsample [+ rate] + gap/step fill.
-    Everything that does NOT depend on the quantile — so p50/p95/p99
-    dashboard panels, which differ only in q, can reuse one stage.
-    Returns (filled [S, B], in_range [S, B], series_mask [S, B],
-    presence [S])."""
-    rel_q, ok = window_mask(rel_ts, sid, valid_in, include, lo, hi,
-                            shift)
-    presence = series_presence(sid, ok, num_series=num_series)
+    Returns (series_values [S, B] post-rate, series_mask [S, B]
+    post-rate, filled [S, B], in_range [S, B], presence [S] pre-rate).
+    ``filled``/``in_range`` carry the lerp (or, under rate, step) fill
+    of the full grid: filling is ROW-LOCAL, so a series' filled row is
+    identical whether or not other series are included — which makes
+    the fill cacheable here rather than re-run per panel."""
+    ok = valid_in & (rel_ts >= lo) & (rel_ts <= hi)
     out = downsample_group(
-        rel_q, vals, sid, ok, num_series=num_series,
-        num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+        rel_ts - shift, vals, sid, ok,
+        num_series=num_series, num_buckets=num_buckets,
+        interval=interval, agg_down=agg_down,
         agg_group="count", rate=rate, counter_max=counter_max,
         reset_value=reset_value, counter=counter,
         drop_resets=drop_resets)
     fill = step_fill if rate else gap_fill
     filled, in_range = fill(out["series_values"], out["series_mask"],
                             num_buckets)
-    return filled, in_range, out["series_mask"], presence
+    return (out["series_values"], out["series_mask"], filled, in_range,
+            out["presence"])
 
 
-def _quantile_apply(filled, in_range, series_mask, gmap, q, *,
-                    num_groups):
-    """Cheap per-quantile half: [G, B] quantiles + group masks from a
-    (possibly cached) stage."""
+def _group_stage(filled, in_range, series_mask, gmap, *, num_groups,
+                 agg_group):
+    """Cross-series aggregation of a (filled, masked) [S, B] grid into
+    [G, B] — row-wise segment reductions (S vector updates, never a
+    flat S*B scatter)."""
     if num_groups == 1:
-        gv = masked_quantile_axis0(filled, in_range, q)[:1]
+        g_count, g_total, g_m2, _, g_mn, g_mx = group_moments(
+            filled, in_range)
+        gv = _finish(agg_group, g_count, g_total, g_m2, g_mn, g_mx)[None]
         gm = series_mask.any(axis=0)[None]
+        return gv, gm
+    need = _needs(agg_group)
+    g_count = jax.ops.segment_sum(
+        in_range.astype(jnp.float32), gmap, num_groups)
+    v = jnp.where(in_range, filled, 0.0)
+    g_total = g_m2 = g_mn = g_mx = None
+    if "sum" in need or "m2" in need:
+        g_total = jax.ops.segment_sum(v, gmap, num_groups)
+    if "m2" in need:
+        g_mean = g_total / jnp.maximum(g_count, 1.0)
+        centered = jnp.where(in_range, filled - g_mean[gmap], 0.0)
+        g_m2 = jax.ops.segment_sum(centered * centered, gmap,
+                                   num_groups)
+    if "min" in need:
+        g_mn = jax.ops.segment_min(
+            jnp.where(in_range, filled, _POS_INF), gmap, num_groups)
+    if "max" in need:
+        g_mx = jax.ops.segment_max(
+            jnp.where(in_range, filled, _NEG_INF), gmap, num_groups)
+    gv = _finish(agg_group, g_count, g_total, g_m2, g_mn, g_mx)
+    gm = jax.ops.segment_sum(
+        series_mask.astype(jnp.int32), gmap, num_groups) > 0
+    return gv, gm
+
+
+def _shrink_wrap(gv, gm, g_out, b_out):
+    """Clip apply outputs to the (64-quantized) live group/bucket counts
+    and bit-pack the mask before they cross the transport: the axon
+    tunnel moves device->host data at ~30 MB/s with a ~100 ms floor
+    (measured), so fetching the PADDED [G, B] grids dominated wide
+    group-by queries. g_out/b_out are static (bounded recompiles: 64
+    quantization)."""
+    gv = gv[..., :g_out, :b_out]
+    gm = jnp.packbits(gm[:g_out, :b_out], axis=1)
+    return gv, gm
+
+
+def _moment_apply(series_values, series_mask, filled, in_range, include,
+                  gmap, *, num_groups, agg_group,
+                  g_out=None, b_out=None):
+    """Cheap per-query half of a resident-window MOMENT query: include
+    masking (row-wise — identical to having filtered the points
+    upstream, since fill is row-local) + group aggregation over the
+    cached [S, B] stage grids."""
+    sm = series_mask & include[:, None]
+    if agg_group in NOLERP_AGGS:
+        f, ir = series_values, sm
+    else:
+        f, ir = filled, in_range & include[:, None]
+    gv, gm = _group_stage(f, ir, sm, gmap,
+                          num_groups=num_groups, agg_group=agg_group)
+    if g_out is None:
+        return gv, gm
+    return _shrink_wrap(gv, gm, g_out, b_out)
+
+
+def _quantile_apply(series_mask, filled, in_range,
+                    include, gmap, q, *, num_groups,
+                    g_out=None, b_out=None):
+    """Cheap per-quantile half: include masking + [G, B] masked
+    quantiles from the cached stage's filled grid (quantiles always use
+    the lerp/step fill family — reference SpanGroup percentile
+    semantics)."""
+    sm = series_mask & include[:, None]
+    ir = in_range & include[:, None]
+    if num_groups == 1:
+        gv = masked_quantile_axis0(filled, ir, q)[:1]
+        gm = sm.any(axis=0)[None]
     else:
         # host=* percentile dashboards: all groups' quantiles in the
         # same program (excluded/padded series carry no valid buckets,
         # so wherever gmap sends them they add nothing).
-        gv = masked_quantile_groups(filled, in_range, gmap, q,
+        gv = masked_quantile_groups(filled, ir, gmap, q,
                                     num_groups=num_groups)[0]
         gm = jax.ops.segment_sum(
-            series_mask.astype(jnp.int32), gmap, num_groups) > 0
-    return gv, gm
+            sm.astype(jnp.int32), gmap, num_groups) > 0
+    if g_out is None:
+        return gv, gm
+    return _shrink_wrap(gv, gm, g_out, b_out)
 
 
-window_quantile_stage = functools.partial(
+window_series_stage = functools.partial(
     jax.jit, static_argnames=("num_series", "num_buckets", "interval",
                               "agg_down", "rate", "counter",
-                              "drop_resets"))(_window_stage)
+                              "drop_resets"))(_window_series_stage)
+
+window_moment_apply = functools.partial(
+    jax.jit, static_argnames=("num_groups", "agg_group",
+                              "g_out", "b_out"))(_moment_apply)
 
 window_quantile_apply = functools.partial(
-    jax.jit, static_argnames=("num_groups",))(_quantile_apply)
+    jax.jit, static_argnames=("num_groups",
+                              "g_out", "b_out"))(_quantile_apply)
 
 
 @functools.partial(
@@ -372,41 +451,21 @@ def window_query(rel_ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
                  rate: bool = False, counter_max: float = 0.0,
                  reset_value: float = 0.0, counter: bool = False,
                  drop_resets: bool = False):
-    """The whole resident-window MOMENT query in ONE jit: range/series
-    masking, fused downsample [+ rate] + group aggregation (all groups
-    at once), and series presence. Fusing matters beyond kernel
-    launches: on a remote-device transport (the axon tunnel), a large
-    jit OUTPUT fed into the NEXT jit pays an N-proportional per-hop
-    cost (measured ~85 ms per 64 MB intermediate), so mask ->
-    downsample -> group as separate calls costs seconds at 10M points
-    while this single program runs in ~1 ms. Only small results cross
-    the boundary. (Percentile queries use window_quantile_stage/apply
-    instead, so the heavy stage can be cached across p50/p95/p99
-    panels — the intermediates stay device-resident.)
+    """The whole resident-window MOMENT query in ONE jit — the
+    single-shot composition of window_series_stage + window_moment_apply
+    (one dispatch instead of two; results are identical, so the
+    executor's cached-stage path and this path are interchangeable).
 
     Returns (group_values [G, B], group_mask [G, B], presence [S]).
     """
-    rel_q, ok = window_mask(rel_ts, sid, valid_in, include, lo, hi,
-                            shift)
-    presence = series_presence(sid, ok, num_series=num_series)
-    rate_kw = dict(rate=rate, counter_max=counter_max,
-                   reset_value=reset_value, counter=counter,
-                   drop_resets=drop_resets)
-    if num_groups == 1:
-        out = downsample_group(
-            rel_q, vals, sid, ok, num_series=num_series,
-            num_buckets=num_buckets, interval=interval,
-            agg_down=agg_down, agg_group=agg_group, **rate_kw)
-        gv = out["group_values"][None]
-        gm = out["group_mask"][None]
-    else:
-        out = downsample_multigroup(
-            rel_q, vals, sid, ok, gmap, num_series=num_series,
-            num_groups=num_groups, num_buckets=num_buckets,
-            interval=interval, agg_down=agg_down, agg_group=agg_group,
-            **rate_kw)
-        gv = out["group_values"]
-        gm = out["group_mask"]
+    sv, sm, filled, in_range, presence = _window_series_stage(
+        rel_ts, vals, sid, valid_in, lo, hi, shift,
+        num_series=num_series, num_buckets=num_buckets,
+        interval=interval, agg_down=agg_down, rate=rate,
+        counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)
+    gv, gm = _moment_apply(sv, sm, filled, in_range, include, gmap,
+                           num_groups=num_groups, agg_group=agg_group)
     return gv, gm, presence
 
 
@@ -418,7 +477,15 @@ def _series_stage(ts, vals, sid, valid, *, num_series, num_buckets,
                   interval, agg_down, with_ts: bool):
     """Shared per-(series, bucket) downsample stage: one fused segment
     reduction producing series_values/series_mask [S, B] (and, when
-    ``with_ts``, per-bucket integer-mean member timestamps)."""
+    ``with_ts``, per-bucket integer-mean member timestamps).
+
+    Negative result, measured r03: a scatter-free formulation for
+    (sid, ts)-sorted columns — int32/fixed-point-int64 prefix sums +
+    searchsorted of the [S*B] grid — LOST to the XLA scatter on both
+    TPU (1248 vs 598 ms at N=20M) and CPU (179 vs 56 ms): the grid-
+    side searchsorted (820 ms default 'scan', 305 ms 'sort' method on
+    TPU) costs more than the scatter it replaces. The scatter path
+    stays; don't re-derive without beating those numbers."""
     bucket = jnp.clip(ts // interval, 0, num_buckets - 1)
     seg = jnp.where(valid, sid * num_buckets + bucket,
                     num_series * num_buckets)
@@ -489,6 +556,10 @@ def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
         ts, vals, sid, valid, num_series=num_series,
         num_buckets=num_buckets, interval=interval, agg_down=agg_down,
         with_ts=True)
+    # Pre-rate: "series has any valid point", free from the bucket grid
+    # — a separate segment reduction over the N points (series_presence)
+    # would cost a second N-sized scatter pass.
+    presence = series_mask.any(axis=1)
     if rate:
         series_values, series_mask = bucket_rate(
             series_values, series_mask, interval, counter_max,
@@ -512,6 +583,7 @@ def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
         "series_values": series_values,
         "series_ts": series_ts,
         "series_mask": series_mask,
+        "presence": presence,
         "group_values": group_values,
         # Emit only buckets where some series has a real point (the union
         # grid); filled contributions never create grid points. With rate,
@@ -551,6 +623,7 @@ def downsample_multigroup(ts: jnp.ndarray, vals: jnp.ndarray,
         ts, vals, sid, valid, num_series=num_series,
         num_buckets=num_buckets, interval=interval, agg_down=agg_down,
         with_ts=False)
+    presence = series_mask.any(axis=1)  # pre-rate, see downsample_group
     if rate:
         series_values, series_mask = bucket_rate(
             series_values, series_mask, interval, counter_max,
@@ -565,26 +638,15 @@ def downsample_multigroup(ts: jnp.ndarray, vals: jnp.ndarray,
         filled, in_range = gap_fill(series_values, series_mask,
                                     num_buckets)
 
-    b_idx = jnp.arange(num_buckets, dtype=jnp.int32)
-    gb = group_of_sid[:, None] * num_buckets + b_idx[None, :]
-    gn = num_groups * num_buckets + 1
-    gseg = jnp.where(in_range, gb, num_groups * num_buckets).reshape(-1)
-    g_count, g_total, g_m2, g_mn, g_mx = _segment_moments(
-        filled.reshape(-1), gseg, in_range.reshape(-1), gn,
-        need=_needs(agg_group))
-    group_values = _finish(agg_group, g_count, g_total, g_m2, g_mn,
-                           g_mx)[:-1].reshape(num_groups, num_buckets)
-    # A group's bucket is emitted when some member series has a REAL
-    # point there (lerp fills never create grid points).
-    rseg = jnp.where(series_mask, gb,
-                     num_groups * num_buckets).reshape(-1)
-    real = jax.ops.segment_sum(
-        series_mask.reshape(-1).astype(jnp.int32), rseg, gn)[:-1]
+    group_values, group_mask = _group_stage(
+        filled, in_range, series_mask, group_of_sid,
+        num_groups=num_groups, agg_group=agg_group)
     return {
         "group_values": group_values,
-        "group_mask": real.reshape(num_groups, num_buckets) > 0,
+        "group_mask": group_mask,
         "series_values": series_values,
         "series_mask": series_mask,
+        "presence": presence,
     }
 
 
@@ -668,53 +730,45 @@ def masked_quantile_groups(vals: jnp.ndarray, mask: jnp.ndarray,
                            gmap: jnp.ndarray, q: jnp.ndarray, *,
                            num_groups: int):
     """Per-(group, bucket) quantiles across member series, all groups in
-    one call: the percentile form of downsample_multigroup's group
-    stage. ``gmap`` [S] maps each series row to its group; semantics per
-    group match masked_quantile_axis0 on that group's rows alone.
+    one call: the percentile form of the multigroup group stage.
+    ``gmap`` [S] maps each series row to its group; semantics per group
+    match masked_quantile_axis0 on that group's rows alone.
 
-    Same radix-select scheme as masked_quantile_axis0, with the plain
-    column counts replaced by segment counts over ``gmap`` (one
-    segment_sum per pass) and the per-column selection state [B] widened
-    to [G, B]. Replaces the sequential per-group kernel loop the
-    reference's SpanGroup materialization forces
-    (src/core/TsdbQuery.java:294-363) for host=* percentile dashboards.
+    ONE segmented 2-key sort does all the work: each column sorts by
+    (group, value-order-key), which lays every (group, bucket)'s valid
+    members out as a contiguous ascending run at a COLUMN-INDEPENDENT
+    row offset (group sizes come from gmap alone), so rank selection is
+    two take_along_axis gathers + a lerp. This replaced a 32-pass
+    radix-select whose per-bit [S, B] segment reductions dominated
+    grouped-percentile latency ~10x on TPU, and replaces the
+    sequential per-group kernel loop the reference's SpanGroup
+    materialization forces (src/core/TsdbQuery.java:294-363).
     Returns [K, G, B].
     """
+    S, B = vals.shape
     keys = jnp.where(mask, _order_key(vals), jnp.uint32(0xFFFFFFFF))
+    gcol = jnp.broadcast_to(gmap[:, None], (S, B)).astype(jnp.int32)
+    # Lexicographic segmented sort along the series axis: primary key
+    # group, secondary key value order; invalid entries sink to each
+    # group's tail (key 0xFFFFFFFF).
+    _, skeys = jax.lax.sort((gcol, keys), dimension=0, num_keys=2)
+    svals = _key_to_float(skeys)
+    # Column-independent group layout: group g's rows start at the
+    # exclusive prefix of group sizes.
+    sizes = jax.ops.segment_sum(jnp.ones_like(gmap, jnp.int32), gmap,
+                                num_groups)
+    starts = jnp.cumsum(sizes) - sizes                       # [G]
     n = jax.ops.segment_sum(mask.astype(jnp.int32), gmap,
-                            num_groups)  # [G, B]
-
-    def kth(k):
-        """Key of rank ``k`` [G, B] within each (group, bucket)."""
-        def body(i, carry):
-            prefix, kk = carry
-            bit = 31 - i
-            pref_s = prefix[gmap]  # [S, B]
-            m_hi = ((keys >> bit) >> 1) == ((pref_s >> bit) >> 1)
-            bit0 = ((keys >> bit) & 1) == 0
-            c0 = jax.ops.segment_sum(
-                (mask & m_hi & bit0).astype(jnp.int32), gmap, num_groups)
-            take1 = kk >= c0
-            return (jnp.where(take1, prefix | (jnp.uint32(1) << bit),
-                              prefix),
-                    jnp.where(take1, kk - c0, kk))
-        prefix, _ = jax.lax.fori_loop(
-            0, 32, body, (jnp.zeros_like(k, jnp.uint32), k))
-        return prefix
+                            num_groups)                      # [G, B]
 
     def one(qi):
         pos = jnp.maximum(n - 1, 0).astype(jnp.float32) * qi
         lo = jnp.floor(pos).astype(jnp.int32)
         hi = jnp.ceil(pos).astype(jnp.int32)
-        key_lo = kth(lo)
-        vlo = _key_to_float(key_lo)
-        klo_s = key_lo[gmap]  # [S, B]
-        cle = jax.ops.segment_sum(
-            (mask & (keys <= klo_s)).astype(jnp.int32), gmap, num_groups)
-        above = jax.ops.segment_min(
-            jnp.where(mask & (keys > klo_s), keys,
-                      jnp.uint32(0xFFFFFFFF)), gmap, num_groups)
-        vhi = jnp.where(hi < cle, vlo, _key_to_float(above))
+        idx_lo = jnp.clip(starts[:, None] + lo, 0, S - 1)    # [G, B]
+        idx_hi = jnp.clip(starts[:, None] + hi, 0, S - 1)
+        vlo = jnp.take_along_axis(svals, idx_lo, axis=0)
+        vhi = jnp.take_along_axis(svals, idx_hi, axis=0)
         out = vlo + (pos - lo) * (vhi - vlo)
         return jnp.where(n > 0, out, 0.0)
 

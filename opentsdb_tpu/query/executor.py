@@ -293,6 +293,11 @@ class QueryExecutor:
         num_buckets = _pad_size(int((end - qbase) // interval + 1))
         S_all = len(cols.series_keys)
         S_pad = _pad_size(S_all)
+        if S_pad * num_buckets >= 2**31:
+            # The kernels' per-(series, bucket) segment ids are int32;
+            # a huge series-count x bucket-count product would wrap.
+            # Scan path handles it (per-group kernels, smaller grids).
+            return None
         gkeys = sorted(groups)
         G = _pad_size(len(gkeys))
         # Device-resident include/gmap, cached per (window instance,
@@ -329,51 +334,64 @@ class QueryExecutor:
         shift32 = np.int32(qbase - cols.epoch)
         ngroups = 1 if len(gkeys) == 1 else G
         rate_kw = self._rate_kw(spec)
-        if agg.kind == "percentile":
-            # p50/p95/p99 dashboard panels differ only in q: cache the
-            # heavy stage (masking + per-series downsample + fill) as
-            # DEVICE-resident arrays and run only the quantile select
-            # per panel. The intermediates never cross the transport,
-            # so the split costs one extra dispatch, not a transfer.
-            fkey = (dw.instance_id, metric_uid, cols.version, fk,
-                    start, end, interval, dsagg,
-                    tuple(sorted(rate_kw.items())))
-            cache = getattr(self, "_dw_stage_cache", None)
-            if cache is None:
-                cache = self._dw_stage_cache = {}
-            stage = cache.get(fkey)
-            if stage is None:
-                stage = kernels.window_quantile_stage(
-                    cols.rel_ts, cols.values, cols.sid, cols.valid,
-                    include, lo32, hi32, shift32, num_series=S_pad,
-                    num_buckets=num_buckets, interval=interval,
-                    agg_down=dsagg, **rate_kw)
-                if len(cache) >= 4:  # a handful of HBM-sized stages
-                    cache.clear()
-                cache[fkey] = stage
-            filled, in_range, series_mask, presence = stage
-            gv, gm = kernels.window_quantile_apply(
-                filled, in_range, series_mask, gmap,
-                np.array([agg.quantile], np.float32),
-                num_groups=ngroups)
-        else:
-            # One fused jit for the whole query: on a remote-device
-            # transport, chaining separate kernels pays an
-            # N-proportional cost per large intermediate (see
-            # kernels.window_query).
-            gv, gm, presence = kernels.window_query(
-                cols.rel_ts, cols.values, cols.sid, cols.valid, include,
-                gmap, lo32, hi32, shift32,
-                num_series=S_pad, num_groups=ngroups,
+        # The heavy N-point half of ANY window query (range mask +
+        # per-series downsample [+ rate]) is FILTER-INDEPENDENT, so it
+        # caches per (window instance, metric, data version, range,
+        # interval, downsample, rate) and stays device-resident: every
+        # dashboard panel over the same range — any tag filter, any
+        # group-by, moments and p50/p95/p99 alike — reuses one stage
+        # and pays only the [S, B]-sized apply + one dispatch. On the
+        # ~70 ms/round-trip axon tunnel this is the difference between
+        # ~N-scatter cost per panel and ~dispatch-floor per panel.
+        skey = (dw.instance_id, metric_uid, cols.version, start, end,
+                interval, dsagg, tuple(sorted(rate_kw.items())))
+        cache = getattr(self, "_dw_stage_cache", None)
+        if cache is None:
+            cache = self._dw_stage_cache = {}
+        stage = cache.get(skey)
+        if stage is None:
+            grids = kernels.window_series_stage(
+                cols.rel_ts, cols.values, cols.sid, cols.valid,
+                lo32, hi32, shift32, num_series=S_pad,
                 num_buckets=num_buckets, interval=interval,
-                agg_down=dsagg, agg_group=spec.aggregator, **rate_kw)
+                agg_down=dsagg, **rate_kw)
+            # [5] fills with the host copy of presence on first fetch.
+            stage = list(grids) + [None]
+            if len(cache) >= 4:  # a handful of HBM-sized stages
+                cache.clear()
+            cache[skey] = stage
+        sv, sm, filled, in_range, presence_dev = stage[:5]
+        # Shrink-wrap the fetch: clip to the live group/bucket counts
+        # (64-quantized so statics don't churn recompiles) and bit-pack
+        # the mask on device — the tunnel's device->host path runs at
+        # ~30 MB/s, so fetching padded [G, B] grids dominated wide
+        # group-by queries (measured 800 ms of a 930 ms host=* p95).
+        b_live = int((end - qbase) // interval + 1)
+        g_out = min(ngroups, _pad64(len(gkeys)))
+        b_out = min(num_buckets, _pad64(b_live))
+        shrink = dict(g_out=g_out, b_out=b_out)
+        if agg.kind == "percentile":
+            gv, gm = kernels.window_quantile_apply(
+                sm, filled, in_range, include, gmap,
+                np.array([agg.quantile], np.float32),
+                num_groups=ngroups, **shrink)
+        else:
+            gv, gm = kernels.window_moment_apply(
+                sv, sm, filled, in_range, include, gmap,
+                num_groups=ngroups, agg_group=spec.aggregator,
+                **shrink)
         # Series with no in-range points must not shape group labels or
         # emit empty groups — match the scan path, which never sees
         # them. (Pre-rate presence: computed from the raw in-range
         # mask, like the scan path's "series exists".) One batched
-        # device_get: three separate np.asarray fetches would pay three
-        # transport round trips (~70 ms each on the axon tunnel).
-        gv, gm, has_points = jax.device_get((gv, gm, presence))
+        # device_get — separate np.asarray fetches would each pay a
+        # transport round trip; presence is fetched once per stage.
+        if stage[5] is None:
+            gv, gm, stage[5] = jax.device_get((gv, gm, presence_dev))
+        else:
+            gv, gm = jax.device_get((gv, gm))
+        has_points = stage[5]
+        gm = np.unpackbits(gm, axis=1, count=b_out).astype(bool)
         results = []
         for gi, gkey in enumerate(gkeys):
             live = [sid for sid in groups[gkey] if has_points[sid]]
@@ -886,6 +904,13 @@ def _pad_size(n: int) -> int:
     while size < n:
         size *= 2
     return size
+
+
+def _pad64(n: int) -> int:
+    """Round up to a multiple of 64 (min 64): fetch-slice quantization —
+    fine enough to cut padded-transfer waste, coarse enough to bound
+    the distinct static shapes the apply kernels compile for."""
+    return max((n + 63) // 64 * 64, 64)
 
 
 def _filter_key(exact, group_bys):
