@@ -286,17 +286,6 @@ def group_moments(filled: jnp.ndarray, in_range: jnp.ndarray):
 # Device-window helpers (storage/devstore.py query path)
 # ---------------------------------------------------------------------------
 
-@jax.jit
-def window_mask(rel_ts: jnp.ndarray, sid: jnp.ndarray, valid: jnp.ndarray,
-                include: jnp.ndarray, lo, hi, shift):
-    """Range + series filter over resident columns, entirely on device:
-    keeps points of included series with epoch-relative timestamps in
-    [lo, hi], rebased to ``shift`` (the query's bucket-aligned base).
-    Returns (query-relative ts [N] int32, valid [N])."""
-    ok = valid & include[sid] & (rel_ts >= lo) & (rel_ts <= hi)
-    return rel_ts - shift, ok
-
-
 def _window_series_stage(rel_ts, vals, sid, valid_in, lo, hi, shift, *,
                          num_series, num_buckets, interval, agg_down,
                          rate=False, counter_max=0.0, reset_value=0.0,

@@ -283,7 +283,7 @@ class QueryExecutor:
             return []
 
         # The shift (qbase - epoch) participates in arithmetic on device
-        # (rel_ts - shift in window_mask) — unlike lo/hi, which are
+        # (rel_ts - shift in window_series_stage) — unlike lo/hi, which are
         # comparison-only and clamp safely. If it doesn't fit in int32
         # (e.g. an all-time query against a metric whose epoch is past
         # 2^31), fall back to the scan path rather than silently
@@ -357,6 +357,15 @@ class QueryExecutor:
                 agg_down=dsagg, **rate_kw)
             # [5] fills with the host copy of presence on first fetch.
             stage = list(grids) + [None]
+            # Stages of this metric's EARLIER data versions can never
+            # hit again (version is monotonic) but each pins [S, B]
+            # grids in HBM the devwindow's own budget can't see — drop
+            # them before the size cap so active ingest (a version bump
+            # per flush) doesn't strand dead grids on device.
+            for k in [k for k in cache
+                      if k[:2] == (dw.instance_id, metric_uid)
+                      and k[2] != cols.version]:
+                del cache[k]
             if len(cache) >= 4:  # a handful of HBM-sized stages
                 cache.clear()
             cache[skey] = stage

@@ -502,3 +502,53 @@ class TestMeshedRatePercentile:
         np.testing.assert_array_equal(p.timestamps, m.timestamps)
         np.testing.assert_allclose(m.values, p.values, rtol=1e-3,
                                    atol=1e-4)
+
+
+class TestStageCacheSharing:
+    """The devwindow stage cache is FILTER-INDEPENDENT (r03 design):
+    one cached [S, B] stage serves every panel over the same (metric,
+    range, interval, downsample) — different tag filters, group-bys,
+    aggregators and quantiles — with include applied at the [S, B]
+    apply stage. These guard that sharing never changes answers."""
+
+    def test_one_stage_many_panels(self, tsdb):
+        ex = QueryExecutor(tsdb, backend="tpu")
+        panels = [
+            QuerySpec("sys.cpu.user", {}, "sum", downsample=(600, "avg")),
+            QuerySpec("sys.cpu.user", {"host": "web01"}, "sum",
+                      downsample=(600, "avg")),
+            QuerySpec("sys.cpu.user", {"host": "*"}, "max",
+                      downsample=(600, "avg")),
+            QuerySpec("sys.cpu.user", {}, "p95", downsample=(600, "avg")),
+            QuerySpec("sys.cpu.user", {"host": "*"}, "p50",
+                      downsample=(600, "avg")),
+        ]
+        # All five panels share one (metric, range, interval, agg_down)
+        # -> ONE stage cache entry.
+        got = [ex.run(spec, BT, BT + 7200) for spec in panels]
+        assert len(getattr(ex, "_dw_stage_cache")) == 1
+        # Each panel must still match its own oracle run.
+        ex_cpu = QueryExecutor(tsdb, backend="cpu")
+        for spec, res in zip(panels, got):
+            want = ex_cpu.run(spec, BT, BT + 7200)
+            assert len(want) == len(res)
+            for c, t in zip(want, res):
+                assert c.tags == t.tags
+                np.testing.assert_array_equal(c.timestamps, t.timestamps)
+                np.testing.assert_allclose(t.values, c.values, rtol=5e-3,
+                                           atol=0.5)
+
+    def test_stage_invalidated_by_new_data(self, tsdb):
+        """A data change bumps cols.version, so the cached stage must
+        not serve stale answers."""
+        ex = QueryExecutor(tsdb, backend="tpu")
+        spec = QuerySpec("sys.mem.free", {}, "sum", downsample=(600, "avg"))
+        before = ex.run(spec, BT, BT + 7200)
+        ts = np.arange(BT + 3600, BT + 3900, 60, dtype=np.int64)
+        tsdb.add_batch("sys.mem.free", ts, np.full(len(ts), 1e6, np.float32),
+                       {"host": "web09"})
+        if tsdb.devwindow is not None:
+            tsdb.devwindow.flush()
+        after = ex.run(spec, BT, BT + 7200)
+        assert float(np.nanmax(after[0].values)) > \
+            float(np.nanmax(before[0].values))
