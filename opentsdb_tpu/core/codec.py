@@ -232,6 +232,16 @@ def series_key(key: bytes) -> bytes:
     return key[:UID_WIDTH] + key[UID_WIDTH + TIMESTAMP_BYTES:]
 
 
+def series_tag_uids(skey: bytes) -> dict[bytes, bytes]:
+    """Tag (tagk_uid -> tagv_uid) pairs of a SERIES key (metric UID then
+    alternating tagk/tagv UIDs — no base-time bytes). The one definition
+    of the series-key tag layout; query planning and the devwindow
+    series directory both parse through here."""
+    w = UID_WIDTH
+    return {skey[i:i + w]: skey[i + w:i + 2 * w]
+            for i in range(w, len(skey), 2 * w)}
+
+
 # ---------------------------------------------------------------------------
 # Cells and compaction-format helpers
 # ---------------------------------------------------------------------------

@@ -433,17 +433,17 @@ class TSDB:
             rows.clear(), quals.clear(), vals.clear(), bases.clear()
             return out
 
-        for cells in self.store.scan(self.table, start_key, stop_key,
-                                     family=FAMILY, key_regexp=key_regexp):
-            key = cells[0].key
+        for key, items in self.store.scan_raw(
+                self.table, start_key, stop_key,
+                family=FAMILY, key_regexp=key_regexp):
             base = codec.key_base_time(key)
             kept = 0
-            for c in cells:
-                if len(c.qualifier) % 2 != 0 or not c.qualifier:
+            for q, v in items:
+                if len(q) % 2 != 0 or not q:
                     continue  # foreign/annotation cells: skipped like
                     # read_row
-                quals.append(c.qualifier)
-                vals.append(c.value)
+                quals.append(q)
+                vals.append(v)
                 bases.append(base)
                 kept += 1
             rows.append((key, kept))
@@ -451,6 +451,87 @@ class TSDB:
                 yield from decode_batch()
         if rows:
             yield from decode_batch()
+
+    def scan_series(self, start_key: bytes, stop_key: bytes,
+                    key_regexp: bytes | None = None,
+                    batch_cells: int = 1 << 18):
+        """Whole-range columnar scan regrouped BY SERIES in vectorized
+        passes: returns (series_keys, per_series Columns dict) with one
+        global (series, timestamp) lexsort + one vectorized dedup pass
+        instead of per-row Columns objects and per-series
+        re-concatenation. Profiled on the cold query path (the row-hour
+        layout means ~10 points/row): per-row namedtuple construction +
+        columns_concat of ~168 hour-parts per series cost more than the
+        decode itself; here both collapse into a handful of
+        whole-range numpy ops. Duplicate (series, ts) points collapse
+        when value-equal and raise IllegalDataError otherwise —
+        sort_dedup's rule (reference complexCompact :600-679)."""
+        from opentsdb_tpu.core.errors import IllegalDataError
+        quals: list[bytes] = []
+        vals: list[bytes] = []
+        bases: list[int] = []
+        cell_sid: list[int] = []
+        skey_index: dict[bytes, int] = {}
+        skeys: list[bytes] = []
+        parts: list[tuple] = []     # decoded (ts, f, i, isf, sid) batches
+
+        def decode_batch():
+            ts, f, i, isf, cop = codec_np.decode_cells_flat(
+                quals, vals, np.asarray(bases, np.int64))
+            sid = np.asarray(cell_sid, np.int64)[cop]
+            parts.append((ts, f, i, isf, sid))
+            quals.clear(), vals.clear(), bases.clear(), cell_sid.clear()
+
+        for key, items in self.store.scan_raw(
+                self.table, start_key, stop_key,
+                family=FAMILY, key_regexp=key_regexp):
+            base = codec.key_base_time(key)
+            skey = codec.series_key(key)
+            si = skey_index.get(skey)
+            if si is None:
+                si = skey_index[skey] = len(skeys)
+                skeys.append(skey)
+            for q, v in items:
+                if len(q) % 2 != 0 or not q:
+                    continue
+                quals.append(q)
+                vals.append(v)
+                bases.append(base)
+                cell_sid.append(si)
+            if len(quals) >= batch_cells:
+                decode_batch()
+        if quals:
+            decode_batch()
+        if not parts:
+            return skeys, {}
+        ts = np.concatenate([p[0] for p in parts])
+        f = np.concatenate([p[1] for p in parts])
+        i = np.concatenate([p[2] for p in parts])
+        isf = np.concatenate([p[3] for p in parts])
+        sid = np.concatenate([p[4] for p in parts])
+        order = np.lexsort((ts, sid))
+        ts, f, i, isf, sid = (ts[order], f[order], i[order], isf[order],
+                              sid[order])
+        if len(ts) > 1:
+            dup = (sid[1:] == sid[:-1]) & (ts[1:] == ts[:-1])
+            if dup.any():
+                same = ((isf[1:] == isf[:-1])
+                        & np.where(isf[1:], f[1:] == f[:-1],
+                                   i[1:] == i[:-1]))
+                if (dup & ~same).any():
+                    bad = int(ts[1:][dup & ~same][0])
+                    raise IllegalDataError(
+                        f"Found out of order or duplicate data: "
+                        f"ts={bad} -- run an fsck.")
+                keep = np.concatenate(([True], ~dup))
+                ts, f, i, isf, sid = (ts[keep], f[keep], i[keep],
+                                      isf[keep], sid[keep])
+        bounds = np.searchsorted(sid, np.arange(len(skeys) + 1))
+        per_series = {
+            skeys[s]: codec.Columns(ts[a:b], f[a:b], i[a:b], isf[a:b])
+            for s, (a, b) in enumerate(zip(bounds[:-1], bounds[1:]))
+            if b > a}
+        return skeys, per_series
 
     # ------------------------------------------------------------------
     # Suggest / admin / lifecycle

@@ -147,23 +147,14 @@ class QueryExecutor:
             min(codec.base_time(end) + MAX_TIMESPAN, 0xFFFFFFFF))
         regexp = self._build_regexp(exact, group_bys)
 
-        spans: dict[bytes, list] = {}
-        span_tags: dict[bytes, dict[bytes, bytes]] = {}
-        for key, cols in self.tsdb.scan_columns(start_key, stop_key,
-                                                key_regexp=regexp):
-            skey = codec.series_key(key)
-            if skey not in spans:
-                spans[skey] = []
-                span_tags[skey] = dict(codec.parse_row_key(key).tag_uids)
-            spans[skey].append(cols)
-
+        _, per_series = self.tsdb.scan_series(start_key, stop_key,
+                                              key_regexp=regexp)
         groups: dict[tuple, list[_Span]] = {}
-        for skey, parts in spans.items():
-            cat = codec.columns_concat(parts)
+        for skey, cat in per_series.items():
             m = (cat.timestamps >= start) & (cat.timestamps <= end)
             if not m.any():
                 continue
-            tag_uids = span_tags[skey]
+            tag_uids = codec.series_tag_uids(skey)
             named = {
                 self.tsdb.tagk.get_name(k): self.tsdb.tagv.get_name(v)
                 for k, v in tag_uids.items()}
@@ -440,11 +431,8 @@ class QueryExecutor:
         gb = {k: (set(v) if v else None) for k, v in group_bys}
         groups: dict[tuple, list[int]] = {}
         named: dict[int, dict[str, str]] = {}
-        w = UID_WIDTH
         for sid, skey in enumerate(cols.series_keys):
-            pairs = [(skey[i:i + w], skey[i + w:i + 2 * w])
-                     for i in range(w, len(skey), 2 * w)]
-            tag_uids = dict(pairs)
+            tag_uids = codec.series_tag_uids(skey)
             ok = all(tag_uids.get(k) == v for k, v in want.items())
             if ok:
                 for k, allowed in gb.items():
@@ -460,7 +448,7 @@ class QueryExecutor:
                 []).append(sid)
             named[sid] = {
                 self.tsdb.tagk.get_name(k): self.tsdb.tagv.get_name(v)
-                for k, v in pairs}
+                for k, v in tag_uids.items()}
         if len(cache) > 128:
             cache.clear()
         cache[fkey] = (cols.generation, groups, named)

@@ -111,6 +111,19 @@ class KVStore:
              key_regexp: bytes | None = None) -> Iterator[list[Cell]]:
         raise NotImplementedError
 
+    def scan_raw(self, table: str, start: bytes, stop: bytes,
+                 family: bytes | None = None,
+                 key_regexp: bytes | None = None,
+                 ) -> Iterator[tuple[bytes, list[tuple[bytes, bytes]]]]:
+        """Scan for bulk decode: (key, [(qualifier, value), ...]) rows,
+        qualifiers sorted — no Cell objects. Default adapts scan();
+        stores override with a batched implementation (the columnar
+        read path calls this per row-HOUR, so per-row allocation and
+        locking overhead multiplies by the whole scanned range)."""
+        for cells in self.scan(table, start, stop, family=family,
+                               key_regexp=key_regexp):
+            yield cells[0].key, [(c.qualifier, c.value) for c in cells]
+
     def atomic_increment(self, table: str, key: bytes, family: bytes,
                          qualifier: bytes, amount: int = 1) -> int:
         raise NotImplementedError
@@ -407,8 +420,15 @@ class MemKVStore(KVStore):
             return
         payload = b"".join(struct.pack(">I", len(p)) + p for p in parts)
         self._wal.write(_REC.pack(op, len(payload)) + payload)
+        # Always push past the USERSPACE buffer: without this, up to
+        # 8 KiB of acknowledged writes sit in the Python file object and
+        # a SIGTERM/crash loses them silently — found live, with every
+        # verification daemon's WAL at 0 bytes after a kill. flush() is
+        # process-crash-safe (data reaches the OS page cache);
+        # ``fsync`` additionally survives power loss, at ~ms cost per
+        # append.
+        self._wal.flush()
         if self._fsync:
-            self._wal.flush()
             os.fsync(self._wal.fileno())
 
     @staticmethod
@@ -694,6 +714,29 @@ class MemKVStore(KVStore):
             cells.sort(key=lambda c: (c.family, c.qualifier))
             return cells
 
+    def _snapshot_keys(self, table: str, start: bytes,
+                       stop: bytes) -> list[bytes]:
+        """Key snapshot across all tiers (live memtable + frozen +
+        sstable, tombstone-excluded). Caller holds the lock. One
+        definition for scan() and scan_raw() so tier-merge fixes can't
+        diverge the two."""
+        t = self._table(table)
+        keys = t.range_keys(start, stop)
+        ft = self._frozen.get(table) if self._frozen else None
+        extra = set()
+        if ft is not None:
+            extra.update(k for k in ft.range_keys(start, stop)
+                         if k not in t.rows and k not in t.row_tombs)
+        if self._sst is not None:
+            extra.update(
+                k for k in self._sst.scan_keys(table, start, stop)
+                if k not in t.rows and k not in t.row_tombs
+                and not (ft is not None and (k in ft.rows
+                                             or k in ft.row_tombs)))
+        if extra:
+            keys = sorted(set(keys) | extra)
+        return keys
+
     def scan(self, table: str, start: bytes, stop: bytes,
              family: bytes | None = None,
              key_regexp: bytes | None = None) -> Iterator[list[Cell]]:
@@ -709,21 +752,7 @@ class MemKVStore(KVStore):
         """
         pattern = re.compile(key_regexp, re.S) if key_regexp else None
         with self._lock:
-            t = self._table(table)
-            keys = t.range_keys(start, stop)
-            ft = self._frozen.get(table) if self._frozen else None
-            extra = set()
-            if ft is not None:
-                extra.update(k for k in ft.range_keys(start, stop)
-                             if k not in t.rows and k not in t.row_tombs)
-            if self._sst is not None:
-                extra.update(
-                    k for k in self._sst.scan_keys(table, start, stop)
-                    if k not in t.rows and k not in t.row_tombs
-                    and not (ft is not None and (k in ft.rows
-                                                 or k in ft.row_tombs)))
-            if extra:
-                keys = sorted(set(keys) | extra)
+            keys = self._snapshot_keys(table, start, stop)
         for key in keys:
             if pattern is not None and not pattern.match(key):
                 continue
@@ -736,6 +765,59 @@ class MemKVStore(KVStore):
             cells.sort(key=lambda c: (c.family, c.qualifier))
             if cells:
                 yield cells
+
+    def scan_raw(self, table: str, start: bytes, stop: bytes,
+                 family: bytes | None = None,
+                 key_regexp: bytes | None = None, chunk: int = 1024,
+                 ) -> Iterator[tuple[bytes, list[tuple[bytes, bytes]]]]:
+        """Batched form of scan() for the columnar decode path: rows as
+        (key, sorted [(qualifier, value), ...]), the lock taken once per
+        ``chunk`` keys and no Cell allocations. Same snapshot semantics
+        as scan(); a 1M-point query scans ~100k+ row-hours, so the
+        per-row lock/namedtuple/generator overhead of the cell API was
+        the single largest host cost of the cold query path (profiled:
+        ~16 us/row, more than the vectorized decode itself)."""
+        pattern = re.compile(key_regexp, re.S) if key_regexp else None
+        with self._lock:
+            keys = self._snapshot_keys(table, start, stop)
+        if pattern is not None:
+            keys = [k for k in keys if pattern.match(k)]
+        for i in range(0, len(keys), chunk):
+            out = []
+            with self._lock:
+                # Tier state re-checked UNDER THE LOCK each chunk: a
+                # concurrent checkpoint() can freeze the live memtable
+                # between chunks, and a stale fast-path would then read
+                # the freshly-emptied live dict and silently drop rows.
+                if self._sst is None and self._frozen is None:
+                    # No lower tiers => no tombstones; read the live
+                    # memtable dict directly (skips a function call +
+                    # tier checks per row — this loop runs per row-hour
+                    # over the whole scanned range).
+                    rows_get = self._table(table).rows.get
+                    for key in keys[i:i + chunk]:
+                        row = rows_get(key)
+                        if not row:
+                            continue
+                        items = [(q, v) for (f, q), v in row.items()
+                                 if family is None or f == family]
+                        if items:
+                            items.sort()
+                            out.append((key, items))
+                else:
+                    for key in keys[i:i + chunk]:
+                        row = self._merged_row(table, key)
+                        if not row:
+                            continue
+                        if family is None:
+                            items = [(q, v) for (_, q), v in row.items()]
+                        else:
+                            items = [(q, v) for (f, q), v in row.items()
+                                     if f == family]
+                        if items:
+                            items.sort()
+                            out.append((key, items))
+            yield from out
 
     # -- atomics ----------------------------------------------------------
 

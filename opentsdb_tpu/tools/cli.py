@@ -98,6 +98,17 @@ def cmd_tsd(args) -> int:
 
     async def main():
         await server.start()
+        # Graceful shutdown on SIGTERM/SIGINT (the reference registers
+        # a JVM shutdown hook, TSDMain.java): flush + close the WAL and
+        # stop threads instead of dying with buffered state.
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, server.request_shutdown)
+            except (NotImplementedError, RuntimeError):
+                pass  # non-Unix event loop
         print(f"Ready to serve on {tsdb.config.bind}:{server.port}",
               flush=True)
         await server.serve_forever()

@@ -189,6 +189,26 @@ class TestPutMany:
         assert s.has_row("t", b"k2")
         assert not s.has_row("t", b"k3")
 
+    def test_wal_records_reach_disk_without_close(self, tmp_path):
+        """Every acknowledged append must be visible on disk IMMEDIATELY
+        (userspace buffer flushed): a SIGTERM'd daemon must not lose
+        acked writes. Found live in r03 — a killed TSD left a 0-byte
+        WAL because small workloads never filled Python's 8 KiB file
+        buffer."""
+        import os
+        import shutil
+
+        wal = str(tmp_path / "wal.log")
+        s = MemKVStore(wal_path=wal)
+        s.ensure_table("t")
+        s.put("t", b"row1", b"f", b"q", b"v")
+        # NO flush/close: the record must already be on disk, and a
+        # store replaying a snapshot of the file must see the row.
+        assert os.path.getsize(wal) > 0
+        shutil.copy(wal, str(tmp_path / "snap.log"))
+        s2 = MemKVStore(wal_path=str(tmp_path / "snap.log"))
+        assert s2.has_row("t", b"row1")
+
     def test_wal_replay_matches_put_loop(self, tmp_path):
         wal = str(tmp_path / "wal.log")
         s = MemKVStore(wal_path=wal)
@@ -251,3 +271,21 @@ class TestIncrementalIndex:
         list(store.scan(T, b"zzz", b"\xff" * 8))
         assert id(t.base) == base_id  # no O(N) rebuild for 5 inserts
         assert len(t.delta) == 5
+
+
+def test_scan_raw_sees_rows_frozen_mid_scan(tmp_path):
+    """A checkpoint() between scan_raw chunks freezes the live memtable;
+    the scan's later chunks must keep reading through the tiers (the
+    fast-path tier check re-evaluates under each chunk's lock — a
+    stale check read the freshly-emptied live dict and silently
+    dropped every remaining row)."""
+    s = MemKVStore(wal_path=str(tmp_path / "wal"))
+    s.ensure_table("t")
+    keys = [b"k%05d" % i for i in range(3000)]
+    for k in keys:
+        s.put("t", k, b"f", b"q", b"v" + k)
+    it = s.scan_raw("t", b"", b"\xff" * 8, chunk=1024)
+    got = [next(it)[0]]               # first chunk begins streaming
+    s.checkpoint()                    # freezes live memtable mid-scan
+    got += [k for k, _ in it]
+    assert got == keys
