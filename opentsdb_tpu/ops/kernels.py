@@ -28,8 +28,6 @@ import numpy as np
 
 from opentsdb_tpu.core.const import NOLERP_AGGS
 
-AGG_IDS = {"sum": 0, "min": 1, "max": 2, "avg": 3, "dev": 4, "count": 5}
-
 # Plain Python floats: creating jnp scalars at import time would
 # instantiate a device array and eagerly initialize the backend.
 _NEG_INF = float("-inf")
@@ -315,11 +313,9 @@ def _window_series_stage(rel_ts, vals, sid, valid_in, lo, hi, shift, *,
         agg_group="count", rate=rate, counter_max=counter_max,
         reset_value=reset_value, counter=counter,
         drop_resets=drop_resets)
-    fill = step_fill if rate else gap_fill
-    filled, in_range = fill(out["series_values"], out["series_mask"],
-                            num_buckets)
-    return (out["series_values"], out["series_mask"], filled, in_range,
-            out["presence"])
+    return _stage_tail(out["series_values"], out["series_mask"],
+                       out["presence"], num_buckets=num_buckets,
+                       rate=rate)
 
 
 def _group_stage(filled, in_range, series_mask, gmap, *, num_groups,
@@ -411,6 +407,127 @@ def _quantile_apply(series_mask, filled, in_range,
     if g_out is None:
         return gv, gm
     return _shrink_wrap(gv, gm, g_out, b_out)
+
+
+def _stage_tail(series_values, series_mask, presence, *, num_buckets,
+                rate):
+    """Shared tail of both window stages (concat + chunked): fill per
+    the rate family and return the stage contract. One definition so
+    the fill-choice semantics can't diverge between the two."""
+    fill = step_fill if rate else gap_fill
+    filled, in_range = fill(series_values, series_mask, num_buckets)
+    return series_values, series_mask, filled, in_range, presence
+
+
+def chunk_mergeable(agg_down: str) -> bool:
+    """Whether the chunked (concat-free) stage supports this downsample
+    aggregator: count/sum/avg/min/max merge across chunks exactly; the
+    centered second moment (dev) does not merge safely in f32. The one
+    place the rule lives — executor routing checks it, the fold asserts
+    it."""
+    return "m2" not in _needs(agg_down)
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(4, 5, 6, 7),
+    static_argnames=("num_series", "num_buckets", "interval", "need"))
+def _chunk_fold(rel_ts, vals, sid, valid, count, total, mn, mx,
+                lo, hi, shift, *, num_series, num_buckets, interval,
+                need):
+    """Fold ONE resident chunk into the per-(series, bucket)
+    accumulators. Compiled once per chunk shape class (chunks are
+    pow2-padded, so there are only a handful); accumulators are donated
+    so the fold is in-place. The stage driver issues these
+    back-to-back ASYNC — dispatch does not wait for the device, so K
+    chunks cost ~K host-side submissions, not K round trips."""
+    nseg = num_series * num_buckets + 1
+    ok = valid & (rel_ts >= lo) & (rel_ts <= hi)
+    bucket = jnp.clip((rel_ts - shift) // interval, 0, num_buckets - 1)
+    seg = jnp.where(ok, sid * num_buckets + bucket, nseg - 1)
+    count = count + jax.ops.segment_sum(ok.astype(jnp.float32), seg,
+                                        nseg)
+    if "sum" in need:
+        total = total + jax.ops.segment_sum(
+            jnp.where(ok, vals, 0.0), seg, nseg)
+    if "min" in need:
+        mn = jnp.minimum(mn, jax.ops.segment_min(
+            jnp.where(ok, vals, _POS_INF), seg, nseg))
+    if "max" in need:
+        mx = jnp.maximum(mx, jax.ops.segment_max(
+            jnp.where(ok, vals, _NEG_INF), seg, nseg))
+    return count, total, mn, mx
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_series", "num_buckets", "interval", "agg_down",
+                     "rate", "counter", "drop_resets"))
+def _chunk_stage_finish(count, total, mn, mx, *, num_series, num_buckets,
+                        interval, agg_down, rate=False, counter_max=0.0,
+                        reset_value=0.0, counter=False,
+                        drop_resets=False):
+    per = _finish(agg_down, count,
+                  total if "sum" in _needs(agg_down) else None,
+                  None,
+                  mn if "min" in _needs(agg_down) else None,
+                  mx if "max" in _needs(agg_down) else None)
+    shape = (num_series, num_buckets)
+    series_values = per[:-1].reshape(shape)
+    series_mask = count[:-1].reshape(shape) > 0
+    presence = series_mask.any(axis=1)  # pre-rate, like downsample_group
+    if rate:
+        series_values, series_mask = bucket_rate(
+            series_values, series_mask, interval, counter_max,
+            reset_value, counter=counter, drop_resets=drop_resets)
+    return _stage_tail(series_values, series_mask, presence,
+                       num_buckets=num_buckets, rate=rate)
+
+
+def window_series_stage_chunks(chunks, lo, hi, shift, *, num_series,
+                               num_buckets, interval, agg_down,
+                               rate=False, counter_max=0.0,
+                               reset_value=0.0, counter=False,
+                               drop_resets=False):
+    """window_series_stage over the devwindow's RAW CHUNK LIST — no
+    concatenated copy of the columns ever exists, so a queryable window
+    can approach the chip's WHOLE HBM (the concat view costs a second
+    full copy plus N-sized transients, capping it near half — the
+    1B-points-resident north star, BASELINE.md).
+
+    Structure: one per-chunk fold jit (compiled once per pow2 chunk
+    shape class, NOT one giant unrolled program that would retrace on
+    every chunk-count change) driven by a host loop; async dispatch
+    pipelines the folds on device and only the finish stage joins.
+    Accumulators are donated, so peak HBM is the resident chunks + one
+    accumulator set + one chunk's transients.
+
+    Supports the mergeable families (see chunk_mergeable); callers
+    route ``dev`` to the concat stage.
+
+    ``chunks``: iterable of (rel_ts, values, sid, valid) tuples.
+    Returns the window_series_stage contract: (series_values,
+    series_mask, filled, in_range, presence)."""
+    assert chunk_mergeable(agg_down), \
+        "dev downsample needs the concat stage"
+    need = _needs(agg_down)
+    nseg = num_series * num_buckets + 1
+    count = jnp.zeros(nseg, jnp.float32)
+    # Unused statistics still flow through the fold signature (static
+    # ``need`` gates their updates to no-ops) so one jit serves every
+    # mergeable aggregator per shape class.
+    total = jnp.zeros(nseg, jnp.float32)
+    mn = jnp.full(nseg, _POS_INF, jnp.float32)
+    mx = jnp.full(nseg, _NEG_INF, jnp.float32)
+    for rel_ts, vals, sid, valid in chunks:
+        count, total, mn, mx = _chunk_fold(
+            rel_ts, vals, sid, valid, count, total, mn, mx,
+            lo, hi, shift, num_series=num_series,
+            num_buckets=num_buckets, interval=interval, need=need)
+    return _chunk_stage_finish(
+        count, total, mn, mx, num_series=num_series,
+        num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+        rate=rate, counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)
 
 
 window_series_stage = functools.partial(

@@ -265,7 +265,12 @@ class QueryExecutor:
             exact, group_bys = self._tag_filters(spec.tags)
         except NoSuchUniqueName:
             return None  # scan path raises the canonical error
-        cols = dw.columns(metric_uid, start, end)
+        # Mergeable downsample families fold the raw chunk list without
+        # a concatenated copy (window can approach the whole HBM); dev
+        # needs the centered M2, which only the concat stage computes.
+        use_chunks = kernels.chunk_mergeable(dsagg)
+        cols = (dw.chunk_columns if use_chunks else dw.columns)(
+            metric_uid, start, end)
         if cols is None:
             return None
         groups, named = self._devwindow_groups(
@@ -341,11 +346,17 @@ class QueryExecutor:
             cache = self._dw_stage_cache = {}
         stage = cache.get(skey)
         if stage is None:
-            grids = kernels.window_series_stage(
-                cols.rel_ts, cols.values, cols.sid, cols.valid,
-                lo32, hi32, shift32, num_series=S_pad,
-                num_buckets=num_buckets, interval=interval,
-                agg_down=dsagg, **rate_kw)
+            if use_chunks:
+                grids = kernels.window_series_stage_chunks(
+                    cols.chunks, lo32, hi32, shift32, num_series=S_pad,
+                    num_buckets=num_buckets, interval=interval,
+                    agg_down=dsagg, **rate_kw)
+            else:
+                grids = kernels.window_series_stage(
+                    cols.rel_ts, cols.values, cols.sid, cols.valid,
+                    lo32, hi32, shift32, num_series=S_pad,
+                    num_buckets=num_buckets, interval=interval,
+                    agg_down=dsagg, **rate_kw)
             # [5] fills with the host copy of presence on first fetch.
             stage = list(grids) + [None]
             # Stages of this metric's EARLIER data versions can never

@@ -66,11 +66,25 @@ class DevColumns(NamedTuple):
     #                         chunks) — derived-result cache key
 
 
+class DevChunks(NamedTuple):
+    """One metric's resident window as its RAW device chunk list — no
+    concatenation. The chunked query stage (ops/kernels
+    window_series_stage_chunks) folds these into [S, B] grids with
+    per-chunk transients, so a window can approach the chip's whole
+    HBM: the concat view costs a second full copy of the columns plus
+    N-sized kernel transients, which caps it near half the HBM."""
+    chunks: list            # [(rel_ts, values, sid, valid) device arrays]
+    epoch: int
+    series_keys: list
+    generation: int
+    version: int
+
+
 class _MetricWindow:
     __slots__ = ("sids", "keys", "last_ts", "epoch", "chunks",
                  "staged_ts", "staged_vals", "staged_sid", "staged_n",
                  "dirty", "complete_from", "concat", "generation",
-                 "device_points", "inflight")
+                 "version", "device_points", "inflight")
 
     def __init__(self) -> None:
         self.sids: dict[bytes, int] = {}
@@ -86,6 +100,9 @@ class _MetricWindow:
         self.complete_from: int | None = None  # None = since forever
         self.concat: DevColumns | None = None
         self.generation = 0
+        self.version = 0          # bumps on ANY data change (chunk
+        #                           appended/evicted, invalidate) —
+        #                           derived-result cache key
         self.device_points = 0
         self.inflight = 0               # taken-but-not-uploaded batches
 
@@ -127,7 +144,6 @@ class DeviceWindow:
         # chunk fleet-wide.
         self._total_points = 0
         self._seq = 0
-        self._concat_version = 0
         # stats
         self.appended_points = 0
         self.evicted_points = 0
@@ -287,6 +303,7 @@ class DeviceWindow:
             mw.device_points += n
             self._total_points += n
             mw.concat = None
+            mw.version += 1
             # Evict the globally-oldest chunks past the (per-chip, NOT
             # per-metric) budget. complete_from of the owning metric
             # advances past everything the evicted chunk could cover.
@@ -302,6 +319,7 @@ class DeviceWindow:
                 self._total_points -= old["n"]
                 self.evicted_points += old["n"]
                 victim.concat = None
+                victim.version += 1
                 nxt = old["max_ts"] + 1
                 if (victim.complete_from is None
                         or nxt > victim.complete_from):
@@ -335,6 +353,7 @@ class DeviceWindow:
         mw.dirty = True
         mw.chunks.clear()
         mw.concat = None
+        mw.version += 1
         mw.staged_ts.clear()
         mw.staged_vals.clear()
         mw.staged_sid.clear()
@@ -344,10 +363,13 @@ class DeviceWindow:
 
     # -- query side ----------------------------------------------------
 
-    def columns(self, metric_uid: bytes, start: int,
-                end: int) -> DevColumns | None:
-        """The metric's resident columns when they exactly cover
-        [start, end]; None means the caller must use the scan path."""
+    def _ready_window(self, metric_uid: bytes,
+                      start: int) -> "_MetricWindow | None":
+        """The shared availability preamble of columns()/chunk_columns():
+        drain this metric's staged batch, wait for ITS in-flight
+        uploads, then validate the exact-coverage contract. Returns the
+        window with the LOCK HELD on success (caller must release), or
+        None (lock released) for scan-path fallback."""
         with self._lock:
             mw = self._metrics.get(metric_uid)
             if mw is None:
@@ -369,20 +391,29 @@ class DeviceWindow:
         with self._cond:
             while mw.inflight > 0:
                 self._cond.wait()
-        with self._lock:
-            if mw.dirty:
-                self.dirty_fallbacks += 1
-                return None
-            if mw.complete_from is not None and start < mw.complete_from:
-                self.window_misses += 1
-                return None
-            if not mw.chunks:
-                self.window_misses += 1
-                return None
+        self._lock.acquire()
+        if mw.dirty:
+            self.dirty_fallbacks += 1
+            self._lock.release()
+            return None
+        if (mw.complete_from is not None and start < mw.complete_from) \
+                or not mw.chunks:
+            self.window_misses += 1
+            self._lock.release()
+            return None
+        return mw
+
+    def columns(self, metric_uid: bytes, start: int,
+                end: int) -> DevColumns | None:
+        """The metric's resident columns when they exactly cover
+        [start, end]; None means the caller must use the scan path."""
+        mw = self._ready_window(metric_uid, start)
+        if mw is None:
+            return None
+        try:
             if mw.concat is None or mw.concat.generation != mw.generation:
                 import jax.numpy as jnp
 
-                self._concat_version += 1
                 mw.concat = DevColumns(
                     rel_ts=jnp.concatenate(
                         [c["ts"] for c in mw.chunks]),
@@ -393,9 +424,30 @@ class DeviceWindow:
                         [c["valid"] for c in mw.chunks]),
                     epoch=mw.epoch, series_keys=list(mw.keys),
                     generation=mw.generation,
-                    version=self._concat_version)
+                    version=mw.version)
             self.window_hits += 1
             return mw.concat
+        finally:
+            self._lock.release()
+
+    def chunk_columns(self, metric_uid: bytes, start: int,
+                      end: int) -> DevChunks | None:
+        """Like columns(), but returns the raw chunk list without
+        building (or caching) the concatenated view — the chunked query
+        stage folds it without a second full copy of the columns. Same
+        availability contract: None means scan-path fallback."""
+        mw = self._ready_window(metric_uid, start)
+        if mw is None:
+            return None
+        try:
+            self.window_hits += 1
+            return DevChunks(
+                chunks=[(c["ts"], c["vals"], c["sid"], c["valid"])
+                        for c in mw.chunks],
+                epoch=mw.epoch, series_keys=list(mw.keys),
+                generation=mw.generation, version=mw.version)
+        finally:
+            self._lock.release()
 
     # -- observability -------------------------------------------------
 

@@ -62,6 +62,11 @@ def main() -> int:
     ap.add_argument("--chunk", type=int, default=100_000,
                     help="points per add_batch call")
     ap.add_argument("--rss-cap-gb", type=float, default=100.0)
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="spill memtable->sstable + truncate WAL every N "
+                         "ingested points (0=only at end) — the "
+                         "steady-state daemon shape: bounded RSS and "
+                         "bounded recovery time under sustained ingest")
     ap.add_argument("--workdir", default="/tmp/tsdb_scale")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
@@ -105,6 +110,8 @@ def main() -> int:
     total = 0
     peak_rss = 0.0
     ceiling = None
+    mid_ckpts: list[dict] = []
+    next_ckpt = args.checkpoint_every or (1 << 62)
     t_ingest = time.perf_counter()
     last_log = t_ingest
     for si in range(args.series):
@@ -117,6 +124,17 @@ def main() -> int:
             vals = (np.cumsum(rng.normal(0, 1, n).astype(np.float32))
                     + 100.0)
             total += tsdb.add_batch("scale.metric", ts, vals, tags)
+            if total >= next_ckpt:
+                t0 = time.perf_counter()
+                rows = tsdb.checkpoint()
+                mid_ckpts.append({
+                    "at_points": total,
+                    "wall_s": round(time.perf_counter() - t0, 1),
+                    "rows_spilled": rows,
+                    "rss_gb_after": round(rss_gb(), 1)})
+                log(f"  mid-run checkpoint @ {total:,}: "
+                    f"{mid_ckpts[-1]}")
+                next_ckpt = total + args.checkpoint_every
         if si % 50 == 0 or si == args.series - 1:
             now = time.perf_counter()
             r = rss_gb()
@@ -141,6 +159,8 @@ def main() -> int:
                      "peak_rss_gb": round(peak_rss, 1),
                      "ceiling": ceiling or "target reached"}
     out["wal_bytes"] = os.path.getsize(wal) if os.path.exists(wal) else 0
+    if mid_ckpts:
+        out["mid_checkpoints"] = mid_ckpts
     log(f"ingested {total:,} in {ingest_s:,.0f}s "
         f"({total/ingest_s:,.0f} dps), wal "
         f"{out['wal_bytes']/(1<<30):.2f} GB")
