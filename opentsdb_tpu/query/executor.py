@@ -269,8 +269,17 @@ class QueryExecutor:
         # a concatenated copy (window can approach the whole HBM); dev
         # needs the centered M2, which only the concat stage computes.
         use_chunks = kernels.chunk_mergeable(dsagg)
-        cols = (dw.chunk_columns if use_chunks else dw.columns)(
-            metric_uid, start, end)
+        try:
+            cols = (dw.chunk_columns if use_chunks else dw.columns)(
+                metric_uid, start, end)
+        except Exception as e:
+            # dev's concat view doubles the window's footprint; a
+            # near-HBM-sized window then OOMs building it. Degrade to
+            # the scan path (the exact-or-fall-back contract) instead
+            # of erroring the query.
+            if _is_device_oom(e):
+                return None
+            raise
         if cols is None:
             return None
         groups, named = self._devwindow_groups(
@@ -346,17 +355,22 @@ class QueryExecutor:
             cache = self._dw_stage_cache = {}
         stage = cache.get(skey)
         if stage is None:
-            if use_chunks:
-                grids = kernels.window_series_stage_chunks(
-                    cols.chunks, lo32, hi32, shift32, num_series=S_pad,
-                    num_buckets=num_buckets, interval=interval,
-                    agg_down=dsagg, **rate_kw)
-            else:
-                grids = kernels.window_series_stage(
-                    cols.rel_ts, cols.values, cols.sid, cols.valid,
-                    lo32, hi32, shift32, num_series=S_pad,
-                    num_buckets=num_buckets, interval=interval,
-                    agg_down=dsagg, **rate_kw)
+            try:
+                if use_chunks:
+                    grids = kernels.window_series_stage_chunks(
+                        cols.chunks, lo32, hi32, shift32,
+                        num_series=S_pad, num_buckets=num_buckets,
+                        interval=interval, agg_down=dsagg, **rate_kw)
+                else:
+                    grids = kernels.window_series_stage(
+                        cols.rel_ts, cols.values, cols.sid, cols.valid,
+                        lo32, hi32, shift32, num_series=S_pad,
+                        num_buckets=num_buckets, interval=interval,
+                        agg_down=dsagg, **rate_kw)
+            except Exception as e:
+                if _is_device_oom(e):
+                    return None
+                raise
             # [5] fills with the host copy of presence on first fetch.
             stage = list(grids) + [None]
             # Stages of this metric's EARLIER data versions can never
@@ -919,6 +933,14 @@ def _pad64(n: int) -> int:
     fine enough to cut padded-transfer waste, coarse enough to bound
     the distinct static shapes the apply kernels compile for."""
     return max((n + 63) // 64 * 64, 64)
+
+
+def _is_device_oom(e: Exception) -> bool:
+    """Device allocation failure (XLA RESOURCE_EXHAUSTED) — the one
+    non-contract error the devwindow path converts into a scan-path
+    fallback rather than raising."""
+    msg = str(e)
+    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
 
 
 def _filter_key(exact, group_bys):

@@ -114,7 +114,8 @@ class DeviceWindow:
 
     def __init__(self, staging_points: int = 1 << 20,
                  max_points: int = 1 << 26,
-                 background: bool = True) -> None:
+                 background: bool = True,
+                 stall_timeout: float = 60.0) -> None:
         # Process-unique instance token: DevColumns.version counters
         # restart at 0 in a replacement window, so derived-result caches
         # key on (instance_id, version) to survive window swaps.
@@ -123,6 +124,14 @@ class DeviceWindow:
         self.staging_points = staging_points
         self.max_points = max_points
         self.background = background
+        # Degraded-mode guard: a wedged accelerator (hung transport)
+        # freezes the uploader mid-device-call FOREVER. Ingest and
+        # queries must not hang with it — after stall_timeout they
+        # dirty-mark the affected metric and proceed (queries fall back
+        # to the storage scan path; the mark is sticky like every other
+        # fallback). The reference's analog is the HBase-down drain
+        # posture: degrade, never block the write path indefinitely.
+        self.stall_timeout = stall_timeout
         self._lock = threading.RLock()
         self._metrics: dict[bytes, _MetricWindow] = {}
         # Background uploader: host->device copies of staged chunks run
@@ -148,6 +157,7 @@ class DeviceWindow:
         self.appended_points = 0
         self.evicted_points = 0
         self.dirty_fallbacks = 0
+        self.upload_stalls = 0
         self.window_hits = 0
         self.window_misses = 0
 
@@ -239,7 +249,23 @@ class DeviceWindow:
                         target=self._upload_loop, daemon=True,
                         name="devwindow-uploader")
                     self._uploader.start()
-        self._pending.put(work)
+        import queue as _queue
+        try:
+            self._pending.put(work, timeout=self.stall_timeout)
+        except _queue.Full:
+            # Uploader hasn't drained a bounded queue for the whole
+            # stall window: the device (or its transport) is wedged.
+            # Drop THIS metric to degraded mode instead of blocking the
+            # ingest thread behind a dead accelerator. The dropped work
+            # item's in-flight count (taken in _take_staged) must be
+            # released here — it will never reach _run_upload — or
+            # queries would wait on it forever.
+            mw = work[0]
+            with self._cond:
+                self.upload_stalls += 1
+                self._mark_dirty(mw)
+                mw.inflight -= 1
+                self._cond.notify_all()
 
     def _upload_loop(self) -> None:
         while True:
@@ -388,9 +414,22 @@ class DeviceWindow:
         # still drains FIFO behind whatever is ahead of it.
         if work is not None:
             self._run_upload(work)
+        import time as _time
+        deadline = _time.monotonic() + self.stall_timeout
         with self._cond:
-            while mw.inflight > 0:
-                self._cond.wait()
+            # ``dirty`` short-circuits: an already-degraded metric must
+            # answer immediately (sticky scan fallback), not wait a
+            # full stall_timeout per query.
+            while mw.inflight > 0 and not mw.dirty:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    # In-flight upload wedged: degrade this metric so
+                    # the query (and every later one) takes the scan
+                    # path instead of hanging on a dead device.
+                    self.upload_stalls += 1
+                    self._mark_dirty(mw)
+                    break
+                self._cond.wait(timeout=remaining)
         self._lock.acquire()
         if mw.dirty:
             self.dirty_fallbacks += 1
@@ -457,6 +496,7 @@ class DeviceWindow:
         collector.record("devwindow.hits", self.window_hits)
         collector.record("devwindow.misses", self.window_misses)
         collector.record("devwindow.dirty_fallbacks", self.dirty_fallbacks)
+        collector.record("devwindow.upload_stalls", self.upload_stalls)
         with self._lock:
             collector.record("devwindow.metrics", len(self._metrics))
             collector.record(

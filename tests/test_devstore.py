@@ -402,3 +402,50 @@ def test_chunked_stage_matches_concat_stage():
                 np.testing.assert_allclose(
                     ga, gb, rtol=1e-5, atol=1e-5,
                     err_msg=f"{agg} rate={rate} {name}")
+
+
+def test_wedged_uploader_degrades_instead_of_blocking():
+    """A hung accelerator transport must not hang ingest or queries:
+    once the uploader stalls past stall_timeout, appends dirty-mark the
+    metric (sticky scan-path fallback) instead of blocking on the full
+    queue, and queries waiting on an in-flight upload time out to the
+    scan path. Found live in r03: a wedged tunnel froze a 250M-point
+    ingest run mid-flight."""
+    import threading
+    import time
+
+    dw = DeviceWindow(staging_points=64, max_points=1 << 20,
+                      stall_timeout=0.3)
+    gate = threading.Event()
+    real_upload = dw._run_upload
+
+    def stuck_upload(work):
+        gate.wait()             # simulates a hung device call
+        real_upload(work)
+
+    dw._run_upload = stuck_upload
+    muid = b"\x00\x00\x01"
+    key = muid + b"\x00\x00\x01\x00\x00\x02"
+    ts0 = 1_700_000_000
+
+    t0 = time.monotonic()
+    for i in range(8):          # enough batches to fill queue + stall
+        ts = np.arange(ts0 + i * 1000, ts0 + i * 1000 + 100,
+                       dtype=np.int64)
+        dw.append(muid, key, ts, np.ones(100, np.float32))
+    ingest_wall = time.monotonic() - t0
+    # Ingest proceeded: it waited out at most a few stall timeouts, not
+    # forever (a blocking put would never return).
+    assert ingest_wall < 5.0
+    mw = dw._metrics[muid]
+    assert mw.dirty and dw.upload_stalls >= 1
+    # Queries: sticky degraded mode, IMMEDIATE scan fallback — the
+    # dirty mark short-circuits the in-flight wait, and dropped work
+    # items release their in-flight counts (no leak that would make
+    # every later query pay a full stall_timeout).
+    for _ in range(3):
+        t0 = time.monotonic()
+        assert dw.columns(muid, ts0, ts0 + 10_000) is None
+        assert time.monotonic() - t0 < 0.1
+    assert dw.dirty_fallbacks >= 3
+    gate.set()                  # unblock the daemon thread
