@@ -419,19 +419,10 @@ def _stage_tail(series_values, series_mask, presence, *, num_buckets,
     return series_values, series_mask, filled, in_range, presence
 
 
-def chunk_mergeable(agg_down: str) -> bool:
-    """Whether the chunked (concat-free) stage supports this downsample
-    aggregator: count/sum/avg/min/max merge across chunks exactly; the
-    centered second moment (dev) does not merge safely in f32. The one
-    place the rule lives — executor routing checks it, the fold asserts
-    it."""
-    return "m2" not in _needs(agg_down)
-
-
 @functools.partial(
-    jax.jit, donate_argnums=(4, 5, 6, 7),
+    jax.jit, donate_argnums=(4, 5, 6, 7, 8),
     static_argnames=("num_series", "num_buckets", "interval", "need"))
-def _chunk_fold(rel_ts, vals, sid, valid, count, total, mn, mx,
+def _chunk_fold(rel_ts, vals, sid, valid, count, total, m2, mn, mx,
                 lo, hi, shift, *, num_series, num_buckets, interval,
                 need):
     """Fold ONE resident chunk into the per-(series, bucket)
@@ -439,38 +430,63 @@ def _chunk_fold(rel_ts, vals, sid, valid, count, total, mn, mx,
     pow2-padded, so there are only a handful); accumulators are donated
     so the fold is in-place. The stage driver issues these
     back-to-back ASYNC — dispatch does not wait for the device, so K
-    chunks cost ~K host-side submissions, not K round trips."""
+    chunks cost ~K host-side submissions, not K round trips.
+
+    ``m2`` accumulates the exact pairwise (Chan et al.) combination:
+    the chunk's M2 is centered on the CHUNK-local segment means, then
+    corrected by the mean shift against the running accumulator —
+    numerically sound where a naive E[x^2]-E[x]^2 merge cancels
+    catastrophically (same scheme as the sharded psum fan-in,
+    parallel/sharded.py)."""
     nseg = num_series * num_buckets + 1
     ok = valid & (rel_ts >= lo) & (rel_ts <= hi)
     bucket = jnp.clip((rel_ts - shift) // interval, 0, num_buckets - 1)
     seg = jnp.where(ok, sid * num_buckets + bucket, nseg - 1)
-    count = count + jax.ops.segment_sum(ok.astype(jnp.float32), seg,
-                                        nseg)
-    if "sum" in need:
-        total = total + jax.ops.segment_sum(
-            jnp.where(ok, vals, 0.0), seg, nseg)
+    c_cnt = jax.ops.segment_sum(ok.astype(jnp.float32), seg, nseg)
+    c_tot = None
+    if "sum" in need or "m2" in need:
+        c_tot = jax.ops.segment_sum(jnp.where(ok, vals, 0.0), seg,
+                                    nseg)
+    if "m2" in need:
+        c_mean = c_tot / jnp.maximum(c_cnt, 1.0)
+        centered = jnp.where(ok, vals - c_mean[seg], 0.0)
+        c_m2 = jax.ops.segment_sum(centered * centered, seg, nseg)
+        # Chan combine with the running (count, total, m2): the
+        # mean-shift correction uses the PRE-update accumulator.
+        a_cnt = count
+        a_mean = total / jnp.maximum(a_cnt, 1.0)
+        tot_n = a_cnt + c_cnt
+        delta = c_mean - a_mean
+        corr = jnp.where(tot_n > 0,
+                         delta * delta * a_cnt * c_cnt
+                         / jnp.maximum(tot_n, 1.0), 0.0)
+        m2 = m2 + c_m2 + corr
+    count = count + c_cnt
+    if c_tot is not None:
+        total = total + c_tot
     if "min" in need:
         mn = jnp.minimum(mn, jax.ops.segment_min(
             jnp.where(ok, vals, _POS_INF), seg, nseg))
     if "max" in need:
         mx = jnp.maximum(mx, jax.ops.segment_max(
             jnp.where(ok, vals, _NEG_INF), seg, nseg))
-    return count, total, mn, mx
+    return count, total, m2, mn, mx
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("num_series", "num_buckets", "interval", "agg_down",
                      "rate", "counter", "drop_resets"))
-def _chunk_stage_finish(count, total, mn, mx, *, num_series, num_buckets,
-                        interval, agg_down, rate=False, counter_max=0.0,
-                        reset_value=0.0, counter=False,
+def _chunk_stage_finish(count, total, m2, mn, mx, *, num_series,
+                        num_buckets, interval, agg_down, rate=False,
+                        counter_max=0.0, reset_value=0.0, counter=False,
                         drop_resets=False):
+    need = _needs(agg_down)
     per = _finish(agg_down, count,
-                  total if "sum" in _needs(agg_down) else None,
-                  None,
-                  mn if "min" in _needs(agg_down) else None,
-                  mx if "max" in _needs(agg_down) else None)
+                  total if ("sum" in need or "m2" in need) else None,
+                  m2 if "m2" in need else None,
+                  mn if "min" in need else None,
+                  mx if "max" in need else None)
     shape = (num_series, num_buckets)
     series_values = per[:-1].reshape(shape)
     series_mask = count[:-1].reshape(shape) > 0
@@ -501,14 +517,12 @@ def window_series_stage_chunks(chunks, lo, hi, shift, *, num_series,
     Accumulators are donated, so peak HBM is the resident chunks + one
     accumulator set + one chunk's transients.
 
-    Supports the mergeable families (see chunk_mergeable); callers
-    route ``dev`` to the concat stage.
+    Every moment family merges exactly (dev via the chunk-locally-
+    centered M2 + Chan mean-shift correction — see _chunk_fold).
 
     ``chunks``: iterable of (rel_ts, values, sid, valid) tuples.
     Returns the window_series_stage contract: (series_values,
     series_mask, filled, in_range, presence)."""
-    assert chunk_mergeable(agg_down), \
-        "dev downsample needs the concat stage"
     need = _needs(agg_down)
     nseg = num_series * num_buckets + 1
     count = jnp.zeros(nseg, jnp.float32)
@@ -516,15 +530,16 @@ def window_series_stage_chunks(chunks, lo, hi, shift, *, num_series,
     # ``need`` gates their updates to no-ops) so one jit serves every
     # mergeable aggregator per shape class.
     total = jnp.zeros(nseg, jnp.float32)
+    m2 = jnp.zeros(nseg, jnp.float32)
     mn = jnp.full(nseg, _POS_INF, jnp.float32)
     mx = jnp.full(nseg, _NEG_INF, jnp.float32)
     for rel_ts, vals, sid, valid in chunks:
-        count, total, mn, mx = _chunk_fold(
-            rel_ts, vals, sid, valid, count, total, mn, mx,
+        count, total, m2, mn, mx = _chunk_fold(
+            rel_ts, vals, sid, valid, count, total, m2, mn, mx,
             lo, hi, shift, num_series=num_series,
             num_buckets=num_buckets, interval=interval, need=need)
     return _chunk_stage_finish(
-        count, total, mn, mx, num_series=num_series,
+        count, total, m2, mn, mx, num_series=num_series,
         num_buckets=num_buckets, interval=interval, agg_down=agg_down,
         rate=rate, counter_max=counter_max, reset_value=reset_value,
         counter=counter, drop_resets=drop_resets)

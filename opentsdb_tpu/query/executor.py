@@ -265,21 +265,11 @@ class QueryExecutor:
             exact, group_bys = self._tag_filters(spec.tags)
         except NoSuchUniqueName:
             return None  # scan path raises the canonical error
-        # Mergeable downsample families fold the raw chunk list without
-        # a concatenated copy (window can approach the whole HBM); dev
-        # needs the centered M2, which only the concat stage computes.
-        use_chunks = kernels.chunk_mergeable(dsagg)
-        try:
-            cols = (dw.chunk_columns if use_chunks else dw.columns)(
-                metric_uid, start, end)
-        except Exception as e:
-            # dev's concat view doubles the window's footprint; a
-            # near-HBM-sized window then OOMs building it. Degrade to
-            # the scan path (the exact-or-fall-back contract) instead
-            # of erroring the query.
-            if _is_device_oom(e):
-                return None
-            raise
+        # The window serves queries from its raw chunk list (no
+        # concatenated copy — the window can approach the whole HBM);
+        # every moment family folds chunk-wise, dev included (Chan M2
+        # combination, ops/kernels._chunk_fold).
+        cols = dw.chunk_columns(metric_uid, start, end)
         if cols is None:
             return None
         groups, named = self._devwindow_groups(
@@ -356,18 +346,14 @@ class QueryExecutor:
         stage = cache.get(skey)
         if stage is None:
             try:
-                if use_chunks:
-                    grids = kernels.window_series_stage_chunks(
-                        cols.chunks, lo32, hi32, shift32,
-                        num_series=S_pad, num_buckets=num_buckets,
-                        interval=interval, agg_down=dsagg, **rate_kw)
-                else:
-                    grids = kernels.window_series_stage(
-                        cols.rel_ts, cols.values, cols.sid, cols.valid,
-                        lo32, hi32, shift32, num_series=S_pad,
-                        num_buckets=num_buckets, interval=interval,
-                        agg_down=dsagg, **rate_kw)
+                grids = kernels.window_series_stage_chunks(
+                    cols.chunks, lo32, hi32, shift32,
+                    num_series=S_pad, num_buckets=num_buckets,
+                    interval=interval, agg_down=dsagg, **rate_kw)
             except Exception as e:
+                # A near-HBM window can still OOM building the stage
+                # grids; degrade to the storage scan (the
+                # exact-or-fall-back contract) instead of erroring.
                 if _is_device_oom(e):
                     return None
                 raise

@@ -359,7 +359,14 @@ class DeviceWindow:
                                    self._metrics.values()) if w]
         for w in work:
             self._submit(w)
-        self._pending.join()
+        # Bounded barrier: join() would block forever if the uploader
+        # is wedged inside a device call (task_done only fires after
+        # the hung upload returns). Best-effort within stall_timeout.
+        import time as _time
+        deadline = _time.monotonic() + self.stall_timeout
+        while (self._pending.unfinished_tasks
+               and _time.monotonic() < deadline):
+            _time.sleep(0.01)
 
     def invalidate(self, metric_uid: bytes | None = None) -> None:
         """Mark window state unusable after storage mutations the append
@@ -405,15 +412,29 @@ class DeviceWindow:
         # Upload + drain OUTSIDE the lock (the uploader takes the lock
         # to append chunks); then re-check under the lock — the drain
         # can mark dirty (upload failure) or advance complete_from.
-        # The query's own staged batch uploads INLINE on this thread
-        # (not via _submit): the bounded queue may be full of other
-        # metrics' uploads, and blocking a query on those would couple
-        # its latency to unrelated ingest bursts. Then wait on THIS
-        # metric's in-flight count, not the global queue. Residual
-        # coupling: a batch of this metric already sitting in the queue
-        # still drains FIFO behind whatever is ahead of it.
+        # The query's staged batch uploads INLINE (not via the queue:
+        # queueing would couple this query's latency to other metrics'
+        # stuck uploads — ADVICE r02) but on a JOINABLE helper thread
+        # with the stall deadline: a device call wedged inside the
+        # transport cannot be interrupted, so the query thread must
+        # never make it directly. On timeout the metric degrades
+        # (sticky dirty -> scan path) and the parked helper is a
+        # bounded daemon-thread leak; if the device later revives and
+        # the upload lands, _upload's dirty check discards it.
         if work is not None:
-            self._run_upload(work)
+            t = threading.Thread(target=self._run_upload, args=(work,),
+                                 daemon=True,
+                                 name="devwindow-query-drain")
+            t.start()
+            t.join(timeout=self.stall_timeout)
+            if t.is_alive():
+                # The parked helper keeps ownership of the in-flight
+                # count (it decrements on eventual return); the sticky
+                # dirty mark short-circuits every wait on it.
+                with self._cond:
+                    self.upload_stalls += 1
+                    self._mark_dirty(work[0])
+                    self._cond.notify_all()
         import time as _time
         deadline = _time.monotonic() + self.stall_timeout
         with self._cond:
@@ -425,9 +446,11 @@ class DeviceWindow:
                 if remaining <= 0:
                     # In-flight upload wedged: degrade this metric so
                     # the query (and every later one) takes the scan
-                    # path instead of hanging on a dead device.
+                    # path instead of hanging on a dead device. Wake
+                    # the other waiters — their loop re-checks dirty.
                     self.upload_stalls += 1
                     self._mark_dirty(mw)
+                    self._cond.notify_all()
                     break
                 self._cond.wait(timeout=remaining)
         self._lock.acquire()

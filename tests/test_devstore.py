@@ -386,7 +386,7 @@ def test_chunked_stage_matches_concat_stage():
     hi = np.int32(end - cc.epoch)
     sh = np.int32(0)
     for agg, rate in (("avg", False), ("max", False), ("sum", True),
-                      ("count", False)):
+                      ("count", False), ("dev", False)):
         kw2 = dict(kw, agg_down=agg, rate=rate)
         a = kernels.window_series_stage_chunks(
             ch.chunks, lo, hi, sh, **kw2)
