@@ -203,7 +203,7 @@ class TSDServer:
                     if len(buf) > MAX_BUFFER:
                         raise ValueError(
                             "frame length exceeds buffer limit")
-                    chunk = await reader.read(1 << 16)
+                    chunk = await reader.read(1 << 20)
                     if not chunk:
                         break
                     buf += chunk
