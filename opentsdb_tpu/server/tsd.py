@@ -203,7 +203,8 @@ class TSDServer:
                     if len(buf) > MAX_BUFFER:
                         raise ValueError(
                             "frame length exceeds buffer limit")
-                    chunk = await reader.read(1 << 20)
+                    chunk = await reader.read(
+                        max(MAX_BUFFER + 1 - len(buf), 1))
                     if not chunk:
                         break
                     buf += chunk

@@ -415,18 +415,25 @@ class MemKVStore(KVStore):
 
     # -- WAL --------------------------------------------------------------
 
-    def _wal_append(self, op: int, *parts: bytes) -> None:
+    def _wal_append(self, op: int, *parts: bytes,
+                    flush: bool = True) -> None:
         if self._wal is None:
             return
         payload = b"".join(struct.pack(">I", len(p)) + p for p in parts)
         self._wal.write(_REC.pack(op, len(payload)) + payload)
-        # Always push past the USERSPACE buffer: without this, up to
-        # 8 KiB of acknowledged writes sit in the Python file object and
-        # a SIGTERM/crash loses them silently — found live, with every
-        # verification daemon's WAL at 0 bytes after a kill. flush() is
-        # process-crash-safe (data reaches the OS page cache);
-        # ``fsync`` additionally survives power loss, at ~ms cost per
-        # append.
+        # Always push past the USERSPACE buffer before acknowledging:
+        # without this, up to 8 KiB of acknowledged writes sit in the
+        # Python file object and a SIGTERM/crash loses them silently —
+        # found live, with every verification daemon's WAL at 0 bytes
+        # after a kill. flush() is process-crash-safe (data reaches the
+        # OS page cache); ``fsync`` additionally survives power loss.
+        # Batch writers pass flush=False per record and call
+        # _wal_flush() ONCE before the batch acknowledges (the ack
+        # boundary, not the record, is the durability promise).
+        if flush:
+            self._wal_flush()
+
+    def _wal_flush(self) -> None:
         self._wal.flush()
         if self._fsync:
             os.fsync(self._wal.fileno())
@@ -665,28 +672,40 @@ class MemKVStore(KVStore):
             pure_mem = self._sst is None and self._frozen is None
             throttle = self.throttle_rows
             wal = self._wal is not None and durable
-            for key, qualifier, value in cells:
-                row = rows.get(key)
-                if row is None:
-                    if throttle is not None and len(rows) >= throttle:
-                        err = PleaseThrottleError(
-                            f"table '{table}' holds >= {throttle} rows")
-                        err.partial_existed = existed
-                        raise err
-                    e = (False if pure_mem
-                         else self._has_row_locked(table, key))
-                else:
-                    e = True if pure_mem \
-                        else self._has_row_locked(table, key)
-                # WAL before any visible mutation, same as put().
+            try:
+                for key, qualifier, value in cells:
+                    row = rows.get(key)
+                    if row is None:
+                        if throttle is not None and len(rows) >= throttle:
+                            err = PleaseThrottleError(
+                                f"table '{table}' holds >= {throttle} "
+                                f"rows")
+                            err.partial_existed = existed
+                            raise err
+                        e = (False if pure_mem
+                             else self._has_row_locked(table, key))
+                    else:
+                        e = True if pure_mem \
+                            else self._has_row_locked(table, key)
+                    # WAL before any visible mutation, same as put().
+                    if wal:
+                        self._wal_append(_OP_PUT, tenc, key, family,
+                                         qualifier, value, flush=False)
+                    if row is None:
+                        row = rows[key] = {}
+                        t.note_insert(key)
+                    row[(family, qualifier)] = value
+                    existed.append(e)
+            finally:
                 if wal:
-                    self._wal_append(_OP_PUT, tenc, key, family,
-                                     qualifier, value)
-                if row is None:
-                    row = rows[key] = {}
-                    t.note_insert(key)
-                row[(family, qualifier)] = value
-                existed.append(e)
+                    # One flush per batch — in a finally, because a
+                    # mid-batch throttle has already APPLIED (and will
+                    # acknowledge, via partial_existed) the earlier
+                    # cells: their records must reach the OS before the
+                    # exception escapes, same promise as the success
+                    # path. The ack boundary, not the record, is the
+                    # durability unit.
+                    self._wal_flush()
         return existed
 
     def delete(self, table: str, key: bytes, family: bytes,
