@@ -289,3 +289,30 @@ def test_scan_raw_sees_rows_frozen_mid_scan(tmp_path):
     s.checkpoint()                    # freezes live memtable mid-scan
     got += [k for k, _ in it]
     assert got == keys
+
+
+def test_put_many_throttle_still_flushes_wal(tmp_path):
+    """A mid-batch PleaseThrottleError acknowledges the cells it DID
+    apply (partial_existed); their WAL records must already be on disk
+    when the exception escapes — the batch-flush optimization must not
+    skip the finally flush on the throttle path."""
+    import os
+
+    wal = str(tmp_path / "wal")
+    s = MemKVStore(wal_path=wal, throttle_rows=2)
+    s.ensure_table("t")
+    size0 = os.path.getsize(wal)
+    with pytest.raises(PleaseThrottleError) as ei:
+        s.put_many("t", b"f", [
+            (b"k1", b"q", b"v"),
+            (b"k2", b"q", b"v"),
+            (b"k3", b"q", b"v"),   # throttled
+        ])
+    assert ei.value.partial_existed == [False, False]
+    assert os.path.getsize(wal) > size0  # applied records flushed
+    # A replay of the snapshot sees exactly the applied cells.
+    import shutil
+    shutil.copy(wal, str(tmp_path / "snap"))
+    s2 = MemKVStore(wal_path=str(tmp_path / "snap"))
+    assert s2.has_row("t", b"k1") and s2.has_row("t", b"k2")
+    assert not s2.has_row("t", b"k3")
