@@ -597,6 +597,10 @@ class TSDB:
                              f"kind={kind}")
             collector.record("uid.cache-size", uid.cache_size(),
                              f"kind={kind}")
+        wal_errs = getattr(self.store, "wal_swallowed_flush_errors", None)
+        if wal_errs is not None:
+            collector.record("storage.wal.swallowed_flush_errors",
+                             wal_errs)
         cq = self.compactionq
         collector.record("compaction.count", cq.written_cells)
         collector.record("compaction.deleted_cells", cq.deleted_cells)

@@ -381,26 +381,43 @@ class QueryExecutor:
         g_out = min(ngroups, _pad64(len(gkeys)))
         b_out = min(num_buckets, _pad64(b_live))
         shrink = dict(g_out=g_out, b_out=b_out)
-        if agg.kind == "percentile":
-            gv, gm = kernels.window_quantile_apply(
-                sm, filled, in_range, include, gmap,
-                np.array([agg.quantile], np.float32),
-                num_groups=ngroups, **shrink)
-        else:
-            gv, gm = kernels.window_moment_apply(
-                sv, sm, filled, in_range, include, gmap,
-                num_groups=ngroups, agg_group=spec.aggregator,
-                **shrink)
-        # Series with no in-range points must not shape group labels or
-        # emit empty groups — match the scan path, which never sees
-        # them. (Pre-rate presence: computed from the raw in-range
-        # mask, like the scan path's "series exists".) One batched
-        # device_get — separate np.asarray fetches would each pay a
-        # transport round trip; presence is fetched once per stage.
-        if stage[5] is None:
-            gv, gm, stage[5] = jax.device_get((gv, gm, presence_dev))
-        else:
-            gv, gm = jax.device_get((gv, gm))
+        # The applies allocate fresh [S,B]/[G,B] buffers on a device the
+        # resident window may have filled to within a few hundred MB of
+        # HBM — an OOM here (or in the fetch's staging buffer) must
+        # degrade to the scan path exactly like a stage-build OOM, or
+        # the exact-or-fall-back contract breaks precisely in the
+        # 1B-resident regime it exists for.
+        try:
+            if agg.kind == "percentile":
+                gv, gm = kernels.window_quantile_apply(
+                    sm, filled, in_range, include, gmap,
+                    np.array([agg.quantile], np.float32),
+                    num_groups=ngroups, **shrink)
+            else:
+                gv, gm = kernels.window_moment_apply(
+                    sv, sm, filled, in_range, include, gmap,
+                    num_groups=ngroups, agg_group=spec.aggregator,
+                    **shrink)
+            # Series with no in-range points must not shape group labels
+            # or emit empty groups — match the scan path, which never
+            # sees them. (Pre-rate presence: computed from the raw
+            # in-range mask, like the scan path's "series exists".) One
+            # batched device_get — separate np.asarray fetches would
+            # each pay a transport round trip; presence is fetched once
+            # per stage.
+            if stage[5] is None:
+                gv, gm, stage[5] = jax.device_get((gv, gm, presence_dev))
+            else:
+                gv, gm = jax.device_get((gv, gm))
+        except Exception as e:
+            if _is_device_oom(e):
+                # Drop the stage too: leaving it cached would pin its
+                # [S, B] grids in the very HBM that just ran out, and
+                # every later query of this panel would re-dispatch a
+                # doomed apply before falling back.
+                cache.pop(skey, None)
+                return None
+            raise
         has_points = stage[5]
         gm = np.unpackbits(gm, axis=1, count=b_out).astype(bool)
         results = []

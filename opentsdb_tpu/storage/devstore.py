@@ -40,7 +40,9 @@ No reference analog: HBase scans are the reference's only read path
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time as _time
 from typing import NamedTuple
 
 import numpy as np
@@ -84,7 +86,8 @@ class _MetricWindow:
     __slots__ = ("sids", "keys", "last_ts", "epoch", "chunks",
                  "staged_ts", "staged_vals", "staged_sid", "staged_n",
                  "dirty", "complete_from", "concat", "generation",
-                 "version", "device_points", "inflight")
+                 "version", "device_points", "inflight",
+                 "inflight_since")
 
     def __init__(self) -> None:
         self.sids: dict[bytes, int] = {}
@@ -105,6 +108,11 @@ class _MetricWindow:
         #                           derived-result cache key
         self.device_points = 0
         self.inflight = 0               # taken-but-not-uploaded batches
+        # Monotonic time of THIS metric's last upload progress while it
+        # has in-flight batches (None = quiescent): the per-metric
+        # wedge detector, immune to other metrics' completions keeping
+        # the global liveness signal fresh.
+        self.inflight_since: float | None = None
 
 
 class DeviceWindow:
@@ -153,6 +161,13 @@ class DeviceWindow:
         # chunk fleet-wide.
         self._total_points = 0
         self._seq = 0
+        # Liveness signal: bumps on EVERY upload completion (success or
+        # failure). Stall handling keys off this, not off elapsed time
+        # alone — a backlogged-but-progressing uploader (big chunks,
+        # slow transport) must produce backpressure or a cache miss,
+        # never the sticky dirty mark reserved for a wedged device
+        # (ADVICE r03: a transient slowdown was a permanent cache loss).
+        self._uploads_completed = 0
         # stats
         self.appended_points = 0
         self.evicted_points = 0
@@ -219,6 +234,8 @@ class DeviceWindow:
                  mw.staged_n)
         mw.staged_ts, mw.staged_vals, mw.staged_sid = [], [], []
         mw.staged_n = 0
+        if mw.inflight == 0:
+            mw.inflight_since = _time.monotonic()
         mw.inflight += 1
         seq = self._seq
         self._seq += 1
@@ -250,22 +267,39 @@ class DeviceWindow:
                         name="devwindow-uploader")
                     self._uploader.start()
         import queue as _queue
-        try:
-            self._pending.put(work, timeout=self.stall_timeout)
-        except _queue.Full:
-            # Uploader hasn't drained a bounded queue for the whole
-            # stall window: the device (or its transport) is wedged.
-            # Drop THIS metric to degraded mode instead of blocking the
-            # ingest thread behind a dead accelerator. The dropped work
-            # item's in-flight count (taken in _take_staged) must be
-            # released here — it will never reach _run_upload — or
-            # queries would wait on it forever.
-            mw = work[0]
+        while True:
             with self._cond:
-                self.upload_stalls += 1
-                self._mark_dirty(mw)
-                mw.inflight -= 1
-                self._cond.notify_all()
+                base = self._uploads_completed
+            try:
+                self._pending.put(work, timeout=self.stall_timeout)
+                return
+            except _queue.Full:
+                with self._cond:
+                    if (self._uploads_completed != base
+                            and not self._metric_stuck(
+                                work[0], _time.monotonic())):
+                        # An upload finished during the wait: the
+                        # uploader is alive, just backlogged. Keep
+                        # blocking — a bounded queue IS the backpressure
+                        # mechanism — rather than dirty-marking a
+                        # healthy metric's whole window. (Unless THIS
+                        # metric's own oldest batch is ancient — then
+                        # it is stuck regardless of global liveness.)
+                        continue
+                    # No upload completed for a full stall window on a
+                    # full queue: the device (or its transport) is
+                    # wedged. Drop THIS metric to degraded mode instead
+                    # of blocking the ingest thread behind a dead
+                    # accelerator. The dropped work item's in-flight
+                    # count (taken in _take_staged) must be released
+                    # here — it will never reach _run_upload — or
+                    # queries would wait on it forever.
+                    mw = work[0]
+                    self.upload_stalls += 1
+                    self._mark_dirty(mw)
+                    mw.inflight -= 1
+                    self._cond.notify_all()
+                    return
 
     def _upload_loop(self) -> None:
         while True:
@@ -282,6 +316,13 @@ class DeviceWindow:
     def _upload_done(self, mw: _MetricWindow) -> None:
         with self._cond:
             mw.inflight -= 1
+            if mw.inflight == 0:
+                mw.inflight_since = None
+            else:
+                # This metric itself made progress: restart its
+                # per-metric wedge clock.
+                mw.inflight_since = _time.monotonic()
+            self._uploads_completed += 1
             self._cond.notify_all()
 
     def _upload(self, mw: _MetricWindow, batch, seq: int) -> None:
@@ -362,7 +403,6 @@ class DeviceWindow:
         # Bounded barrier: join() would block forever if the uploader
         # is wedged inside a device call (task_done only fires after
         # the hung upload returns). Best-effort within stall_timeout.
-        import time as _time
         deadline = _time.monotonic() + self.stall_timeout
         while (self._pending.unfinished_tasks
                and _time.monotonic() < deadline):
@@ -396,83 +436,118 @@ class DeviceWindow:
 
     # -- query side ----------------------------------------------------
 
-    def _ready_window(self, metric_uid: bytes,
-                      start: int) -> "_MetricWindow | None":
-        """The shared availability preamble of columns()/chunk_columns():
-        drain this metric's staged batch, wait for ITS in-flight
-        uploads, then validate the exact-coverage contract. Returns the
-        window with the LOCK HELD on success (caller must release), or
-        None (lock released) for scan-path fallback."""
-        with self._lock:
-            mw = self._metrics.get(metric_uid)
-            if mw is None:
-                self.window_misses += 1
-                return None
-            work = self._take_staged(mw)
-        # Upload + drain OUTSIDE the lock (the uploader takes the lock
-        # to append chunks); then re-check under the lock — the drain
-        # can mark dirty (upload failure) or advance complete_from.
-        # The query's staged batch uploads INLINE (not via the queue:
-        # queueing would couple this query's latency to other metrics'
-        # stuck uploads — ADVICE r02) but on a JOINABLE helper thread
-        # with the stall deadline: a device call wedged inside the
-        # transport cannot be interrupted, so the query thread must
-        # never make it directly. On timeout the metric degrades
-        # (sticky dirty -> scan path) and the parked helper is a
-        # bounded daemon-thread leak; if the device later revives and
-        # the upload lands, _upload's dirty check discards it.
-        if work is not None:
-            t = threading.Thread(target=self._run_upload, args=(work,),
-                                 daemon=True,
-                                 name="devwindow-query-drain")
-            t.start()
-            t.join(timeout=self.stall_timeout)
-            if t.is_alive():
-                # The parked helper keeps ownership of the in-flight
-                # count (it decrements on eventual return); the sticky
-                # dirty mark short-circuits every wait on it.
-                with self._cond:
-                    self.upload_stalls += 1
-                    self._mark_dirty(work[0])
-                    self._cond.notify_all()
-        import time as _time
-        deadline = _time.monotonic() + self.stall_timeout
+    def _wait_quiet(self, mw: _MetricWindow) -> str:
+        """Wait for this metric's in-flight uploads with the
+        wedged-vs-slow distinction (ADVICE r03): the sticky dirty mark
+        is reserved for a device that has completed NOTHING for a full
+        stall window; a backlogged-but-progressing uploader yields a
+        bounded plain miss instead (scan fallback now, window intact
+        for the next query). Returns ``"ready"`` (quiescent — caller
+        still re-checks dirty under the lock), or ``"slow"``.
+
+        Progress = ``_uploads_completed`` advancing, ANY metric: device
+        calls mostly serialize, so a completion is evidence the
+        transport is alive. But it is not proof THIS metric's upload
+        moves (a query-drain helper can be stuck in its own device call
+        while the uploader thread completes others), so a per-metric
+        hard deadline — ``inflight_since`` older than 4x stall_timeout
+        — converts a persistently-stuck metric to sticky dirty no
+        matter how fresh the global signal is; without it, every query
+        of that metric would pay the 2x cap forever. ``dirty``
+        short-circuits — an already-degraded metric answers
+        immediately, not after a stall_timeout per query."""
+
         with self._cond:
-            # ``dirty`` short-circuits: an already-degraded metric must
-            # answer immediately (sticky scan fallback), not wait a
-            # full stall_timeout per query.
+            last = self._uploads_completed
+            now = _time.monotonic()
+            deadline = now + self.stall_timeout       # wedge detector
+            cap = now + 2 * self.stall_timeout        # latency bound
             while mw.inflight > 0 and not mw.dirty:
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    # In-flight upload wedged: degrade this metric so
-                    # the query (and every later one) takes the scan
-                    # path instead of hanging on a dead device. Wake
-                    # the other waiters — their loop re-checks dirty.
+                now = _time.monotonic()
+                if self._uploads_completed != last:
+                    last = self._uploads_completed
+                    deadline = now + self.stall_timeout
+                if now >= deadline or self._metric_stuck(mw, now):
+                    # Nothing completed for a full stall window while
+                    # we held in-flight work: wedged. Degrade this
+                    # metric so the query (and every later one) takes
+                    # the scan path instead of hanging on a dead
+                    # device. Wake the other waiters — their loop
+                    # re-checks dirty.
                     self.upload_stalls += 1
                     self._mark_dirty(mw)
                     self._cond.notify_all()
                     break
-                self._cond.wait(timeout=remaining)
-        self._lock.acquire()
-        if mw.dirty:
-            self.dirty_fallbacks += 1
-            self._lock.release()
-            return None
-        if (mw.complete_from is not None and start < mw.complete_from) \
-                or not mw.chunks:
-            self.window_misses += 1
-            self._lock.release()
-            return None
-        return mw
+                if now >= cap:
+                    return "slow"
+                self._cond.wait(timeout=min(deadline, cap) - now)
+        return "ready"
+
+    def _metric_stuck(self, mw: _MetricWindow, now: float) -> bool:
+        """True when THIS metric's oldest in-flight batch has made no
+        progress for 4x stall_timeout — the per-metric wedge verdict
+        that global upload completions cannot mask. Caller holds
+        _cond/_lock."""
+        return (mw.inflight_since is not None
+                and now - mw.inflight_since >= 4 * self.stall_timeout)
+
+    @contextlib.contextmanager
+    def _ready_window(self, metric_uid: bytes, start: int):
+        """The shared availability preamble of columns()/chunk_columns()
+        as a context manager: drain this metric's staged batch, wait for
+        ITS in-flight uploads, validate the exact-coverage contract.
+        Yields the window WITH THE LOCK HELD (released on exit, every
+        path — the old hand-off-a-held-lock contract deadlocked if any
+        future early return forgot the release), or None for scan-path
+        fallback."""
+        with self._lock:
+            mw = self._metrics.get(metric_uid)
+            if mw is None:
+                self.window_misses += 1
+                yield None
+                return
+            work = self._take_staged(mw)
+        # Upload + drain OUTSIDE the lock (the uploader takes the
+        # lock to append chunks); then re-check under the lock —
+        # the drain can mark dirty (upload failure) or advance
+        # complete_from. The query's staged batch uploads INLINE
+        # (not via the queue: queueing would couple this query's
+        # latency to other metrics' stuck uploads — ADVICE r02) but
+        # on a daemon helper thread: a device call wedged inside
+        # the transport cannot be interrupted, so the query thread
+        # must never make it directly. The helper's batch counts in
+        # mw.inflight (released in _run_upload's finally), so the
+        # unified _wait_quiet below applies the same wedged-vs-slow
+        # policy to it; a parked helper is a bounded daemon-thread
+        # leak, and if the device later revives and the upload
+        # lands, _upload's dirty check discards it.
+        if work is not None:
+            threading.Thread(target=self._run_upload, args=(work,),
+                             daemon=True,
+                             name="devwindow-query-drain").start()
+        if self._wait_quiet(mw) == "slow":
+            with self._lock:       # counters mutate under the lock only
+                self.window_misses += 1
+            yield None
+            return
+        with self._lock:
+            if mw.dirty:
+                self.dirty_fallbacks += 1
+                yield None
+            elif (mw.complete_from is not None
+                    and start < mw.complete_from) or not mw.chunks:
+                self.window_misses += 1
+                yield None
+            else:
+                yield mw
 
     def columns(self, metric_uid: bytes, start: int,
                 end: int) -> DevColumns | None:
         """The metric's resident columns when they exactly cover
         [start, end]; None means the caller must use the scan path."""
-        mw = self._ready_window(metric_uid, start)
-        if mw is None:
-            return None
-        try:
+        with self._ready_window(metric_uid, start) as mw:
+            if mw is None:
+                return None
             if mw.concat is None or mw.concat.generation != mw.generation:
                 import jax.numpy as jnp
 
@@ -489,8 +564,6 @@ class DeviceWindow:
                     version=mw.version)
             self.window_hits += 1
             return mw.concat
-        finally:
-            self._lock.release()
 
     def chunk_columns(self, metric_uid: bytes, start: int,
                       end: int) -> DevChunks | None:
@@ -498,18 +571,15 @@ class DeviceWindow:
         building (or caching) the concatenated view — the chunked query
         stage folds it without a second full copy of the columns. Same
         availability contract: None means scan-path fallback."""
-        mw = self._ready_window(metric_uid, start)
-        if mw is None:
-            return None
-        try:
+        with self._ready_window(metric_uid, start) as mw:
+            if mw is None:
+                return None
             self.window_hits += 1
             return DevChunks(
                 chunks=[(c["ts"], c["vals"], c["sid"], c["valid"])
                         for c in mw.chunks],
                 epoch=mw.epoch, series_keys=list(mw.keys),
                 generation=mw.generation, version=mw.version)
-        finally:
-            self._lock.release()
 
     # -- observability -------------------------------------------------
 

@@ -40,6 +40,7 @@ Design notes (TPU-first, not an HBase rebuild):
 from __future__ import annotations
 
 import io
+import logging
 import os
 import re
 import struct
@@ -272,6 +273,13 @@ class MemKVStore(KVStore):
         self._wal: io.BufferedWriter | None = None
         self._sst: SSTable | None = None
         self._sst_path = wal_path + ".sst" if wal_path else None
+        # Flush failures SWALLOWED on put_many's exceptional exit (the
+        # in-flight throttle error wins) — the one case where a flush
+        # failure cannot propagate to the caller. Ordinary flush
+        # failures raise loudly and are not counted here; nonzero means
+        # acknowledged cells whose WAL records may not have reached the
+        # OS with no exception having told anyone.
+        self.wal_swallowed_flush_errors = 0
         # Immutable middle tier while a checkpoint merge is in flight.
         self._frozen: dict[str, _Table] | None = None
         if self._sst_path and os.path.exists(self._sst_path):
@@ -672,6 +680,7 @@ class MemKVStore(KVStore):
             pure_mem = self._sst is None and self._frozen is None
             throttle = self.throttle_rows
             wal = self._wal is not None and durable
+            batch_ok = False
             try:
                 for key, qualifier, value in cells:
                     row = rows.get(key)
@@ -696,6 +705,7 @@ class MemKVStore(KVStore):
                         t.note_insert(key)
                     row[(family, qualifier)] = value
                     existed.append(e)
+                batch_ok = True
             finally:
                 if wal:
                     # One flush per batch — in a finally, because a
@@ -704,8 +714,29 @@ class MemKVStore(KVStore):
                     # cells: their records must reach the OS before the
                     # exception escapes, same promise as the success
                     # path. The ack boundary, not the record, is the
-                    # durability unit.
-                    self._wal_flush()
+                    # durability unit. A flush failure (e.g. ENOSPC)
+                    # must not REPLACE an in-flight exception, though:
+                    # callers rely on PleaseThrottleError.partial_existed
+                    # to know which cells applied, so the flush error
+                    # surfaces only when the batch itself succeeded.
+                    # (A local flag, not sys.exc_info(): exc_info also
+                    # sees a HANDLED exception in any CALLER's except
+                    # block, which would silently swallow real flush
+                    # failures for callers running retry loops.)
+                    try:
+                        self._wal_flush()
+                    except Exception:
+                        if batch_ok:
+                            raise
+                        # Can't replace the in-flight exception, but a
+                        # swallowed flush failure means the applied
+                        # cells' durability promise is BROKEN until the
+                        # next successful flush — leave a trace.
+                        self.wal_swallowed_flush_errors += 1
+                        logging.getLogger(__name__).exception(
+                            "WAL flush failed during exceptional "
+                            "put_many exit; %d applied cells not yet "
+                            "durable", len(existed))
         return existed
 
     def delete(self, table: str, key: bytes, family: bytes,

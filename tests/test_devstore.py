@@ -449,3 +449,110 @@ def test_wedged_uploader_degrades_instead_of_blocking():
         assert time.monotonic() - t0 < 0.1
     assert dw.dirty_fallbacks >= 3
     gate.set()                  # unblock the daemon thread
+
+
+def test_slow_but_progressing_uploader_is_not_dirty_marked():
+    """ADVICE r03: a backlogged-but-ALIVE uploader (each upload slower
+    than stall_timeout's granularity but completing) must never trigger
+    the sticky dirty mark — that turned a transient slowdown into a
+    permanent loss of the metric's whole HBM window. Ingest applies
+    backpressure; a query caught mid-backlog returns a bounded plain
+    miss; once the backlog drains the window serves again."""
+    import time
+
+    dw = DeviceWindow(staging_points=64, max_points=1 << 20,
+                      stall_timeout=2.0)
+    real_upload = dw._run_upload
+
+    def slow_upload(work):
+        time.sleep(0.25)        # slower than queue turnover, << timeout
+        real_upload(work)
+
+    dw._run_upload = slow_upload
+    muid = b"\x00\x00\x01"
+    key = muid + b"\x00\x00\x01\x00\x00\x02"
+    ts0 = 1_700_000_000
+    for i in range(8):          # fills the bounded queue repeatedly
+        ts = np.arange(ts0 + i * 1000, ts0 + i * 1000 + 100,
+                       dtype=np.int64)
+        dw.append(muid, key, ts, np.ones(100, np.float32))
+    mw = dw._metrics[muid]
+    assert not mw.dirty, "slow-but-progressing uploader was dirty-marked"
+    assert dw.upload_stalls == 0
+    # After the backlog drains, the window must serve (all 800 points).
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with dw._cond:
+            if mw.inflight == 0:
+                break
+        time.sleep(0.05)
+    cols = dw.columns(muid, ts0, ts0 + 10_000)
+    assert cols is not None and not mw.dirty
+    assert mw.device_points == 800
+
+
+def test_per_metric_stuck_upload_degrades_despite_global_progress():
+    """The global liveness signal (any upload completing) must not mask
+    a single metric whose own upload is wedged: other metrics' traffic
+    keeps the transport 'alive', but after 4x stall_timeout without
+    progress on ITS oldest in-flight batch the stuck metric converts to
+    sticky dirty — otherwise every query of it would pay the 2x-cap
+    slow-miss latency forever."""
+    import threading
+    import time
+
+    dw = DeviceWindow(staging_points=1 << 20, max_points=1 << 20,
+                      stall_timeout=0.3)
+    gate = threading.Event()
+    real_upload = dw._run_upload
+    MUID_A, MUID_B = b"\x00\x00\x01", b"\x00\x00\x02"
+
+    def upload(work):
+        if work[0] is dw._metrics.get(MUID_A):
+            gate.wait()         # only A's transfer is stuck
+        real_upload(work)
+
+    dw._run_upload = upload
+    ts0 = 1_700_000_000
+    keyA = MUID_A + b"\x00\x00\x01\x00\x00\x02"
+    keyB = MUID_B + b"\x00\x00\x01\x00\x00\x02"
+    dw.append(MUID_A, keyA, np.arange(ts0, ts0 + 100, dtype=np.int64),
+              np.ones(100, np.float32))
+    stop = threading.Event()
+
+    def churn_b():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            ts = np.arange(ts0 + i * 1000, ts0 + i * 1000 + 10,
+                           dtype=np.int64)
+            dw.append(MUID_B, keyB, ts, np.ones(10, np.float32))
+            with dw._lock:
+                w = dw._take_staged(dw._metrics[MUID_B])
+            if w is not None:
+                dw._submit(w)
+            time.sleep(0.05)
+
+    t = threading.Thread(target=churn_b, daemon=True)
+    t.start()
+    try:
+        # Every query of A misses (helper-thread drain is gated); after
+        # the per-metric deadline (4x stall_timeout = 1.2s) it must be
+        # sticky-dirty despite B's completions resetting the global
+        # wedge detector the whole time.
+        mwA = None
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            assert dw.columns(MUID_A, ts0, ts0 + 10_000) is None
+            mwA = dw._metrics[MUID_A]
+            if mwA.dirty:
+                break
+        assert mwA is not None and mwA.dirty, \
+            "stuck metric never degraded while global progress continued"
+        # Sticky: immediate scan fallback from here on.
+        t0 = time.monotonic()
+        assert dw.columns(MUID_A, ts0, ts0 + 10_000) is None
+        assert time.monotonic() - t0 < 0.1
+    finally:
+        stop.set()
+        gate.set()
