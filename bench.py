@@ -616,10 +616,12 @@ def main() -> int:
         args.series, args.points_per_series = 200, 100
         args.probe_budget = min(args.probe_budget, 150.0)
 
-    # Best-effort build of the native wire decoder (gitignored artifact).
+    # Best-effort build of the native wire decoder + ingest extension
+    # (gitignored artifacts). Runs BEFORE any opentsdb_tpu import so
+    # utils/nativeext.py finds the .so at module load. make is
+    # incremental: a no-op when both are current.
     native_dir = os.path.join(REPO, "native")
-    if not os.path.exists(os.path.join(native_dir, "libtsdwire.so")):
-        subprocess.run(["make", "-C", native_dir], capture_output=True)
+    subprocess.run(["make", "-C", native_dir], capture_output=True)
 
     import jax
 

@@ -1,0 +1,252 @@
+/* CPython extension for the batch-ingest hot loops.
+ *
+ * Measured motivation (10M-point sustained-ingest attribution, r04, one
+ * CPU core): after the WAL record and encode buffers were vectorized,
+ * the remaining cost of at-scale ingest was interpreter-level per-cell
+ * work — building one bytes key + one {(family, qual): value} dict per
+ * row-hour for the memtable (~3 s / 1.75M cells) and slicing the
+ * per-row qualifier/value bytes out of the encode buffers (~1.9 s).
+ * Both are pure allocation loops with no Python semantics, so they
+ * belong in C; the Python fallbacks in storage/kv.py and core/codec_np
+ * remain the reference implementations (and run where the .so is not
+ * built).
+ *
+ * Reference parity note: the reference's ingest hot path is Java
+ * (src/core/TSDB.java:240-352 + IncomingDataPoints); this plays the
+ * same role for the TPU-native runtime - the accelerator does query
+ * compute, C does the row bookkeeping.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+/* slice_keys(blob: bytes, key_len: int) -> list[bytes]
+ * The i-th element is blob[i*key_len:(i+1)*key_len]. */
+static PyObject *
+slice_keys(PyObject *self, PyObject *args)
+{
+    Py_buffer blob;
+    Py_ssize_t klen;
+    if (!PyArg_ParseTuple(args, "y*n", &blob, &klen))
+        return NULL;
+    if (klen <= 0 || blob.len % klen != 0) {
+        PyBuffer_Release(&blob);
+        PyErr_SetString(PyExc_ValueError,
+                        "blob length not a multiple of key_len");
+        return NULL;
+    }
+    Py_ssize_t n = blob.len / klen;
+    PyObject *out = PyList_New(n);
+    if (!out) {
+        PyBuffer_Release(&blob);
+        return NULL;
+    }
+    const char *p = (const char *)blob.buf;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *k = PyBytes_FromStringAndSize(p + i * klen, klen);
+        if (!k) {
+            Py_DECREF(out);
+            PyBuffer_Release(&blob);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, i, k);   /* steals ref */
+    }
+    PyBuffer_Release(&blob);
+    return out;
+}
+
+/* rows_update_new(rows: dict, keys: list[bytes], family: bytes,
+ *                 quals: list[bytes], vals: list[bytes]) -> None
+ * For each i: rows[keys[i]] = {(family, quals[i]): vals[i]}.
+ * Caller guarantees keys are NOT already present (the no-duplicate
+ * fast path) - existing rows would be OVERWRITTEN, which is why the
+ * Python caller checks `rows.keys() & keys` first. */
+static PyObject *
+rows_update_new(PyObject *self, PyObject *args)
+{
+    PyObject *rows, *keys, *family, *quals, *vals;
+    if (!PyArg_ParseTuple(args, "O!O!SO!O!", &PyDict_Type, &rows,
+                          &PyList_Type, &keys, &family,
+                          &PyList_Type, &quals, &PyList_Type, &vals))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(keys);
+    if (PyList_GET_SIZE(quals) != n || PyList_GET_SIZE(vals) != n) {
+        PyErr_SetString(PyExc_ValueError, "length mismatch");
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *ck = PyTuple_Pack(2, family, PyList_GET_ITEM(quals, i));
+        if (!ck)
+            return NULL;
+        PyObject *row = PyDict_New();
+        if (!row) {
+            Py_DECREF(ck);
+            return NULL;
+        }
+        if (PyDict_SetItem(row, ck, PyList_GET_ITEM(vals, i)) < 0 ||
+            PyDict_SetItem(rows, PyList_GET_ITEM(keys, i), row) < 0) {
+            Py_DECREF(ck);
+            Py_DECREF(row);
+            return NULL;
+        }
+        Py_DECREF(ck);
+        Py_DECREF(row);
+    }
+    Py_RETURN_NONE;
+}
+
+/* upsert_cells(rows: dict, keys: list[bytes], family: bytes,
+ *              quals: list[bytes], vals: list[bytes], pending: set)
+ *     -> existed: list[bool]
+ * Full put_many semantics for the PURE-MEMTABLE store (no lower
+ * tiers, so no tombstones and existence == presence in rows): for
+ * each i, set {(family, quals[i]): vals[i]} into rows[keys[i]],
+ * creating the row when absent. existed[i] is True when the row held
+ * cells before cell i landed (pre-existing row OR an earlier cell of
+ * this batch - matching KVStore.put_many's contract). A created
+ * row's key goes into `pending` (the _Table sorted-key index)
+ * IMMEDIATELY after the insert, so an allocation failure mid-batch
+ * can never leave a row in `rows` that scans will not see; a set-add
+ * failure rolls the row insert back before raising for the same
+ * reason. The caller must have ruled out a mid-batch throttle trip. */
+static PyObject *
+upsert_cells(PyObject *self, PyObject *args)
+{
+    PyObject *rows, *keys, *family, *quals, *vals, *pending;
+    if (!PyArg_ParseTuple(args, "O!O!SO!O!O!", &PyDict_Type, &rows,
+                          &PyList_Type, &keys, &family,
+                          &PyList_Type, &quals, &PyList_Type, &vals,
+                          &PySet_Type, &pending))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(keys);
+    if (PyList_GET_SIZE(quals) != n || PyList_GET_SIZE(vals) != n) {
+        PyErr_SetString(PyExc_ValueError, "length mismatch");
+        return NULL;
+    }
+    PyObject *existed = PyList_New(n);
+    if (!existed)
+        return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *key = PyList_GET_ITEM(keys, i);
+        PyObject *row = PyDict_GetItemWithError(rows, key); /* borrowed */
+        if (!row && PyErr_Occurred())
+            goto fail;
+        int was_new = (row == NULL);
+        if (was_new) {
+            row = PyDict_New();
+            if (!row)
+                goto fail;
+            if (PyDict_SetItem(rows, key, row) < 0) {
+                Py_DECREF(row);
+                goto fail;
+            }
+            Py_DECREF(row);   /* rows holds the ref; row stays valid */
+            if (PySet_Add(pending, key) < 0) {
+                PyDict_DelItem(rows, key);
+                goto fail;
+            }
+        }
+        PyObject *ck = PyTuple_Pack(2, family, PyList_GET_ITEM(quals, i));
+        if (!ck)
+            goto fail;
+        if (PyDict_SetItem(row, ck, PyList_GET_ITEM(vals, i)) < 0) {
+            Py_DECREF(ck);
+            goto fail;
+        }
+        Py_DECREF(ck);
+        PyObject *flag = was_new ? Py_False : Py_True;
+        Py_INCREF(flag);
+        PyList_SET_ITEM(existed, i, flag);
+    }
+    return existed;
+fail:
+    Py_XDECREF(existed);
+    return NULL;
+}
+
+/* slice_cells(quals: bytes, vbytes: bytes,
+ *             row_starts: buffer[int64], row_ends: buffer[int64],
+ *             val_starts: buffer[int64], val_ends: buffer[int64])
+ *     -> (list[bytes], list[bytes])
+ * Per row i: qual = quals[2*rs[i]:2*re[i]],
+ *            val  = vbytes[vs[i]:ve[i]] (+ b"\x00" when re-rs > 1). */
+static PyObject *
+slice_cells(PyObject *self, PyObject *args)
+{
+    Py_buffer qb, vb, rs, re, vs, ve;
+    if (!PyArg_ParseTuple(args, "y*y*y*y*y*y*", &qb, &vb, &rs, &re,
+                          &vs, &ve))
+        return NULL;
+    PyObject *out_q = NULL, *out_v = NULL, *ret = NULL;
+    Py_ssize_t n = rs.len / (Py_ssize_t)sizeof(int64_t);
+    if (re.len != rs.len || vs.len != rs.len || ve.len != rs.len) {
+        PyErr_SetString(PyExc_ValueError, "bounds length mismatch");
+        goto done;
+    }
+    const int64_t *prs = (const int64_t *)rs.buf;
+    const int64_t *pre = (const int64_t *)re.buf;
+    const int64_t *pvs = (const int64_t *)vs.buf;
+    const int64_t *pve = (const int64_t *)ve.buf;
+    const char *q = (const char *)qb.buf;
+    const char *v = (const char *)vb.buf;
+    out_q = PyList_New(n);
+    out_v = PyList_New(n);
+    if (!out_q || !out_v)
+        goto done;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        int64_t a = prs[i], b = pre[i], va = pvs[i], ve_ = pve[i];
+        if (a < 0 || b < a || 2 * b > qb.len || va < 0 || ve_ < va ||
+            ve_ > vb.len) {
+            PyErr_SetString(PyExc_ValueError, "bounds out of range");
+            goto done;
+        }
+        PyObject *qs = PyBytes_FromStringAndSize(q + 2 * a,
+                                                 2 * (b - a));
+        if (!qs)
+            goto done;
+        PyList_SET_ITEM(out_q, i, qs);
+        int multi = (b - a) > 1;
+        PyObject *vo = PyBytes_FromStringAndSize(NULL,
+                                                 (ve_ - va) + multi);
+        if (!vo)
+            goto done;
+        char *dst = PyBytes_AS_STRING(vo);
+        memcpy(dst, v + va, (size_t)(ve_ - va));
+        if (multi)
+            dst[ve_ - va] = '\0';
+        PyList_SET_ITEM(out_v, i, vo);
+    }
+    ret = PyTuple_Pack(2, out_q, out_v);
+done:
+    Py_XDECREF(out_q);
+    Py_XDECREF(out_v);
+    PyBuffer_Release(&qb);
+    PyBuffer_Release(&vb);
+    PyBuffer_Release(&rs);
+    PyBuffer_Release(&re);
+    PyBuffer_Release(&vs);
+    PyBuffer_Release(&ve);
+    return ret;
+}
+
+static PyMethodDef Methods[] = {
+    {"slice_keys", slice_keys, METH_VARARGS,
+     "Slice a contiguous key blob into a list of fixed-width keys."},
+    {"rows_update_new", rows_update_new, METH_VARARGS,
+     "Bulk-insert single-cell rows into a memtable dict."},
+    {"upsert_cells", upsert_cells, METH_VARARGS,
+     "Full batch upsert with existed flags (pure-memtable store)."},
+    {"slice_cells", slice_cells, METH_VARARGS,
+     "Slice per-row qualifier/value bytes out of encode buffers."},
+    {NULL, NULL, 0, NULL}
+};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "tsd_ingest_ext",
+    "C hot loops for batch ingest (see file docstring).", -1, Methods
+};
+
+PyMODINIT_FUNC
+PyInit_tsd_ingest_ext(void)
+{
+    return PyModule_Create(&module);
+}

@@ -18,6 +18,7 @@ import numpy as np
 from opentsdb_tpu.core.codec import Columns
 from opentsdb_tpu.core.const import FLAG_BITS, FLAG_FLOAT, LENGTH_MASK
 from opentsdb_tpu.core.errors import IllegalDataError
+from opentsdb_tpu.utils.nativeext import ext as _EXT
 
 _INT_WIDTH_BOUNDS = (
     (1, -0x80, 0x7F),
@@ -49,21 +50,23 @@ def encode_cell(deltas: np.ndarray, float_values: np.ndarray,
     """
     if len(deltas) == 0:
         raise ValueError("empty cell")
-    return encode_cells_multi(deltas, float_values, int_values, is_float,
-                              np.array([0]))[0]
+    qs, vs = encode_cells_multi(deltas, float_values, int_values,
+                                is_float, np.array([0]))
+    return qs[0], vs[0]
 
 
 def encode_cells_multi(deltas: np.ndarray, float_values: np.ndarray,
                        int_values: np.ndarray, is_float: np.ndarray,
                        row_starts: np.ndarray,
-                       ) -> list[tuple[bytes, bytes]]:
+                       ) -> tuple[list[bytes], list[bytes]]:
     """Encode MANY rows' points in one vectorized pass.
 
     Points must be sorted by row then delta, deduplicated, with
     ``row_starts`` marking each row's first index (ascending, starting at
     0). All qualifier/value bytes are computed in two flat buffers and
-    sliced per row — no per-point Python. Returns one (qualifier, value)
-    cell per row, with the trailing meta byte on multi-point cells.
+    sliced per row — no per-point Python. Returns (qualifiers, values):
+    two parallel lists with one entry per row, the trailing meta byte on
+    multi-point cells' values.
     """
     n = len(deltas)
     if n == 0:
@@ -72,43 +75,69 @@ def encode_cells_multi(deltas: np.ndarray, float_values: np.ndarray,
     if ((deltas < 0) | (deltas >= 3600)).any():
         raise ValueError("time delta out of range in batch")
     is_float = np.asarray(is_float, dtype=bool)
-    widths = np.where(is_float, 4, int_widths(np.asarray(int_values)))
-    flags = np.where(is_float, FLAG_FLOAT | 0x3, widths - 1)
-    quals = ((deltas << FLAG_BITS) | flags).astype(">u2").tobytes()
+    all_float = bool(is_float.all())
+    if all_float:
+        # The telnet/collector hot shape: every point a 4-byte float,
+        # so the value buffer is just the packed f32 column — no width
+        # computation, no offset cumsum, no fancy-index scatter (the
+        # scatter alone cost ~0.5 s per 10M points).
+        widths = None
+        flags = np.int64(FLAG_FLOAT | 0x3)
+        quals = ((deltas << FLAG_BITS) | flags).astype(">u2").tobytes()
+        vbytes = np.asarray(float_values).astype(">f4").tobytes()
+        offsets = None
+    else:
+        widths = np.where(is_float, 4, int_widths(np.asarray(int_values)))
+        flags = np.where(is_float, FLAG_FLOAT | 0x3, widths - 1)
+        quals = ((deltas << FLAG_BITS) | flags).astype(">u2").tobytes()
 
-    offsets = np.zeros(n, dtype=np.int64)
-    np.cumsum(widths[:-1], out=offsets[1:])
-    total = int(offsets[-1] + widths[-1]) if n else 0
-    buf = np.zeros(total, dtype=np.uint8)
-    if is_float.any():
-        fbytes = np.asarray(float_values)[is_float].astype(">f4") \
-            .view(np.uint8).reshape(-1, 4)
-        pos = offsets[is_float, None] + np.arange(4)
-        buf[pos.ravel()] = fbytes.ravel()
-    ivals = np.asarray(int_values)
-    for width in (1, 2, 4, 8):
-        m = (~is_float) & (widths == width)
-        if not m.any():
-            continue
-        wbytes = ivals[m].astype(">i8").view(np.uint8) \
-            .reshape(-1, 8)[:, 8 - width:]
-        pos = offsets[m, None] + np.arange(width)
-        buf[pos.ravel()] = wbytes.ravel()
-    vbytes = buf.tobytes()
+        offsets = np.zeros(n, dtype=np.int64)
+        np.cumsum(widths[:-1], out=offsets[1:])
+        total = int(offsets[-1] + widths[-1]) if n else 0
+        buf = np.zeros(total, dtype=np.uint8)
+        if is_float.any():
+            fbytes = np.asarray(float_values)[is_float].astype(">f4") \
+                .view(np.uint8).reshape(-1, 4)
+            pos = offsets[is_float, None] + np.arange(4)
+            buf[pos.ravel()] = fbytes.ravel()
+        ivals = np.asarray(int_values)
+        for width in (1, 2, 4, 8):
+            m = (~is_float) & (widths == width)
+            if not m.any():
+                continue
+            wbytes = ivals[m].astype(">i8").view(np.uint8) \
+                .reshape(-1, 8)[:, 8 - width:]
+            pos = offsets[m, None] + np.arange(width)
+            buf[pos.ravel()] = wbytes.ravel()
+        vbytes = buf.tobytes()
 
     row_starts = np.asarray(row_starts, dtype=np.int64)
     row_ends = np.append(row_starts[1:], n)
-    val_starts = offsets[row_starts]
-    val_ends = np.append(val_starts[1:], total)
-    out = []
-    for i in range(len(row_starts)):
-        a, b = int(row_starts[i]), int(row_ends[i])
-        va, vb = int(val_starts[i]), int(val_ends[i])
-        v = vbytes[va:vb]
-        if b - a > 1:
-            v += b"\x00"
-        out.append((quals[2 * a:2 * b], v))
-    return out
+    if all_float:
+        val_starts = row_starts * 4
+        val_ends = row_ends * 4
+    else:
+        val_starts = offsets[row_starts]
+        val_ends = np.append(val_starts[1:], total)
+    if _EXT is not None:
+        return _EXT.slice_cells(
+            quals, vbytes,
+            np.ascontiguousarray(row_starts).tobytes(),
+            np.ascontiguousarray(row_ends).tobytes(),
+            np.ascontiguousarray(val_starts, np.int64).tobytes(),
+            np.ascontiguousarray(val_ends, np.int64).tobytes())
+    # tolist() yields native ints once (indexing numpy scalars per row
+    # plus int() casts cost ~2.7 us/row across millions of row-hours);
+    # list comprehensions beat an append loop by ~30% on top. Two
+    # parallel lists, not tuples: the caller feeds put_many_columnar,
+    # and a tuple per row-hour was ~1 us of pure allocation.
+    rs, re_ = row_starts.tolist(), row_ends.tolist()
+    out_quals = [quals[2 * a:2 * b] for a, b in zip(rs, re_)]
+    out_vals = [
+        vbytes[va:vb] + b"\x00" if b - a > 1 else vbytes[va:vb]
+        for a, b, va, vb in zip(rs, re_, val_starts.tolist(),
+                                val_ends.tolist())]
+    return out_quals, out_vals
 
 
 def decode_cell(qual: bytes, value: bytes, base_ts: int) -> Columns:
@@ -138,11 +167,20 @@ def sort_dedup(deltas: np.ndarray, float_values: np.ndarray,
     errors, not overwrites.
     """
     deltas = np.asarray(deltas)
-    order = np.argsort(deltas, kind="stable")
-    d = deltas[order]
-    f = np.asarray(float_values)[order]
-    i = np.asarray(int_values)[order]
-    isf = np.asarray(is_float)[order]
+    if len(deltas) > 1 and (deltas[1:] >= deltas[:-1]).all():
+        # The collector pattern: batches arrive time-sorted, and one
+        # O(n) monotonicity check beats the O(n log n) argsort + four
+        # gathers it replaces (~8% of sustained batch ingest).
+        d = deltas
+        f = np.asarray(float_values)
+        i = np.asarray(int_values)
+        isf = np.asarray(is_float)
+    else:
+        order = np.argsort(deltas, kind="stable")
+        d = deltas[order]
+        f = np.asarray(float_values)[order]
+        i = np.asarray(int_values)[order]
+        isf = np.asarray(is_float)[order]
     if len(d) > 1:
         dup = d[1:] == d[:-1]
         if dup.any():

@@ -209,8 +209,15 @@ class DeviceWindow:
             mw.last_ts[sid] = int(timestamps[-1])
             if mw.epoch is None:
                 mw.epoch = int(timestamps[0])
-            mw.staged_ts.append(np.asarray(timestamps, np.int64))
-            mw.staged_vals.append(np.asarray(values, np.float32))
+            # Stage COPIES: the window owns its buffers. asarray would
+            # alias a caller's array of the right dtype, and since
+            # sort_dedup's sorted fast path started returning the
+            # ingest input by reference, a collector reusing its batch
+            # buffer would silently rewrite staged timestamps under
+            # the window. The memcpy is ~12 B/point, noise next to the
+            # upload it feeds.
+            mw.staged_ts.append(np.array(timestamps, np.int64))
+            mw.staged_vals.append(np.array(values, np.float32))
             mw.staged_sid.append(np.full(n, sid, np.int32))
             mw.staged_n += n
             self.appended_points += n

@@ -94,6 +94,12 @@ def cmd_tsd(args) -> int:
     from opentsdb_tpu.server.tsd import TSDServer
 
     tsdb = make_tsdb(args, start_thread=True)
+    # Replayed WAL/sstable state is in place: freeze it out of cycle
+    # collection (utils/gctune.py has the measured motivation — gen2
+    # passes over a multi-million-object memtable cost ~40% of
+    # sustained ingest).
+    from opentsdb_tpu.utils.gctune import tune_for_ingest
+    tune_for_ingest()
     server = TSDServer(tsdb)
 
     async def main():

@@ -1,0 +1,37 @@
+"""Loader for the optional C ingest extension (native/ingest_ext.c).
+
+``ext`` is the imported ``tsd_ingest_ext`` module or None; callers keep
+their pure-Python fallbacks as the reference implementations. Built by
+``make -C native`` (no pip involved); the .so lives in native/, which
+is not a package dir, so it is loaded by file path.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import sysconfig
+
+LOG = logging.getLogger(__name__)
+
+
+def _load():
+    so = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "native",
+        "tsd_ingest_ext" + sysconfig.get_config_var("EXT_SUFFIX"))
+    if not os.path.exists(so):
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location("tsd_ingest_ext", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        LOG.info("native ingest extension loaded from %s", so)
+        return mod
+    except Exception:  # pragma: no cover - build/env specific
+        LOG.exception("failed to load native ingest extension")
+        return None
+
+
+ext = _load()

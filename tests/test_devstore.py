@@ -172,14 +172,14 @@ class TestFallbacks:
 
         _load(tsdb, series=2)
         muid = tsdb.metrics.get_id("m.cpu")
-        orig = tsdb.store.put_many
+        orig = tsdb.store.put_many_columnar
 
         def throttling(*a, **k):
             e = PleaseThrottleError("full")
             e.partial_existed = []
             raise e
 
-        tsdb.store.put_many = throttling
+        tsdb.store.put_many_columnar = throttling
         try:
             with pytest.raises(PleaseThrottleError):
                 tsdb.add_batch("m.cpu",
@@ -187,7 +187,7 @@ class TestFallbacks:
                                np.arange(5.0), {"host": "h0",
                                                 "dc": "west"})
         finally:
-            tsdb.store.put_many = orig
+            tsdb.store.put_many_columnar = orig
         assert tsdb.devwindow.columns(muid, BT, BT + 7200) is None
 
     def test_timespan_beyond_int32_marks_dirty(self, tsdb):

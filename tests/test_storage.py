@@ -316,3 +316,52 @@ def test_put_many_throttle_still_flushes_wal(tmp_path):
     s2 = MemKVStore(wal_path=str(tmp_path / "snap"))
     assert s2.has_row("t", b"k1") and s2.has_row("t", b"k2")
     assert not s2.has_row("t", b"k3")
+
+
+def test_put_many_empty_batch_is_noop(tmp_path):
+    """put_many([]) / put_many_columnar(n=0) return [] without touching
+    the WAL (the batched WAL record can't frame zero cells)."""
+    import os
+
+    wal = str(tmp_path / "wal")
+    s = MemKVStore(wal_path=wal)
+    s.ensure_table("t")
+    size0 = os.path.getsize(wal)
+    assert s.put_many("t", b"f", []) == []
+    assert s.put_many_columnar("t", b"f", b"", 8, [], []) == []
+    assert os.path.getsize(wal) == size0
+
+
+def test_put_many_columnar_rejects_misframed_blob(tmp_path):
+    """A key blob whose length disagrees with n*key_len must fail
+    loudly: the WAL record trusts that framing, so a silent mismatch
+    would corrupt durable state on replay."""
+    s = MemKVStore(wal_path=str(tmp_path / "wal"))
+    with pytest.raises(ValueError):
+        s.put_many_columnar("t", b"f", b"abcdabcdXX", 4,
+                            [b"q1", b"q2"], [b"v1", b"v2"])
+    with pytest.raises(ValueError):
+        s.put_many_columnar("t", b"f", b"abcdabcd", 4, [b"q1"],
+                            [b"v1", b"v2"])
+
+
+def test_put_many_columnar_matches_put_many(tmp_path):
+    """The columnar entry point is put_many with a different calling
+    convention: same existed flags, same replayable WAL state — also
+    for intra-batch duplicate keys and pre-existing rows."""
+    walA, walB = str(tmp_path / "a"), str(tmp_path / "b")
+    a, b = MemKVStore(wal_path=walA), MemKVStore(wal_path=walB)
+    pre = [(b"kkk1", b"q0", b"v0")]
+    a.put_many("t", b"f", pre)
+    b.put_many("t", b"f", pre)
+    keys = [b"kkk1", b"kkk2", b"kkk3", b"kkk2"]   # dup kkk2 in-batch
+    quals = [b"q1", b"q2", b"q3", b"q4"]
+    vals = [b"v1", b"v2", b"v3", b"v4"]
+    ea = a.put_many("t", b"f", list(zip(keys, quals, vals)))
+    eb = b.put_many_columnar("t", b"f", b"".join(keys), 4, quals, vals)
+    assert ea == eb == [True, False, False, True]
+    ra = MemKVStore(wal_path=walA)
+    rb = MemKVStore(wal_path=walB)
+    rows_a = [(k, cells) for k, cells in ra.scan_raw("t", b"", b"\xff")]
+    rows_b = [(k, cells) for k, cells in rb.scan_raw("t", b"", b"\xff")]
+    assert rows_a == rows_b and len(rows_a) == 3
