@@ -199,7 +199,8 @@ class _Table:
     when stale entries dominate.
     """
 
-    __slots__ = ("rows", "base", "delta", "pending", "stale", "row_tombs")
+    __slots__ = ("rows", "base", "delta", "pending", "stale", "row_tombs",
+                 "tombs")
 
     def __init__(self) -> None:
         # Cell value None = tombstone masking a spilled sstable cell.
@@ -209,6 +210,11 @@ class _Table:
         self.pending: set[bytes] = set()
         self.stale = 0  # deleted keys still present in base/delta
         self.row_tombs: set[bytes] = set()  # whole-row masks over the sstable
+        # Count of cell tombstones ever written into rows (checkpoint
+        # uses it to pick the fast memtable-only spill: a tier with no
+        # tombstones cannot mask lower-generation cells, so spilling it
+        # as a new generation needs no merge).
+        self.tombs = 0
 
     def note_insert(self, key: bytes) -> None:
         self.pending.add(key)
@@ -290,7 +296,15 @@ class MemKVStore(KVStore):
         self._fsync = fsync
         self._wal_path = wal_path
         self._wal: io.BufferedWriter | None = None
-        self._sst: SSTable | None = None
+        # Spill tier: a LIST of sstable generations, OLDEST FIRST. A
+        # checkpoint normally spills just the frozen memtable as a new
+        # generation (O(new rows), not O(total) — full rewrites grew
+        # linearly: 28s at 25M points, 114s at 75M); reads overlay
+        # generations in order. A full merge (collapse to one
+        # generation) runs only when the frozen tier holds tombstones
+        # (which must mask lower-generation cells) or the generation
+        # count hits _MAX_GENERATIONS.
+        self._ssts: list[SSTable] = []
         self._sst_path = wal_path + ".sst" if wal_path else None
         # Flush failures SWALLOWED on put_many's exceptional exit (the
         # in-flight throttle error wins) — the one case where a flush
@@ -301,10 +315,12 @@ class MemKVStore(KVStore):
         self.wal_swallowed_flush_errors = 0
         # Immutable middle tier while a checkpoint merge is in flight.
         self._frozen: dict[str, _Table] | None = None
-        if self._sst_path and os.path.exists(self._sst_path):
-            self._sst = SSTable(self._sst_path)
-            for name in self._sst.tables():
-                self._table(name)
+        if self._sst_path:
+            for path in self._generation_paths():
+                sst = SSTable(path)
+                self._ssts.append(sst)
+                for name in sst.tables():
+                    self._table(name)
         if wal_path:
             # Create the WAL's parent directory so a fresh --wal path
             # works without operator mkdir (same courtesy as the /q
@@ -333,6 +349,69 @@ class MemKVStore(KVStore):
                     with open(wal_path, "r+b") as f:
                         f.truncate(valid_bytes)
             self._wal = open(wal_path, "ab")
+
+    _MAX_GENERATIONS = 8
+
+    def _generation_paths(self) -> list[str]:
+        """Live spill generations, oldest first. The manifest (written
+        atomically on every checkpoint) is the source of truth — stray
+        generation files it does not name (crash leftovers between a
+        full-merge swap and the old-file unlinks) are deleted here,
+        because loading them would resurrect cells a merge already
+        dropped. No manifest = legacy layout: the single ``<wal>.sst``."""
+        man = self._sst_path + ".manifest"
+        d = os.path.dirname(os.path.abspath(self._sst_path))
+        if not os.path.exists(man):
+            return [self._sst_path] if os.path.exists(self._sst_path) \
+                else []
+        import json as _json
+        with open(man) as f:
+            names = _json.load(f)
+        live = [os.path.join(d, fn) for fn in names]
+        liveset = set(names)
+        base = os.path.basename(self._sst_path)
+        for fn in os.listdir(d):
+            if (fn == base or fn.startswith(base + ".g")) \
+                    and fn not in liveset and not fn.endswith(".tmp") \
+                    and not fn.endswith(".manifest"):
+                try:
+                    os.unlink(os.path.join(d, fn))
+                except OSError:
+                    pass
+        return [p for p in live if os.path.exists(p)]
+
+    def _write_manifest(self, paths: list[str]) -> None:
+        """Atomically record the live generation set (tmp + rename +
+        directory fsync, same durability contract as write_sstable)."""
+        import json as _json
+        man = self._sst_path + ".manifest"
+        tmp = man + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump([os.path.basename(p) for p in paths], f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, man)
+        dfd = os.open(os.path.dirname(os.path.abspath(man)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    def _next_generation_path(self) -> str:
+        used = set()
+        d = os.path.dirname(os.path.abspath(self._sst_path))
+        prefix = os.path.basename(self._sst_path) + ".g"
+        for fn in os.listdir(d):
+            if fn.startswith(prefix) and not fn.endswith(".tmp") \
+                    and not fn.endswith(".manifest"):
+                try:
+                    used.add(int(fn[len(prefix):]))
+                except ValueError:
+                    continue
+        n = 1
+        while n in used:
+            n += 1
+        return self._sst_path + f".g{n}"
 
     # -- table helpers ----------------------------------------------------
 
@@ -374,8 +453,8 @@ class MemKVStore(KVStore):
             ft = self._frozen.get(table) if self._frozen else None
             if ft is not None:
                 keys |= set(ft.rows)
-            if self._sst is not None:
-                keys.update(self._sst.scan_keys(table, b"", None))
+            for sst in self._ssts:
+                keys.update(sst.scan_keys(table, b"", None))
             return sum(1 for k in keys if self._merged_row(table, k))
 
     def has_row(self, table: str, key: bytes) -> bool:
@@ -387,7 +466,7 @@ class MemKVStore(KVStore):
         if row:
             # Tombstones (None cells) only exist once a lower tier
             # does; the pure-memtable hot ingest path stays O(1).
-            if self._sst is None and self._frozen is None:
+            if not self._ssts and self._frozen is None:
                 return True
             if any(v is not None for v in row.values()):
                 return True
@@ -403,7 +482,7 @@ class MemKVStore(KVStore):
         """Lower tiers (sstable, then frozen memtable) overlaid with the
         live memtable's cells/tombstones. Caller holds the lock."""
         t = self._table(table)
-        if self._sst is None and self._frozen is None:
+        if not self._ssts and self._frozen is None:
             # No lower tiers => no tombstones possible; serve the row
             # as-is (the default-config hot path allocates nothing).
             return t.rows.get(key) or None
@@ -411,10 +490,15 @@ class MemKVStore(KVStore):
         merged: dict[tuple[bytes, bytes], bytes] = {}
         sst_masked = key in t.row_tombs or (
             ft is not None and key in ft.row_tombs)
-        if self._sst is not None and not sst_masked:
-            cells = self._sst.get(table, key)
-            if cells:
-                merged = {(f, q): v for f, q, v in cells}
+        if not sst_masked:
+            # Overlay generations oldest -> newest (generations never
+            # hold tombstones — a tombstoned frozen tier forces a full
+            # merge — so plain dict overlay is the whole story).
+            for sst in self._ssts:
+                cells = sst.get(table, key)
+                if cells:
+                    for f, q, v in cells:
+                        merged[(f, q)] = v
         if ft is not None and key not in t.row_tombs:
             row = ft.rows.get(key)
             if row:
@@ -438,7 +522,7 @@ class MemKVStore(KVStore):
         ft = self._frozen.get(table) if self._frozen else None
         if ft is not None and (key in ft.rows):
             return True
-        return self._sst is not None and self._sst.has_key(table, key)
+        return any(sst.has_key(table, key) for sst in self._ssts)
 
     # -- WAL --------------------------------------------------------------
 
@@ -587,29 +671,41 @@ class MemKVStore(KVStore):
                 self.flush()
                 self._wal.close()
                 self._wal = None
-            if self._sst is not None:
-                self._sst.close()
-                self._sst = None
+            for sst in self._ssts:
+                sst.close()
+            self._ssts = []
 
     # -- checkpoint / spill ----------------------------------------------
 
     def checkpoint(self) -> int:
-        """Merge frozen memtable + previous spill into a new sstable
-        generation, then drop the pre-checkpoint WAL records. Returns rows
-        written (0 = not persistent / already in progress).
+        """Spill the frozen memtable to a new sstable generation, then
+        drop the pre-checkpoint WAL records. Returns rows written
+        (0 = not persistent / already in progress).
+
+        Normally an O(frozen-rows) memtable-only spill: the new
+        generation is appended to the tier list and reads overlay it
+        (full rewrites grew linearly with history — 28 s at 25M points,
+        114 s at 75M — which dominated sustained ingest). A FULL merge
+        (collapse every generation + frozen into one, tombstones
+        applied) runs only when the frozen tier holds tombstones (which
+        must mask lower cells — a tombstone-free generation can never
+        mask anything, so plain overlay is exact) or the generation
+        count hits _MAX_GENERATIONS.
 
         Three phases, designed so ingest/queries never wait on the merge:
           1. (brief lock) freeze the memtable as an immutable middle tier,
              rotate the WAL: pre-checkpoint records move to <wal>.old,
              writes continue into a fresh WAL.
-          2. (no lock) stream sstable ∪ frozen — tombstones applied — into
-             a temp file, fsync, atomically rename over the generation.
-          3. (brief lock) swap in the new SSTable, discard the frozen
-             tier, unlink <wal>.old.
+          2. (no lock) stream the spill into a temp file, fsync,
+             atomically rename to the new generation.
+          3. (brief lock) open the new generation, write the manifest
+             (the authoritative generation set — stray files from a
+             crash between manifest write and unlinks are deleted at
+             next load), discard the frozen tier, unlink <wal>.old.
         Crash-safe: <wal>.old survives until the new generation is durable
         (sstable.write_sstable fsyncs the file AND its directory before
         phase 3); recovery replays <wal>.old then the WAL, which is
-        idempotent over either generation.
+        idempotent over any manifest state.
         """
         if self._sst_path is None:
             return 0
@@ -633,36 +729,75 @@ class MemKVStore(KVStore):
                 else:
                     os.replace(self._wal_path, old_path)
                     self._wal = open(self._wal_path, "ab")
-            frozen, frozen_sst = self._frozen, self._sst
+            frozen = self._frozen
+            gens = list(self._ssts)
+            full = (any(ft.row_tombs or ft.tombs
+                        for ft in frozen.values())
+                    or len(gens) + 1 >= self._MAX_GENERATIONS)
+            empty = not any(ft.rows or ft.row_tombs
+                            for ft in frozen.values())
+            out_path = self._next_generation_path()
 
-        def merged_rows():
-            for name in sorted(frozen):
-                ft = frozen[name]
-                keys = set(ft.rows)
-                if frozen_sst is not None:
-                    keys.update(k for k in
-                                frozen_sst.scan_keys(name, b"", None)
-                                if k not in ft.row_tombs)
-                for key in sorted(keys):
-                    merged: dict[tuple[bytes, bytes], bytes] = {}
-                    if frozen_sst is not None and key not in ft.row_tombs:
-                        cells = frozen_sst.get(name, key)
-                        if cells:
-                            merged = {(f, q): v for f, q, v in cells}
-                    row = ft.rows.get(key)
-                    if row:
-                        for ck, v in row.items():
-                            if v is None:
-                                merged.pop(ck, None)
-                            else:
-                                merged[ck] = v
-                    if merged:
-                        yield (name, key,
-                               sorted((f, q, v)
-                                      for (f, q), v in merged.items()))
+        if empty:
+            # Nothing to spill, but the WAL rotation above must still
+            # conclude: a WAL whose records net out to an empty
+            # memtable (put-then-delete churn on unspilled rows) holds
+            # no state the generations don't — dropping <wal>.old loses
+            # nothing, and skipping here would let idle/churn daemons'
+            # timer checkpoints grow the WAL without bound while an
+            # empty generation file accreted per call.
+            with self._lock:
+                self._frozen = None
+                if os.path.exists(old_path):
+                    os.unlink(old_path)
+            return 0
+
+        if full:
+            def spill_rows():
+                names = set(frozen)
+                for g in gens:
+                    names.update(g.tables())
+                for name in sorted(names):
+                    ft = frozen.get(name) or _Table()
+                    keys = set(ft.rows)
+                    for g in gens:
+                        keys.update(k for k in
+                                    g.scan_keys(name, b"", None)
+                                    if k not in ft.row_tombs)
+                    for key in sorted(keys):
+                        merged: dict[tuple[bytes, bytes], bytes] = {}
+                        if key not in ft.row_tombs:
+                            for g in gens:
+                                for f, q, v in g.get(name, key) or []:
+                                    merged[(f, q)] = v
+                        row = ft.rows.get(key)
+                        if row:
+                            for ck, v in row.items():
+                                if v is None:
+                                    merged.pop(ck, None)
+                                else:
+                                    merged[ck] = v
+                        if merged:
+                            yield (name, key,
+                                   sorted((f, q, v)
+                                          for (f, q), v in
+                                          merged.items()))
+        else:
+            def spill_rows():
+                # Memtable-only: by the `full` test above the frozen
+                # tier holds no tombstones, so every cell value is
+                # real bytes and no lower-generation read is needed.
+                for name in sorted(frozen):
+                    ft = frozen[name]
+                    for key in sorted(ft.rows):
+                        row = ft.rows[key]
+                        if row:
+                            yield (name, key,
+                                   sorted((f, q, v)
+                                          for (f, q), v in row.items()))
 
         try:
-            n = write_sstable(self._sst_path, merged_rows())
+            n = write_sstable(out_path, spill_rows())
         except Exception:
             # Disk full or similar mid-merge: thaw the frozen tier back
             # under the live memtable so the store isn't wedged (a stuck
@@ -680,17 +815,37 @@ class MemKVStore(KVStore):
                         merged.update(live.rows.get(k, {}))
                         live.rows[k] = merged
                     live.row_tombs |= ft.row_tombs
+                    # Tombstone cells travel back with the rows: the
+                    # counter must too, or the RETRY checkpoint would
+                    # pick the fast tombstone-free spill and feed None
+                    # values to write_sstable (and, had that written,
+                    # resurrect the masked lower-generation cells).
+                    live.tombs += ft.tombs
                     for k in ft.rows:
                         live.note_insert(k)
                 self._frozen = None
             raise
 
         with self._lock:
-            old = self._sst
-            self._sst = SSTable(self._sst_path)
+            new_sst = SSTable(out_path)
+            if full:
+                dropped = self._ssts
+                self._ssts = [new_sst]
+            else:
+                dropped = []
+                self._ssts = self._ssts + [new_sst]
+            # Manifest BEFORE unlinking: a crash in between leaves
+            # stray files the next load deletes (they are never opened,
+            # so dropped cells cannot resurrect).
+            self._write_manifest([s.path for s in self._ssts])
             self._frozen = None
-            if old is not None:
-                old.close()
+            for g in dropped:
+                path = g.path
+                g.close()
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
             if os.path.exists(old_path):
                 os.unlink(old_path)
         return n
@@ -720,6 +875,7 @@ class MemKVStore(KVStore):
         for q in qualifiers:
             if spilled:
                 row[(family, q)] = None  # tombstone masks the sstable cell
+                t.tombs += 1
             else:
                 row.pop((family, q), None)
         if not row:
@@ -770,7 +926,7 @@ class MemKVStore(KVStore):
             rows = t.rows
             # With no lower tiers the memtable is the whole truth, so
             # existence is one dict probe (the default-config hot path).
-            pure_mem = self._sst is None and self._frozen is None
+            pure_mem = not self._ssts and self._frozen is None
             throttle = self.throttle_rows
             wal = self._wal is not None and durable
             keys = [c[0] for c in cells]
@@ -864,10 +1020,12 @@ class MemKVStore(KVStore):
         durability is off)."""
         rows = t.rows
         n = len(keys)
-        pure_mem = self._sst is None and self._frozen is None
+        pure_mem = not self._ssts and self._frozen is None
         throttle = self.throttle_rows
-        if _EXT is not None and pure_mem and (
-                throttle is None or len(rows) + n <= throttle):
+        # Conservative bound (assumes every key new): when it holds, a
+        # mid-batch throttle trip is impossible.
+        throttle_ok = throttle is None or len(rows) + n <= throttle
+        if _EXT is not None and pure_mem and throttle_ok:
             # One C pass does the whole upsert + existed flags + the
             # pending-index adds, in lockstep with each row insert
             # (full put_many semantics incl. intra-batch duplicate
@@ -881,6 +1039,37 @@ class MemKVStore(KVStore):
                 wal_cb()
             return existed
         ks = set(keys)
+        # Lower-tier candidate prefilter: a key can only exist below
+        # the live memtable if it is in the frozen memtable or inside
+        # the sstable's key range. Sound as a filter because the exact
+        # probe (_has_row_locked) remains the oracle for every
+        # surviving candidate — it only drops keys NO lower tier can
+        # hold. Time-ordered ingest (new base-times sort after every
+        # spilled key) passes almost nothing through, which keeps
+        # post-checkpoint sustained ingest off the 1 us/key bisect.
+        lower = set()
+        if not pure_mem:
+            if self._frozen is not None:
+                ft = self._frozen.get(table)
+                if ft is not None:
+                    lower |= ft.rows.keys() & ks
+            for sst in self._ssts:
+                bounds = sst.key_bounds(table)
+                if bounds is not None:
+                    lo, hi = bounds
+                    lower |= {k for k in ks if lo <= k <= hi}
+        if _EXT is not None and throttle_ok and not lower:
+            # No batch key can touch a lower tier, so memtable presence
+            # is existence and the C upsert stays sound post-checkpoint
+            # (the sustained-ingest steady state). One nuance: a live
+            # all-tombstone row reads as existed=True where the exact
+            # probe could say False — benign, existed only enqueues a
+            # compaction that then no-ops.
+            existed = _EXT.upsert_cells(
+                rows, keys, family, quals, vals, t.pending)
+            if wal_cb is not None:
+                wal_cb()
+            return existed
         if len(ks) != n:
             return None
         dups = rows.keys() & ks
@@ -891,8 +1080,13 @@ class MemKVStore(KVStore):
             existed = ([False] * n if not dups
                        else [k in dups for k in keys])
         else:
-            hrl = self._has_row_locked
-            existed = [hrl(table, k) for k in keys]
+            candidates = dups | lower
+            if candidates:
+                hrl = self._has_row_locked
+                present = {k for k in candidates if hrl(table, k)}
+                existed = [k in present for k in keys]
+            else:
+                existed = [False] * n
         if not dups:
             if _EXT is not None:
                 _EXT.rows_update_new(rows, keys, family, quals, vals)
@@ -986,9 +1180,9 @@ class MemKVStore(KVStore):
         if ft is not None:
             extra.update(k for k in ft.range_keys(start, stop)
                          if k not in t.rows and k not in t.row_tombs)
-        if self._sst is not None:
+        for sst in self._ssts:
             extra.update(
-                k for k in self._sst.scan_keys(table, start, stop)
+                k for k in sst.scan_keys(table, start, stop)
                 if k not in t.rows and k not in t.row_tombs
                 and not (ft is not None and (k in ft.rows
                                              or k in ft.row_tombs)))
@@ -1048,7 +1242,7 @@ class MemKVStore(KVStore):
                 # concurrent checkpoint() can freeze the live memtable
                 # between chunks, and a stale fast-path would then read
                 # the freshly-emptied live dict and silently drop rows.
-                if self._sst is None and self._frozen is None:
+                if not self._ssts and self._frozen is None:
                     # No lower tiers => no tombstones; read the live
                     # memtable dict directly (skips a function call +
                     # tier checks per row — this loop runs per row-hour

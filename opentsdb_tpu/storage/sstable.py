@@ -117,6 +117,18 @@ class SSTable:
         idx = self._index.get(table)
         return len(idx[0]) if idx else 0
 
+    def key_bounds(self, table: str) -> tuple[bytes, bytes] | None:
+        """(smallest, largest) row key stored for ``table``, or None
+        when the table is absent — a batch existence prefilter: keys
+        outside this range cannot be in the sstable, which lets
+        time-ordered ingest (new base-times sort after every spilled
+        key) skip the per-key bisect entirely."""
+        idx = self._index.get(table)
+        if not idx or not idx[0]:
+            return None
+        keys = idx[0]
+        return keys[0], keys[-1]
+
     def has_key(self, table: str, key: bytes) -> bool:
         idx = self._index.get(table)
         if not idx:

@@ -64,7 +64,10 @@ class TestCheckpoint:
         wal_before = os.path.getsize(wal(tmp_path))
         assert store.checkpoint() == 10
         assert os.path.getsize(wal(tmp_path)) == 0 < wal_before
-        assert os.path.exists(wal(tmp_path) + ".sst")
+        # Generation files are named by the manifest (tiered spill).
+        assert store._ssts and all(os.path.exists(s.path)
+                                   for s in store._ssts)
+        assert os.path.exists(wal(tmp_path) + ".sst.manifest")
         # Reads come from the spill tier now.
         assert store.get(T, b"row3") == [Cell(b"row3", F, b"q", b"v3")]
         assert store.row_count(T) == 10
@@ -289,7 +292,10 @@ class TestCheckpoint:
         assert store.row_count(T) == 3
         assert store.get(T, b"b")[0].value == b"v2"
         monkeypatch.undo()
-        assert store.checkpoint() == 3  # retry succeeds
+        # Retry spills the thawed memtable (b, c) as a new generation;
+        # `a` already lives in the first generation (tiered spill: rows
+        # written = frozen rows, not the whole history).
+        assert store.checkpoint() == 2
         assert not os.path.exists(wal(tmp_path) + ".old")
         store.close()
         again = MemKVStore(wal_path=wal(tmp_path))
@@ -347,3 +353,141 @@ class TestTSDBCheckpoint:
         assert len(results) == 1
         assert list(results[0].values) == [float(i) for i in range(50)]
         again.shutdown()
+
+
+class TestTieredGenerations:
+    def test_fast_spill_appends_generation(self, tmp_path):
+        """Tombstone-free checkpoints spill only the frozen memtable —
+        one new generation each, earlier generations untouched."""
+        store = MemKVStore(wal_path=wal(tmp_path))
+        for gen in range(3):
+            for i in range(4):
+                store.put(T, b"g%d-row%d" % (gen, i), F, b"q",
+                          b"v%d" % gen)
+            assert store.checkpoint() == 4
+        assert len(store._ssts) == 3
+        # Every row readable across generations; scans merge-sorted.
+        for gen in range(3):
+            assert store.get(T, b"g%d-row0" % gen)[0].value == \
+                b"v%d" % gen
+        keys = [cells[0].key for cells in store.scan(T, b"", b"")]
+        assert len(keys) == 12 and keys == sorted(keys)
+        store.close()
+        again = MemKVStore(wal_path=wal(tmp_path))
+        assert again.row_count(T) == 12
+        assert len(again._ssts) == 3
+        again.close()
+
+    def test_cross_generation_cell_overlay(self, tmp_path):
+        """Later generations overlay earlier ones per cell: a row whose
+        cells arrive across two checkpoints reads merged, and a
+        rewritten cell takes the newest value."""
+        store = MemKVStore(wal_path=wal(tmp_path))
+        store.put(T, b"row", F, b"q1", b"old")
+        store.checkpoint()
+        store.put(T, b"row", F, b"q1", b"NEW")
+        store.put(T, b"row", F, b"q2", b"extra")
+        store.checkpoint()
+        cells = store.get(T, b"row")
+        assert {(c.qualifier, c.value) for c in cells} == \
+            {(b"q1", b"NEW"), (b"q2", b"extra")}
+        store.close()
+
+    def test_delete_forces_full_merge_and_never_resurrects(self, tmp_path):
+        """A tombstone in the frozen tier forces the full merge (a fast
+        spill would drop the tombstone and the masked cell would
+        resurrect from the older generation on reload)."""
+        store = MemKVStore(wal_path=wal(tmp_path))
+        store.put(T, b"keep", F, b"q", b"v")
+        store.put(T, b"gone", F, b"q", b"v")
+        store.checkpoint()
+        store.put(T, b"fresh", F, b"q", b"v")
+        store.delete(T, b"gone", F, [b"q"])
+        assert store.get(T, b"gone") == []
+        store.checkpoint()              # tombstone -> full merge
+        assert len(store._ssts) == 1    # collapsed
+        assert store.get(T, b"gone") == []
+        store.close()
+        again = MemKVStore(wal_path=wal(tmp_path))
+        assert again.get(T, b"gone") == []
+        assert again.get(T, b"keep")[0].value == b"v"
+        assert again.row_count(T) == 2
+        again.close()
+
+    def test_generation_cap_collapses(self, tmp_path):
+        store = MemKVStore(wal_path=wal(tmp_path))
+        cap = MemKVStore._MAX_GENERATIONS
+        for gen in range(cap + 2):
+            store.put(T, b"row%02d" % gen, F, b"q", b"v")
+            store.checkpoint()
+        assert len(store._ssts) < cap
+        assert store.row_count(T) == cap + 2
+        store.close()
+
+    def test_manifest_ignores_and_cleans_stray_generations(self, tmp_path):
+        """A generation file not named by the manifest (crash between
+        full-merge manifest write and old-file unlinks) must not be
+        loaded — loading it would resurrect merged-away cells — and is
+        deleted at open."""
+        store = MemKVStore(wal_path=wal(tmp_path))
+        store.put(T, b"row", F, b"q", b"v")
+        store.checkpoint()
+        live = [s.path for s in store._ssts]
+        store.close()
+        stray = wal(tmp_path) + ".sst.g99"
+        from opentsdb_tpu.storage.sstable import write_sstable
+        write_sstable(stray, iter([("t", b"zombie",
+                                    [(F, b"q", b"boo")])]))
+        again = MemKVStore(wal_path=wal(tmp_path))
+        assert [s.path for s in again._ssts] == live
+        assert again.get(T, b"zombie") == []
+        assert not os.path.exists(stray)
+        again.close()
+
+    def test_failed_full_merge_retry_keeps_tombstones(self, tmp_path,
+                                                      monkeypatch):
+        """A failed FULL merge thaws tombstone cells back into the live
+        memtable; the retry must still classify as a full merge (the
+        tombs counter travels with the rows) — a fast spill would feed
+        None values to write_sstable and, if written, resurrect the
+        masked generation cells."""
+        import opentsdb_tpu.storage.kv as kv_mod
+
+        store = MemKVStore(wal_path=wal(tmp_path))
+        store.put(T, b"k", F, b"q", b"v")
+        store.checkpoint()
+        store.delete(T, b"k", F, [b"q"])       # tombstone over gen1
+
+        def boom(path, rows):
+            list(rows)
+            raise OSError("disk full")
+
+        monkeypatch.setattr(kv_mod, "write_sstable", boom)
+        with pytest.raises(OSError):
+            store.checkpoint()
+        monkeypatch.undo()
+        store.checkpoint()                      # retry
+        assert store.get(T, b"k") == []
+        store.close()
+        again = MemKVStore(wal_path=wal(tmp_path))
+        assert again.get(T, b"k") == [], "masked cell resurrected"
+        again.close()
+
+    def test_churn_to_empty_memtable_still_truncates_wal(self, tmp_path):
+        """put-then-delete churn that nets out to an empty memtable must
+        still reclaim the WAL on checkpoint (no state is lost: the
+        generations already hold everything the WAL's net effect
+        kept)."""
+        store = MemKVStore(wal_path=wal(tmp_path))
+        for i in range(20):
+            store.put(T, b"tmp%d" % i, F, b"q", b"v")
+            store.delete(T, b"tmp%d" % i, F, [b"q"])
+        store.flush()
+        assert os.path.getsize(wal(tmp_path)) > 0
+        assert store.checkpoint() == 0
+        assert os.path.getsize(wal(tmp_path)) == 0
+        assert not os.path.exists(wal(tmp_path) + ".old")
+        store.close()
+        again = MemKVStore(wal_path=wal(tmp_path))
+        assert again.row_count(T) == 0
+        again.close()

@@ -97,3 +97,26 @@ class TestHybridSketches:
         got = np.asarray(hybrid_tdigest(values, valid, qs, mesh=mesh))
         exact = np.quantile(values.reshape(-1), qs)
         np.testing.assert_allclose(got, exact, rtol=0.05)
+
+
+def test_two_process_dcn_merge_end_to_end():
+    """The committed multi-process proof (VERDICT r03 item 9): fork two
+    OS processes joined via jax.distributed, HOST mesh axis spanning
+    the process boundary, and check the script's own oracle assertions
+    pass (uneven shards + straggler included). ~40 s on one core."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "multihost_run.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    r = subprocess.run([sys.executable, script], env=env,
+                       capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stderr[-3000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["process_count"] == 2
+    assert rec["devices_global"] == 8 and rec["devices_local"] == 4
+    assert rec["straggler_observed_wall_s"] >= 1.5
