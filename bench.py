@@ -647,6 +647,15 @@ def main() -> int:
                "tpu_probe": probe_log, "sanity": sanity,
                "peak_gbps": peak}
 
+    # Process-wide GC posture for ingest-heavy work (utils/gctune.py:
+    # gen2 passes over a multi-million-object memtable cost ~40% of
+    # sustained ingest). Applied before EVERY config, stand-in
+    # included — it is process configuration, like a JVM heap flag, so
+    # the comparison stays fair (the reference's JVM collector never
+    # paid this tax in the first place).
+    from opentsdb_tpu.utils.gctune import tune_for_ingest
+    tune_for_ingest()
+
     # Config 5 first: ingest+compact (host+storage path, the headline).
     log("config 5: ingest+compact ...")
     ing = bench_ingest(min(args.series, 1000),

@@ -353,21 +353,31 @@ def _group_stage(filled, in_range, series_mask, gmap, *, num_groups,
     return gv, gm
 
 
-def _shrink_wrap(gv, gm, g_out, b_out):
+def _shrink_wrap(gv, gm, g_out, b_out, wire_bf16=False):
     """Clip apply outputs to the (64-quantized) live group/bucket counts
     and bit-pack the mask before they cross the transport: the axon
     tunnel moves device->host data at ~30 MB/s with a ~100 ms floor
     (measured), so fetching the PADDED [G, B] grids dominated wide
     group-by queries. g_out/b_out are static (bounded recompiles: 64
-    quantization)."""
+    quantization).
+
+    ``wire_bf16`` additionally halves the [G, B] value payload by
+    casting to bfloat16 ON DEVICE (opt-in via Config.wire_bf16: it
+    trades the window path's byte-exactness vs the scan path for wire
+    bytes — ~2-3 significant digits, plenty for dashboard pixels,
+    wrong for billing). bfloat16, not float16: the float32 exponent
+    range means big group sums can't overflow to inf (f16 tops out at
+    65504)."""
     gv = gv[..., :g_out, :b_out]
+    if wire_bf16:
+        gv = gv.astype(jnp.bfloat16)
     gm = jnp.packbits(gm[:g_out, :b_out], axis=1)
     return gv, gm
 
 
 def _moment_apply(series_values, series_mask, filled, in_range, include,
                   gmap, *, num_groups, agg_group,
-                  g_out=None, b_out=None):
+                  g_out=None, b_out=None, wire_bf16=False):
     """Cheap per-query half of a resident-window MOMENT query: include
     masking (row-wise — identical to having filtered the points
     upstream, since fill is row-local) + group aggregation over the
@@ -381,12 +391,12 @@ def _moment_apply(series_values, series_mask, filled, in_range, include,
                           num_groups=num_groups, agg_group=agg_group)
     if g_out is None:
         return gv, gm
-    return _shrink_wrap(gv, gm, g_out, b_out)
+    return _shrink_wrap(gv, gm, g_out, b_out, wire_bf16)
 
 
 def _quantile_apply(series_mask, filled, in_range,
                     include, gmap, q, *, num_groups,
-                    g_out=None, b_out=None):
+                    g_out=None, b_out=None, wire_bf16=False):
     """Cheap per-quantile half: include masking + [G, B] masked
     quantiles from the cached stage's filled grid (quantiles always use
     the lerp/step fill family — reference SpanGroup percentile
@@ -406,7 +416,7 @@ def _quantile_apply(series_mask, filled, in_range,
             sm.astype(jnp.int32), gmap, num_groups) > 0
     if g_out is None:
         return gv, gm
-    return _shrink_wrap(gv, gm, g_out, b_out)
+    return _shrink_wrap(gv, gm, g_out, b_out, wire_bf16)
 
 
 def _stage_tail(series_values, series_mask, presence, *, num_buckets,
@@ -552,11 +562,13 @@ window_series_stage = functools.partial(
 
 window_moment_apply = functools.partial(
     jax.jit, static_argnames=("num_groups", "agg_group",
-                              "g_out", "b_out"))(_moment_apply)
+                              "g_out", "b_out",
+                              "wire_bf16"))(_moment_apply)
 
 window_quantile_apply = functools.partial(
     jax.jit, static_argnames=("num_groups",
-                              "g_out", "b_out"))(_quantile_apply)
+                              "g_out", "b_out",
+                              "wire_bf16"))(_quantile_apply)
 
 
 @functools.partial(

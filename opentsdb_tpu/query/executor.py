@@ -380,7 +380,9 @@ class QueryExecutor:
         b_live = int((end - qbase) // interval + 1)
         g_out = min(ngroups, _pad64(len(gkeys)))
         b_out = min(num_buckets, _pad64(b_live))
-        shrink = dict(g_out=g_out, b_out=b_out)
+        shrink = dict(g_out=g_out, b_out=b_out,
+                      wire_bf16=bool(getattr(self.tsdb.config,
+                                            "wire_bf16", False)))
         # The applies allocate fresh [S,B]/[G,B] buffers on a device the
         # resident window may have filled to within a few hundred MB of
         # HBM — an OOM here (or in the fetch's staging buffer) must

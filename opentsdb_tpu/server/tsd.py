@@ -56,19 +56,50 @@ LOG = logging.getLogger(__name__)
 
 MAX_LINE = 1024       # per-line telnet framing limit (reference
                       # LineBasedFrameDecoder's 1024 B discard protection)
-MAX_BUFFER = 1 << 20  # pipelined-burst buffer bound for the bulk path
+MAX_BUFFER = 1 << 22  # pipelined-burst buffer bound for the bulk path
+                      # (4 MiB: bigger bursts = bigger native-decode
+                      # batches and fewer pipeline turns per point)
 
 
 def _put_prefix_len(buf: bytes) -> int:
-    """Byte length of the longest prefix of complete ``put `` lines."""
-    pos = 0
-    while True:
-        nl = buf.find(b"\n", pos)
-        if nl < 0:
-            return pos
-        if not buf.startswith(b"put ", pos):
-            return pos
-        pos = nl + 1
+    """Byte length of the longest prefix of complete ``put `` lines.
+
+    Vectorized: the per-line find/startswith loop cost ~200 ns x ~28k
+    lines per MiB (~210 ms per million points) on the socket ingest
+    path. Four numpy gathers test every line head at once."""
+    if len(buf) < 4096:
+        pos = 0
+        while True:
+            nl = buf.find(b"\n", pos)
+            if nl < 0:
+                return pos
+            if not buf.startswith(b"put ", pos):
+                return pos
+            pos = nl + 1
+    import numpy as np
+
+    if not buf.startswith(b"put "):
+        return 0
+    arr = np.frombuffer(buf, np.uint8)
+    nls = np.flatnonzero(arr == 10)
+    if len(nls) == 0:
+        return 0
+    # Line i (i >= 1) starts at nls[i-1] + 1; it must begin "put ".
+    starts = nls[:-1] + 1
+    # A line start too close to the end can't hold "put " — treat as
+    # non-put so the prefix stops before it (the loop path does too,
+    # via startswith failing).
+    in_range = starts + 4 <= len(buf)
+    okput = (in_range
+             & (arr[np.minimum(starts, len(buf) - 1)] == 0x70)
+             & (arr[np.minimum(starts + 1, len(buf) - 1)] == 0x75)
+             & (arr[np.minimum(starts + 2, len(buf) - 1)] == 0x74)
+             & (arr[np.minimum(starts + 3, len(buf) - 1)] == 0x20))
+    bad = np.flatnonzero(~okput)
+    if len(bad) == 0:
+        return int(nls[-1]) + 1
+    # Prefix = complete put lines before the first non-put line start.
+    return int(nls[bad[0]]) + 1
 
 _CONTENT_TYPES = {
     ".html": "text/html; charset=UTF-8",

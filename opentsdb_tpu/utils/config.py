@@ -52,6 +52,15 @@ class Config:
     device_window: bool = True
     device_window_staging: int = 1 << 20   # points per upload chunk
     device_window_points: int = 1 << 26    # resident budget (~12 B/point)
+    # Halve window-query [G, B] value payloads on the wire by casting
+    # to bfloat16 ON DEVICE before the device->host fetch (the
+    # ~30 MB/s tunnel made wide group-by fetches payload-bound).
+    # bfloat16, not float16: same 2-byte payload but float32 exponent
+    # range, so big group sums cannot overflow to inf (f16 tops out at
+    # 65504). OPT-IN: it trades the window path's byte-exactness vs
+    # the scan path for bytes — ~2-3 significant digits, fine for
+    # dashboard pixels, wrong for billing.
+    wire_bf16: bool = False
 
     # compute backend: 'tpu' = jitted JAX kernels; 'cpu' = numpy oracle
     backend: str = "tpu"

@@ -1,0 +1,116 @@
+"""Chase the wedged TPU tunnel and run the chip-bound work the moment
+it returns (VERDICT r03 item 1: the tunnel has eaten the end of three
+rounds; everything chip-bound must fire the instant a probe succeeds,
+unattended).
+
+Loop: probe (fresh subprocess per attempt, tpu_reprobe.py) -> on
+success run, in strict priority order so a re-wedge mid-sequence costs
+the least-valuable tail, not the 1B north star:
+  1. bench_resident --points 1e9 (budget 1<<30 ~ 13 GB of 16 GB HBM)
+     -> BENCH_RESIDENT.json
+  2. bench.py (full system, chip) -> BENCH_DETAILS.json, copied to
+     BENCH_TPU.json when the device is a TPU
+  3. pytest tests/test_tpu_hardware.py -> TPU_TESTS.json
+  4. bench_scale --points 1e8 (chip leg, tiered checkpoints)
+Every step's rc/wall goes to TPU_CHASE.json as it lands (a re-wedge
+must not lose the record of what DID complete).
+
+Run: nohup python scripts/tpu_chase.py [budget_s] &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "TPU_CHASE.json")
+PY = sys.executable
+
+
+def record(state: dict) -> None:
+    with open(OUT, "w") as f:
+        json.dump(state, f, indent=2)
+
+
+def step(state, name, cmd, timeout):
+    t0 = time.time()
+    entry = {"cmd": " ".join(cmd),
+             "started": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime())}
+    state["steps"].append(entry)
+    record(state)
+    try:
+        r = subprocess.run(cmd, cwd=REPO, timeout=timeout,
+                           capture_output=True, text=True)
+        entry["rc"] = r.returncode
+        entry["tail"] = (r.stdout + r.stderr)[-1500:]
+    except subprocess.TimeoutExpired:
+        entry["rc"] = -1
+        entry["tail"] = f"timeout after {timeout}s"
+    entry["wall_s"] = round(time.time() - t0, 1)
+    record(state)
+    return entry["rc"] == 0
+
+
+def main() -> int:
+    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 6 * 3600
+    state = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime()),
+             "steps": []}
+    t0 = time.time()
+    while time.time() - t0 < budget:
+        probe = subprocess.run(
+            [PY, os.path.join(REPO, "scripts", "tpu_reprobe.py"),
+             "3000"], cwd=REPO)
+        if probe.returncode == 0:
+            break
+        time.sleep(30)
+    else:
+        state["result"] = "tunnel never returned within budget"
+        record(state)
+        return 1
+
+    state["tunnel_up"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    record(state)
+
+    step(state, "resident_1b",
+         [PY, "scripts/bench_resident.py", "--points", "1000000000"],
+         3600)
+    if step(state, "bench_tpu", [PY, "bench.py"], 2400):
+        try:
+            with open(os.path.join(REPO, "BENCH_DETAILS.json")) as f:
+                det = json.load(f)
+            if det.get("platform") == "tpu":
+                shutil.copy(os.path.join(REPO, "BENCH_DETAILS.json"),
+                            os.path.join(REPO, "BENCH_TPU.json"))
+                state["bench_tpu_captured"] = True
+        except Exception as e:  # pragma: no cover
+            state["bench_tpu_captured"] = f"error: {e}"
+        record(state)
+    if step(state, "tpu_tests",
+            [PY, "-m", "pytest", "tests/test_tpu_hardware.py", "-q"],
+            1800):
+        with open(os.path.join(REPO, "TPU_TESTS.json"), "w") as f:
+            json.dump({"ok": True,
+                       "tail": state["steps"][-1]["tail"][-400:],
+                       "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime())}, f,
+                      indent=2)
+    step(state, "scale_100m_tpu",
+         [PY, "scripts/bench_scale.py", "--points", "100000000",
+          "--series", "2000", "--checkpoint-every", "25000000",
+          "--workdir", "/tmp/ts_100m_tpu"],
+         3600)
+    state["result"] = "sequence complete"
+    record(state)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

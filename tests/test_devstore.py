@@ -4,6 +4,8 @@ The window must be invisible semantically: every query it serves must be
 byte-identical (grids) / float32-identical (values) to the storage scan
 path, and anything it cannot guarantee (out-of-order writes, evicted
 ranges, un-downsampled queries) must fall back rather than approximate.
+One explicit opt-in exception: Config.wire_bf16 trades value precision
+(bfloat16 on the wire) for fetch payload — tested to tolerance below.
 """
 
 import numpy as np
@@ -556,3 +558,44 @@ def test_per_metric_stuck_upload_degrades_despite_global_progress():
     finally:
         stop.set()
         gate.set()
+
+
+def test_wire_bf16_halves_payload_within_tolerance():
+    """Config.wire_bf16 casts window-query [G, B] grids to float16 on
+    device before the fetch (opt-in payload trade for the ~30 MB/s
+    tunnel): results must match the exact path to float16 tolerance
+    and identical masks/labels."""
+    t = TSDB(MemKVStore(), Config(auto_create_metrics=True,
+                                  enable_sketches=False,
+                                  wire_bf16=True),
+             start_compaction_thread=False)
+    try:
+        _load(t)
+        ex = QueryExecutor(t, backend="tpu")
+        spec = QuerySpec("m.cpu", {"host": "*"}, "p95",
+                         downsample=(600, "avg"))
+        h0 = t.devwindow.window_hits
+        got = ex.run(spec, BT, BT + 7200)
+        assert t.devwindow.window_hits > h0      # served by the window
+        dw, t.devwindow = t.devwindow, None
+        try:
+            want = ex.run(spec, BT, BT + 7200)
+        finally:
+            t.devwindow = dw
+        assert len(got) == len(want) and got
+        for a, b in zip(got, want):
+            assert a.tags == b.tags
+            np.testing.assert_array_equal(a.timestamps, b.timestamps)
+            np.testing.assert_allclose(a.values, b.values,
+                                       rtol=1e-2, atol=1e-2)
+        # Overflow regime: group sums far above float16's 65504 max
+        # must stay finite (bfloat16 keeps float32's exponent range).
+        for i in range(8):
+            ts = BT + np.arange(100, dtype=np.int64) * 60
+            t.add_batch("m.big", ts, np.full(100, 5e4), {"host": f"b{i}"})
+        big = ex.run(QuerySpec("m.big", {}, "sum",
+                               downsample=(600, "sum")), BT, BT + 7200)
+        assert np.isfinite(big[0].values).all()
+        assert big[0].values.max() > 65504 * 10
+    finally:
+        t.compactionq.shutdown()
