@@ -581,6 +581,12 @@ class TSDB:
         return ckpt() if ckpt else 0
 
     def shutdown(self) -> None:
+        # Idempotent: the CLI dispatcher sweeps any TSDB a command
+        # opened (exception/early-return safety net), which may run
+        # after the command already shut down cleanly itself.
+        if getattr(self, "_shutdown_done", False):
+            return
+        self._shutdown_done = True
         self.compactionq.shutdown()
         if self.sketches is not None and self._sketch_path():
             # Spill + snapshot in one window: the snapshot's coverage

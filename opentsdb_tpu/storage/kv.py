@@ -39,6 +39,7 @@ Design notes (TPU-first, not an HBase rebuild):
 
 from __future__ import annotations
 
+import fcntl
 import io
 import logging
 import os
@@ -316,6 +317,52 @@ class MemKVStore(KVStore):
         self.wal_swallowed_flush_errors = 0
         # Immutable middle tier while a checkpoint merge is in flight.
         self._frozen: dict[str, _Table] | None = None
+        self._lockfd: int | None = None
+        if wal_path:
+            # Create the WAL's parent directory so a fresh --wal path
+            # works without operator mkdir (same courtesy as the /q
+            # cache dir).
+            parent = os.path.dirname(os.path.abspath(wal_path))
+            os.makedirs(parent, exist_ok=True)
+            # Advisory single-writer lock, held for the store's
+            # lifetime and acquired BEFORE any recovery work touches
+            # disk: _generation_paths deletes any generation file the
+            # manifest doesn't name, so a second opener racing a
+            # writer between its generation rename and manifest write
+            # would unlink the writer's live spill. A separate .lock
+            # file (not the WAL itself) because checkpoint
+            # rotates/reopens the WAL, which would drop a lock held on
+            # its fd.
+            self._lockfd = os.open(wal_path + ".lock",
+                                   os.O_CREAT | os.O_RDWR, 0o644)
+            try:
+                fcntl.flock(self._lockfd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(self._lockfd)
+                self._lockfd = None
+                raise RuntimeError(
+                    f"WAL path {wal_path!r} is locked by another "
+                    f"MemKVStore (single-writer store; remove "
+                    f"{wal_path}.lock only if the owner is dead)")
+        try:
+            self._open_tiers(wal_path)
+        except BaseException:
+            # Recovery failed after the flock was acquired (corrupt
+            # generation file, WAL replay error): release the lock or
+            # an in-process repair-and-retry would be refused with a
+            # misleading "locked by another store" forever.
+            for sst in self._ssts:
+                sst.close()
+            self._ssts = []
+            if self._lockfd is not None:
+                os.close(self._lockfd)
+                self._lockfd = None
+            raise
+
+    def _open_tiers(self, wal_path: str | None) -> None:
+        """Load sstable generations, replay the WAL(s), open for append
+        (the recovery tail of __init__; caller owns lock-fd cleanup on
+        failure)."""
         if self._sst_path:
             for path in self._generation_paths():
                 sst = SSTable(path)
@@ -323,11 +370,6 @@ class MemKVStore(KVStore):
                 for name in sst.tables():
                     self._table(name)
         if wal_path:
-            # Create the WAL's parent directory so a fresh --wal path
-            # works without operator mkdir (same courtesy as the /q
-            # cache dir).
-            parent = os.path.dirname(os.path.abspath(wal_path))
-            os.makedirs(parent, exist_ok=True)
             # A leftover <wal>.old means a crash interrupted a checkpoint:
             # replay it first (records older than everything in the WAL).
             old_path = wal_path + ".old"
@@ -550,6 +592,38 @@ class MemKVStore(KVStore):
         if self._fsync:
             os.fsync(self._wal.fileno())
 
+    # _REC frames the payload with a u32 length, capping one record at
+    # 4 GiB. Batches whose blobs approach that are split into multiple
+    # _OP_PUT_BATCH records (replay applies them in order, so the split
+    # is invisible); the margin below the u32 limit leaves room for the
+    # length arrays + header.
+    _WAL_BATCH_LIMIT = 1 << 30
+
+    @staticmethod
+    def _batch_splits(cell_bytes: "np.ndarray") -> list[tuple[int, int]]:
+        """[(start, stop)) cell ranges whose ACTUAL blob bytes each fit
+        _WAL_BATCH_LIMIT (cumulative-sum greedy, so size-skewed batches
+        can't overflow a chunk; a lone cell above the limit still gets
+        its own record — only a single >4 GiB cell is unframeable). The
+        common case (total under the limit) returns one full range."""
+        n = len(cell_bytes)
+        limit = MemKVStore._WAL_BATCH_LIMIT
+        csum = np.cumsum(cell_bytes, dtype=np.int64)
+        if n <= 1 or csum[-1] <= limit:
+            return [(0, n)]
+        out = []
+        lo = 0
+        base = 0
+        while lo < n:
+            # Furthest stop with csum[stop-1] - base <= limit; always
+            # advance at least one cell.
+            hi = int(np.searchsorted(csum, base + limit, side="right"))
+            hi = max(hi, lo + 1)
+            out.append((lo, hi))
+            base = int(csum[hi - 1])
+            lo = hi
+        return out
+
     def _wal_append_batch(self, table: bytes, family: bytes,
                           cells: list[tuple[bytes, bytes, bytes]]) -> None:
         """One COLUMNAR WAL record for a whole put_many batch, then
@@ -569,14 +643,24 @@ class MemKVStore(KVStore):
             return
         n = len(cells)
         ks, qs, vs = zip(*cells)
-        payload = b"".join((
-            struct.pack(">IHH", n, len(table), len(family)),
-            table, family,
-            np.fromiter(map(len, ks), ">u4", n).tobytes(),
-            np.fromiter(map(len, qs), ">u4", n).tobytes(),
-            np.fromiter(map(len, vs), ">u4", n).tobytes(),
-            b"".join(ks), b"".join(qs), b"".join(vs)))
-        self._wal.write(_REC.pack(_OP_PUT_BATCH, len(payload)) + payload)
+        kl = np.fromiter(map(len, ks), ">u4", n)
+        ql = np.fromiter(map(len, qs), ">u4", n)
+        vl = np.fromiter(map(len, vs), ">u4", n)
+        blob = int(kl.sum()) + int(ql.sum()) + int(vl.sum())
+        splits = ([(0, n)] if blob <= self._WAL_BATCH_LIMIT else
+                  self._batch_splits(kl.astype(np.int64)
+                                     + ql.astype(np.int64)
+                                     + vl.astype(np.int64)))
+        for lo, hi in splits:
+            payload = b"".join((
+                struct.pack(">IHH", hi - lo, len(table), len(family)),
+                table, family,
+                kl[lo:hi].tobytes(), ql[lo:hi].tobytes(),
+                vl[lo:hi].tobytes(),
+                b"".join(ks[lo:hi]), b"".join(qs[lo:hi]),
+                b"".join(vs[lo:hi])))
+            self._wal.write(_REC.pack(_OP_PUT_BATCH, len(payload))
+                            + payload)
         self._wal_flush()
 
     def _wal_append_batch_columnar(self, table: bytes, family: bytes,
@@ -588,14 +672,22 @@ class MemKVStore(KVStore):
         contiguous buffer) — no per-key slicing or re-join."""
         if self._wal is None:
             return
-        payload = b"".join((
-            struct.pack(">IHH", n, len(table), len(family)),
-            table, family,
-            np.full(n, key_len, ">u4").tobytes(),
-            np.fromiter(map(len, quals), ">u4", n).tobytes(),
-            np.fromiter(map(len, vals), ">u4", n).tobytes(),
-            key_blob, b"".join(quals), b"".join(vals)))
-        self._wal.write(_REC.pack(_OP_PUT_BATCH, len(payload)) + payload)
+        ql = np.fromiter(map(len, quals), ">u4", n)
+        vl = np.fromiter(map(len, vals), ">u4", n)
+        blob = n * key_len + int(ql.sum()) + int(vl.sum())
+        splits = ([(0, n)] if blob <= self._WAL_BATCH_LIMIT else
+                  self._batch_splits(ql.astype(np.int64)
+                                     + vl.astype(np.int64) + key_len))
+        for lo, hi in splits:
+            payload = b"".join((
+                struct.pack(">IHH", hi - lo, len(table), len(family)),
+                table, family,
+                np.full(hi - lo, key_len, ">u4").tobytes(),
+                ql[lo:hi].tobytes(), vl[lo:hi].tobytes(),
+                key_blob[lo * key_len:hi * key_len],
+                b"".join(quals[lo:hi]), b"".join(vals[lo:hi])))
+            self._wal.write(_REC.pack(_OP_PUT_BATCH, len(payload))
+                            + payload)
         self._wal_flush()
 
     @staticmethod
@@ -696,6 +788,19 @@ class MemKVStore(KVStore):
             for sst in self._ssts:
                 sst.close()
             self._ssts = []
+            if self._lockfd is not None:
+                os.close(self._lockfd)  # releases the flock
+                self._lockfd = None
+
+    def _simulate_crash(self) -> None:
+        """TEST HOOK: release the single-writer lock WITHOUT flushing
+        or closing, the way process death does (the OS drops a dead
+        process's flock; unflushed state is simply lost). Crash-
+        recovery tests reopen the wal path after calling this."""
+        with self._lock:
+            if self._lockfd is not None:
+                os.close(self._lockfd)
+                self._lockfd = None
 
     # -- checkpoint / spill ----------------------------------------------
 
@@ -828,38 +933,42 @@ class MemKVStore(KVStore):
             # next checkpoint appends the live WAL to it, and recovery
             # replays .old + WAL, so durability is unaffected.
             with self._lock:
-                for name, ft in self._frozen.items():
-                    live = self._tables[name]
-                    for k, row in ft.rows.items():
-                        if k in live.row_tombs:
-                            continue  # deleted while merge was in flight
-                        merged = dict(row)
-                        merged.update(live.rows.get(k, {}))
-                        live.rows[k] = merged
-                    live.row_tombs |= ft.row_tombs
-                    # Tombstone cells travel back with the rows: the
-                    # counter must too, or the RETRY checkpoint would
-                    # pick the fast tombstone-free spill and feed None
-                    # values to write_sstable (and, had that written,
-                    # resurrect the masked lower-generation cells).
-                    live.tombs += ft.tombs
-                    for k in ft.rows:
-                        live.note_insert(k)
-                self._frozen = None
+                self._thaw_frozen_locked()
             raise
 
         with self._lock:
-            new_sst = SSTable(out_path)
-            if full:
-                dropped = self._ssts
-                self._ssts = [new_sst]
-            else:
-                dropped = []
-                self._ssts = self._ssts + [new_sst]
-            # Manifest BEFORE unlinking: a crash in between leaves
-            # stray files the next load deletes (they are never opened,
-            # so dropped cells cannot resurrect).
-            self._write_manifest([s.path for s in self._ssts])
+            # Phase 3 failures (sstable open, manifest tmp write right
+            # after a near-full-disk spill) get the SAME recovery as a
+            # spill failure: drop the new generation and thaw — a stuck
+            # _frozen would no-op every later checkpoint and grow the
+            # WAL without bound, with durability intact but the daemon
+            # degraded until restart.
+            new_sst = None
+            try:
+                new_sst = SSTable(out_path)
+                if full:
+                    dropped = self._ssts
+                    self._ssts = [new_sst]
+                else:
+                    dropped = []
+                    self._ssts = self._ssts + [new_sst]
+                # Manifest BEFORE unlinking: a crash in between leaves
+                # stray files the next load deletes (they are never
+                # opened, so dropped cells cannot resurrect).
+                try:
+                    self._write_manifest([s.path for s in self._ssts])
+                except Exception:
+                    self._ssts = dropped if full else self._ssts[:-1]
+                    raise
+            except Exception:
+                if new_sst is not None:
+                    new_sst.close()
+                try:
+                    os.unlink(out_path)
+                except OSError:
+                    pass
+                self._thaw_frozen_locked()
+                raise
             self._frozen = None
             for g in dropped:
                 path = g.path
@@ -871,6 +980,30 @@ class MemKVStore(KVStore):
             if os.path.exists(old_path):
                 os.unlink(old_path)
         return n
+
+    def _thaw_frozen_locked(self) -> None:
+        """Fold the frozen middle tier back under the live memtable
+        after a failed checkpoint (caller holds the lock). Live cells
+        win; row tombstones written while the merge was in flight keep
+        masking the thawed rows."""
+        for name, ft in self._frozen.items():
+            live = self._tables[name]
+            for k, row in ft.rows.items():
+                if k in live.row_tombs:
+                    continue  # deleted while merge was in flight
+                merged = dict(row)
+                merged.update(live.rows.get(k, {}))
+                live.rows[k] = merged
+            live.row_tombs |= ft.row_tombs
+            # Tombstone cells travel back with the rows: the counter
+            # must too, or the RETRY checkpoint would pick the fast
+            # tombstone-free spill and feed None values to
+            # write_sstable (and, had that written, resurrect the
+            # masked lower-generation cells).
+            live.tombs += ft.tombs
+            for k in ft.rows:
+                live.note_insert(k)
+        self._frozen = None
 
     # -- mutation ---------------------------------------------------------
 

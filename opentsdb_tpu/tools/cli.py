@@ -45,6 +45,13 @@ def common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--verbose", action="store_true")
 
 
+# TSDBs opened by the current main() invocation; the dispatcher shuts
+# down any the command left open (early return or exception), so no
+# code path can leak the WAL's single-writer flock for the rest of an
+# embedding process.
+_OPEN_TSDBS: list[TSDB] = []
+
+
 def make_tsdb(args, start_thread: bool = False) -> TSDB:
     if (getattr(args, "backend", None) == "cpu"
             or os.environ.get("JAX_PLATFORMS") == "cpu"):
@@ -81,7 +88,9 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         cfg.checkpoint_interval = getattr(args, "checkpoint_interval", 0.0)
         cfg.mesh_devices = getattr(args, "mesh_devices", 0)
     store = MemKVStore(wal_path=args.wal)
-    return TSDB(store, cfg, start_compaction_thread=start_thread)
+    tsdb = TSDB(store, cfg, start_compaction_thread=start_thread)
+    _OPEN_TSDBS.append(tsdb)
+    return tsdb
 
 
 # ---------------------------------------------------------------------------
@@ -394,8 +403,17 @@ def _fix_row(tsdb: TSDB, key: bytes, cells) -> int:
 
 def cmd_uid(args) -> int:
     """UID admin (UidManager.java): grep / assign / rename / fsck /
-    lookups."""
+    lookups. Always shuts the store down on exit — early returns that
+    skipped shutdown leaked the WAL's single-writer lock for the rest
+    of the process."""
     tsdb = make_tsdb(args)
+    try:
+        return _cmd_uid(tsdb, args)
+    finally:
+        tsdb.shutdown()
+
+
+def _cmd_uid(tsdb: TSDB, args) -> int:
     words = list(args.args)
     if not words:
         print("usage: uid [grep|assign|rename|fsck|KIND NAME|ID]",
@@ -423,12 +441,10 @@ def cmd_uid(args) -> int:
         for name in words[2:]:
             uid = uids[kind].get_or_create_id(name)
             print(f"{name}: [{', '.join(str(b) for b in uid)}]")
-        tsdb.shutdown()
         return 0
     if cmd == "rename":
         _, kind, old, new = words
         uids[kind].rename(old, new)
-        tsdb.shutdown()
         return 0
     if cmd == "fsck":
         return _uid_fsck(tsdb)
@@ -588,7 +604,18 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
     if getattr(args, "auto", False):
         args.auto_metric = True
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    finally:
+        # Commands normally shut their TSDB down themselves; this
+        # catches early returns and exceptions (shutdown is
+        # idempotent), releasing the WAL flock for embedders/tests
+        # that call main() repeatedly in one process.
+        while _OPEN_TSDBS:
+            try:
+                _OPEN_TSDBS.pop().shutdown()
+            except Exception:
+                LOG.exception("shutdown during cleanup failed")
 
 
 if __name__ == "__main__":

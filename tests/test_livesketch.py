@@ -178,6 +178,7 @@ class TestTSDBIntegration:
         before_q = t.sketches.quantile(
             list(t.sketches.series_keys()), [0.5, 0.99])
         # simulate crash: no shutdown/checkpoint, just reopen the WAL
+        t.store._simulate_crash()
         t2 = self._tsdb(wal)
         from opentsdb_tpu.query.executor import QueryExecutor
         assert QueryExecutor(t2).sketch_distinct("m.c", "host") == 8
@@ -200,6 +201,7 @@ class TestTSDBIntegration:
                         RNG.normal(20, 1, 50), {"host": f"post{h}"})
         t.store.flush()
         # crash (no shutdown); reopen
+        t.store._simulate_crash()
         t2 = self._tsdb(wal)
         from opentsdb_tpu.query.executor import QueryExecutor
         ex = QueryExecutor(t2)
@@ -256,6 +258,7 @@ class TestFlushChunking:
                         RNG.normal(5, 1, 40), {"host": f"h{h}"})
         t.checkpoint()  # spills memtable, truncates WAL
         # crash immediately (no shutdown): memtable empty on reopen
+        t.store._simulate_crash()
         t2 = TSDB(MemKVStore(wal_path=wal),
                   Config(auto_create_metrics=True),
                   start_compaction_thread=False)

@@ -216,10 +216,12 @@ class TestPutMany:
         s.put_many("t", b"f", [(b"a", b"q1", b"v1"), (b"b", b"q1", b"v2"),
                                (b"a", b"q2", b"v3")])
         s.flush()
-        s2 = MemKVStore(wal_path=wal)
         rows = lambda st: [c for r in st.scan("t", b"", b"\xff" * 8)
                            for c in r]
-        assert rows(s2) == rows(s) and len(rows(s)) == 3
+        expect = rows(s)
+        s.close()  # releases the single-writer lock before reopening
+        s2 = MemKVStore(wal_path=wal)
+        assert rows(s2) == expect and len(expect) == 3
 
 
 class TestIncrementalIndex:
@@ -360,6 +362,8 @@ def test_put_many_columnar_matches_put_many(tmp_path):
     ea = a.put_many("t", b"f", list(zip(keys, quals, vals)))
     eb = b.put_many_columnar("t", b"f", b"".join(keys), 4, quals, vals)
     assert ea == eb == [True, False, False, True]
+    a.close()
+    b.close()
     ra = MemKVStore(wal_path=walA)
     rb = MemKVStore(wal_path=walB)
     rows_a = [(k, cells) for k, cells in ra.scan_raw("t", b"", b"\xff")]

@@ -202,3 +202,23 @@ class TestBuildData:
         assert main(["version"]) == 0
         out = capsys.readouterr().out
         assert out.startswith("opentsdb_tpu ")
+
+
+class TestDispatcherCleanup:
+    def test_failed_command_releases_wal_lock(self, tmp_path, wal,
+                                              capsys):
+        """A command that dies mid-way (bad user input after the store
+        opened) must not leak the WAL's single-writer flock: the
+        dispatcher sweeps any TSDB the command left open, so the next
+        main() call in the same process can reopen the path."""
+        from opentsdb_tpu.core.errors import BadRequestError
+
+        f = write_datafile(tmp_path / "d.txt", [f"m.x {BT} 1 a=b"])
+        assert main(["import", "--wal", wal, f]) == 0
+        with pytest.raises(BadRequestError):
+            main(["query", "--wal", wal, "not-a-date", "sum", "m.x"])
+        capsys.readouterr()
+        # Lock released despite the exception: query again, clean.
+        assert main(["query", "--wal", wal, str(BT), str(BT + 10),
+                     "sum", "m.x"]) == 0
+        assert "m.x" in capsys.readouterr().out
