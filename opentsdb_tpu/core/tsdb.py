@@ -587,16 +587,23 @@ class TSDB:
         if getattr(self, "_shutdown_done", False):
             return
         self._shutdown_done = True
-        self.compactionq.shutdown()
-        if self.sketches is not None and self._sketch_path():
-            # Spill + snapshot in one window: the snapshot's coverage
-            # contract (== the sstable tier) must hold on the next boot,
-            # where the replayed memtable is re-folded on top of it.
-            self.checkpoint()
-        self.store.flush()
-        close = getattr(self.store, "close", None)
-        if close:
-            close()
+        try:
+            self.compactionq.shutdown()
+            if self.sketches is not None and self._sketch_path():
+                # Spill + snapshot in one window: the snapshot's
+                # coverage contract (== the sstable tier) must hold on
+                # the next boot, where the replayed memtable is
+                # re-folded on top of it.
+                self.checkpoint()
+            self.store.flush()
+        finally:
+            # The store MUST close even when checkpoint/flush raise
+            # (ENOSPC is a first-class path): close releases the WAL's
+            # single-writer flock, without which every later open of
+            # this path in the process is refused.
+            close = getattr(self.store, "close", None)
+            if close:
+                close()
 
     def collect_stats(self, collector) -> None:
         """Push internal counters into a StatsCollector (reference :129-175)."""

@@ -646,19 +646,29 @@ class TSDServer:
 
         cache_path = self._cache_path(query_string, q)
         if cache_path and self._cache_fresh(cache_path, q, end, now):
-            self.cache_hits += 1
             with open(cache_path, "rb") as f:
                 body = f.read()
-            ctype = ("image/png" if cache_path.endswith(".png")
-                     else "text/plain" if cache_path.endswith(".txt")
-                     else "application/json")
-            extra = {}
-            try:  # drag-zoom headers survive cache hits via a sidecar
-                with open(cache_path + ".meta") as f:
-                    extra = json.load(f)
-            except (OSError, ValueError):
-                pass
-            return 200, ctype, body, extra
+            # A PNG under 21 bytes (minimum possible PNG) cannot be
+            # valid — regenerate instead of serving garbage (reference
+            # GraphHandler.isDiskCacheHit :367-374; our tmp+rename
+            # writes make this near-impossible, but an operator
+            # touching files in the cachedir shouldn't wedge a graph).
+            # Zero-byte .txt/.json bodies are NOT rejected: an empty
+            # ascii result is the negative-cache hit — a query known
+            # to plot 0 points is re-served from disk without
+            # re-running the executor (reference :399-419).
+            if not (cache_path.endswith(".png") and len(body) < 21):
+                self.cache_hits += 1
+                ctype = ("image/png" if cache_path.endswith(".png")
+                         else "text/plain" if cache_path.endswith(".txt")
+                         else "application/json")
+                extra = {}
+                try:  # drag-zoom headers survive cache hits via a sidecar
+                    with open(cache_path + ".meta") as f:
+                        extra = json.load(f)
+                except (OSError, ValueError):
+                    pass
+                return 200, ctype, body, extra
         self.cache_misses += 1
 
         loop = asyncio.get_running_loop()

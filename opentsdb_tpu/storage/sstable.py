@@ -173,6 +173,147 @@ def write_sstable(path: str, rows: Iterable[Row]) -> int:
     return n
 
 
+def _frame_record(table_b: bytes, key: bytes,
+                  cells: dict) -> bytes:
+    """One record from a cell dict ({(fam, qual): value}, no Nones),
+    cells sorted — same wire layout as write_sstable's loop."""
+    triples = sorted((f, q, v) for (f, q), v in cells.items())
+    parts = [_U16.pack(len(table_b)), table_b, _U16.pack(len(key)), key,
+             _U32.pack(len(triples))]
+    for fam, qual, value in triples:
+        parts += [_U16.pack(len(fam)), fam, _U16.pack(len(qual)), qual,
+                  _U32.pack(len(value)), value]
+    return b"".join(parts)
+
+
+def merge_sstables(path: str, gens: "list[SSTable]",
+                   frozen: dict) -> int:
+    """Collapse sstable generations (OLDEST FIRST) + a frozen memtable
+    tier into one new sstable at ``path`` — the full-merge leg of
+    checkpoint (storage/kv.py), rebuilt as a COPY-MERGE.
+
+    ``frozen``: {table: (rows, row_tombs, has_cell_tombs)} with rows =
+    {key: {(fam, qual): value-or-None}} (None = tombstone masking a
+    lower generation) and row_tombs masking whole lower-tier rows.
+
+    Keys present in exactly one generation and untouched by the frozen
+    tier — at scale, nearly all of them (time-major ingest puts each
+    row-hour in one spill) — have their record bytes copied VERBATIM,
+    contiguous runs as single slices, so the merge runs at IO speed.
+    Only multi-source keys and frozen rows are decoded and re-framed
+    (tombstones applied). The previous streamed per-row merge paid a
+    per-key binary search per generation plus Python framing for every
+    row: 20.7 us/row, 145 s for a 7M-row merge measured at the 1B
+    400M-point mark; the copy path is two orders cheaper.
+    Returns rows written. Same tmp + fsync + atomic-rename durability
+    contract as write_sstable.
+    """
+    names = set(frozen)
+    for g in gens:
+        names.update(g.tables())
+    tmp = path + ".tmp"
+    n = 0
+    index: dict[str, tuple[list[bytes], list[int]]] = {}
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        off = len(_MAGIC)
+        for name in sorted(names):
+            rows_f, row_tombs, has_tombs = frozen.get(
+                name, ({}, set(), False))
+            tb = name.encode()
+            extents = [g.record_extents(name) for g in gens]
+            # Multi-source keys: seen in >1 generation, or overlaid by
+            # a frozen row. (Running set-union dup detection; the
+            # per-table transient is ~O(total keys).)
+            seen: set[bytes] = set()
+            dup: set[bytes] = set()
+            for keys, _, _ in extents:
+                ks = set(keys)
+                dup |= seen & ks
+                seen |= ks
+            dup.update(k for k in rows_f if k in seen)
+            pairs: list[tuple[bytes, int]] = []
+            # 1) Verbatim copy of single-source, frozen-untouched runs.
+            for (keys, starts, ends), g in zip(extents, gens):
+                mm = g._mm
+                m = len(keys)
+                i = 0
+                while i < m:
+                    k = keys[i]
+                    if k in dup or k in row_tombs:
+                        i += 1
+                        continue
+                    # Extend the run only while records stay adjacent
+                    # IN THE FILE (key order != file order in a
+                    # previously-merged generation).
+                    j = i + 1
+                    while j < m and keys[j] not in dup \
+                            and keys[j] not in row_tombs \
+                            and int(starts[j]) == int(ends[j - 1]):
+                        j += 1
+                    a, b = int(starts[i]), int(ends[j - 1])
+                    f.write(mm[a:b])
+                    delta = off - a
+                    pairs.extend(
+                        (keys[t], int(starts[t]) + delta)
+                        for t in range(i, j))
+                    off += b - a
+                    i = j
+            # 2) Multi-source keys: overlay oldest -> newest -> frozen.
+            for k in dup:
+                merged: dict = {}
+                if k not in row_tombs:
+                    for g in gens:
+                        cells = g.get(name, k)
+                        if cells:
+                            for fam, q, v in cells:
+                                merged[(fam, q)] = v
+                row = rows_f.get(k)
+                if row:
+                    for ck, v in row.items():
+                        if v is None:
+                            merged.pop(ck, None)
+                        else:
+                            merged[ck] = v
+                if not merged:
+                    continue
+                rec = _frame_record(tb, k, merged)
+                f.write(rec)
+                pairs.append((k, off))
+                off += len(rec)
+            # 3) Frozen-only rows (C-framed when tombstone-free).
+            fr_only = sorted(k for k in rows_f
+                             if k not in dup and rows_f[k])
+            if fr_only and _EXT is not None and not has_tombs:
+                recs, offs_be, _ = _EXT.frame_rows_dict(
+                    tb, fr_only, rows_f, off)
+                f.write(recs)
+                pairs.extend(zip(
+                    fr_only,
+                    np.frombuffer(offs_be, ">u8").astype(
+                        np.int64).tolist()))
+                off += len(recs)
+            else:
+                for k in fr_only:
+                    cells = {ck: v for ck, v in rows_f[k].items()
+                             if v is not None}
+                    if not cells:
+                        continue
+                    rec = _frame_record(tb, k, cells)
+                    f.write(rec)
+                    pairs.append((k, off))
+                    off += len(rec)
+            if not pairs:
+                continue
+            # Timsort exploits the concatenated sorted runs.
+            pairs.sort()
+            index[name] = ([p[0] for p in pairs], [p[1] for p in pairs])
+            n += len(pairs)
+        _finish_file(f, index, off)
+    _durable_rename(tmp, path)
+    return n
+
+
 class SSTable:
     """mmap-backed reader over one sstable generation."""
 
@@ -183,6 +324,7 @@ class SSTable:
         self._mm = mmap.mmap(self._f.fileno(), size, access=mmap.ACCESS_READ)
         # table -> (sorted keys, parallel row offsets)
         self._index: dict[str, tuple[list[bytes], list[int]]] = {}
+        self._all_starts = None  # record_extents' sorted-start cache
         head = self._mm[:len(_MAGIC)]
         if head == _MAGIC:
             self._load_footer()
@@ -195,6 +337,7 @@ class SSTable:
         mm = self._mm
         ntables, footer_start = _TRAILER.unpack_from(
             mm, len(mm) - _TRAILER.size)
+        self._data_end = footer_start
         off = footer_start
         for _ in range(ntables):
             (tlen,) = _U16.unpack_from(mm, off)
@@ -213,6 +356,7 @@ class SSTable:
             self._index[table] = (keys, offs)
 
     def _build_index_v1(self) -> None:
+        self._data_end = len(self._mm)
         mm, off, end = self._mm, len(_MAGIC_V1), len(self._mm)
         while off < end:
             start = off
@@ -314,6 +458,36 @@ class SSTable:
         lo = bisect_left(keys, start)
         hi = bisect_left(keys, stop) if stop else len(keys)
         return keys[lo:hi]
+
+    def record_extents(self, table: str) -> tuple[
+            "list[bytes]", "np.ndarray", "np.ndarray"]:
+        """(sorted keys, record starts, record ends) for one table.
+
+        Records carry no embedded offsets, so a [start, end) byte
+        slice relocates verbatim into another file — the basis of the
+        copy-merge compaction (merge_sstables), which moves unique-key
+        records at IO speed instead of decode/re-frame speed. Every
+        writer appends records back-to-back, but NOT necessarily in
+        key order (merge_sstables scatters re-framed rows after the
+        copy runs), so each record's end is the smallest record start
+        greater than its own — computed against the file's full start
+        set, with the record section's end as the sentinel.
+        """
+        idx = self._index.get(table)
+        if not idx or not idx[0]:
+            e = np.empty(0, np.int64)
+            return [], e, e
+        keys, offs = idx
+        starts = np.asarray(offs, dtype=np.int64)
+        all_starts = self._all_starts
+        if all_starts is None:
+            all_starts = np.sort(np.concatenate(
+                [np.asarray(o, dtype=np.int64)
+                 for _, o in self._index.values()]
+                + [np.asarray([self._data_end], dtype=np.int64)]))
+            self._all_starts = all_starts
+        ends = all_starts[np.searchsorted(all_starts, starts, "right")]
+        return keys, starts, ends
 
     def iter_rows(self, table: str) -> Iterator[
             tuple[bytes, list[tuple[bytes, bytes, bytes]]]]:

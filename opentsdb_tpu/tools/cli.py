@@ -21,6 +21,7 @@ import gzip
 import logging
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -48,8 +49,18 @@ def common_args(p: argparse.ArgumentParser) -> None:
 # TSDBs opened by the current main() invocation; the dispatcher shuts
 # down any the command left open (early return or exception), so no
 # code path can leak the WAL's single-writer flock for the rest of an
-# embedding process.
-_OPEN_TSDBS: list[TSDB] = []
+# embedding process. Thread-local (an embedder may run main() from
+# several threads) and swept only above the invocation's own
+# high-water mark (nested main() calls must not close their caller's
+# store).
+_OPEN_TSDBS = threading.local()
+
+
+def _open_list() -> list:
+    lst = getattr(_OPEN_TSDBS, "lst", None)
+    if lst is None:
+        lst = _OPEN_TSDBS.lst = []
+    return lst
 
 
 def make_tsdb(args, start_thread: bool = False) -> TSDB:
@@ -89,7 +100,7 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         cfg.mesh_devices = getattr(args, "mesh_devices", 0)
     store = MemKVStore(wal_path=args.wal)
     tsdb = TSDB(store, cfg, start_compaction_thread=start_thread)
-    _OPEN_TSDBS.append(tsdb)
+    _open_list().append(tsdb)
     return tsdb
 
 
@@ -604,16 +615,19 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
     if getattr(args, "auto", False):
         args.auto_metric = True
+    lst = _open_list()
+    mark = len(lst)
     try:
         return args.fn(args)
     finally:
         # Commands normally shut their TSDB down themselves; this
         # catches early returns and exceptions (shutdown is
         # idempotent), releasing the WAL flock for embedders/tests
-        # that call main() repeatedly in one process.
-        while _OPEN_TSDBS:
+        # that call main() repeatedly in one process. Only this
+        # invocation's entries (above the mark) are swept.
+        while len(lst) > mark:
             try:
-                _OPEN_TSDBS.pop().shutdown()
+                lst.pop().shutdown()
             except Exception:
                 LOG.exception("shutdown during cleanup failed")
 

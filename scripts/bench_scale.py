@@ -28,11 +28,13 @@ Run:  python scripts/bench_scale.py [--points 1000000000] [--cpu]
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import os
 import shutil
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -217,6 +219,57 @@ def main() -> int:
     synth_s = 0.0
     mid_ckpts: list[dict] = []
     next_ckpt = args.checkpoint_every or (1 << 62)
+
+    # GC pause attribution: the collector's stop-the-world time is part
+    # of the unattributed wall unless measured directly.
+    gc_acc = {"s": 0.0, "t0": 0.0}
+
+    def _gc_cb(phase, info):
+        if phase == "start":
+            gc_acc["t0"] = time.perf_counter()
+        else:
+            gc_acc["s"] += time.perf_counter() - gc_acc["t0"]
+
+    gc.callbacks.append(_gc_cb)
+
+    # Overlapped checkpoints (VERDICT r04 item 3): the 3-phase spill
+    # design only locks briefly at freeze/swap, so the phase-2 sstable
+    # write runs on this thread WHILE ingest continues — on the 1-core
+    # host the win is the hidden IO/fsync wait, and ingest only blocks
+    # when the next trigger fires before the previous spill finished
+    # (counted as checkpoint.wait).
+    ckpt = {"thread": None, "wait_s": 0.0, "spill_s": 0.0,
+            "error": None}
+
+    def _ckpt_join():
+        t = ckpt["thread"]
+        if t is not None and t.is_alive():
+            t0 = time.perf_counter()
+            t.join()
+            ckpt["wait_s"] += time.perf_counter() - t0
+        ckpt["thread"] = None
+        if ckpt["error"] is not None:
+            # A swallowed spill failure would publish an artifact whose
+            # dps/attribution silently undercount checkpoint cost.
+            raise RuntimeError("mid-run checkpoint failed") \
+                from ckpt["error"]
+
+    def _ckpt_run(at_points: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            rows = tsdb.checkpoint()
+        except BaseException as e:
+            ckpt["error"] = e
+            ckpt["spill_s"] += time.perf_counter() - t0
+            raise
+        wall = time.perf_counter() - t0
+        ckpt["spill_s"] += wall
+        mid_ckpts.append({
+            "at_points": at_points, "wall_s": round(wall, 1),
+            "rows_spilled": rows, "overlapped": True,
+            "rss_gb_after": round(rss_gb(), 1)})
+        log(f"  mid-run checkpoint @ {at_points:,}: {mid_ckpts[-1]}")
+
     t_ingest = time.perf_counter()
     last_log = t_ingest
     stop = False
@@ -241,15 +294,11 @@ def main() -> int:
             total += tsdb.add_batch("scale.metric", ts, vals,
                                     tags_by_series[si])
             if total >= next_ckpt:
-                t0 = time.perf_counter()
-                rows = tsdb.checkpoint()
-                mid_ckpts.append({
-                    "at_points": total,
-                    "wall_s": round(time.perf_counter() - t0, 1),
-                    "rows_spilled": rows,
-                    "rss_gb_after": round(rss_gb(), 1)})
-                log(f"  mid-run checkpoint @ {total:,}: "
-                    f"{mid_ckpts[-1]}")
+                _ckpt_join()  # previous spill must land first
+                t = threading.Thread(target=_ckpt_run, args=(total,),
+                                     daemon=True)
+                ckpt["thread"] = t
+                t.start()
                 next_ckpt = total + args.checkpoint_every
         now = time.perf_counter()
         r = rss_gb()
@@ -265,6 +314,8 @@ def main() -> int:
             stop = True
         if stop:
             break
+    _ckpt_join()  # an in-flight spill is part of the ingest story
+    gc.callbacks.remove(_gc_cb)
     if tsdb.devwindow is not None:
         tsdb.devwindow.flush()
     if tsdb.sketches is not None:
@@ -276,8 +327,21 @@ def main() -> int:
         "dps": round(total / ingest_s),
         "synth_s": round(synth_s, 1),
         "dps_ex_synth": round(total / max(ingest_s - synth_s, 1e-9)),
+        "dps_between_checkpoints": round(
+            total / max(ingest_s - synth_s - ckpt["wait_s"], 1e-9)),
         "peak_rss_gb": round(peak_rss, 1),
         "ceiling": ceiling or "target reached"}
+    # Checkpoint + GC lines so the attribution sums to the wall
+    # (VERDICT r04: 79 s of a 153 s wall was unattributed — mostly the
+    # synchronous checkpoints the table omitted). The overlapped spill
+    # wall is reported nested: it runs concurrently, so only the
+    # blocked join time (checkpoint.wait) is wall the ingest loop lost
+    # outright; the GIL/CPU the spill thread steals from ingest shows
+    # up inside the other lines' own timings.
+    attr.acc["checkpoint.spill"] = ckpt["spill_s"]
+    attr.nested.add("checkpoint.spill")
+    attr.acc["checkpoint.wait"] = ckpt["wait_s"]
+    attr.acc["gc"] = gc_acc["s"]
     out["ingest"]["attribution"] = attr.table(ingest_s - synth_s)
     out["wal_bytes"] = os.path.getsize(wal) if os.path.exists(wal) else 0
     if mid_ckpts:

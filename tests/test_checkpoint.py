@@ -280,13 +280,10 @@ class TestCheckpoint:
 
         import opentsdb_tpu.storage.kv as kv_mod
 
-        def boom(path, rows):
-            # Consume like the real writers would (rows may be a
-            # generator or the bulk path's materialized dict).
-            list(rows)
+        def boom(path, *a):
             raise OSError("disk full")
 
-        monkeypatch.setattr(kv_mod, "write_sstable", boom)
+        monkeypatch.setattr(kv_mod, "merge_sstables", boom)
         monkeypatch.setattr(kv_mod, "write_sstable_bulk", boom)
         with pytest.raises(OSError):
             store.checkpoint()
@@ -461,11 +458,10 @@ class TestTieredGenerations:
         store.checkpoint()
         store.delete(T, b"k", F, [b"q"])       # tombstone over gen1
 
-        def boom(path, rows):
-            list(rows)
+        def boom(path, gens, frozen):
             raise OSError("disk full")
 
-        monkeypatch.setattr(kv_mod, "write_sstable", boom)
+        monkeypatch.setattr(kv_mod, "merge_sstables", boom)
         with pytest.raises(OSError):
             store.checkpoint()
         monkeypatch.undo()
@@ -544,6 +540,59 @@ class TestTieredGenerations:
         # After close the path is reusable.
         again = MemKVStore(wal_path=wal(tmp_path))
         assert again.get(T, b"k") == [Cell(b"k", F, b"q", b"v")]
+        again.close()
+
+    def test_copy_merge_differential(self, tmp_path, monkeypatch):
+        """The copy-merge full collapse (sstable.merge_sstables) must
+        be bit-equivalent in CONTENT to the naive per-row merge, under
+        a workload that exercises every leg: keys unique to one
+        generation (verbatim copy runs), keys overwritten across
+        generations (overlay), frozen-tier overwrites, cell tombstones
+        masking spilled cells, row tombstones, a second table, and
+        empty-after-masking rows. Oracle: a plain dict fed the same
+        operations; checked via scan + reopen."""
+        import random
+
+        monkeypatch.setattr(MemKVStore, "_MAX_GENERATIONS", 4)
+        rng = random.Random(11)
+        store = MemKVStore(wal_path=wal(tmp_path))
+        oracle: dict[tuple[str, bytes, bytes], bytes] = {}
+        tables = [T, "tsdb-uid"]
+        for round_i in range(6):
+            for _ in range(120):
+                tb = tables[rng.random() < 0.2]
+                k = b"k%03d" % rng.randrange(80)
+                q = b"q%d" % rng.randrange(4)
+                op = rng.random()
+                if op < 0.70:
+                    v = b"v%d.%d" % (round_i, rng.randrange(1000))
+                    store.put(tb, k, F, q, v)
+                    oracle[(tb, k, q)] = v
+                elif op < 0.85:
+                    store.delete(tb, k, F, [q])
+                    oracle.pop((tb, k, q), None)
+                else:
+                    store.delete_row(tb, k)
+                    for kk in [kk for kk in oracle
+                               if kk[0] == tb and kk[1] == k]:
+                        del oracle[kk]
+            store.checkpoint()
+
+        def dump(s):
+            out = {}
+            for tb in tables:
+                for cells in s.scan(tb, b"", b""):
+                    for c in cells:
+                        out[(tb, c.key, c.qualifier)] = c.value
+            return out
+
+        assert dump(store) == oracle
+        # The collapse left at most _MAX_GENERATIONS files and reopen
+        # agrees (the merged sstable is what recovery loads).
+        assert len(store._ssts) <= 4
+        store.close()
+        again = MemKVStore(wal_path=wal(tmp_path))
+        assert dump(again) == oracle
         again.close()
 
     def test_churn_to_empty_memtable_still_truncates_wal(self, tmp_path):

@@ -1,0 +1,29 @@
+"""Two-OS-process end-to-end slice: a separate ingestor process writes
+over a real TCP socket into a spawned tsd daemon (virtual 8-device
+mesh), and /q answers exactly those points — the reference's
+collectors-write-to-TSDs deployment shape (reference README:8-17),
+scaled down for CI. The full-size run is scripts/two_process_e2e.py
+(TWO_PROC_E2E.json).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_ingest_and_query():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "two_process_e2e.py"),
+         "--points", "50000", "--series", "20",
+         "--workdir", "/tmp/two_proc_test"],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["points"] == 50000
+    assert out["sum_check"] == "exact"
+    assert out["query_points_returned"] == 2500
+    assert out["ingest_over_wire"]["sent"] == 50000
