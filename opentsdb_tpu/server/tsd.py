@@ -649,15 +649,20 @@ class TSDServer:
             with open(cache_path, "rb") as f:
                 body = f.read()
             # A PNG under 21 bytes (minimum possible PNG) cannot be
-            # valid — regenerate instead of serving garbage (reference
-            # GraphHandler.isDiskCacheHit :367-374; our tmp+rename
-            # writes make this near-impossible, but an operator
-            # touching files in the cachedir shouldn't wedge a graph).
-            # Zero-byte .txt/.json bodies are NOT rejected: an empty
-            # ascii result is the negative-cache hit — a query known
-            # to plot 0 points is re-served from disk without
-            # re-running the executor (reference :399-419).
-            if not (cache_path.endswith(".png") and len(body) < 21):
+            # valid, and a 0-byte .json cannot either (an empty JSON
+            # result serializes as b"[]") — regenerate instead of
+            # serving garbage (reference GraphHandler.isDiskCacheHit
+            # :367-374; our tmp+rename writes make this
+            # near-impossible, but an operator touching files in the
+            # cachedir shouldn't wedge a graph). Zero-byte .txt bodies
+            # are NOT rejected: an empty ascii result is the
+            # negative-cache hit — a query known to plot 0 points is
+            # re-served from disk without re-running the executor
+            # (reference :399-419).
+            corrupt = ((cache_path.endswith(".png") and len(body) < 21)
+                       or (cache_path.endswith(".json")
+                           and len(body) == 0))
+            if not corrupt:
                 self.cache_hits += 1
                 ctype = ("image/png" if cache_path.endswith(".png")
                          else "text/plain" if cache_path.endswith(".txt")

@@ -234,31 +234,42 @@ def merge_sstables(path: str, gens: "list[SSTable]",
             dup.update(k for k in rows_f if k in seen)
             pairs: list[tuple[bytes, int]] = []
             # 1) Verbatim copy of single-source, frozen-untouched runs.
+            # Vectorized segmentation: a per-key Python loop (set
+            # probes + numpy scalar int conversions + a tuple genexpr)
+            # cost ~2.2 us/key — 39 s of a 127 s profile at 17.5M rows.
+            # Here the skipped keys (dup/row-tomb, both small sets) are
+            # located by bisect, file-contiguity breaks (key order !=
+            # file order in a previously-merged generation) come from
+            # one numpy compare, and each surviving segment costs one
+            # slice write + one vector add, with C-speed zip for the
+            # footer pairs.
+            skip = dup | row_tombs
             for (keys, starts, ends), g in zip(extents, gens):
                 mm = g._mm
                 m = len(keys)
-                i = 0
-                while i < m:
-                    k = keys[i]
-                    if k in dup or k in row_tombs:
-                        i += 1
+                if m == 0:
+                    continue
+                excl = set()
+                if skip:
+                    for k in skip:
+                        p = bisect_left(keys, k)
+                        if p < m and keys[p] == k:
+                            excl.add(p)
+                breaks = np.nonzero(starts[1:] != ends[:-1])[0] + 1
+                cuts = np.unique(np.concatenate([
+                    np.array([0, m], np.int64), breaks,
+                    np.fromiter(excl, np.int64, len(excl)),
+                    np.fromiter((p + 1 for p in excl), np.int64,
+                                len(excl))]))
+                for a, b in zip(cuts[:-1].tolist(), cuts[1:].tolist()):
+                    if a in excl:
                         continue
-                    # Extend the run only while records stay adjacent
-                    # IN THE FILE (key order != file order in a
-                    # previously-merged generation).
-                    j = i + 1
-                    while j < m and keys[j] not in dup \
-                            and keys[j] not in row_tombs \
-                            and int(starts[j]) == int(ends[j - 1]):
-                        j += 1
-                    a, b = int(starts[i]), int(ends[j - 1])
-                    f.write(mm[a:b])
-                    delta = off - a
-                    pairs.extend(
-                        (keys[t], int(starts[t]) + delta)
-                        for t in range(i, j))
-                    off += b - a
-                    i = j
+                    lo, hi = int(starts[a]), int(ends[b - 1])
+                    f.write(mm[lo:hi])
+                    pairs.extend(zip(
+                        keys[a:b],
+                        (starts[a:b] + (off - lo)).tolist()))
+                    off += hi - lo
             # 2) Multi-source keys: overlay oldest -> newest -> frozen.
             for k in dup:
                 merged: dict = {}

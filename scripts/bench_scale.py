@@ -274,48 +274,57 @@ def main() -> int:
     last_log = t_ingest
     stop = False
     done_pps = 0          # per-series points actually ingested
-    for boff in range(0, pps, block):
-        bn = min(block, pps - boff)
-        # --- synthesis (excluded from attribution, counted in wall +
-        # reported separately) ---
-        t0 = time.perf_counter()
-        rel = (boff + np.arange(bn, dtype=np.int64)) * step
-        template = (np.cumsum(rng.normal(0, 1, bn).astype(np.float32))
-                    + 100.0)
-        blocks = []
-        for si in range(args.series):
-            blocks.append((base + rel + phase[si],
-                           template + np.float32(si)))
-        synth_s += time.perf_counter() - t0
-        # --- timed time-major ingest: every series advances through
-        # this block before any series sees the next one ---
-        for si in range(args.series):
-            ts, vals = blocks[si]
-            total += tsdb.add_batch("scale.metric", ts, vals,
-                                    tags_by_series[si])
-            if total >= next_ckpt:
-                _ckpt_join()  # previous spill must land first
-                t = threading.Thread(target=_ckpt_run, args=(total,),
-                                     daemon=True)
-                ckpt["thread"] = t
-                t.start()
-                next_ckpt = total + args.checkpoint_every
-        now = time.perf_counter()
-        r = rss_gb()
-        peak_rss = max(peak_rss, r)
-        if now - last_log > 30 or boff + bn >= pps:
-            log(f"  t+{boff + bn}/{pps} per series: {total:,} pts, "
-                f"{total / (now - t_ingest):,.0f} dps, rss {r:.1f} GB")
-            last_log = now
-        done_pps = boff + bn
-        if r > args.rss_cap_gb:
-            ceiling = f"RSS {r:.1f} GB > cap {args.rss_cap_gb} GB"
-            log(f"  stopping early: {ceiling}")
-            stop = True
-        if stop:
-            break
-    _ckpt_join()  # an in-flight spill is part of the ingest story
-    gc.callbacks.remove(_gc_cb)
+    # An ingest failure (or a failed overlapped spill surfacing at the
+    # next trigger) must still join the spill thread — never abandon it
+    # mid-write — and uninstall the process-global GC callback (a leak
+    # for any embedder retrying after the exception).
+    try:
+        for boff in range(0, pps, block):
+            bn = min(block, pps - boff)
+            # --- synthesis (excluded from attribution, counted in wall +
+            # reported separately) ---
+            t0 = time.perf_counter()
+            rel = (boff + np.arange(bn, dtype=np.int64)) * step
+            template = (np.cumsum(rng.normal(0, 1, bn).astype(np.float32))
+                        + 100.0)
+            blocks = []
+            for si in range(args.series):
+                blocks.append((base + rel + phase[si],
+                               template + np.float32(si)))
+            synth_s += time.perf_counter() - t0
+            # --- timed time-major ingest: every series advances through
+            # this block before any series sees the next one ---
+            for si in range(args.series):
+                ts, vals = blocks[si]
+                total += tsdb.add_batch("scale.metric", ts, vals,
+                                        tags_by_series[si])
+                if total >= next_ckpt:
+                    _ckpt_join()  # previous spill must land first
+                    t = threading.Thread(target=_ckpt_run, args=(total,),
+                                         daemon=True)
+                    ckpt["thread"] = t
+                    t.start()
+                    next_ckpt = total + args.checkpoint_every
+            now = time.perf_counter()
+            r = rss_gb()
+            peak_rss = max(peak_rss, r)
+            if now - last_log > 30 or boff + bn >= pps:
+                log(f"  t+{boff + bn}/{pps} per series: {total:,} pts, "
+                    f"{total / (now - t_ingest):,.0f} dps, rss {r:.1f} GB")
+                last_log = now
+            done_pps = boff + bn
+            if r > args.rss_cap_gb:
+                ceiling = f"RSS {r:.1f} GB > cap {args.rss_cap_gb} GB"
+                log(f"  stopping early: {ceiling}")
+                stop = True
+            if stop:
+                break
+        _ckpt_join()  # an in-flight spill is part of the ingest story
+    finally:
+        t = ckpt["thread"]
+        if t is not None and t.is_alive():
+            t.join()
+        gc.callbacks.remove(_gc_cb)
     if tsdb.devwindow is not None:
         tsdb.devwindow.flush()
     if tsdb.sketches is not None:

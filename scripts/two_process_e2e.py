@@ -23,7 +23,6 @@ import argparse
 import json
 import os
 import shutil
-import socket
 import subprocess
 import sys
 import time
@@ -33,7 +32,6 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 BT = 1356998400
-PORT = 14299
 
 
 def log(msg: str) -> None:
@@ -89,24 +87,37 @@ def main() -> int:
                           ).strip(),
                PYTHONPATH=REPO + ":" + os.environ.get("PYTHONPATH", ""))
 
+    # Ephemeral port (--port 0): a hardcoded one would let a second
+    # invocation silently ingest into an unrelated live daemon. The
+    # daemon prints the bound port in its ready line.
+    logpath = os.path.join(args.workdir, "tsd.log")
     daemon = subprocess.Popen(
         [sys.executable, "-m", "opentsdb_tpu.tools.cli", "tsd",
-         "--port", str(PORT), "--bind", "127.0.0.1", "--backend", "cpu",
+         "--port", "0", "--bind", "127.0.0.1", "--backend", "cpu",
          "--wal", os.path.join(args.workdir, "wal"),
          "--cachedir", os.path.join(args.workdir, "cache"),
          "--mesh-devices", "8", "--auto-metric"],
-        env=env, stdout=open(os.path.join(args.workdir, "tsd.log"), "w"),
-        stderr=subprocess.STDOUT)
+        env=env, stdout=open(logpath, "w"), stderr=subprocess.STDOUT)
     try:
-        for _ in range(120):
+        port = None
+        for _ in range(240):
             try:
-                with socket.create_connection(("127.0.0.1", PORT), 1):
-                    break
+                with open(logpath) as f:
+                    for ln in f:
+                        if ln.startswith("Ready to serve on "):
+                            port = int(ln.rsplit(":", 1)[1])
+                            break
             except OSError:
-                time.sleep(0.5)
+                pass
+            if port is not None:
+                break
+            if daemon.poll() is not None:
+                raise RuntimeError("daemon died during startup")
+            time.sleep(0.5)
         else:
             raise RuntimeError("daemon never came up")
-        log("daemon up; starting ingestor process")
+        PORT = port
+        log(f"daemon up on :{PORT}; starting ingestor process")
 
         t0 = time.time()
         ing = subprocess.run(
