@@ -109,7 +109,13 @@ class CompactionQueue:
                 if (self.checkpoint_interval
                         and now - self._last_checkpoint
                         >= self.checkpoint_interval):
-                    self._tsdb.checkpoint()
+                    store = self._tsdb.store
+                    if getattr(store, "read_only", False):
+                        # Replica daemon: the timer polls the writer's
+                        # durable state instead of spilling.
+                        store.refresh()
+                    else:
+                        self._tsdb.checkpoint()
                     self._last_checkpoint = now
                     self.checkpoints += 1
                 size = len(self._queue)

@@ -27,6 +27,15 @@ class PleaseThrottleError(Exception):
     """
 
 
+class ReadOnlyStoreError(OSError):
+    """A mutation was attempted on a read-only store replica.
+
+    Read-only stores open another daemon's WAL/sstable state without
+    the single-writer lock (the N-TSDs-over-one-store deployment
+    shape, reference README:8-17); every write path refuses with this.
+    """
+
+
 class NoSuchUniqueName(Exception):
     """Name -> UID lookup failed (reference src/uid/NoSuchUniqueName.java)."""
 

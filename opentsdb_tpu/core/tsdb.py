@@ -574,6 +574,10 @@ class TSDB:
         still-replayable memtable, and recovery's re-fold double-counts
         it: exact for HLLs (register max is idempotent), within sketch
         tolerance for digests (the tradeoff the module doc accepts)."""
+        if getattr(self.store, "read_only", False):
+            # A replica owns neither the sketch snapshot nor the spill
+            # tier; writing either would race the writer daemon.
+            return 0
         path = self._sketch_path()
         if self.sketches is not None and path:
             self.sketches.save(path)

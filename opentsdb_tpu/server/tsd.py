@@ -32,6 +32,7 @@ from opentsdb_tpu.core.errors import (
     BadRequestError,
     NoSuchUniqueName,
     PleaseThrottleError,
+    ReadOnlyStoreError,
 )
 from opentsdb_tpu.graph.plot import Plot
 from opentsdb_tpu.query.aggregators import Aggregators
@@ -311,6 +312,10 @@ class TSDServer:
                 self.hbase_errors_put += 1
                 writer.write(
                     f"put: Please throttle writes: {err}\n".encode())
+            elif "read-only" in err:
+                self.hbase_errors_put += 1
+                writer.write(
+                    f"put: read-only replica: {err}\n".encode())
             else:
                 self.illegal_arguments_put += 1
                 writer.write(f"put: illegal argument: {err}\n".encode())
@@ -422,6 +427,11 @@ class TSDServer:
         except PleaseThrottleError as e:
             self.hbase_errors_put += 1
             writer.write(f"put: Please throttle writes: {e}\n".encode())
+        except ReadOnlyStoreError as e:
+            # A replica daemon (--read-only) serves reads only; tell
+            # the collector to write to the writer frontend instead.
+            self.hbase_errors_put += 1
+            writer.write(f"put: read-only replica: {e}\n".encode())
 
     # ------------------------------------------------------------------
     # HTTP protocol

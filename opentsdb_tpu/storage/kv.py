@@ -51,7 +51,8 @@ from typing import Iterator, NamedTuple
 
 import numpy as np
 
-from opentsdb_tpu.core.errors import PleaseThrottleError
+from opentsdb_tpu.core.errors import (PleaseThrottleError,
+                                       ReadOnlyStoreError)
 from opentsdb_tpu.storage.sstable import (SSTable, merge_sstables,
                                           write_sstable_bulk)
 from opentsdb_tpu.utils.nativeext import ext as _EXT
@@ -291,12 +292,29 @@ class MemKVStore(KVStore):
 
     def __init__(self, wal_path: str | None = None,
                  throttle_rows: int | None = None,
-                 fsync: bool = False) -> None:
+                 fsync: bool = False, read_only: bool = False) -> None:
+        """``read_only=True`` opens another daemon's store WITHOUT the
+        single-writer lock: a replica that serves reads over the same
+        WAL + sstable generations while the writer keeps ingesting —
+        the reference's N-TSDs-over-one-shared-store deployment shape
+        (reference README:8-17). Replicas never truncate torn WAL
+        tails (the writer may be mid-append), never delete
+        manifest-stray generation files, and refuse every mutation
+        with ReadOnlyStoreError; ``refresh()`` catches the replica up
+        to the writer's latest durable state."""
         self._tables: dict[str, _Table] = {}
         self._lock = threading.RLock()
         self.throttle_rows = throttle_rows
         self._fsync = fsync
         self._wal_path = wal_path
+        self.read_only = read_only
+        # Replica replay position: {"wal": (inode, replayed bytes),
+        # "old": (inode, size) | None} — refresh() replays just the
+        # WAL suffix when the writer has only appended, and rebuilds
+        # only when the WAL rotated, the manifest changed, or the
+        # <wal>.old file appeared/changed (NOT on every poll while a
+        # writer's long merge keeps .old on disk).
+        self._ro_state: dict | None = None
         self._wal: io.BufferedWriter | None = None
         # Spill tier: a LIST of sstable generations, OLDEST FIRST. A
         # checkpoint normally spills just the frozen memtable as a new
@@ -318,7 +336,7 @@ class MemKVStore(KVStore):
         # Immutable middle tier while a checkpoint merge is in flight.
         self._frozen: dict[str, _Table] | None = None
         self._lockfd: int | None = None
-        if wal_path:
+        if wal_path and not read_only:
             # Create the WAL's parent directory so a fresh --wal path
             # works without operator mkdir (same courtesy as the /q
             # cache dir).
@@ -375,23 +393,106 @@ class MemKVStore(KVStore):
             old_path = wal_path + ".old"
             if os.path.exists(old_path):
                 old_valid = self._replay(old_path)
-                if old_valid < os.path.getsize(old_path):
+                if old_valid < os.path.getsize(old_path) \
+                        and not self.read_only:
                     # Torn tail: truncate, or a later checkpoint would
                     # append live records after the garbage where replay
-                    # can never reach them.
+                    # can never reach them. (A replica never truncates:
+                    # the "torn" tail may be the writer mid-append.)
                     with open(old_path, "r+b") as f:
                         f.truncate(old_valid)
             valid_bytes = 0
+            ino = -1
             if os.path.exists(wal_path):
+                ino = os.stat(wal_path).st_ino
                 valid_bytes = self._replay(wal_path)
-                if valid_bytes < os.path.getsize(wal_path):
+                if valid_bytes < os.path.getsize(wal_path) \
+                        and not self.read_only:
                     # Torn record at the tail (crash mid-write): truncate it
                     # away so appends continue from the last valid boundary —
                     # otherwise the next replay would stop at the garbage and
                     # silently drop everything written after it.
                     with open(wal_path, "r+b") as f:
                         f.truncate(valid_bytes)
-            self._wal = open(wal_path, "ab")
+            if self.read_only:
+                self._ro_state = {"wal": (ino, valid_bytes),
+                                  "old": self._stat_old()}
+            else:
+                self._wal = open(wal_path, "ab")
+
+    def _stat_old(self) -> "tuple[int, int] | None":
+        try:
+            st = os.stat(self._wal_path + ".old")
+            return (st.st_ino, st.st_size)
+        except OSError:
+            return None
+
+    def refresh(self) -> bool:
+        """Catch a read-only replica up to the writer's current durable
+        state. Returns True when anything changed.
+
+        When the WAL is the same file and has only grown, just the
+        suffix replays (cheap steady-state poll). A rotated WAL or a
+        changed manifest (the writer checkpointed) triggers a full
+        rebuild — which is exactly crash recovery, so it is correct in
+        ANY in-flight writer state: mid-checkpoint the replica sees the
+        old manifest + <wal>.old + fresh WAL, and replaying .old then
+        the WAL over the manifest generations reproduces the data."""
+        if not self.read_only:
+            raise ValueError("refresh() is for read-only stores")
+        if not self._wal_path:
+            return False
+        with self._lock:
+            man_now = self._generation_paths()
+            if [s.path for s in self._ssts] != man_now:
+                self._rebuild_locked()
+                return True
+            state = self._ro_state or {"wal": (-1, 0), "old": None}
+            if self._stat_old() != state["old"]:
+                # <wal>.old appeared/changed: a writer checkpoint is in
+                # flight (or a new crash remnant) — its records precede
+                # the current WAL, so a rebuild is the only correct
+                # catch-up. Recording its (inode, size) means a LONG
+                # merge (minutes at 1B scale) costs one rebuild, not
+                # one per poll.
+                self._rebuild_locked()
+                return True
+            try:
+                f = open(self._wal_path, "rb")
+            except OSError:
+                return False
+            with f:
+                # fstat on the OPEN fd: a writer rotation between a
+                # path-stat and the open would otherwise let the
+                # replay seek to the old file's offset inside the NEW
+                # file and misparse garbage as records (the WAL frame
+                # has no checksum).
+                st = os.fstat(f.fileno())
+                ino, off = state["wal"]
+                if st.st_ino != ino or st.st_size < off:
+                    self._rebuild_locked()
+                    return True
+                if st.st_size == off:
+                    return False
+                valid = self._replay_file(f, start=off)
+            self._ro_state = {"wal": (ino, valid),
+                              "old": state["old"]}
+            return valid > off
+
+    def _rebuild_locked(self) -> None:
+        """Full replica reload: fresh tables, current generations,
+        .old + WAL replay (the crash-recovery path, minus truncation).
+        Caller holds the lock. Open sstable handles for dropped
+        generations close afterwards — Linux keeps unlinked files
+        readable until the fd closes, so readers racing a writer's
+        full merge never see missing data."""
+        old_ssts = self._ssts
+        self._tables = {}
+        self._ssts = []
+        self._ro_state = None
+        self._open_tiers(self._wal_path)
+        for sst in old_ssts:
+            sst.close()
 
     _MAX_GENERATIONS = 8
 
@@ -411,16 +512,20 @@ class MemKVStore(KVStore):
         with open(man) as f:
             names = _json.load(f)
         live = [os.path.join(d, fn) for fn in names]
-        liveset = set(names)
-        base = os.path.basename(self._sst_path)
-        for fn in os.listdir(d):
-            if (fn == base or fn.startswith(base + ".g")) \
-                    and fn not in liveset and not fn.endswith(".tmp") \
-                    and not fn.endswith(".manifest"):
-                try:
-                    os.unlink(os.path.join(d, fn))
-                except OSError:
-                    pass
+        if not self.read_only:
+            # Replicas must never delete: a "stray" may be the live
+            # writer's generation mid-rename.
+            liveset = set(names)
+            base = os.path.basename(self._sst_path)
+            for fn in os.listdir(d):
+                if (fn == base or fn.startswith(base + ".g")) \
+                        and fn not in liveset \
+                        and not fn.endswith(".tmp") \
+                        and not fn.endswith(".manifest"):
+                    try:
+                        os.unlink(os.path.join(d, fn))
+                    except OSError:
+                        pass
         return [p for p in live if os.path.exists(p)]
 
     def _write_manifest(self, paths: list[str]) -> None:
@@ -467,6 +572,11 @@ class MemKVStore(KVStore):
     def ensure_table(self, table: str) -> None:
         with self._lock:
             self._table(table)
+
+    def _check_writable(self) -> None:
+        if self.read_only:
+            raise ReadOnlyStoreError(
+                f"store on {self._wal_path!r} is a read-only replica")
 
     def memtable_keys(self, table: str) -> list[bytes]:
         """Row keys in the live memtable only (excludes spilled tiers).
@@ -701,75 +811,84 @@ class MemKVStore(KVStore):
             off += n
         return parts
 
-    def _replay(self, path: str) -> int:
-        """Apply every complete WAL record; returns the valid byte count."""
-        valid = 0
+    def _replay(self, path: str, start: int = 0) -> int:
+        """Apply every complete WAL record from byte ``start``; returns
+        the valid byte count (absolute, including ``start``)."""
         with open(path, "rb") as f:
-            while True:
-                hdr = f.read(_REC.size)
-                if len(hdr) < _REC.size:
-                    break  # truncated tail: stop at last complete record
-                op, plen = _REC.unpack(hdr)
-                payload = f.read(plen)
-                if len(payload) < plen:
-                    break
-                valid += _REC.size + plen
-                if op == _OP_PUT_BATCH:
-                    n, tl, fl = struct.unpack_from(">IHH", payload, 0)
-                    off = 8
-                    table = payload[off:off + tl].decode()
-                    off += tl
-                    fam = payload[off:off + fl]
-                    off += fl
-                    lo = off            # the three u32 length arrays
-                    kl = np.frombuffer(payload, ">u4", n, off)
-                    ql = np.frombuffer(payload, ">u4", n, off + 4 * n)
-                    vl = np.frombuffer(payload, ">u4", n, off + 8 * n)
-                    off += 12 * n
-                    # Blob starts: keys, then quals, then values.
-                    ko, qo = off, off + int(kl.sum())
-                    vo = qo + int(ql.sum())
-                    if _EXT is not None:
-                        # Bulk replay: slice the three blobs in C and
-                        # upsert the whole record in one pass. Exactly
-                        # _apply_put per cell (set the cell, create the
-                        # row + pending entry when absent — no tier
-                        # probes, no throttle on replay), so the result
-                        # is identical to the loop below; recovery of a
-                        # 10M-point WAL drops from ~10 s to ~2 s.
-                        mv = memoryview(payload)
-                        keys = _EXT.slice_varlen(mv[ko:qo],
-                                                 mv[lo:lo + 4 * n])
-                        quals = _EXT.slice_varlen(
-                            mv[qo:vo], mv[lo + 4 * n:lo + 8 * n])
-                        vals = _EXT.slice_varlen(
-                            mv[vo:vo + int(vl.sum())],
-                            mv[lo + 8 * n:lo + 12 * n])
-                        t = self._table(table)
-                        _EXT.upsert_cells(t.rows, keys, fam, quals,
-                                          vals, t.pending)
-                        continue
-                    apply_put = self._apply_put
-                    for lk, lq, lv in zip(kl.tolist(), ql.tolist(),
-                                          vl.tolist()):
-                        apply_put(table, payload[ko:ko + lk], fam,
-                                  payload[qo:qo + lq],
-                                  payload[vo:vo + lv])
-                        ko += lk
-                        qo += lq
-                        vo += lv
+            return self._replay_file(f, start)
+
+    def _replay_file(self, f, start: int = 0) -> int:
+        """_replay over an already-open file (refresh() verifies the
+        fd's inode before seeking — reopening by path would race a
+        writer's WAL rotation)."""
+        valid = start
+        if start:
+            f.seek(start)
+        while True:
+            hdr = f.read(_REC.size)
+            if len(hdr) < _REC.size:
+                break  # truncated tail: stop at last complete record
+            op, plen = _REC.unpack(hdr)
+            payload = f.read(plen)
+            if len(payload) < plen:
+                break
+            valid += _REC.size + plen
+            if op == _OP_PUT_BATCH:
+                n, tl, fl = struct.unpack_from(">IHH", payload, 0)
+                off = 8
+                table = payload[off:off + tl].decode()
+                off += tl
+                fam = payload[off:off + fl]
+                off += fl
+                lo = off            # the three u32 length arrays
+                kl = np.frombuffer(payload, ">u4", n, off)
+                ql = np.frombuffer(payload, ">u4", n, off + 4 * n)
+                vl = np.frombuffer(payload, ">u4", n, off + 8 * n)
+                off += 12 * n
+                # Blob starts: keys, then quals, then values.
+                ko, qo = off, off + int(kl.sum())
+                vo = qo + int(ql.sum())
+                if _EXT is not None:
+                    # Bulk replay: slice the three blobs in C and
+                    # upsert the whole record in one pass. Exactly
+                    # _apply_put per cell (set the cell, create the
+                    # row + pending entry when absent — no tier
+                    # probes, no throttle on replay), so the result
+                    # is identical to the loop below; recovery of a
+                    # 10M-point WAL drops from ~10 s to ~2 s.
+                    mv = memoryview(payload)
+                    keys = _EXT.slice_varlen(mv[ko:qo],
+                                             mv[lo:lo + 4 * n])
+                    quals = _EXT.slice_varlen(
+                        mv[qo:vo], mv[lo + 4 * n:lo + 8 * n])
+                    vals = _EXT.slice_varlen(
+                        mv[vo:vo + int(vl.sum())],
+                        mv[lo + 8 * n:lo + 12 * n])
+                    t = self._table(table)
+                    _EXT.upsert_cells(t.rows, keys, fam, quals,
+                                      vals, t.pending)
                     continue
-                parts = self._split_payload(payload)
-                table = parts[0].decode()
-                if op == _OP_PUT:
-                    _, key, fam, qual, value = parts
-                    self._apply_put(table, key, fam, qual, value)
-                elif op == _OP_DELETE:
-                    _, key, fam, *quals = parts
-                    self._apply_delete(table, key, fam, quals)
-                elif op == _OP_DELETE_ROW:
-                    _, key = parts
-                    self._apply_delete_row(table, key)
+                apply_put = self._apply_put
+                for lk, lq, lv in zip(kl.tolist(), ql.tolist(),
+                                      vl.tolist()):
+                    apply_put(table, payload[ko:ko + lk], fam,
+                              payload[qo:qo + lq],
+                              payload[vo:vo + lv])
+                    ko += lk
+                    qo += lq
+                    vo += lv
+                continue
+            parts = self._split_payload(payload)
+            table = parts[0].decode()
+            if op == _OP_PUT:
+                _, key, fam, qual, value = parts
+                self._apply_put(table, key, fam, qual, value)
+            elif op == _OP_DELETE:
+                _, key, fam, *quals = parts
+                self._apply_delete(table, key, fam, quals)
+            elif op == _OP_DELETE_ROW:
+                _, key = parts
+                self._apply_delete_row(table, key)
         return valid
 
     def flush(self) -> None:
@@ -842,7 +961,7 @@ class MemKVStore(KVStore):
         phase 3); recovery replays <wal>.old then the WAL, which is
         idempotent over any manifest state.
         """
-        if self._sst_path is None:
+        if self._sst_path is None or self.read_only:
             return 0
         old_path = self._wal_path + ".old"
         with self._lock:
@@ -1060,6 +1179,7 @@ class MemKVStore(KVStore):
 
     def put(self, table: str, key: bytes, family: bytes, qualifier: bytes,
             value: bytes, durable: bool = True) -> None:
+        self._check_writable()
         with self._lock:
             self._check_throttle(table, key)
             if durable:
@@ -1076,6 +1196,7 @@ class MemKVStore(KVStore):
         Semantics identical to a put() loop (WAL order, throttle check
         per new row, partial application if throttled mid-batch).
         """
+        self._check_writable()
         existed: list[bool] = []
         if not cells:
             return existed
@@ -1273,6 +1394,7 @@ class MemKVStore(KVStore):
         flows straight through to the WAL record. Shares the bulk fast
         path with put_many; anything irregular zips the triples and
         delegates to put_many (identical semantics)."""
+        self._check_writable()
         n = len(quals)
         L = key_len
         if len(vals) != n or len(key_blob) != n * L:
@@ -1303,12 +1425,14 @@ class MemKVStore(KVStore):
 
     def delete(self, table: str, key: bytes, family: bytes,
                qualifiers: list[bytes]) -> None:
+        self._check_writable()
         with self._lock:
             self._wal_append(_OP_DELETE, table.encode(), key, family,
                              *qualifiers)
             self._apply_delete(table, key, family, qualifiers)
 
     def delete_row(self, table: str, key: bytes) -> None:
+        self._check_writable()
         with self._lock:
             self._wal_append(_OP_DELETE_ROW, table.encode(), key)
             self._apply_delete_row(table, key)
@@ -1437,6 +1561,7 @@ class MemKVStore(KVStore):
                          qualifier: bytes, amount: int = 1) -> int:
         """Increment an 8-byte big-endian counter cell, returning the new
         value (initialized from 0 like HBase's ICV)."""
+        self._check_writable()
         with self._lock:
             row = self._merged_row(table, key)
             cur = row.get((family, qualifier)) if row else None
@@ -1452,6 +1577,7 @@ class MemKVStore(KVStore):
                         value: bytes) -> bool:
         """Atomic CAS: write only if the cell currently equals ``expected``
         (None = cell must not exist). Returns success."""
+        self._check_writable()
         with self._lock:
             row = self._merged_row(table, key)
             cur = row.get((family, qualifier)) if row else None

@@ -43,6 +43,14 @@ def common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
     p.add_argument("--auto-metric", action="store_true",
                    help="automatically create metric UIDs (ingest)")
+    p.add_argument("--read-only", action="store_true",
+                   help="open the WAL as a read-only replica of a "
+                        "(possibly live) writer daemon: serve reads "
+                        "over the same store files without the "
+                        "single-writer lock; all mutations refused. "
+                        "A replica daemon polls the writer's durable "
+                        "state every --checkpoint-interval seconds "
+                        "(default 5 when read-only)")
     p.add_argument("--verbose", action="store_true")
 
 
@@ -97,8 +105,15 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         cfg.cachedir = args.cachedir
         cfg.flush_interval = args.flush_interval
         cfg.checkpoint_interval = getattr(args, "checkpoint_interval", 0.0)
+        if getattr(args, "read_only", False) \
+                and not cfg.checkpoint_interval:
+            # A replica that never polls would serve a permanently
+            # frozen snapshot; the timer drives store.refresh() for
+            # read-only daemons (core/compaction.py).
+            cfg.checkpoint_interval = 5.0
         cfg.mesh_devices = getattr(args, "mesh_devices", 0)
-    store = MemKVStore(wal_path=args.wal)
+    store = MemKVStore(wal_path=args.wal,
+                       read_only=getattr(args, "read_only", False))
     tsdb = TSDB(store, cfg, start_compaction_thread=start_thread)
     _open_list().append(tsdb)
     return tsdb

@@ -1,0 +1,183 @@
+"""Read-only replica mode: a second store/daemon serving reads over a
+live writer's WAL + sstable generations — the reference's
+N-TSDs-over-one-shared-store deployment shape (reference README:8-17),
+where any number of TSD frontends answer queries against the same
+storage while writers keep ingesting.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.errors import ReadOnlyStoreError
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.storage.kv import Cell, MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+T = "tsdb"
+F = b"t"
+BT = 1356998400
+
+
+def wal(tmp_path):
+    return str(tmp_path / "wal")
+
+
+class TestReplicaStore:
+    def test_replica_opens_alongside_live_writer(self, tmp_path):
+        w = MemKVStore(wal_path=wal(tmp_path))
+        w.put(T, b"k1", F, b"q", b"v1")
+        # No single-writer lock conflict: the replica opens while the
+        # writer holds the flock, and sees the flushed state.
+        r = MemKVStore(wal_path=wal(tmp_path), read_only=True)
+        assert r.get(T, b"k1") == [Cell(b"k1", F, b"q", b"v1")]
+        r.close()
+        w.close()
+
+    def test_replica_refuses_mutations(self, tmp_path):
+        w = MemKVStore(wal_path=wal(tmp_path))
+        w.put(T, b"k", F, b"q", b"v")
+        r = MemKVStore(wal_path=wal(tmp_path), read_only=True)
+        with pytest.raises(ReadOnlyStoreError):
+            r.put(T, b"x", F, b"q", b"v")
+        with pytest.raises(ReadOnlyStoreError):
+            r.put_many(T, F, [(b"x", b"q", b"v")])
+        with pytest.raises(ReadOnlyStoreError):
+            r.put_many_columnar(T, F, b"xxxx", 4, [b"q"], [b"v"])
+        with pytest.raises(ReadOnlyStoreError):
+            r.delete(T, b"k", F, [b"q"])
+        with pytest.raises(ReadOnlyStoreError):
+            r.delete_row(T, b"k")
+        with pytest.raises(ReadOnlyStoreError):
+            r.atomic_increment(T, b"c", F, b"q")
+        with pytest.raises(ReadOnlyStoreError):
+            r.compare_and_set(T, b"k", F, b"q", None, b"v")
+        assert r.checkpoint() == 0  # no-op, never raises (shutdown path)
+        r.close()
+        w.close()
+
+    def test_refresh_replays_appended_suffix(self, tmp_path):
+        w = MemKVStore(wal_path=wal(tmp_path))
+        w.put(T, b"k1", F, b"q", b"v1")
+        r = MemKVStore(wal_path=wal(tmp_path), read_only=True)
+        assert r.get(T, b"k2") == []
+        w.put(T, b"k2", F, b"q", b"v2")  # appended after replica open
+        assert r.refresh() is True
+        assert r.get(T, b"k2") == [Cell(b"k2", F, b"q", b"v2")]
+        assert r.refresh() is False  # steady state: nothing new
+        r.close()
+        w.close()
+
+    def test_refresh_across_writer_checkpoints(self, tmp_path,
+                                               monkeypatch):
+        """Writer checkpoints (WAL rotation + spill + manifest) and
+        keeps writing; refresh() rebuilds and the replica sees
+        everything — including across a generation-collapsing full
+        merge, while still holding handles to since-unlinked files."""
+        monkeypatch.setattr(MemKVStore, "_MAX_GENERATIONS", 3)
+        w = MemKVStore(wal_path=wal(tmp_path))
+        r = MemKVStore(wal_path=wal(tmp_path), read_only=True)
+        for i in range(6):
+            w.put(T, b"g%d" % i, F, b"q", b"v%d" % i)
+            w.checkpoint()
+            assert r.refresh() is True
+            for j in range(i + 1):
+                assert r.get(T, b"g%d" % j) == \
+                    [Cell(b"g%d" % j, F, b"q", b"v%d" % j)], (i, j)
+        # The writer's full merges collapsed generations; the replica
+        # tracked the manifest the whole way.
+        assert len(r._ssts) == len(w._ssts)
+        r.close()
+        w.close()
+
+    def test_replica_never_deletes_or_truncates(self, tmp_path):
+        """A replica must not run the writer's destructive recovery:
+        stray generation files stay (they may be a live writer's
+        in-flight spill) and torn WAL tails stay (they may be the
+        writer mid-append)."""
+        w = MemKVStore(wal_path=wal(tmp_path))
+        w.put(T, b"k", F, b"q", b"v")
+        w.checkpoint()
+        stray = wal(tmp_path) + ".sst.g99"
+        from opentsdb_tpu.storage.sstable import write_sstable
+        write_sstable(stray, iter([("t", b"s", [(F, b"q", b"x")])]))
+        w.put(T, b"k2", F, b"q", b"v2")
+        w.flush()
+        # Simulate the writer mid-append: a torn record at the tail.
+        with open(wal(tmp_path), "ab") as f:
+            f.write(b"\x01\x00\x00\x00\xff partial")
+        size_before = os.path.getsize(wal(tmp_path))
+        r = MemKVStore(wal_path=wal(tmp_path), read_only=True)
+        assert os.path.exists(stray), "replica deleted a stray file"
+        assert os.path.getsize(wal(tmp_path)) == size_before, \
+            "replica truncated the writer's WAL"
+        assert r.get(T, b"k2") == [Cell(b"k2", F, b"q", b"v2")]
+        r.close()
+        w.close()
+        os.unlink(stray)
+
+
+class TestReplicaDaemon:
+    def test_reader_daemon_serves_writer_ingest(self, tmp_path):
+        """Two TSD frontends over one store: ingest goes to the writer
+        daemon, /q is answered by the READ-ONLY daemon after its
+        refresh — the second-frontend slice of the reference's
+        many-TSDs deployment."""
+        from opentsdb_tpu.server.tsd import TSDServer
+
+        wpath = wal(tmp_path)
+        wcfg = Config(auto_create_metrics=True, wal_path=wpath, port=0,
+                      bind="127.0.0.1")
+        writer = TSDB(MemKVStore(wal_path=wpath), wcfg,
+                      start_compaction_thread=False)
+        writer.add_batch("ro.m", BT + np.arange(50) * 10,
+                         np.arange(50, dtype=np.float64), {"h": "a"})
+        writer.store.flush()
+
+        rcfg = Config(auto_create_metrics=False, wal_path=wpath,
+                      port=0, bind="127.0.0.1")
+        rcfg.device_window = False
+        reader = TSDB(MemKVStore(wal_path=wpath, read_only=True), rcfg,
+                      start_compaction_thread=False)
+        server = TSDServer(reader)
+
+        async def drive(port):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(f"GET /q?start={BT}&end={BT + 800}&m=sum:ro.m&ascii"
+                    " HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+                    .encode())
+            await w.drain()
+            data = await r.read()
+            w.close()
+            return data
+
+        def more_ingest():
+            # Writer keeps ingesting; the reader daemon's refresh (the
+            # compaction-timer hook in production) catches it up.
+            writer.add_batch("ro.m", BT + 600 + np.arange(10) * 10,
+                             np.ones(10), {"h": "a"})
+            writer.store.flush()
+            assert reader.store.refresh() is True
+
+        async def main():
+            await server.start()
+            try:
+                first = await drive(server.port)
+                more_ingest()
+                second = await drive(server.port)
+                return first, second
+            finally:
+                server._pool.shutdown(wait=False)
+                server._server.close()
+                await server._server.wait_closed()
+
+        first, second = asyncio.run(main())
+        head, _, body = first.partition(b"\r\n\r\n")
+        assert b" 200 " in head.split(b"\r\n")[0]
+        assert len(body.strip().split(b"\n")) == 50
+        head, _, body = second.partition(b"\r\n\r\n")
+        assert len(body.strip().split(b"\n")) == 60
+        writer.shutdown()
+        reader.shutdown()
