@@ -61,7 +61,18 @@ class TSDB:
         # ingest mirrors into HBM so queries skip the host->device
         # upload. CPU-oracle deployments skip it (nothing to upload to).
         self.devwindow = None
-        if self.config.device_window and self.config.backend != "cpu":
+        # A replica never ingests, so nothing would keep the window
+        # (or its completeness bookkeeping) in sync with the writer's
+        # appends arriving via store.refresh() — a boot-warmed window
+        # would serve STALE resident answers while claiming coverage.
+        # Replicas use the scan path. (Sketches stay: they reload on
+        # every replica rebuild — reload_sketches() — so their lag is
+        # bounded by the writer's checkpoint cadence + the poll.)
+        # Checked locally, NOT written back into config: the Config
+        # object is caller-owned and may be shared with a writer TSDB.
+        use_devwindow = (self.config.device_window
+                        and not getattr(store, "read_only", False))
+        if use_devwindow and self.config.backend != "cpu":
             from opentsdb_tpu.storage.devstore import DeviceWindow
 
             self.devwindow = DeviceWindow(
@@ -130,6 +141,19 @@ class TSDB:
                 return
         # No snapshot (or unknown store shape): rebuild from everything.
         self._refold(self.scan_columns(b"", b"\xff" * 64))
+
+    def reload_sketches(self) -> None:
+        """Replica catch-up: re-load the writer's sketch snapshot and
+        re-fold the (freshly rebuilt) memtable on top. The refresh
+        timer calls this whenever store.refresh() REBUILT — which
+        happens on every writer checkpoint — so replica sketch lag is
+        bounded by the writer's checkpoint cadence plus the poll
+        interval (suffix replays between checkpoints are not folded;
+        re-folding the whole memtable per poll would be O(window)
+        every few seconds). Queries racing the swap keep a coherent
+        reference to the previous sketch set."""
+        if self.config.enable_sketches:
+            self._init_sketches()
 
     def _refold(self, rows) -> None:
         for key, cols in rows:

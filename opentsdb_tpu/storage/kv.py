@@ -308,6 +308,10 @@ class MemKVStore(KVStore):
         self._fsync = fsync
         self._wal_path = wal_path
         self.read_only = read_only
+        # Count of replica full rebuilds (each corresponds to a writer
+        # checkpoint/rotation); TSDB's refresh timer keys sketch
+        # snapshot reloads off it.
+        self.rebuilds = 0
         # Replica replay position: {"wal": (inode, replayed bytes),
         # "old": (inode, size) | None} — refresh() replays just the
         # WAL suffix when the writer has only appended, and rebuilds
@@ -491,6 +495,7 @@ class MemKVStore(KVStore):
         self._ssts = []
         self._ro_state = None
         self._open_tiers(self._wal_path)
+        self.rebuilds += 1
         for sst in old_ssts:
             sst.close()
 

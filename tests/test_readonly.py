@@ -119,6 +119,64 @@ class TestReplicaStore:
         os.unlink(stray)
 
 
+class TestReplicaDevwindow:
+    def test_replica_disables_device_window(self, tmp_path):
+        """A replica must not boot a device-resident window: nothing
+        syncs it with writer appends arriving via refresh(), so a
+        boot-warmed window would serve STALE resident answers while
+        claiming coverage. Replicas take the scan path."""
+        w = MemKVStore(wal_path=wal(tmp_path))
+        cfg = Config(auto_create_metrics=True, wal_path=wal(tmp_path))
+        assert cfg.device_window
+        writer = TSDB(w, cfg, start_compaction_thread=False)
+        writer.add_batch("dw.m", BT + np.arange(10) * 10,
+                         np.ones(10), {"h": "a"})
+        writer.store.flush()
+        rcfg = Config(auto_create_metrics=False,
+                      wal_path=wal(tmp_path))
+        assert rcfg.device_window
+        reader = TSDB(MemKVStore(wal_path=wal(tmp_path),
+                                 read_only=True), rcfg,
+                      start_compaction_thread=False)
+        assert reader.devwindow is None
+        writer.shutdown()
+        reader.shutdown()
+
+
+class TestReplicaSketches:
+    def test_sketches_reload_after_writer_checkpoint(self, tmp_path):
+        """A replica's sketch set reloads from the writer's snapshot
+        whenever refresh() rebuilt (= the writer checkpointed), so
+        sketch answers lag by at most a checkpoint window + poll —
+        never unboundedly."""
+        wpath = wal(tmp_path)
+        wcfg = Config(auto_create_metrics=True, wal_path=wpath)
+        writer = TSDB(MemKVStore(wal_path=wpath), wcfg,
+                      start_compaction_thread=False)
+        for h in range(4):
+            writer.add_batch("sk.m", BT + np.arange(20) * 10,
+                             np.ones(20), {"host": f"h{h}"})
+        writer.checkpoint()  # snapshot covers 4 hosts
+
+        rcfg = Config(auto_create_metrics=False, wal_path=wpath)
+        reader = TSDB(MemKVStore(wal_path=wpath, read_only=True), rcfg,
+                      start_compaction_thread=False)
+        from opentsdb_tpu.query.executor import QueryExecutor
+        assert QueryExecutor(reader).sketch_distinct("sk.m", "host") == 4
+
+        for h in range(4, 9):
+            writer.add_batch("sk.m", BT + np.arange(20) * 10,
+                             np.ones(20), {"host": f"h{h}"})
+        writer.checkpoint()  # snapshot now covers 9 hosts
+        before = reader.store.rebuilds
+        assert reader.store.refresh() is True
+        assert reader.store.rebuilds > before
+        reader.reload_sketches()  # what the refresh timer does
+        assert QueryExecutor(reader).sketch_distinct("sk.m", "host") == 9
+        writer.shutdown()
+        reader.shutdown()
+
+
 class TestReplicaDaemon:
     def test_reader_daemon_serves_writer_ingest(self, tmp_path):
         """Two TSD frontends over one store: ingest goes to the writer
