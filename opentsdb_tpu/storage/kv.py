@@ -944,12 +944,18 @@ class MemKVStore(KVStore):
         Normally an O(frozen-rows) memtable-only spill: the new
         generation is appended to the tier list and reads overlay it
         (full rewrites grew linearly with history — 28 s at 25M points,
-        114 s at 75M — which dominated sustained ingest). A FULL merge
-        (collapse every generation + frozen into one, tombstones
-        applied) runs only when the frozen tier holds tombstones (which
-        must mask lower cells — a tombstone-free generation can never
-        mask anything, so plain overlay is exact) or the generation
-        count hits _MAX_GENERATIONS.
+        114 s at 75M — which dominated sustained ingest). When the
+        generation count hits _MAX_GENERATIONS, a SIZE-TIERED partial
+        merge collapses only the newest age-contiguous suffix of
+        generations (plus frozen) whose combined size the next-older
+        generation does not dwarf — so the largest, oldest generations
+        are left untouched and write amplification stays logarithmic
+        instead of rewriting the whole history every cap-hit (268 s of
+        the 828 s 1B-run wall was the two full collapses). A FULL
+        merge (every generation + frozen) runs only when the frozen
+        tier holds tombstones: tombstones must mask cells in EVERY
+        lower generation, and a partial merge would drop them for the
+        kept prefix, resurrecting the masked cells.
 
         Three phases, designed so ingest/queries never wait on the merge:
           1. (brief lock) freeze the memtable as an immutable middle tier,
@@ -990,9 +996,16 @@ class MemKVStore(KVStore):
                     self._wal = open(self._wal_path, "ab")
             frozen = self._frozen
             gens = list(self._ssts)
-            full = (any(ft.row_tombs or ft.tombs
-                        for ft in frozen.values())
-                    or len(gens) + 1 >= self._MAX_GENERATIONS)
+            tombstoned = any(ft.row_tombs or ft.tombs
+                             for ft in frozen.values())
+            if tombstoned:
+                keep: list[SSTable] = []
+                merge_gens = gens
+            elif len(gens) + 1 >= self._MAX_GENERATIONS:
+                keep, merge_gens = self._select_merge_suffix(gens)
+            else:
+                keep, merge_gens = gens, []
+            use_merge = tombstoned or bool(merge_gens)
             empty = not any(ft.rows or ft.row_tombs
                             for ft in frozen.values())
             out_path = self._next_generation_path()
@@ -1011,7 +1024,7 @@ class MemKVStore(KVStore):
                     os.unlink(old_path)
             return 0
 
-        if full:
+        if use_merge:
             # Copy-merge collapse (sstable.merge_sstables): unique-key
             # records relocate verbatim at IO speed; only multi-source
             # keys and the frozen tier re-frame (tombstones applied
@@ -1022,7 +1035,7 @@ class MemKVStore(KVStore):
                 for name, ft in frozen.items()}
         else:
             def spill_tables():
-                # Memtable-only: by the `full` test above the frozen
+                # Memtable-only: by the tombstone test above the frozen
                 # tier holds no tombstones, so every cell value is
                 # real bytes and no lower-generation read is needed.
                 # Sorted keys + the row dict itself: write_sstable_bulk
@@ -1034,7 +1047,8 @@ class MemKVStore(KVStore):
                         for name, ft in frozen.items()}
 
         try:
-            n = (merge_sstables(out_path, gens, frozen_payload) if full
+            n = (merge_sstables(out_path, merge_gens, frozen_payload)
+                 if use_merge
                  else write_sstable_bulk(out_path, spill_tables()))
         except Exception:
             # Disk full or similar mid-merge: thaw the frozen tier back
@@ -1058,19 +1072,20 @@ class MemKVStore(KVStore):
             unlink_new = True
             try:
                 new_sst = SSTable(out_path)
-                if full:
-                    dropped = self._ssts
-                    self._ssts = [new_sst]
-                else:
-                    dropped = []
-                    self._ssts = self._ssts + [new_sst]
+                # The new generation replaces exactly the merged
+                # age-contiguous suffix (all of them on a full merge,
+                # none on a plain spill), preserving overlay order:
+                # everything in `keep` is strictly older than what the
+                # new generation holds.
+                dropped = merge_gens
+                self._ssts = keep + [new_sst]
                 # Manifest BEFORE unlinking: a crash in between leaves
                 # stray files the next load deletes (they are never
                 # opened, so dropped cells cannot resurrect).
                 try:
                     self._write_manifest([s.path for s in self._ssts])
                 except Exception:
-                    old = dropped if full else self._ssts[:-1]
+                    old = keep + merge_gens
                     self._ssts = old
                     # The failure point is ambiguous: the new manifest
                     # may already be DURABLE (os.replace landed, the
@@ -1108,6 +1123,49 @@ class MemKVStore(KVStore):
             if os.path.exists(old_path):
                 os.unlink(old_path)
         return n
+
+    @staticmethod
+    def _select_merge_suffix(gens: "list[SSTable]",
+                             ) -> "tuple[list[SSTable], list[SSTable]]":
+        """Size-tiered pick at the generation cap: absorb older
+        generations into the merge only while each is no larger than
+        everything newer already being merged. This yields geometric
+        tiers — the oldest, largest generations are kept verbatim and
+        the generation count stays bounded (the suffix always absorbs
+        at least one existing generation, so each partial merge
+        shrinks the count by at least one... or holds it at cap-1 in
+        the steady state). Returns (keep-prefix, merge-suffix), both
+        age-ordered.
+
+        The frozen tier's sstable footprint is estimated as the size
+        of the NEWEST generation (steady-state spill windows are
+        equal). Using the rotated <wal>.old size instead degenerated
+        in the 1B run: WAL bytes run ~2.3x the sstable bytes for the
+        same data, the over-estimate dragged the accumulated big
+        generation into EVERY cap-hit, and the per-checkpoint merge
+        grew linearly (5.2M -> 11.4M rows over 10 checkpoints —
+        quadratic total IO, the exact pathology tiering exists to
+        avoid)."""
+        def size(g):
+            try:
+                return os.path.getsize(g.path)
+            except OSError:
+                # Unreadable: treat as too big to absorb — the loop
+                # stops at it. As the SEED that would invert into
+                # absorb-everything, so the seed uses 0 instead (the
+                # pick then merges just the newest gen + frozen, the
+                # minimal safe choice).
+                return None
+        i = len(gens) - 1          # always absorb the newest
+        newest = size(gens[-1])
+        acc = 2 * (newest or 0)    # + the frozen tier, estimated equal
+        while i > 0:
+            s = size(gens[i - 1])
+            if s is None or s > acc:
+                break
+            acc += s
+            i -= 1
+        return gens[:i], gens[i:]
 
     def _thaw_frozen_locked(self) -> None:
         """Fold the frozen middle tier back under the live memtable

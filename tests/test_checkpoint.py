@@ -542,6 +542,45 @@ class TestTieredGenerations:
         assert again.get(T, b"k") == [Cell(b"k", F, b"q", b"v")]
         again.close()
 
+    def test_size_tiered_partial_merge_keeps_big_generation(
+            self, tmp_path, monkeypatch):
+        """At the generation cap with no tombstones, only the newest
+        size-comparable suffix merges; a much larger old generation is
+        kept verbatim (same file, same inode) — write amplification
+        stays logarithmic instead of rewriting the whole history every
+        cap-hit. Content must stay exact through the partial merges
+        and across a reopen."""
+        monkeypatch.setattr(MemKVStore, "_MAX_GENERATIONS", 4)
+        store = MemKVStore(wal_path=wal(tmp_path))
+        # A deliberately large first generation (~100 KB).
+        big_val = b"x" * 100
+        for i in range(1000):
+            store.put(T, b"big%04d" % i, F, b"q", big_val)
+        store.checkpoint()
+        assert len(store._ssts) == 1
+        big_path = store._ssts[0].path
+        big_ino = os.stat(big_path).st_ino
+        # Small spills until cap-triggered merges happen, twice over.
+        for r in range(8):
+            store.put(T, b"small%d" % r, F, b"q", b"v%d" % r)
+            store.checkpoint()
+            assert len(store._ssts) < 4
+        # The big generation was never rewritten.
+        assert store._ssts[0].path == big_path
+        assert os.stat(big_path).st_ino == big_ino
+        # All content intact, through the tiers and after reopen.
+        for i in range(1000):
+            assert store.get(T, b"big%04d" % i) == \
+                [Cell(b"big%04d" % i, F, b"q", big_val)]
+        for r in range(8):
+            assert store.get(T, b"small%d" % r) == \
+                [Cell(b"small%d" % r, F, b"q", b"v%d" % r)]
+        store.close()
+        again = MemKVStore(wal_path=wal(tmp_path))
+        assert again.row_count(T) == 1008
+        assert again.get(T, b"big0500")[0].value == big_val
+        again.close()
+
     def test_copy_merge_differential(self, tmp_path, monkeypatch):
         """The copy-merge full collapse (sstable.merge_sstables) must
         be bit-equivalent in CONTENT to the naive per-row merge, under
