@@ -1603,16 +1603,84 @@ class MemKVStore(KVStore):
                         if items:
                             items.sort()
                             out.append((key, items))
-                else:
+                elif pattern is not None:
+                    # Selective regexp scans touch few rows: per-key
+                    # merged reads beat extracting whole key ranges
+                    # that the filter would then discard.
                     for key in keys[i:i + chunk]:
                         row = self._merged_row(table, key)
                         if not row:
                             continue
+                        items = [(q, v) for (f, q), v in row.items()
+                                 if family is None or f == family]
+                        if items:
+                            items.sort()
+                            out.append((key, items))
+                else:
+                    # Tiered: RANGE-extract each generation once per
+                    # chunk (two bisects + a sequential record walk)
+                    # instead of probing every generation per key —
+                    # per-key sst.get() was ~5 s of a 17 s cold 1-week
+                    # scan over the 1B store (2.35M probes). Overlay
+                    # order and tombstone semantics are exactly
+                    # _merged_row's: generations oldest->newest, then
+                    # frozen, then the live memtable; row tombstones
+                    # mask all lower tiers.
+                    ck = keys[i:i + chunk]
+                    lo = ck[0]
+                    hi = keys[i + chunk] if i + chunk < len(keys) \
+                        else (stop or None)
+                    t = self._table(table)
+                    ft = self._frozen.get(table) if self._frozen \
+                        else None
+                    # Row tombstones suppress generation rows BEFORE
+                    # the record decode (post-delete_row sweeps can
+                    # mask many keys until the next full merge).
+                    masked = t.row_tombs
+                    if ft is not None and ft.row_tombs:
+                        masked = masked | ft.row_tombs
+                    merged: dict[bytes, dict] = {}
+                    for sst in self._ssts:
+                        for key, cells in sst.iter_rows_range(
+                                table, lo, hi, skip=masked):
+                            row = merged.get(key)
+                            if row is None:
+                                row = merged[key] = {}
+                            for f, q, v in cells:
+                                row[(f, q)] = v
+                    if ft is not None:
+                        for key in ft.range_keys(lo, hi):
+                            if key in t.row_tombs:
+                                continue
+                            row = merged.get(key)
+                            if row is None:
+                                row = merged[key] = {}
+                            for ckey, v in ft.rows[key].items():
+                                if v is None:
+                                    row.pop(ckey, None)
+                                else:
+                                    row[ckey] = v
+                    live_get = t.rows.get
+                    for key in ck:
+                        row = merged.get(key)
+                        lrow = live_get(key)
+                        if lrow:
+                            if row is None:
+                                row = dict(lrow)
+                            else:
+                                for ckey, v in lrow.items():
+                                    if v is None:
+                                        row.pop(ckey, None)
+                                    else:
+                                        row[ckey] = v
+                        if not row:
+                            continue
                         if family is None:
-                            items = [(q, v) for (_, q), v in row.items()]
+                            items = [(q, v) for (f, q), v in row.items()
+                                     if v is not None]
                         else:
                             items = [(q, v) for (f, q), v in row.items()
-                                     if f == family]
+                                     if f == family and v is not None]
                         if items:
                             items.sort()
                             out.append((key, items))

@@ -500,6 +500,32 @@ class SSTable:
         ends = all_starts[np.searchsorted(all_starts, starts, "right")]
         return keys, starts, ends
 
+    def iter_rows_range(self, table: str, start: bytes,
+                        stop: bytes | None,
+                        skip: "set[bytes] | None" = None) -> Iterator[
+            tuple[bytes, list[tuple[bytes, bytes, bytes]]]]:
+        """Rows with start <= key < stop (stop None = to the end), in
+        key order — the range form of the read path. One bisect pair
+        per CALL instead of one per key: the cold scan used to probe
+        every generation per row-hour (2.35M get() calls over a 1-week
+        scan of the 1B store, ~5 s of the 17 s wall). ``skip`` (e.g.
+        the caller's row-tombstone set) suppresses rows BEFORE the
+        record decode — masked rows cost a set probe, not a full
+        _read_row."""
+        idx = self._index.get(table)
+        if not idx:
+            return
+        keys, offs = idx
+        lo = bisect_left(keys, start)
+        hi = bisect_left(keys, stop) if stop else len(keys)
+        if skip:
+            for i in range(lo, hi):
+                if keys[i] not in skip:
+                    yield keys[i], self._read_row(offs[i])
+        else:
+            for i in range(lo, hi):
+                yield keys[i], self._read_row(offs[i])
+
     def iter_rows(self, table: str) -> Iterator[
             tuple[bytes, list[tuple[bytes, bytes, bytes]]]]:
         idx = self._index.get(table)
