@@ -71,6 +71,30 @@ s.close()
 """
 
 
+def wait_for_ready(proc, logpath: str, name: str) -> int:
+    """Poll a daemon's log for its COMPLETE ready line; returns the
+    bound port. Only full lines (newline-terminated) are parsed — a
+    buffered stdout can flush mid-line, and a truncated
+    "Ready to serve on 127.0.0.1:54" would otherwise yield a wrong
+    port (or a ValueError from the host part)."""
+    for _ in range(240):
+        try:
+            with open(logpath) as f:
+                for ln in f:
+                    if ln.startswith("Ready to serve on ") \
+                            and ln.endswith("\n"):
+                        try:
+                            return int(ln.strip().rsplit(":", 1)[1])
+                        except ValueError:
+                            pass  # partial flush: retry next poll
+        except OSError:
+            pass
+        if proc.poll() is not None:
+            raise RuntimeError(f"{name} died during startup")
+        time.sleep(0.5)
+    raise RuntimeError(f"{name} never came up")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--points", type=int, default=1_000_000)
@@ -99,24 +123,7 @@ def main() -> int:
          "--mesh-devices", "8", "--auto-metric"],
         env=env, stdout=open(logpath, "w"), stderr=subprocess.STDOUT)
     try:
-        port = None
-        for _ in range(240):
-            try:
-                with open(logpath) as f:
-                    for ln in f:
-                        if ln.startswith("Ready to serve on "):
-                            port = int(ln.rsplit(":", 1)[1])
-                            break
-            except OSError:
-                pass
-            if port is not None:
-                break
-            if daemon.poll() is not None:
-                raise RuntimeError("daemon died during startup")
-            time.sleep(0.5)
-        else:
-            raise RuntimeError("daemon never came up")
-        PORT = port
+        PORT = wait_for_ready(daemon, logpath, "daemon")
         log(f"daemon up on :{PORT}; starting ingestor process")
 
         t0 = time.time()
@@ -163,6 +170,44 @@ def main() -> int:
                     if ln.startswith("tsd.rpc.requests")
                     and "type=put" in ln]
 
+        # Third process: a READ-ONLY replica daemon over the same
+        # store, serving /q while the writer daemon stays live — the
+        # reference's many-TSDs-over-one-storage deployment shape
+        # (reference README:8-17) in full.
+        rlogpath = os.path.join(args.workdir, "tsd_replica.log")
+        replica = subprocess.Popen(
+            [sys.executable, "-m", "opentsdb_tpu.tools.cli", "tsd",
+             "--port", "0", "--bind", "127.0.0.1", "--backend", "cpu",
+             "--wal", os.path.join(args.workdir, "wal"),
+             "--cachedir", os.path.join(args.workdir, "cache_ro"),
+             "--mesh-devices", "8", "--read-only"],
+            env=env, stdout=open(rlogpath, "w"),
+            stderr=subprocess.STDOUT)
+        try:
+            rport = wait_for_ready(replica, rlogpath, "replica")
+            log(f"replica up on :{rport} (writer still live)")
+            url = (f"http://127.0.0.1:{rport}/q?start={BT}&end={end}"
+                   f"&m=sum:two.proc&ascii&nocache")
+            t0 = time.time()
+            body = urllib.request.urlopen(url, timeout=600).read() \
+                .decode()
+            rq_s = round(time.time() - t0, 3)
+            rlines = [ln for ln in body.strip().split("\n") if ln]
+            rsum = sum(float(ln.split()[2]) for ln in rlines)
+            assert len(rlines) == pps, (len(rlines), pps)
+            assert abs(rsum - expect_sum) < 1e-6 * max(expect_sum, 1), \
+                (rsum, expect_sum)
+            q["replica_sum_ascii_s"] = rq_s
+            replica_ok = {"points_served": len(rlines),
+                          "sum_check": "exact",
+                          "writer_live": daemon.poll() is None}
+        finally:
+            replica.terminate()
+            try:
+                replica.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                replica.kill()
+
         out = {
             "points": total, "series": args.series,
             "ingest_over_wire": ingest,
@@ -171,6 +216,7 @@ def main() -> int:
             "sum_check": "exact",
             "daemon_put_requests": (int(put_reqs[0].split()[2])
                                     if put_reqs else None),
+            "readonly_replica_daemon": replica_ok,
             "mesh_devices": 8,
             "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
