@@ -367,7 +367,10 @@ class MemKVStore(KVStore):
                     f"MemKVStore (single-writer store; remove "
                     f"{wal_path}.lock only if the owner is dead)")
         try:
-            self._open_tiers(wal_path)
+            if read_only:
+                self._open_tiers_retrying(wal_path)
+            else:
+                self._open_tiers(wal_path)
         except BaseException:
             # Recovery failed after the flock was acquired (corrupt
             # generation file, WAL replay error): release the lock or
@@ -380,6 +383,27 @@ class MemKVStore(KVStore):
                 os.close(self._lockfd)
                 self._lockfd = None
             raise
+
+    def _open_tiers_retrying(self, wal_path: str | None) -> None:
+        """_open_tiers for replicas, retrying on FileNotFoundError: a
+        live writer's merge can unlink a dropped generation between
+        the replica's manifest read and the file open (found by the
+        replica-vs-writer stress test). The manifest converges, so a
+        bounded re-read wins the race; skipping the missing file
+        instead would silently drop its rows."""
+        for _ in range(8):
+            for sst in self._ssts:
+                sst.close()
+            self._tables = {}
+            self._ssts = []
+            try:
+                self._open_tiers(wal_path)
+                return
+            except FileNotFoundError:
+                continue
+        raise FileNotFoundError(
+            f"generation set for {wal_path!r} kept changing mid-open "
+            f"(writer merging continuously?); gave up after 8 tries")
 
     def _open_tiers(self, wal_path: str | None) -> None:
         """Load sstable generations, replay the WAL(s), open for append
@@ -491,10 +515,23 @@ class MemKVStore(KVStore):
         readable until the fd closes, so readers racing a writer's
         full merge never see missing data."""
         old_ssts = self._ssts
-        self._tables = {}
+        old_tables = self._tables
+        old_state = self._ro_state
         self._ssts = []
         self._ro_state = None
-        self._open_tiers(self._wal_path)
+        try:
+            self._open_tiers_retrying(self._wal_path)
+        except BaseException:
+            # Keep serving the STALE-but-consistent pre-rebuild view
+            # (and don't leak its fds): half-loaded tables would serve
+            # torn reads to a poller that treats the failure as
+            # transient.
+            for sst in self._ssts:
+                sst.close()
+            self._ssts = old_ssts
+            self._tables = old_tables
+            self._ro_state = old_state
+            raise
         self.rebuilds += 1
         for sst in old_ssts:
             sst.close()
@@ -517,20 +554,27 @@ class MemKVStore(KVStore):
         with open(man) as f:
             names = _json.load(f)
         live = [os.path.join(d, fn) for fn in names]
-        if not self.read_only:
-            # Replicas must never delete: a "stray" may be the live
-            # writer's generation mid-rename.
-            liveset = set(names)
-            base = os.path.basename(self._sst_path)
-            for fn in os.listdir(d):
-                if (fn == base or fn.startswith(base + ".g")) \
-                        and fn not in liveset \
-                        and not fn.endswith(".tmp") \
-                        and not fn.endswith(".manifest"):
-                    try:
-                        os.unlink(os.path.join(d, fn))
-                    except OSError:
-                        pass
+        if self.read_only:
+            # Replicas must never delete (a "stray" may be the live
+            # writer's generation mid-rename) — and must NOT filter on
+            # existence either: a writer merge can unlink a manifest
+            # generation between our manifest read and this point, and
+            # silently dropping it would serve reads missing all its
+            # rows. Returning the path unfiltered makes the SSTable
+            # open raise FileNotFoundError, which the replica's retry
+            # turns into a manifest re-read.
+            return live
+        liveset = set(names)
+        base = os.path.basename(self._sst_path)
+        for fn in os.listdir(d):
+            if (fn == base or fn.startswith(base + ".g")) \
+                    and fn not in liveset \
+                    and not fn.endswith(".tmp") \
+                    and not fn.endswith(".manifest"):
+                try:
+                    os.unlink(os.path.join(d, fn))
+                except OSError:
+                    pass
         return [p for p in live if os.path.exists(p)]
 
     def _write_manifest(self, paths: list[str]) -> None:

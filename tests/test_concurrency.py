@@ -19,6 +19,7 @@ real race, not a bad test seed.
 
 import struct
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -254,3 +255,74 @@ class TestServerConcurrentIngestQuery:
             done.set()
             t.join(timeout=120)
         assert not t.is_alive()
+
+
+class TestReplicaRacesWriter:
+    def test_replica_refresh_races_writer_checkpoints(self, tmp_path):
+        """A replica polls refresh() while the writer ingests and
+        checkpoints (rotations, spills, tiered merges) at full speed.
+        Every replica read must be a consistent prefix of the writer's
+        history: for monotone per-key versions, a key's value may lag
+        but never go backwards and never tear."""
+        wal = str(tmp_path / "wal")
+        writer = MemKVStore(wal_path=wal)
+        # tight cap => frequent merges while the replica polls
+        writer._MAX_GENERATIONS = 3
+        stop = threading.Event()
+        versions = {b"k%02d" % i: 0 for i in range(20)}
+        errs: list[BaseException] = []
+
+        def write_loop():
+            v = 0
+            while not stop.is_set():
+                v += 1
+                for k in versions:
+                    writer.put(T, k, F, b"q", b"%06d" % v)
+                    versions[k] = v
+                if v % 3 == 0:
+                    writer.checkpoint()
+
+        def replica_loop():
+            replica = MemKVStore(wal_path=wal, read_only=True)
+            try:
+                last_seen = {k: 0 for k in versions}
+                while not stop.is_set():
+                    replica.refresh()
+                    for k in list(last_seen):
+                        cells = replica.get(T, k)
+                        if not cells:
+                            continue
+                        v = int(cells[0].value)
+                        assert v >= last_seen[k], \
+                            f"{k} went backwards: {last_seen[k]}->{v}"
+                        last_seen[k] = v
+            finally:
+                replica.close()
+
+        def guard(fn):
+            def wrapped():
+                try:
+                    fn()
+                except BaseException as e:
+                    errs.append(e)
+            return wrapped
+
+        threads = [threading.Thread(target=guard(write_loop))] + [
+            threading.Thread(target=guard(replica_loop))
+            for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(6)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "deadlock"
+        if errs:
+            raise errs[0]
+        writer.close()
+        # A fresh replica sees the final state exactly.
+        final = MemKVStore(wal_path=wal, read_only=True)
+        for k, v in versions.items():
+            got = int(final.get(T, k)[0].value)
+            assert got == v, (k, got, v)
+        final.close()
