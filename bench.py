@@ -62,6 +62,24 @@ FROZEN_BASELINE_CONFIG = dict(auto_create_metrics=True,
                               enable_sketches=False,
                               device_window=False)
 
+# --shards N: the batch/telnet/query legs run over an N-way
+# series-sharded store (storage/sharded.py, in-memory shards). The
+# scalar stand-in always keeps the single store — the reference proxy
+# has no shard analog, and the ratio must stay comparable across
+# rounds. Set from main(); module-global so every leg builds stores
+# the same way.
+SHARDS = 1
+
+
+def make_store():
+    from opentsdb_tpu.storage.kv import MemKVStore
+
+    if SHARDS > 1:
+        from opentsdb_tpu.storage.sharded import ShardedKVStore
+
+        return ShardedKVStore(None, shards=SHARDS)
+    return MemKVStore()
+
 # Peak HBM bandwidth by device kind, for the roofline line. Bound to the
 # DETECTED device; suppressed entirely on CPU (a CPU run measured
 # against a TPU roof is noise — r02 printed "0 GB/s of ~819 peak").
@@ -247,11 +265,10 @@ def _batch_ingest_run(series, cfg_kwargs: dict) -> float:
     Includes draining the device window uploader and the sketch folder
     (their work belongs to ingest, not to a later query)."""
     from opentsdb_tpu.core.tsdb import TSDB
-    from opentsdb_tpu.storage.kv import MemKVStore
     from opentsdb_tpu.utils.config import Config
 
     total = sum(len(s[0]) for s in series)
-    tsdb = TSDB(MemKVStore(), Config(**cfg_kwargs),
+    tsdb = TSDB(make_store(), Config(**cfg_kwargs),
                 start_compaction_thread=False)
     t0 = time.perf_counter()
     for i, (ts, vals) in enumerate(series):
@@ -324,7 +341,7 @@ def bench_ingest(num_series: int, points_per_series: int, span: int):
         if count >= wire_points:
             break
     buf = ("\n".join(lines) + "\n").encode()
-    tsdb3 = TSDB(MemKVStore(), Config(auto_create_metrics=True),
+    tsdb3 = TSDB(make_store(), Config(auto_create_metrics=True),
                  start_compaction_thread=False)
     # Two-stage decode/ingest pipeline over socket-read-sized chunks
     # (decode of chunk N+1 overlaps ingest of batch N).
@@ -372,10 +389,9 @@ def bench_telnet_socket(buf: bytes, n_points: int) -> float:
 
     from opentsdb_tpu.core.tsdb import TSDB
     from opentsdb_tpu.server.tsd import TSDServer
-    from opentsdb_tpu.storage.kv import MemKVStore
     from opentsdb_tpu.utils.config import Config
 
-    tsdb = TSDB(MemKVStore(),
+    tsdb = TSDB(make_store(),
                 Config(auto_create_metrics=True, port=0,
                        bind="127.0.0.1"),
                 start_compaction_thread=False)
@@ -432,10 +448,9 @@ def build_query_tsdb(series, base):
     an [S]-sized group map. Sketches stay ON so the streaming /sketch
     path (config 3's t-digest leg) has state to answer from."""
     from opentsdb_tpu.core.tsdb import TSDB
-    from opentsdb_tpu.storage.kv import MemKVStore
     from opentsdb_tpu.utils.config import Config
 
-    tsdb = TSDB(MemKVStore(), Config(auto_create_metrics=True),
+    tsdb = TSDB(make_store(), Config(auto_create_metrics=True),
                 start_compaction_thread=False)
     for i, (ts, vals) in enumerate(series):
         tsdb.add_batch("bench.query", ts, vals, {"host": f"h{i}"})
@@ -611,7 +626,12 @@ def main() -> int:
     ap.add_argument("--probe-budget", type=float, default=420.0,
                     help="seconds to keep re-probing a wedged TPU tunnel "
                          "before falling back to CPU")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="series-shard the batch/telnet/query stores "
+                         "N ways (the scalar stand-in stays unsharded)")
     args = ap.parse_args()
+    global SHARDS
+    SHARDS = max(args.shards, 1)
     if args.quick:
         args.series, args.points_per_series = 200, 100
         args.probe_budget = min(args.probe_budget, 150.0)
@@ -644,6 +664,7 @@ def main() -> int:
     details = {"device": str(dev), "platform": dev.platform,
                "series": args.series,
                "points_per_series": args.points_per_series,
+               "shards": SHARDS,
                "tpu_probe": probe_log, "sanity": sanity,
                "peak_gbps": peak}
 

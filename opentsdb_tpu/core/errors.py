@@ -27,12 +27,16 @@ class PleaseThrottleError(Exception):
     """
 
 
-class ReadOnlyStoreError(OSError):
+class ReadOnlyStoreError(Exception):
     """A mutation was attempted on a read-only store replica.
 
     Read-only stores open another daemon's WAL/sstable state without
     the single-writer lock (the N-TSDs-over-one-store deployment
     shape, reference README:8-17); every write path refuses with this.
+
+    Subclasses Exception (like PleaseThrottleError), NOT OSError: a
+    broad ``except OSError`` around storage I/O must never silently
+    swallow a replica's write refusal as if it were a disk error.
     """
 
 

@@ -49,6 +49,12 @@ class TSDB:
         self.compactionq = CompactionQueue(
             self, start_thread=start_compaction_thread)
         self._lock = threading.Lock()
+        # Optional deregistration hook: the CLI's open-TSDB sweep list
+        # (tools/cli._OPEN_TSDBS) sets this so shutdown() removes the
+        # entry — embedders calling make_tsdb() outside main() would
+        # otherwise accumulate hard references that pin closed stores
+        # (and their memtables) against GC forever.
+        self._deregister = None
         # ingest stats
         self.datapoints_added = 0
         # Streaming sketch state (stats/livesketch.py): loaded from the
@@ -629,9 +635,14 @@ class TSDB:
             # (ENOSPC is a first-class path): close releases the WAL's
             # single-writer flock, without which every later open of
             # this path in the process is refused.
-            close = getattr(self.store, "close", None)
-            if close:
-                close()
+            try:
+                close = getattr(self.store, "close", None)
+                if close:
+                    close()
+            finally:
+                dereg, self._deregister = self._deregister, None
+                if dereg:
+                    dereg()
 
     def collect_stats(self, collector) -> None:
         """Push internal counters into a StatsCollector (reference :129-175)."""
@@ -647,6 +658,9 @@ class TSDB:
         if wal_errs is not None:
             collector.record("storage.wal.swallowed_flush_errors",
                              wal_errs)
+        nshards = getattr(self.store, "shard_count", None)
+        if nshards is not None:
+            collector.record("storage.shards", nshards)
         cq = self.compactionq
         collector.record("compaction.count", cq.written_cells)
         collector.record("compaction.deleted_cells", cq.deleted_cells)

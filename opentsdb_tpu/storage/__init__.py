@@ -7,5 +7,6 @@ compare-and-set, a durability bit, and PleaseThrottle backpressure.
 """
 
 from opentsdb_tpu.storage.kv import Cell, KVStore, MemKVStore
+from opentsdb_tpu.storage.sharded import ShardedKVStore
 
-__all__ = ["Cell", "KVStore", "MemKVStore"]
+__all__ = ["Cell", "KVStore", "MemKVStore", "ShardedKVStore"]

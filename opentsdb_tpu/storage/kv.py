@@ -292,8 +292,14 @@ class MemKVStore(KVStore):
 
     def __init__(self, wal_path: str | None = None,
                  throttle_rows: int | None = None,
-                 fsync: bool = False, read_only: bool = False) -> None:
-        """``read_only=True`` opens another daemon's store WITHOUT the
+                 fsync: bool = False, read_only: bool = False,
+                 max_generations: int | None = None) -> None:
+        """``max_generations`` overrides the sstable generation cap
+        (default ``_MAX_GENERATIONS``); the sharded store staggers it
+        per shard so size-tiered collapses don't fire on the same
+        checkpoint across shards.
+
+        ``read_only=True`` opens another daemon's store WITHOUT the
         single-writer lock: a replica that serves reads over the same
         WAL + sstable generations while the writer keeps ingesting —
         the reference's N-TSDs-over-one-shared-store deployment shape
@@ -304,6 +310,11 @@ class MemKVStore(KVStore):
         to the writer's latest durable state."""
         self._tables: dict[str, _Table] = {}
         self._lock = threading.RLock()
+        if max_generations is not None:
+            if max_generations < 2:
+                raise ValueError(
+                    f"max_generations must be >= 2, got {max_generations}")
+            self._MAX_GENERATIONS = max_generations
         self.throttle_rows = throttle_rows
         self._fsync = fsync
         self._wal_path = wal_path
@@ -1034,7 +1045,24 @@ class MemKVStore(KVStore):
                         dst.write(src.read())
                         dst.flush()
                         os.fsync(dst.fileno())
-                    self._wal = open(self._wal_path, "wb")
+                    # Recreate the WAL under a GUARANTEED-FRESH inode
+                    # (empty tmp + os.replace) rather than truncating
+                    # in place: replicas key their suffix-replay
+                    # position on the WAL's inode, and an in-place 'wb'
+                    # kept the inode while resetting the offset — once
+                    # the regrown WAL crossed a replica's stale offset,
+                    # its replay seeked mid-record and could misparse
+                    # arbitrary bytes as records (frames carry no
+                    # checksum). tmp-then-replace, not unlink-then-
+                    # create: the tmp's inode is allocated while the
+                    # old WAL is still linked, so the filesystem cannot
+                    # hand the replacement the just-freed inode number
+                    # (tmpfs recycles eagerly). A crash in between
+                    # surfaces either WAL state; recovery replays
+                    # <wal>.old (which holds every record) first.
+                    tmp = self._wal_path + ".rotate"
+                    self._wal = open(tmp, "wb")
+                    os.replace(tmp, self._wal_path)
                 else:
                     os.replace(self._wal_path, old_path)
                     self._wal = open(self._wal_path, "ab")

@@ -40,6 +40,14 @@ def common_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--table", default="tsdb")
     p.add_argument("--uidtable", default="tsdb-uid")
     p.add_argument("--wal", default=None, help="WAL file path (shared state)")
+    p.add_argument("--shards", type=int, default=0,
+                   help="partition storage into N series-sharded KVStore "
+                        "shards; with N > 1 the --wal path is the store "
+                        "DIRECTORY (shard-<i>/ subdirs + SHARDS.json). "
+                        "0 = auto: sharded iff --wal already holds a "
+                        "SHARDS.json manifest (its count wins); an "
+                        "explicit N that disagrees with the manifest is "
+                        "a hard error")
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
     p.add_argument("--auto-metric", action="store_true",
                    help="automatically create metric UIDs (ingest)")
@@ -112,10 +120,34 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
             # read-only daemons (core/compaction.py).
             cfg.checkpoint_interval = 5.0
         cfg.mesh_devices = getattr(args, "mesh_devices", 0)
-    store = MemKVStore(wal_path=args.wal,
-                       read_only=getattr(args, "read_only", False))
+    read_only = getattr(args, "read_only", False)
+    shards = getattr(args, "shards", 0) or 0
+    from opentsdb_tpu.storage.sharded import manifest_path
+
+    manifest = manifest_path(args.wal) if args.wal else None
+    if shards > 1 or (manifest and os.path.exists(manifest)):
+        from opentsdb_tpu.storage.sharded import ShardedKVStore
+
+        # An explicit --shards (1 included) is passed through so a
+        # disagreement with the manifest is the promised hard error;
+        # only the 0 default defers to the manifest count.
+        store = ShardedKVStore(args.wal,
+                               shards=shards if shards >= 1 else None,
+                               data_table=args.table,
+                               read_only=read_only)
+        cfg.shards = store.shard_count
+    else:
+        store = MemKVStore(wal_path=args.wal, read_only=read_only)
     tsdb = TSDB(store, cfg, start_compaction_thread=start_thread)
-    _open_list().append(tsdb)
+    lst = _open_list()
+    lst.append(tsdb)
+    # Shutdown (idempotent, always reached via the main() sweep or the
+    # command's own cleanup) removes the entry, so embedders that call
+    # make_tsdb() directly don't pin every store they ever opened.
+    def _dereg(t=tsdb, lst=lst):
+        if t in lst:
+            lst.remove(t)
+    tsdb._deregister = _dereg
     return tsdb
 
 

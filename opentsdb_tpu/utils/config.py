@@ -21,6 +21,13 @@ class Config:
     wal_path: str | None = None
     fsync: bool = False
     throttle_rows: int | None = None
+    # Series-sharded storage (storage/sharded.py): partition rows by a
+    # stable hash of the series identity into N independent shards,
+    # each with its own memtable/WAL/sstable tier — parallel checkpoint
+    # spills, per-shard (~1/N-sized) merge pauses. 1 = the single
+    # MemKVStore. With persistence, wal_path is the store DIRECTORY
+    # and the count is pinned by its SHARDS.json manifest.
+    shards: int = 1
 
     # core behavior (names mirror the reference's system properties)
     auto_create_metrics: bool = False   # tsd.core.auto_create_metrics

@@ -107,12 +107,18 @@ class Attribution:
 
 
 def write_artifacts(out: dict) -> None:
-    """Size-suffixed artifact always; canonical BENCH_SCALE.json only
-    when this run is at least as large as the one it would replace
-    (VERDICT r03 item 4: a 2M smoke run silently clobbered the 100M
-    TPU proof)."""
+    """Size-suffixed artifact always (plus a _S<N> suffix for sharded
+    runs so a shards=1 control and its shards=N counterpart coexist);
+    canonical BENCH_SCALE.json only when this run is at least as large
+    as the one it would replace (VERDICT r03 item 4: a 2M smoke run
+    silently clobbered the 100M TPU proof)."""
     pts = out["ingest"]["points"]
-    suffixed = os.path.join(REPO, f"BENCH_SCALE_{pts // 1_000_000}M.json")
+    # An explicit --shards (1 included) marks a sharding-comparison
+    # run: it gets its own _S<N> name so a shards=1 control never
+    # clobbers the legacy default-engine artifact for that size.
+    ssfx = (f"_S{out['shards']}" if out.get("shards") else "")
+    suffixed = os.path.join(
+        REPO, f"BENCH_SCALE_{pts // 1_000_000}M{ssfx}.json")
     with open(suffixed, "w") as f:
         json.dump(out, f, indent=2)
     canonical = os.path.join(REPO, "BENCH_SCALE.json")
@@ -145,6 +151,14 @@ def main() -> int:
                          "ingested points (0=only at end) — the "
                          "steady-state daemon shape: bounded RSS and "
                          "bounded recovery time under sustained ingest")
+    ap.add_argument("--shards", type=int, default=0,
+                    help="series-shard the store N ways "
+                         "(storage/sharded.py): per-shard WALs and "
+                         "sstable tiers, parallel checkpoint spills, "
+                         "staggered tiered collapses. Any explicit "
+                         "value (1 included) writes a _S<N>-suffixed "
+                         "artifact; the default keeps the legacy "
+                         "single-store naming")
     ap.add_argument("--workdir", default="/tmp/tsdb_scale")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
@@ -167,6 +181,7 @@ def main() -> int:
     from opentsdb_tpu.core.tsdb import TSDB
     from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
     from opentsdb_tpu.storage.kv import MemKVStore
+    from opentsdb_tpu.storage.sharded import ShardedKVStore
     from opentsdb_tpu.utils.config import Config
     from opentsdb_tpu.utils.gctune import tune_for_ingest
     from opentsdb_tpu.utils.nativeext import ext as native_ext
@@ -175,9 +190,20 @@ def main() -> int:
     shutil.rmtree(args.workdir, ignore_errors=True)
     os.makedirs(args.workdir)
     wal = os.path.join(args.workdir, "wal")
-    cfg = Config(auto_create_metrics=True, wal_path=wal)
-    tsdb = TSDB(MemKVStore(wal_path=wal), cfg,
-                start_compaction_thread=False)
+    if args.shards > 1:
+        store = ShardedKVStore(args.workdir, shards=args.shards)
+        wal_paths = [s._wal_path for s in store.shards]
+    else:
+        store = MemKVStore(wal_path=wal)
+        wal_paths = [wal]
+
+    def wal_bytes() -> int:
+        return sum(os.path.getsize(p) for p in wal_paths
+                   if os.path.exists(p))
+
+    cfg = Config(auto_create_metrics=True, wal_path=wal,
+                 shards=max(args.shards, 1))
+    tsdb = TSDB(store, cfg, start_compaction_thread=False)
     tune_for_ingest()
 
     base = 1356998400
@@ -187,6 +213,7 @@ def main() -> int:
     rng = np.random.default_rng(7)
 
     out = {"device": str(dev), "target_points": args.points,
+           "shards": args.shards,
            "series": args.series, "span_s": args.span,
            "points_per_series": pps, "step_s": step,
            "block_points": block, "workload": "time-major",
@@ -201,6 +228,12 @@ def main() -> int:
     if hasattr(tsdb.store, "_wal_append_batch_columnar"):
         attr.wrap(tsdb.store, "_wal_append_batch_columnar", "kv.wal",
                   nested_in="kv.put_batch")
+    elif hasattr(tsdb.store, "shards"):
+        # Sharded store: the WAL writes happen inside each shard; all
+        # shards accumulate into the one kv.wal label.
+        for s in tsdb.store.shards:
+            attr.wrap(s, "_wal_append_batch_columnar", "kv.wal",
+                      nested_in="kv.put_batch")
     if tsdb.devwindow is not None:
         attr.wrap(tsdb.devwindow, "append", "devwindow.append")
     attr.wrap(tsdb, "_observe", "sketch.observe")
@@ -246,7 +279,14 @@ def main() -> int:
         if t is not None and t.is_alive():
             t0 = time.perf_counter()
             t.join()
-            ckpt["wait_s"] += time.perf_counter() - t0
+            blocked = time.perf_counter() - t0
+            ckpt["wait_s"] += blocked
+            # The blocked join is the pause ingest actually OBSERVES
+            # mid-checkpoint (the spill itself is overlapped); record
+            # it on the checkpoint that caused it so worst-single-pause
+            # is in the artifact, not just the sum.
+            if mid_ckpts:
+                mid_ckpts[-1]["blocked_s"] = round(blocked, 1)
         ckpt["thread"] = None
         if ckpt["error"] is not None:
             # A swallowed spill failure would publish an artifact whose
@@ -352,7 +392,12 @@ def main() -> int:
     attr.acc["checkpoint.wait"] = ckpt["wait_s"]
     attr.acc["gc"] = gc_acc["s"]
     out["ingest"]["attribution"] = attr.table(ingest_s - synth_s)
-    out["wal_bytes"] = os.path.getsize(wal) if os.path.exists(wal) else 0
+    if mid_ckpts:
+        out["ingest"]["worst_ckpt_blocked_s"] = max(
+            m.get("blocked_s", 0.0) for m in mid_ckpts)
+        out["ingest"]["worst_ckpt_wall_s"] = max(
+            m["wall_s"] for m in mid_ckpts)
+    out["wal_bytes"] = wal_bytes()
     if mid_ckpts:
         out["mid_checkpoints"] = mid_ckpts
     log(f"ingested {total:,} in {ingest_s:,.0f}s "
@@ -437,8 +482,7 @@ def main() -> int:
         "wall_s": round(time.perf_counter() - t0, 1),
         "rows_spilled": rows,
         "dir_bytes": du(args.workdir),
-        "wal_bytes_after": (os.path.getsize(wal)
-                            if os.path.exists(wal) else 0),
+        "wal_bytes_after": wal_bytes(),
     }
     log(f"checkpoint: {out['checkpoint']}")
 

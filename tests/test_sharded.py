@@ -1,0 +1,415 @@
+"""Series-sharded storage (storage/sharded.py): routing stability,
+cross-shard scan fan-in, per-shard crash/replay, shard-count pinning,
+parallel checkpoint spills, replica refresh across shards, and golden
+query parity between shards=1 and shards=4 on the same ingest.
+
+Also holds the ADVICE-r05 regression for the crash-recovered checkpoint
+path: the WAL must be recreated under a fresh inode (not truncated in
+place) so replicas' suffix-replay inode check fires.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.errors import (PleaseThrottleError,
+                                       ReadOnlyStoreError)
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.storage.kv import Cell, MemKVStore
+from opentsdb_tpu.storage.sharded import ShardedKVStore
+from opentsdb_tpu.utils.config import Config
+
+T = "tsdb"
+F = b"t"
+BT = 1356998400
+
+
+def rowkey(tag: int, hour: int = 0, metric: int = 1) -> bytes:
+    """3B metric + 4B base time + one 6B (tagk, tagv) pair."""
+    return (metric.to_bytes(3, "big")
+            + struct.pack(">I", BT + hour * 3600)
+            + b"\x00\x00\x01" + tag.to_bytes(3, "big"))
+
+
+class TestRouting:
+    def test_series_hours_colocate_and_series_spread(self):
+        s = ShardedKVStore(None, shards=4)
+        for tag in range(32):
+            shards = {s._route(T, rowkey(tag, hour)) for hour in range(8)}
+            assert len(shards) == 1, "one series straddled shards"
+        spread = {s._route(T, rowkey(tag)) for tag in range(32)}
+        assert len(spread) > 1, "32 series all hashed to one shard"
+
+    def test_non_data_table_routes_whole_key(self):
+        s = ShardedKVStore(None, shards=4)
+        # Short keys and foreign tables must not be misparsed as row
+        # keys; the same key always routes to the same shard.
+        for key in (b"m", b"maxid", b"some-name", rowkey(3)):
+            assert s._route("tsdb-uid", key) == s._route("tsdb-uid", key)
+
+    def test_route_stable_across_instances(self, tmp_path):
+        a = ShardedKVStore(str(tmp_path / "s"), shards=4)
+        keys = [rowkey(t, h) for t in range(16) for h in range(2)]
+        routes = [a._route(T, k) for k in keys]
+        for k in keys:
+            a.put(T, k, F, b"q", b"v")
+        a.close()
+        b = ShardedKVStore(str(tmp_path / "s"))
+        assert [b._route(T, k) for k in keys] == routes
+        for k in keys:
+            assert b.get(T, k, F) == [Cell(k, F, b"q", b"v")]
+        b.close()
+
+
+class TestFanIn:
+    def test_scan_is_globally_ordered(self):
+        s = ShardedKVStore(None, shards=4)
+        keys = [rowkey(t, h) for t in range(20) for h in range(3)]
+        for k in reversed(keys):
+            s.put(T, k, F, b"q", b"v" + k[-1:])
+        assert [c[0].key for c in s.scan(T, b"", b"")] == sorted(keys)
+        assert [r[0] for r in s.scan_raw(T, b"", b"")] == sorted(keys)
+
+    def test_scan_range_and_regexp(self):
+        s = ShardedKVStore(None, shards=3)
+        for t in range(10):
+            s.put(T, rowkey(t), F, b"q", b"v")
+        lo, hi = rowkey(2), rowkey(7)
+        got = [c[0].key for c in s.scan(T, lo, hi)]
+        assert got == sorted(rowkey(t) for t in range(2, 7))
+        rx = b"(?s)^.{7}.{3}" + struct.pack(">I", 4)[1:] + b"$"
+        got = [c[0].key for c in s.scan(T, b"", b"", key_regexp=rx)]
+        assert got == [rowkey(4)]
+
+    def test_point_ops_route(self):
+        s = ShardedKVStore(None, shards=4)
+        k = rowkey(9)
+        s.put(T, k, F, b"q1", b"a")
+        s.put(T, k, F, b"q2", b"b")
+        assert s.has_row(T, k) and not s.has_row(T, rowkey(10))
+        assert s.cell_count(T, k) == 2
+        s.delete(T, k, F, [b"q1"])
+        assert s.cell_count(T, k) == 1
+        s.delete_row(T, k)
+        assert not s.has_row(T, k)
+        assert s.atomic_increment("u", b"ctr", F, b"q", 5) == 5
+        assert s.atomic_increment("u", b"ctr", F, b"q", 2) == 7
+        assert s.compare_and_set("u", b"cas", F, b"q", None, b"x")
+        assert not s.compare_and_set("u", b"cas", F, b"q", None, b"y")
+
+    def test_columnar_mixed_batch_routes_per_series(self):
+        s = ShardedKVStore(None, shards=4)
+        keys = [rowkey(t) for t in range(12)]
+        blob = b"".join(keys)
+        flags = s.put_many_columnar(T, F, blob, 13,
+                                    [b"q"] * 12, [b"v"] * 12)
+        assert flags == [False] * 12
+        for k in keys:
+            assert s.get(T, k, F) == [Cell(k, F, b"q", b"v")]
+        # second pass: every row exists now
+        flags = s.put_many_columnar(T, F, blob, 13,
+                                    [b"r"] * 12, [b"w"] * 12)
+        assert flags == [True] * 12
+
+    def test_put_many_groups_and_flags(self):
+        s = ShardedKVStore(None, shards=3)
+        cells = [(rowkey(t), b"q", b"v") for t in range(9)]
+        assert s.put_many(T, F, cells) == [False] * 9
+        cells2 = cells[:4] + [(rowkey(99), b"q", b"v")]
+        assert s.put_many(T, F, cells2) == [True] * 4 + [False]
+
+    def test_throttle_partial_existed_full_length(self):
+        s = ShardedKVStore(None, shards=2, throttle_rows=4)  # 2/shard
+        cells = [(rowkey(t), b"q", b"v") for t in range(16)]
+        with pytest.raises(PleaseThrottleError) as ei:
+            s.put_many(T, F, cells)
+        part = ei.value.partial_existed
+        assert len(part) == 16  # full-length, False = did not apply
+        assert s.row_count(T) <= 4
+
+
+class TestPersistence:
+    def test_manifest_pins_shard_count(self, tmp_path):
+        d = str(tmp_path / "store")
+        s = ShardedKVStore(d, shards=4)
+        s.put(T, rowkey(1), F, b"q", b"v")
+        s.close()
+        with pytest.raises(ValueError, match="shard-count mismatch"):
+            ShardedKVStore(d, shards=2)
+        with pytest.raises(ValueError, match="data-table mismatch"):
+            ShardedKVStore(d, data_table="other")
+        s2 = ShardedKVStore(d)  # auto from manifest
+        assert s2.shard_count == 4
+        s2.close()
+        with pytest.raises(ValueError, match="no SHARDS.json"):
+            ShardedKVStore(str(tmp_path / "nope"), shards=None)
+        with pytest.raises(FileNotFoundError):
+            ShardedKVStore(str(tmp_path / "nope"), shards=4,
+                           read_only=True)
+
+    def test_crash_replay_per_shard(self, tmp_path):
+        d = str(tmp_path / "store")
+        s = ShardedKVStore(d, shards=3)
+        keys = [rowkey(t, h) for t in range(12) for h in range(2)]
+        s.put_many(T, F, [(k, b"q", b"v" + k[-1:]) for k in keys])
+        s._simulate_crash()  # flock released, nothing flushed cleanly
+        s2 = ShardedKVStore(d)
+        assert [c[0].key for c in s2.scan(T, b"", b"")] == sorted(keys)
+        for k in keys:
+            assert s2.get(T, k, F) == [Cell(k, F, b"q", b"v" + k[-1:])]
+        s2.close()
+
+    def test_checkpoint_spills_all_shards_and_reopens(self, tmp_path):
+        d = str(tmp_path / "store")
+        s = ShardedKVStore(d, shards=4)
+        keys = [rowkey(t, h) for t in range(16) for h in range(2)]
+        s.put_many(T, F, [(k, b"q", b"v") for k in keys])
+        assert s.checkpoint() == len(keys)
+        # Each occupied shard's WAL truncated, data now in its sstable.
+        for sh in s.shards:
+            assert os.path.getsize(sh._wal_path) == 0
+        s.put(T, rowkey(99), F, b"q", b"post")  # post-checkpoint WAL
+        s.close()
+        s2 = ShardedKVStore(d)
+        assert s2.row_count(T) == len(keys) + 1
+        assert s2.get(T, rowkey(99), F) == [
+            Cell(rowkey(99), F, b"q", b"post")]
+        s2.close()
+
+    def test_staggered_generation_caps(self):
+        s = ShardedKVStore(None, shards=4)
+        caps = [sh._MAX_GENERATIONS for sh in s.shards]
+        assert len(set(caps)) == 4, (
+            "equal caps re-align every shard's tiered collapse onto "
+            "the same checkpoint")
+
+    def test_replica_refresh_across_shards(self, tmp_path):
+        d = str(tmp_path / "store")
+        w = ShardedKVStore(d, shards=3)
+        w.put(T, rowkey(1), F, b"q", b"v1")
+        r = ShardedKVStore(d, read_only=True)
+        assert r.read_only and r.shard_count == 3
+        assert r.get(T, rowkey(1), F) == [Cell(rowkey(1), F, b"q", b"v1")]
+        with pytest.raises(ReadOnlyStoreError):
+            r.put(T, rowkey(5), F, b"q", b"v")
+        assert r.checkpoint() == 0
+        for t in range(2, 8):
+            w.put(T, rowkey(t), F, b"q", b"v")
+        assert r.refresh() is True
+        assert r.row_count(T) == 7
+        before = r.rebuilds
+        w.checkpoint()
+        assert r.refresh() is True
+        assert r.rebuilds > before  # rotation forces per-shard rebuilds
+        assert r.row_count(T) == 7
+        r.close()
+        w.close()
+
+
+class TestGoldenParity:
+    """shards=1 vs shards=4 must answer queries identically: aggregates
+    bit-exact, sketch estimates equal (the sketches fold above the
+    shard layer in the same order, so they are byte-identical too)."""
+
+    @staticmethod
+    def _build(store):
+        cfg = Config(auto_create_metrics=True, device_window=False)
+        tsdb = TSDB(store, cfg, start_compaction_thread=False)
+        rng = np.random.default_rng(7)
+        for si in range(8):
+            ts = BT + np.arange(400, dtype=np.int64) * 41 + si
+            vals = np.cumsum(rng.normal(0, 1, 400)) + si
+            tsdb.add_batch("par.metric", ts, vals,
+                           {"host": f"h{si}", "dc": f"d{si % 2}"})
+        return tsdb
+
+    def test_golden_queries_match(self):
+        t1 = self._build(MemKVStore())
+        t4 = self._build(ShardedKVStore(None, shards=4))
+        e1, e4 = QueryExecutor(t1), QueryExecutor(t4)
+        end = BT + 400 * 41 + 10
+        specs = [
+            QuerySpec("par.metric", {}, "sum", downsample=(600, "avg")),
+            QuerySpec("par.metric", {}, "sum", rate=True,
+                      downsample=(600, "avg")),
+            QuerySpec("par.metric", {}, "p95", downsample=(600, "avg")),
+            QuerySpec("par.metric", {"dc": "*"}, "sum",
+                      downsample=(600, "avg")),
+            QuerySpec("par.metric", {}, "max"),  # un-downsampled grid
+        ]
+        for spec in specs:
+            r1, r4 = e1.run(spec, BT, end), e4.run(spec, BT, end)
+            assert len(r1) == len(r4)
+            for a, b in zip(r1, r4):
+                assert a.tags == b.tags
+                assert a.aggregated_tags == b.aggregated_tags
+                assert np.array_equal(a.timestamps, b.timestamps)
+                assert np.array_equal(a.values, b.values), spec
+        # Streaming sketch paths: p-quantiles and HLL cardinality.
+        assert (e1.sketch_quantiles("par.metric", {}, [0.5, 0.95, 0.99])
+                == e4.sketch_quantiles("par.metric", {},
+                                       [0.5, 0.95, 0.99]))
+        assert (e1.distinct_tagv("par.metric", {}, "host", BT, end)
+                == e4.distinct_tagv("par.metric", {}, "host", BT, end))
+        t1.shutdown()
+        t4.shutdown()
+
+    def test_persistent_parity_across_checkpoint_reopen(self, tmp_path):
+        t4 = self._build(ShardedKVStore(str(tmp_path / "s4"), shards=4))
+        t1 = self._build(MemKVStore())
+        t4.checkpoint()
+        t4.shutdown()
+        cfg = Config(auto_create_metrics=True, device_window=False)
+        t4b = TSDB(ShardedKVStore(str(tmp_path / "s4")), cfg,
+                   start_compaction_thread=False)
+        e1, e4 = QueryExecutor(t1), QueryExecutor(t4b)
+        end = BT + 400 * 41 + 10
+        spec = QuerySpec("par.metric", {}, "sum", downsample=(600, "avg"))
+        r1, r4 = e1.run(spec, BT, end), e4.run(spec, BT, end)
+        assert np.array_equal(r1[0].timestamps, r4[0].timestamps)
+        assert np.array_equal(r1[0].values, r4[0].values)
+        t1.shutdown()
+        t4b.shutdown()
+
+
+class TestWalRotationFreshInode:
+    """ADVICE r05 satellite: the crash-recovered .old checkpoint path
+    used to truncate the WAL in place (same inode), so a replica's
+    suffix-replay inode check could not fire and a later poll could
+    misparse mid-record garbage. The fix recreates the WAL under a
+    fresh inode; a replica must detect the rotation and rebuild."""
+
+    def _fail_one_spill(self, store, monkeypatch):
+        """Make the next checkpoint fail during phase 2, leaving
+        <wal>.old on disk (the crash-recovered state)."""
+        import opentsdb_tpu.storage.kv as kvmod
+        real = kvmod.write_sstable_bulk
+        calls = {"n": 0}
+
+        def boom(*a, **k):
+            calls["n"] += 1
+            raise OSError("simulated spill failure (disk full)")
+
+        monkeypatch.setattr(kvmod, "write_sstable_bulk", boom)
+        with pytest.raises(OSError):
+            store.checkpoint()
+        monkeypatch.setattr(kvmod, "write_sstable_bulk", real)
+        assert calls["n"] == 1
+        assert os.path.exists(store._wal_path + ".old")
+
+    def test_recovered_old_checkpoint_rotates_wal_inode(
+            self, tmp_path, monkeypatch):
+        wal = str(tmp_path / "wal")
+        w = MemKVStore(wal_path=wal)
+        w.put(T, rowkey(1), F, b"q", b"v1")
+        self._fail_one_spill(w, monkeypatch)
+        w.put(T, rowkey(2), F, b"q", b"v2")
+        ino_before = os.stat(wal).st_ino
+        # This checkpoint takes the .old-append branch (a .old file
+        # already exists) — the WAL must come back as a NEW inode.
+        assert w.checkpoint() > 0
+        assert os.stat(wal).st_ino != ino_before, (
+            "WAL truncated in place: replicas' inode check defeated")
+        w.close()
+
+    def test_replica_detects_rotation_after_recovered_checkpoint(
+            self, tmp_path, monkeypatch):
+        wal = str(tmp_path / "wal")
+        w = MemKVStore(wal_path=wal)
+        w.put(T, rowkey(1), F, b"q", b"v1")
+        r = MemKVStore(wal_path=wal, read_only=True)
+        assert r.get(T, rowkey(1), F) == [Cell(rowkey(1), F, b"q", b"v1")]
+        self._fail_one_spill(w, monkeypatch)
+        w.put(T, rowkey(2), F, b"q", b"v2")
+        assert w.checkpoint() > 0  # .old-append branch, fresh WAL inode
+        # Writes into the regrown WAL cross the replica's stale offset;
+        # the replica must rebuild (inode changed), not suffix-replay.
+        for t in range(3, 7):
+            w.put(T, rowkey(t), F, b"q", b"v%d" % t)
+        assert r.refresh() is True
+        for t in range(1, 7):
+            assert [c.value for c in r.get(T, rowkey(t), F)] \
+                == [b"v%d" % t], t
+        assert r.row_count(T) == 6
+        r.close()
+        w.close()
+
+
+class TestTsdbIntegration:
+    def test_tsdb_over_sharded_store_checkpoints_and_recovers(
+            self, tmp_path):
+        d = str(tmp_path / "store")
+        cfg = Config(auto_create_metrics=True, device_window=False)
+        tsdb = TSDB(ShardedKVStore(d, shards=4), cfg,
+                    start_compaction_thread=False)
+        ts = BT + np.arange(1000, dtype=np.int64) * 13
+        for si in range(6):
+            tsdb.add_batch("it.metric", ts, np.full(1000, float(si)),
+                           {"host": f"h{si}"})
+        assert tsdb.checkpoint() > 0
+        tsdb.store._simulate_crash()
+        tsdb2 = TSDB(ShardedKVStore(d), cfg,
+                     start_compaction_thread=False)
+        ex = QueryExecutor(tsdb2, backend="cpu")
+        res = ex.run(QuerySpec("it.metric", {}, "sum"), BT, int(ts[-1]))
+        assert len(res) == 1
+        assert np.allclose(res[0].values, 15.0)  # 0+1+..+5
+        assert len(res[0].timestamps) == 1000
+        tsdb2.shutdown()
+
+    def test_stats_record_shard_count(self):
+        from opentsdb_tpu.stats.collector import StatsCollector
+        cfg = Config(auto_create_metrics=True, device_window=False,
+                     enable_sketches=False)
+        tsdb = TSDB(ShardedKVStore(None, shards=4), cfg,
+                    start_compaction_thread=False)
+        coll = StatsCollector("tsd")
+        tsdb.collect_stats(coll)
+        assert any("storage.shards" in ln for ln in coll.lines)
+        tsdb.shutdown()
+
+    def test_failed_creation_removes_fresh_manifest(self, tmp_path,
+                                                    monkeypatch):
+        """A first-time creation that dies mid-shard-open must not
+        leave SHARDS.json behind pinning a count for an empty store —
+        a retry with a different N would hard-error forever."""
+        import opentsdb_tpu.storage.sharded as sh_mod
+
+        d = str(tmp_path / "store")
+        real_init = MemKVStore.__init__
+        calls = {"n": 0}
+
+        def boom(self, *a, **k):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise OSError("simulated stale shard lock")
+            real_init(self, *a, **k)
+
+        monkeypatch.setattr(MemKVStore, "__init__", boom)
+        with pytest.raises(OSError):
+            ShardedKVStore(d, shards=4)
+        monkeypatch.undo()
+        assert not os.path.exists(sh_mod.manifest_path(d))
+        s = ShardedKVStore(d, shards=8)  # retry with a different N: ok
+        assert s.shard_count == 8
+        s.close()
+
+    def test_routing_param_mismatch_is_hard_error(self, tmp_path):
+        """The manifest pins the routing byte ranges, not just the
+        count: a build hashing different key bytes must be refused,
+        not silently mis-route point ops."""
+        import json
+
+        import opentsdb_tpu.storage.sharded as sh_mod
+
+        d = str(tmp_path / "store")
+        ShardedKVStore(d, shards=2).close()
+        man = sh_mod.manifest_path(d)
+        rec = json.load(open(man))
+        rec["series_bytes_excluded"] = [4, 9]
+        json.dump(rec, open(man, "w"))
+        with pytest.raises(ValueError, match="routing mismatch"):
+            ShardedKVStore(d)

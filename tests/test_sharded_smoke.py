@@ -1,0 +1,59 @@
+"""Tier-1 sharded-store smoke: a ~2M-point ingest into a 4-shard
+persistent store, checkpoint, crash-reopen, and a verified query — the
+fast end-to-end gate that fails fast when shard routing, the parallel
+spill, or the cross-shard fan-in regress. Sketches and the device
+window are off so the run times the storage engine, not the folds."""
+
+import numpy as np
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.storage.sharded import ShardedKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+SERIES = 20
+PPS = 100_000           # points per series -> 2M total
+STEP = 30
+
+
+def test_two_million_point_four_shard_smoke(tmp_path):
+    d = str(tmp_path / "store")
+    cfg = Config(auto_create_metrics=True, enable_sketches=False,
+                 device_window=False, shards=4)
+    tsdb = TSDB(ShardedKVStore(d, shards=4), cfg,
+                start_compaction_thread=False)
+    ts = BT + np.arange(PPS, dtype=np.int64) * STEP
+    for si in range(SERIES):
+        n = tsdb.add_batch("smoke.metric", ts,
+                           np.full(PPS, float(si), np.float64),
+                           {"host": f"h{si:02d}"})
+        assert n == PPS
+    assert tsdb.datapoints_added == SERIES * PPS
+    # All four shards actually carry data (routing spread the series).
+    occupied = sum(1 for s in tsdb.store.shards
+                   if s.memtable_keys(cfg.table))
+    assert occupied == 4
+    rows = tsdb.checkpoint()
+    assert rows > 0
+    # Spill truncated every shard's WAL (recovery stays bounded).
+    for s in tsdb.store.shards:
+        import os
+        assert os.path.getsize(s._wal_path) == 0
+    tsdb.store._simulate_crash()
+
+    # Reopen (shard count from the manifest) and verify a query: each
+    # series is the constant float(si), so an un-downsampled sum grid
+    # is flat at sum(range(SERIES)) and covers every timestamp.
+    tsdb2 = TSDB(ShardedKVStore(d), cfg, start_compaction_thread=False)
+    ex = QueryExecutor(tsdb2, backend="cpu")
+    res = ex.run(QuerySpec("smoke.metric", {}, "sum",
+                           downsample=(3600, "avg")),
+                 BT, int(ts[-1]))
+    assert len(res) == 1
+    expect = float(sum(range(SERIES)))
+    assert np.allclose(res[0].values, expect)
+    # 100k points x 30 s = 3M s of data -> one bucket per hour, end
+    # bucket included.
+    assert len(res[0].timestamps) == (PPS * STEP - STEP) // 3600 + 1
+    tsdb2.shutdown()

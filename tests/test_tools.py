@@ -222,3 +222,67 @@ class TestDispatcherCleanup:
         assert main(["query", "--wal", wal, str(BT), str(BT + 10),
                      "sum", "m.x"]) == 0
         assert "m.x" in capsys.readouterr().out
+
+
+class TestShardedCli:
+    def test_import_query_scan_fsck_over_sharded_store(
+            self, tmp_path, capsys):
+        """--shards N round trip: import creates <wal>/shard-<i>/ dirs
+        + SHARDS.json; later commands pick the count up from the
+        manifest automatically (no --shards needed)."""
+        import os
+
+        d = str(tmp_path / "store")
+        f = write_datafile(tmp_path / "data.txt", [
+            f"sh.metric {BT + i * 10} {i} host=web{i % 4:02d}"
+            for i in range(40)
+        ])
+        assert main(["import", "--wal", d, "--shards", "4", f]) == 0
+        assert os.path.exists(os.path.join(d, "SHARDS.json"))
+        shard_dirs = [n for n in os.listdir(d) if n.startswith("shard-")]
+        assert sorted(shard_dirs) == [f"shard-{i}" for i in range(4)]
+        capsys.readouterr()
+
+        # Auto-detect from the manifest (no --shards flag).
+        assert main(["query", "--wal", d, str(BT), str(BT + 400),
+                     "sum", "sh.metric"]) == 0
+        out = capsys.readouterr().out.strip().split("\n")
+        assert len(out) == 40
+
+        # Mismatched explicit count is the hard error — including an
+        # explicit --shards 1 (it must not silently defer to the
+        # manifest like the 0 default does).
+        for n in ("2", "1"):
+            with pytest.raises(ValueError, match="shard-count mismatch"):
+                main(["query", "--wal", d, "--shards", n, str(BT),
+                      "sum", "sh.metric"])
+        capsys.readouterr()
+
+        assert main(["fsck", "--wal", d]) == 0
+        assert "Found 0 errors" in capsys.readouterr().out
+
+        assert main(["scan", "--wal", d, "--import", str(BT),
+                     "sh.metric"]) == 0
+        lines = [ln for ln in capsys.readouterr().out.splitlines()
+                 if ln.startswith("sh.metric")]
+        assert len(lines) == 40
+
+    def test_shutdown_deregisters_from_open_list(self, wal):
+        """ADVICE r05: embedders calling make_tsdb() outside main()
+        must not pin every TSDB they ever opened — shutdown removes
+        the dispatcher-sweep entry."""
+        import argparse
+
+        from opentsdb_tpu.tools import cli as cli_mod
+
+        args = argparse.Namespace(
+            table="tsdb", uidtable="tsdb-uid", wal=wal, backend="cpu",
+            auto_metric=True, read_only=False, verbose=False)
+        before = len(cli_mod._open_list())
+        tsdb = cli_mod.make_tsdb(args)
+        assert len(cli_mod._open_list()) == before + 1
+        tsdb.shutdown()
+        assert len(cli_mod._open_list()) == before
+        # Idempotent: a second shutdown doesn't corrupt the list.
+        tsdb.shutdown()
+        assert len(cli_mod._open_list()) == before
