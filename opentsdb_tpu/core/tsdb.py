@@ -85,6 +85,18 @@ class TSDB:
                 staging_points=self.config.device_window_staging,
                 max_points=self.config.device_window_points)
             self._warm_devwindow()
+        # Materialized rollup tier (rollup/tier.py): writer daemons
+        # with a persistent store only — an in-memory store never
+        # spills, so every window would stay memtable-dirty and the
+        # planner could never serve a summary; a replica neither owns
+        # the fold nor the tier's state file.
+        self.rollups = None
+        if (self.config.enable_rollups
+                and not getattr(store, "read_only", False)
+                and getattr(store, "_wal_path", None)):
+            from opentsdb_tpu.rollup.tier import RollupTier
+
+            self.rollups = RollupTier(self, self.config)
 
     def _warm_devwindow(self) -> None:
         """Mirror pre-existing storage (WAL-replayed memtable + sstable
@@ -611,8 +623,19 @@ class TSDB:
         path = self._sketch_path()
         if self.sketches is not None and path:
             self.sketches.save(path)
+        # Rollup tier brackets the spill: mark the about-to-spill
+        # windows in flight (and the tier pending on disk) BEFORE the
+        # raw spill, fold the spilled keys into summary records after —
+        # a crash in between leaves the pending marker and the next
+        # open rebuilds (rollup/tier.py consistency contract).
+        rollups = getattr(self, "rollups", None)  # early-timer safety
+        if rollups is not None:
+            rollups.begin_spill()
         ckpt = getattr(self.store, "checkpoint", None)
-        return ckpt() if ckpt else 0
+        rows = ckpt() if ckpt else 0
+        if rollups is not None:
+            rollups.fold_after_spill()
+        return rows
 
     def shutdown(self) -> None:
         # Idempotent: the CLI dispatcher sweeps any TSDB a command
@@ -640,9 +663,13 @@ class TSDB:
                 if close:
                     close()
             finally:
-                dereg, self._deregister = self._deregister, None
-                if dereg:
-                    dereg()
+                try:
+                    if getattr(self, "rollups", None) is not None:
+                        self.rollups.close()
+                finally:
+                    dereg, self._deregister = self._deregister, None
+                    if dereg:
+                        dereg()
 
     def collect_stats(self, collector) -> None:
         """Push internal counters into a StatsCollector (reference :129-175)."""
@@ -671,3 +698,5 @@ class TSDB:
                              self.sketches.series_count())
         if self.devwindow is not None:
             self.devwindow.collect_stats(collector)
+        if self.rollups is not None:
+            self.rollups.collect_stats(collector)

@@ -94,9 +94,13 @@ def pallas_segment_sum(feat: jnp.ndarray, seg: jnp.ndarray,
     n_tiles = nseg_pad // SEG_TILE
 
     # Under shard_map the out_shape needs the inputs' varying-manual-axes
-    # set, or tracing rejects the pallas_call (check_vma).
-    out_shape = jax.ShapeDtypeStruct((nseg_pad, k), jnp.float32,
-                                     vma=jax.typeof(feat).vma)
+    # set, or tracing rejects the pallas_call (check_vma). Older jax
+    # (pre-typeof/vma) has no such check — a plain struct is correct.
+    try:
+        out_shape = jax.ShapeDtypeStruct((nseg_pad, k), jnp.float32,
+                                         vma=jax.typeof(feat).vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct((nseg_pad, k), jnp.float32)
     out = pl.pallas_call(
         _seg_sum_kernel,
         grid=(n_tiles, n_chunks),

@@ -29,7 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from opentsdb_tpu.ops import sketches
 from opentsdb_tpu.ops.kernels import downsample_group
-from opentsdb_tpu.parallel.mesh import EXPERT_AXIS
+from opentsdb_tpu.parallel.mesh import EXPERT_AXIS, shard_map
 
 FAMILIES = ("moment", "percentile", "cardinality")
 FAMILY_ID = {name: i for i, name in enumerate(FAMILIES)}
@@ -206,7 +206,7 @@ def expert_query_step(fam, ts, vals, items, sid, valid, *, mesh,
             ts[0], vals[0], items[0], sid[0], valid[0])
         return v[None], m[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(EXPERT_AXIS),) * 6,
         out_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS)))

@@ -40,7 +40,7 @@ from jax.sharding import PartitionSpec as P
 from opentsdb_tpu.core.const import NOLERP_AGGS
 from opentsdb_tpu.ops import sketches
 from opentsdb_tpu.ops.kernels import _finish
-from opentsdb_tpu.parallel.mesh import HOST_AXIS, SERIES_AXIS
+from opentsdb_tpu.parallel.mesh import HOST_AXIS, SERIES_AXIS, shard_map
 from opentsdb_tpu.parallel.sharded import _local_group_moments
 
 
@@ -138,7 +138,7 @@ def hybrid_downsample_group(ts, vals, sid, valid, *, mesh,
         out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
         return out[None], g_any[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 4,
         out_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2)
@@ -158,7 +158,7 @@ def hybrid_hll_distinct(items, valid, *, mesh, p: int = 14):
         merged = jax.lax.pmax(host, HOST_AXIS)
         return sketches.hll_estimate(merged)[None]
 
-    fn = jax.shard_map(shard_fn, mesh=mesh,
+    fn = shard_map(shard_fn, mesh=mesh,
                        in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2,
                        out_specs=P((HOST_AXIS, SERIES_AXIS)))
     return fn(items, valid)[0]
@@ -186,7 +186,7 @@ def hybrid_tdigest(values, valid, qs, *, mesh, compression: int = 128):
         gm, gw = sketches._compress(gm, gw, compression=compression)
         return sketches.tdigest_quantile(gm, gw, qs)[None]
 
-    fn = jax.shard_map(shard_fn, mesh=mesh,
+    fn = shard_map(shard_fn, mesh=mesh,
                        in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2,
                        out_specs=P((HOST_AXIS, SERIES_AXIS)))
     return fn(values, valid)[0]

@@ -36,7 +36,7 @@ from opentsdb_tpu.ops.kernels import (
     masked_quantile_groups,
     step_fill,
 )
-from opentsdb_tpu.parallel.mesh import SERIES_AXIS
+from opentsdb_tpu.parallel.mesh import SERIES_AXIS, shard_map
 
 
 def _local_filled(ts, vals, sid, valid, *, num_series, num_buckets,
@@ -130,7 +130,7 @@ def sharded_downsample_group(ts, vals, sid, valid, *, mesh,
         out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
         return out[None], g_any[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(SERIES_AXIS), P(SERIES_AXIS), P(SERIES_AXIS),
                   P(SERIES_AXIS)),
@@ -181,7 +181,7 @@ def sharded_downsample_quantile(ts, vals, sid, valid, q, *, mesh,
             sm.any(axis=0).astype(jnp.int32), SERIES_AXIS) > 0
         return out[None], g_any[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(SERIES_AXIS), P(SERIES_AXIS), P(SERIES_AXIS),
                   P(SERIES_AXIS), P()),
@@ -257,7 +257,7 @@ def sharded_downsample_multigroup(ts, vals, sid, valid, gmap, *, mesh,
         shape = (num_groups, num_buckets)
         return out.reshape(shape)[None], g_real[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(SERIES_AXIS),) * 5,
         out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
@@ -304,7 +304,7 @@ def sharded_downsample_multigroup_quantile(
         g_real = _multigroup_emission(sm, gmap, num_groups, num_buckets)
         return gv[None], g_real[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(SERIES_AXIS),) * 5 + (P(),),
         out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
@@ -323,7 +323,7 @@ def sharded_hll_distinct(items, valid, *, mesh, p: int = 14):
         merged = jax.lax.pmax(regs, SERIES_AXIS)
         return sketches.hll_estimate(merged)[None]
 
-    fn = jax.shard_map(shard_fn, mesh=mesh,
+    fn = shard_map(shard_fn, mesh=mesh,
                        in_specs=(P(SERIES_AXIS), P(SERIES_AXIS)),
                        out_specs=P(SERIES_AXIS))
     return fn(items, valid)[0]
@@ -344,7 +344,7 @@ def sharded_tdigest(values, valid, qs, *, mesh, compression: int = 128):
                                   compression=compression)
         return sketches.tdigest_quantile(m, w, qs)[None]
 
-    fn = jax.shard_map(shard_fn, mesh=mesh,
+    fn = shard_map(shard_fn, mesh=mesh,
                        in_specs=(P(SERIES_AXIS), P(SERIES_AXIS)),
                        out_specs=P(SERIES_AXIS))
     return fn(values, valid)[0]

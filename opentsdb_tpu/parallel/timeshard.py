@@ -55,7 +55,7 @@ from opentsdb_tpu.ops.kernels import (
     masked_quantile_axis0,
     step_fill,
 )
-from opentsdb_tpu.parallel.mesh import TIME_AXIS
+from opentsdb_tpu.parallel.mesh import TIME_AXIS, shard_map
 
 _I32_BIG = np.int32(2**31 - 1)
 
@@ -228,7 +228,7 @@ def timeshard_downsample_group(ts, vals, sid, valid, *, mesh,
                                    g_mx)
         return group_values, series_mask.any(axis=0)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS)),
         out_specs=(P(TIME_AXIS), P(TIME_AXIS)))
@@ -307,7 +307,7 @@ def timeshard_rate(ts, vals, sid, valid, *, mesh, num_series: int,
             use_carry=use_carry)
         return r[None], ok[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS)),
         out_specs=(P(TIME_AXIS), P(TIME_AXIS)))

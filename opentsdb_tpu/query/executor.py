@@ -85,6 +85,15 @@ class QueryExecutor:
         # (reference src/core/TsdbQuery.java:52,278).
         from opentsdb_tpu.stats.collector import LatencyDigest
         self.scan_latency = LatencyDigest()
+        # Planner choice of the most recent run(): "raw", "resident"
+        # (device window), or a rollup resolution label ("1h"/"1d") —
+        # and the most recent ranged sketch_distinct's actual source
+        # ("rollup" vs "scan" fallback). Surfaced in /q and /distinct
+        # JSON metadata and informational only — a server sharing one
+        # executor across worker threads may see a neighbor query's
+        # label under contention.
+        self.last_plan = "raw"
+        self.last_sketch_source = "scan"
 
     # ------------------------------------------------------------------
     # Planning: scan + span assembly + grouping
@@ -196,11 +205,39 @@ class QueryExecutor:
                 "cardinality queries")
         dev = self._run_devwindow(spec, start, end, agg)
         if dev is not None:
+            self.last_plan = "resident"
             return dev
+        # Rollup planner step: serve window-aligned downsamples from
+        # the materialized summary tier (rollup/planner.py), with raw
+        # stitching over edge/dirty windows. The returned spans are
+        # already per-bucket values, so the rewritten spec's downsample
+        # stage is the identity and the shared group stage below runs
+        # unchanged on either backend.
+        planned = self._plan_rollup(spec, start, end)
+        if planned is not None:
+            groups, spec2, res = planned
+            from opentsdb_tpu.rollup.tier import res_label
+            self.last_plan = res_label(res)
+            return self._execute_groups(spec2, groups, start, end)
+        self.last_plan = "raw"
         import time as _time
         t0 = _time.time()
         groups = self._find_spans(spec, start, end)
         self.scan_latency.add((_time.time() - t0) * 1000)
+        return self._execute_groups(spec, groups, start, end)
+
+    def _plan_rollup(self, spec: QuerySpec, start: int, end: int):
+        if getattr(self.tsdb, "rollups", None) is None:
+            return None
+        from opentsdb_tpu.rollup import planner
+        return planner.plan(self, spec, start, end)
+
+    def _execute_groups(self, spec: QuerySpec, groups: dict,
+                        start: int, end: int) -> list[QueryResult]:
+        """Group-stage execution shared by the raw-scan and rollup
+        paths (identical inputs => identical answers, the golden-parity
+        contract of tests/test_rollup.py)."""
+        agg = Aggregators.get(spec.aggregator)
         gkeys = sorted(groups)
         # Ranges wider than int32 seconds (>68 years, e.g. start=0
         # "all-time" against year-2106 timestamps) would wrap the int32
@@ -852,13 +889,26 @@ class QueryExecutor:
                 and (pattern is None or pattern.match(k))]
 
     def sketch_quantiles(self, metric: str, tags: dict[str, str],
-                         qs: list[float]) -> dict:
-        """All-time quantiles of the matching series' merged streaming
-        t-digests (the Histogram.java-replacement path: answered from
-        device-resident state updated at ingest, no storage rescan;
-        staleness bounded by LiveSketches.flush_points and zeroed by the
-        flush inside quantile()). Not range-filtered: digests cover each
-        series' full ingested history."""
+                         qs: list[float], start: int | None = None,
+                         end: int | None = None) -> dict:
+        """Quantiles of the matching series' merged value distribution.
+
+        Without a range: the streaming path — merged per-series
+        t-digests folded at ingest (the Histogram.java replacement),
+        covering each series' full history, no storage rescan.
+
+        With [start, end]: answered from the rollup tier's per-window
+        digest columns — O(windows) digest merges for the covered
+        windows plus a raw fold over the partial edges and any dirty
+        windows — instead of re-folding every raw value per request.
+        When the tier can't serve the range, falls back to an EXACT
+        raw-scan quantile (slower, never wrong)."""
+        if start is not None or end is not None:
+            if start is None or end is None or end <= start:
+                raise BadRequestError(
+                    "sketch range needs both start and end (end > start)")
+            return self._sketch_quantiles_range(metric, tags, qs,
+                                                start, end)
         sk = self.tsdb.sketches
         if sk is None:
             raise BadRequestError(
@@ -872,10 +922,86 @@ class QueryExecutor:
                 "quantiles": {f"{q:g}": float(v)
                               for q, v in zip(qs, out)}}
 
-    def sketch_distinct(self, metric: str, tagk: str) -> int | None:
-        """Streaming distinct-tagv estimate from the per-(metric, tagk)
-        HLL registers; None when the pair has no sketch state (fall back
-        to the scan path). All-time, like the digests."""
+    def _sketch_quantiles_range(self, metric: str, tags: dict[str, str],
+                                qs: list[float], start: int,
+                                end: int) -> dict:
+        from opentsdb_tpu.rollup import planner as rplanner
+        from opentsdb_tpu.rollup import summary as rsummary
+        from opentsdb_tpu.rollup.tier import res_label
+
+        tier = getattr(self.tsdb, "rollups", None)
+        sel = rplanner.sketch_windows(self, tier, metric, tags,
+                                      start, end)
+        if sel is None:
+            # Exact raw fallback: pool every in-range value.
+            spec = QuerySpec(metric, tags)
+            groups = self._find_spans(spec, start, end)
+            vals = [sp.values for spans in groups.values()
+                    for sp in spans]
+            if not vals:
+                raise BadRequestError(
+                    f"no data for metric {metric} in range")
+            pool = np.concatenate(vals)
+            # float32 like the digests quantize, so the two paths
+            # agree within sketch tolerance, not a dtype offset.
+            est = np.quantile(pool.astype(np.float32).astype(np.float64),
+                              np.clip(qs, 0.0, 1.0))
+            return {"metric": metric, "series": len(vals),
+                    "rollup": "raw",
+                    "quantiles": {f"{q:g}": float(v)
+                                  for q, v in zip(qs, est)}}
+        res, records, raw_parts, dirty = sel
+        means: list[np.ndarray] = []
+        weights: list[np.ndarray] = []
+        nseries = 0
+        for skey, (bases, recs, sketches) in records.items():
+            used = False
+            for wb, blob in sketches:
+                if wb in dirty:
+                    continue
+                m, w, _ = rsummary.sketch_decode(blob)
+                if len(m):
+                    means.append(m.astype(np.float64))
+                    weights.append(w.astype(np.float64))
+                    used = True
+            if used:
+                nseries += 1
+        for skey, (ts, vals) in raw_parts.items():
+            if len(vals):
+                means.append(vals.astype(np.float32).astype(np.float64))
+                weights.append(np.ones(len(vals)))
+                if skey not in records:
+                    nseries += 1
+        if not means:
+            raise BadRequestError(
+                f"no data for metric {metric} in range")
+        m = np.concatenate(means)
+        w = np.concatenate(weights)
+        if len(m) > (1 << 16):
+            m, w = rsummary.digest_compress(m, w, 4096)
+        est = rsummary.digest_quantile(m, w, qs)
+        return {"metric": metric, "series": nseries,
+                "rollup": res_label(res),
+                "quantiles": {f"{q:g}": float(v)
+                              for q, v in zip(qs, est)}}
+
+    def sketch_distinct(self, metric: str, tagk: str,
+                        start: int | None = None,
+                        end: int | None = None) -> int | None:
+        """Distinct-tagv count for a metric's tag key.
+
+        Without a range: streaming estimate from the per-(metric, tagk)
+        HLL registers folded at ingest; None when the pair has no
+        sketch state (caller falls back to the scan path). All-time.
+
+        With [start, end]: EXACT count over the series with data in
+        the range, selected from rollup-record presence (O(windows))
+        plus raw stitches — or a raw scan when the tier can't serve."""
+        if start is not None or end is not None:
+            if start is None or end is None or end <= start:
+                raise BadRequestError(
+                    "distinct range needs both start and end")
+            return self._sketch_distinct_range(metric, tagk, start, end)
         sk = self.tsdb.sketches
         if sk is None:
             return None
@@ -885,6 +1011,78 @@ class QueryExecutor:
                                self.tsdb.tagk.get_id(tagk))
         except NoSuchUniqueName:
             return None
+
+    def _sketch_distinct_range(self, metric: str, tagk: str,
+                               start: int, end: int) -> int:
+        from opentsdb_tpu.core import codec as _codec
+        from opentsdb_tpu.rollup import planner as rplanner
+
+        tagk_uid = self.tsdb.tagk.get_id(tagk)
+        tier = getattr(self.tsdb, "rollups", None)
+        sel = rplanner.sketch_windows(self, tier, metric, {}, start, end)
+        if sel is None:
+            self.last_sketch_source = "scan"
+            return self.distinct_tagv(metric, {}, tagk, start, end,
+                                      exact=True)
+        self.last_sketch_source = "rollup"
+        _, records, raw_parts, dirty = sel
+        vals: set[bytes] = set()
+        for skey, (bases, recs, _sk) in records.items():
+            live = bases if not dirty else bases[
+                ~np.isin(bases, np.fromiter(dirty, np.int64,
+                                            len(dirty)))]
+            if len(live):
+                v = _codec.series_tag_uids(skey).get(tagk_uid)
+                if v is not None:
+                    vals.add(v)
+        for skey in raw_parts:
+            v = _codec.series_tag_uids(skey).get(tagk_uid)
+            if v is not None:
+                vals.add(v)
+        return len(vals)
+
+    def sketch_distinct_values(self, metric: str, tags: dict[str, str],
+                               start: int, end: int) -> dict:
+        """Estimated count of DISTINCT VALUES a metric took over a
+        range, from the rollup tier's per-window HLL register columns
+        (register max across windows/series) plus a raw fold over
+        edge/dirty windows. Exact (set-based) fallback when the tier
+        can't serve the range."""
+        from opentsdb_tpu.rollup import planner as rplanner
+        from opentsdb_tpu.rollup import summary as rsummary
+        from opentsdb_tpu.rollup.tier import res_label
+
+        tier = getattr(self.tsdb, "rollups", None)
+        sel = rplanner.sketch_windows(self, tier, metric, tags,
+                                      start, end)
+        hll_p = getattr(self.tsdb.config, "rollup_hll_p", 0)
+        if sel is None or not hll_p:
+            spec = QuerySpec(metric, tags)
+            groups = self._find_spans(spec, start, end)
+            uniq: set = set()
+            for spans in groups.values():
+                for sp in spans:
+                    uniq.update(
+                        np.unique(sp.values.astype(np.float32)
+                                  .view(np.uint32)).tolist())
+            return {"metric": metric, "rollup": "raw",
+                    "distinct_values": len(uniq)}
+        res, records, raw_parts, dirty = sel
+        regs = np.zeros(1 << hll_p, np.uint8)
+        for skey, (bases, recs, sketches) in records.items():
+            for wb, blob in sketches:
+                if wb in dirty:
+                    continue
+                _m, _w, r = rsummary.sketch_decode(blob)
+                if r is not None and len(r) == len(regs):
+                    np.maximum(regs, r, out=regs)
+        for skey, (ts, vals) in raw_parts.items():
+            if len(vals):
+                rsummary.hll_update(
+                    regs, vals.astype(np.float32).view(np.uint32))
+        return {"metric": metric, "rollup": res_label(res),
+                "distinct_values": int(round(
+                    rsummary.hll_estimate(regs)))}
 
     # ------------------------------------------------------------------
     # Cardinality (distinct tag values)

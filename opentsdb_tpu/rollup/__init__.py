@@ -1,0 +1,8 @@
+"""Materialized multi-resolution rollup tier (summaries + planner).
+
+- summary.py: record format, batched window reductions, sketch columns
+- tier.py:    per-shard persistence, checkpoint fold, catch-up daemon
+- planner.py: query-side resolution pick + raw-edge stitching
+"""
+
+from opentsdb_tpu.rollup.summary import EXACT_DSAGGS, REC_DTYPE  # noqa: F401

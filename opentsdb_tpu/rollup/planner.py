@@ -1,0 +1,235 @@
+"""Rollup query planning: serve window-aligned downsamples from the
+materialized tier, stitching raw points over partial and dirty windows.
+
+``plan()`` is the executor's rollup step. It either returns
+``(groups, spec2, res)`` — per-series spans that are ALREADY
+downsampled to the query's buckets, plus a rewritten QuerySpec whose
+downsample stage is the identity — or ``None`` to fall back to the raw
+scan. The executor then runs its normal group/interpolation stage on
+either backend, so rollup-served and raw-served queries share every
+line of group-aggregation code (and their answers can be compared
+bucket for bucket).
+
+Eligibility (the compatibility matrix, README "Rollup tier"):
+
+- downsample present, interval a multiple of some resolution;
+- downsample aggregator one of sum/count/min/max/avg (reconstructed
+  exactly from the moment columns);
+- no rate (rates need consecutive raw points);
+- group aggregator any moment or percentile (both operate on the
+  per-series bucket values, which are exact);
+- tier ready (not rebuilding / crashed / corrupt).
+
+Correctness: windows whose raw rows are still memtable-resident (or
+mid-fold) are *dirty* — their summaries may be stale — so their
+buckets, like the partial windows at the range edges, are recomputed
+from a targeted raw scan. A mostly-dirty range falls back entirely:
+the rollup path would degenerate into a slower raw scan.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from opentsdb_tpu.core import codec
+from opentsdb_tpu.core.const import MAX_TIMESPAN
+from opentsdb_tpu.query.aggregators import Aggregators
+from opentsdb_tpu.rollup import summary
+from opentsdb_tpu.rollup.summary import EXACT_DSAGGS
+from opentsdb_tpu.rollup.tier import _metric_stop, _u32
+
+# A range more dirty than this serves raw outright.
+_MAX_DIRTY_FRACTION = 0.5
+
+
+def _coalesce(ranges: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Merge overlapping/adjacent inclusive [lo, hi] ranges."""
+    out: list[list[int]] = []
+    for lo, hi in sorted(ranges):
+        if out and lo <= out[-1][1] + 1:
+            out[-1][1] = max(out[-1][1], hi)
+        else:
+            out.append([lo, hi])
+    return [(lo, hi) for lo, hi in out]
+
+
+def window_split(start: int, end: int, res: int):
+    """Split [start, end] into (full-window range, raw edge ranges).
+    Returns (w_lo, w_hi, edges) with w_hi < w_lo when no window fits."""
+    s0, e0 = max(start, 0), min(end, 0xFFFFFFFF)
+    w_lo = (s0 + res - 1) // res * res
+    w_hi = (e0 + 1 - res) // res * res
+    edges = []
+    if w_hi >= w_lo:
+        if s0 < w_lo:
+            edges.append((s0, w_lo - 1))
+        if w_hi + res <= e0:
+            edges.append((w_hi + res, e0))
+    return w_lo, w_hi, edges
+
+
+def plan(executor, spec, start: int, end: int):
+    tsdb = executor.tsdb
+    tier = getattr(tsdb, "rollups", None)
+    if tier is None:
+        return None
+    if not spec.downsample:
+        tier.note_fallback("no-downsample")
+        return None
+    if spec.rate:
+        tier.note_fallback("rate")
+        return None
+    interval, dsagg = spec.downsample
+    if dsagg not in EXACT_DSAGGS:
+        tier.note_fallback(f"dsagg-{dsagg}")
+        return None
+    agg = Aggregators.get(spec.aggregator)
+    if agg.kind not in ("moment", "percentile"):
+        tier.note_fallback("aggregator")
+        return None
+    res = tier.pick_resolution(interval)
+    if res is None:
+        tier.note_fallback("interval")
+        return None
+    if not tier.ready:
+        tier.note_miss()
+        return None
+    w_lo, w_hi, edges = window_split(start, end, res)
+    if w_hi < w_lo:
+        tier.note_fallback("short-range")
+        return None
+
+    # Dirty windows: any raw row of the window still outside the
+    # folded tier (for ANY series — window granularity keeps the set
+    # small and the stitch scans contiguous).
+    hours = tier.dirty_hour_bases()
+    dirty = np.unique(hours - hours % res) if len(hours) else hours
+    dirty = dirty[(dirty >= w_lo) & (dirty <= w_hi)]
+    n_windows = (w_hi - w_lo) // res + 1
+    if len(dirty) > _MAX_DIRTY_FRACTION * n_windows:
+        tier.note_fallback("mostly-dirty")
+        return None
+
+    # Raw path setup shared with the scan planner: same UID filters,
+    # same key regexp (rollup keys have the raw key shape).
+    metric_uid = tsdb.metrics.get_id(spec.metric)
+    exact, group_bys = executor._tag_filters(spec.tags)
+    group_by_keys = sorted(k for k, _ in group_bys)
+    regexp = executor._build_regexp(exact, group_bys)
+
+    records = tier.scan_records(res, metric_uid, w_lo, w_hi,
+                                key_regexp=regexp)
+    dirty_set = frozenset(int(b) for b in dirty)
+
+    raw_ranges = _coalesce(
+        edges + [(int(w), int(w) + res - 1) for w in dirty])
+    raw_parts = _scan_raw_parts(tsdb, metric_uid, regexp, raw_ranges)
+
+    from opentsdb_tpu.query.executor import _Span
+
+    groups: dict[tuple, list] = {}
+    for skey in sorted(set(records) | set(raw_parts)):
+        bases_list, recs_list = [], []
+        hit = records.get(skey)
+        if hit is not None:
+            bases, recs, _ = hit
+            if dirty_set:
+                keep = ~np.isin(bases, dirty)
+                bases, recs = bases[keep], recs[keep]
+            if len(bases):
+                bases_list.append(bases)
+                recs_list.append(recs)
+        part = raw_parts.get(skey)
+        if part is not None:
+            ts, vals = part
+            pb, pr = summary.window_summaries(ts, vals, res)
+            if len(pb):
+                bases_list.append(pb)
+                recs_list.append(pr)
+        if not bases_list:
+            continue
+        bases = np.concatenate(bases_list)
+        recs = np.concatenate(recs_list)
+        order = np.argsort(bases, kind="stable")
+        bts, bvals = summary.combine_buckets(bases[order], recs[order],
+                                             interval, dsagg)
+        if not len(bts):
+            continue
+        tag_uids = codec.series_tag_uids(skey)
+        named = {tsdb.tagk.get_name(k): tsdb.tagv.get_name(v)
+                 for k, v in tag_uids.items()}
+        gkey = tuple(tag_uids.get(k, b"") for k in group_by_keys)
+        groups.setdefault(gkey, []).append(
+            _Span(skey, named, bts, bvals))
+    tier.note_hit(res)
+    # The spans are already per-bucket values at bucket-start
+    # timestamps: re-downsampling with 'sum' is the identity (one
+    # value per bucket), so the whole group stage — interpolation,
+    # moments, percentiles, multigroup batching — runs unchanged.
+    spec2 = spec._replace(downsample=(interval, "sum"))
+    return groups, spec2, res
+
+
+def _scan_raw_parts(tsdb, metric_uid: bytes, regexp: bytes | None,
+                    ranges: list[tuple[int, int]]):
+    """Targeted raw scans over the stitch ranges -> per-series sorted
+    (ts, float64 values), filtered to the ranges."""
+    parts: dict[bytes, list] = {}
+    for lo, hi in ranges:
+        start_key = metric_uid + _u32(codec.base_time(lo))
+        stop = codec.base_time(hi) + MAX_TIMESPAN
+        stop_key = (_metric_stop(metric_uid) if stop > 0xFFFFFFFF
+                    else metric_uid + _u32(stop))
+        _, per_series = tsdb.scan_series(start_key, stop_key,
+                                         key_regexp=regexp)
+        for skey, cols in per_series.items():
+            m = (cols.timestamps >= lo) & (cols.timestamps <= hi)
+            if not m.any():
+                continue
+            parts.setdefault(skey, []).append(
+                (cols.timestamps[m], cols.values[m]))
+    return {
+        skey: (np.concatenate([p[0] for p in ps]),
+               np.concatenate([p[1] for p in ps]))
+        for skey, ps in parts.items()}
+
+
+def sketch_windows(executor, tier, metric: str, tags: dict,
+                   start: int, end: int):
+    """Shared selection for the range-limited sketch endpoints: pick a
+    sketch-bearing resolution, split the range, and return
+    ``(res, records, raw_parts, dirty_set)`` — records carry sketch
+    blobs, raw_parts the edge/dirty points to fold in. None when the
+    tier cannot serve the range (caller falls back to an exact raw
+    computation)."""
+    if tier is None or not tier.ready:
+        if tier is not None:
+            tier.note_miss()
+        return None
+    res = tier.sketch_resolution(max(end - start + 1, 1))
+    if res is None:
+        tier.note_fallback("sketch-res")
+        return None
+    w_lo, w_hi, edges = window_split(start, end, res)
+    if w_hi < w_lo:
+        tier.note_fallback("short-range")
+        return None
+    hours = tier.dirty_hour_bases()
+    dirty = np.unique(hours - hours % res) if len(hours) else hours
+    dirty = dirty[(dirty >= w_lo) & (dirty <= w_hi)]
+    n_windows = (w_hi - w_lo) // res + 1
+    if len(dirty) > _MAX_DIRTY_FRACTION * n_windows:
+        tier.note_fallback("mostly-dirty")
+        return None
+    tsdb = executor.tsdb
+    metric_uid = tsdb.metrics.get_id(metric)
+    exact, group_bys = executor._tag_filters(tags)
+    regexp = executor._build_regexp(exact, group_bys)
+    records = tier.scan_records(res, metric_uid, w_lo, w_hi,
+                                key_regexp=regexp, want_sketches=True)
+    dirty_set = frozenset(int(b) for b in dirty)
+    raw_ranges = _coalesce(
+        edges + [(int(w), int(w) + res - 1) for w in dirty_set])
+    raw_parts = _scan_raw_parts(tsdb, metric_uid, regexp, raw_ranges)
+    tier.note_hit(res)
+    return res, records, raw_parts, dirty_set

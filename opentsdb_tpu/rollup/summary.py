@@ -1,0 +1,346 @@
+"""Rollup record format + batched window-summary math.
+
+One rollup record summarizes one (series, coarse window) of raw points:
+count / sum / min / max / first / last, plus — at sketch-bearing
+resolutions — serialized t-digest centroids and HyperLogLog registers
+over the window's values. Records are mergeable (Storyboard,
+arXiv:2002.03063; t-digest, arXiv:1902.04023): moments combine by
+sum/min/max, digests by concatenate+recompress, HLLs by register max —
+so a planner can answer any window-aligned downsample by combining
+whole-window records instead of re-reducing raw points.
+
+Storage layout (tier.py): rollup rows live in a parallel per-shard
+MemKVStore tier under ``rollup-<res>/`` with the SAME key shape as raw
+rows — ``[metric:3][superwindow_base:4][tagk tagv]*`` — so series
+routing, key regexps, and heapq-merge reads work unchanged. One rollup
+row PACKS many consecutive windows into one map cell per kind
+(qualifier = kind byte; value = idx-keyed entry map), the rollup
+analog of the raw tier's 3600-points-per-row packing: a week of
+hourly records is a handful of rows — and a handful of CELLS — per
+series, not 168 (the generic sstable row format frames every cell
+individually, so per-window cells made reads unpack-bound).
+
+Bit-exactness contract: the planner promises rollup-served sum / count /
+min / max / avg answers EQUAL the raw scan's (float64 CPU path) when
+one bucket == one window. That pins the reduction algorithms here to
+the oracle's: per-window ``sum`` must be ``np.sum`` of the time-sorted
+float64 values (numpy's pairwise reduction — ``np.add.reduceat`` is
+strictly sequential and diverges in the last bits once a segment
+reaches numpy's 8-element unroll threshold, so long segments take a
+per-segment ``np.sum``), ``avg`` is served as sum/count (bitwise equal
+to ``np.mean`` = pairwise-sum / n), and min/max/count are order-free.
+Multi-window buckets combine window sums sequentially — associativity
+error only, within float64 tolerance of the raw answer.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# One moment record per (series, window). Little-endian packed; decoded
+# in bulk with np.frombuffer, so a scan never parses records one by one.
+REC_DTYPE = np.dtype([
+    ("count", "<u4"),
+    ("sum", "<f8"), ("min", "<f8"), ("max", "<f8"),
+    ("first", "<f8"), ("last", "<f8"),
+    ("first_dt", "<u4"), ("last_dt", "<u4"),   # ts - window_base
+])
+REC_SIZE = REC_DTYPE.itemsize
+
+# Cell kinds within a rollup row: ONE cell per (superrow, kind) holding
+# a whole window map. The qualifier is the single kind byte; the value
+# concatenates per-window entries. Packing many windows into one cell
+# matters on both sides: the generic sstable row format frames every
+# cell individually (~2 us of struct unpacking per cell on read), so a
+# per-window-cell layout made the rollup READ leg unpack-bound, and the
+# fold paid the same framing per record in its WAL batches.
+KIND_MOMENTS = 0
+KIND_SKETCH = 1
+QUAL_MOMENTS = bytes([KIND_MOMENTS])
+QUAL_SKETCH = bytes([KIND_SKETCH])
+
+# Moment-map entry: window idx within the superrow + the record.
+ENTRY_DTYPE = np.dtype([("idx", "<u2"), ("rec", REC_DTYPE)])
+ENTRY_SIZE = ENTRY_DTYPE.itemsize
+
+_SK_HDR = struct.Struct("<HI")  # sketch-map entry header: idx, blob len
+
+ROLLUP_FAMILY = b"r"
+
+
+def pack_moment_map(entries: dict[int, bytes]) -> bytes:
+    """Serialize {window idx -> REC_SIZE record bytes}, idx-sorted."""
+    return b"".join(struct.pack("<H", i) + entries[i]
+                    for i in sorted(entries))
+
+
+def decode_moment_map(blob: bytes) -> np.ndarray:
+    """Inverse of pack_moment_map -> ENTRY_DTYPE array (idx-sorted)."""
+    return np.frombuffer(blob, ENTRY_DTYPE)
+
+
+def merge_moment_map(blob: bytes, entries: dict[int, bytes]) -> bytes:
+    """RMW merge: new entries REPLACE same-idx entries of the stored
+    map (the tier's replace-from-raw write semantics)."""
+    merged = {int(e["idx"]): bytes(memoryview(blob)[
+        i * ENTRY_SIZE + 2:(i + 1) * ENTRY_SIZE])
+        for i, e in enumerate(decode_moment_map(blob))}
+    merged.update(entries)
+    return pack_moment_map(merged)
+
+
+def pack_sketch_map(entries: dict[int, bytes]) -> bytes:
+    return b"".join(_SK_HDR.pack(i, len(entries[i])) + entries[i]
+                    for i in sorted(entries))
+
+
+def decode_sketch_map(blob: bytes) -> list[tuple[int, bytes]]:
+    out = []
+    off = 0
+    n = len(blob)
+    while off + _SK_HDR.size <= n:
+        idx, ln = _SK_HDR.unpack_from(blob, off)
+        off += _SK_HDR.size
+        out.append((idx, blob[off:off + ln]))
+        off += ln
+    return out
+
+
+def merge_sketch_map(blob: bytes, entries: dict[int, bytes]) -> bytes:
+    merged = dict(decode_sketch_map(blob))
+    merged.update(entries)
+    return pack_sketch_map(merged)
+
+# The downsample aggregators a moment record reconstructs EXACTLY.
+EXACT_DSAGGS = ("sum", "count", "min", "max", "avg")
+
+# numpy switches from the sequential loop to the 8-accumulator unrolled
+# pairwise reduction at 8 elements; below that np.add.reduceat computes
+# the identical float64 result.
+_PAIRWISE_MIN = 8
+
+
+# ---------------------------------------------------------------------------
+# Batched window summaries (segment reductions over decoded columns)
+# ---------------------------------------------------------------------------
+
+def window_summaries(ts: np.ndarray, vals: np.ndarray, res: int,
+                     ) -> tuple[np.ndarray, np.ndarray]:
+    """Summarize one series' sorted points into per-window records.
+
+    Returns (window_bases int64 [W], records REC_DTYPE [W]). One
+    vectorized pass: segment boundaries from the base-time diff, then
+    ufunc.reduceat reductions — except ``sum`` for segments at numpy's
+    pairwise threshold, which re-reduce with np.sum per segment so the
+    stored sum is bit-identical to the oracle's bucket sum (module
+    docstring).
+    """
+    n = len(ts)
+    if n == 0:
+        return (np.empty(0, np.int64), np.empty(0, REC_DTYPE))
+    bases = ts - ts % res
+    starts = np.concatenate(([0], np.flatnonzero(np.diff(bases)) + 1))
+    ends = np.concatenate((starts[1:], [n]))
+    rec = np.empty(len(starts), REC_DTYPE)
+    rec["count"] = (ends - starts).astype(np.uint32)
+    rec["sum"] = np.add.reduceat(vals, starts)
+    long = np.flatnonzero(ends - starts >= _PAIRWISE_MIN)
+    for i in long:
+        rec["sum"][i] = np.sum(vals[starts[i]:ends[i]])
+    rec["min"] = np.minimum.reduceat(vals, starts)
+    rec["max"] = np.maximum.reduceat(vals, starts)
+    rec["first"] = vals[starts]
+    rec["last"] = vals[ends - 1]
+    wbase = bases[starts]
+    rec["first_dt"] = (ts[starts] - wbase).astype(np.uint32)
+    rec["last_dt"] = (ts[ends - 1] - wbase).astype(np.uint32)
+    return wbase, rec
+
+
+def merge_records(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Merge two records of the SAME window (a earlier batch, b later —
+    rebuild accumulates partial windows across scan chunks). Sum adds
+    sequentially (associativity-tolerance only), min/max/count exact,
+    first/last ordered by their in-window deltas."""
+    out = a.copy()
+    out["count"] = a["count"] + b["count"]
+    out["sum"] = a["sum"] + b["sum"]
+    out["min"] = np.minimum(a["min"], b["min"])
+    out["max"] = np.maximum(a["max"], b["max"])
+    if b["first_dt"] < a["first_dt"]:
+        out["first"], out["first_dt"] = b["first"], b["first_dt"]
+    if b["last_dt"] >= a["last_dt"]:
+        out["last"], out["last_dt"] = b["last"], b["last_dt"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Bucket combination (planner side)
+# ---------------------------------------------------------------------------
+
+def combine_buckets(wbase: np.ndarray, rec: np.ndarray, interval: int,
+                    dsagg: str) -> tuple[np.ndarray, np.ndarray]:
+    """Combine one series' window records (sorted by base, count > 0)
+    into downsample buckets of ``interval`` (a multiple of the window
+    resolution). Returns (bucket_ts int64, values float64) — exactly
+    the per-series output of oracle.downsample(mode='aligned',
+    bucket_ts='start') over the same raw points when every bucket is
+    one window, and within float64 associativity tolerance otherwise.
+    """
+    if len(wbase) == 0:
+        return (np.empty(0, np.int64), np.empty(0, np.float64))
+    bbase = wbase - wbase % interval
+    starts = np.concatenate(
+        ([0], np.flatnonzero(np.diff(bbase)) + 1))
+    counts = np.add.reduceat(rec["count"].astype(np.int64), starts)
+    if dsagg == "count":
+        vals = counts.astype(np.float64)
+    elif dsagg == "sum":
+        vals = np.add.reduceat(rec["sum"], starts)
+    elif dsagg == "avg":
+        vals = np.add.reduceat(rec["sum"], starts) / counts
+    elif dsagg == "min":
+        vals = np.minimum.reduceat(rec["min"], starts)
+    elif dsagg == "max":
+        vals = np.maximum.reduceat(rec["max"], starts)
+    else:
+        raise ValueError(f"rollup cannot reconstruct dsagg {dsagg!r}")
+    return bbase[starts], vals
+
+
+# ---------------------------------------------------------------------------
+# Sketch columns: numpy t-digest + HLL (no device round trips at spill)
+# ---------------------------------------------------------------------------
+
+def digest_compress(means: np.ndarray, weights: np.ndarray,
+                    k: int) -> tuple[np.ndarray, np.ndarray]:
+    """k1-scale batch compression (the numpy twin of
+    ops.sketches._compress / stats.collector.LatencyDigest): sort by
+    mean, cluster by the arcsine scale on cumulative quantiles, segment
+    reduce. Returns (means, weights) sorted, <= k centroids, empties
+    dropped."""
+    keep = weights > 0
+    means, weights = means[keep], weights[keep]
+    if len(means) <= k:
+        order = np.argsort(means, kind="stable")
+        return (means[order].astype(np.float32),
+                weights[order].astype(np.float32))
+    order = np.argsort(means, kind="stable")
+    m, w = means[order].astype(np.float64), weights[order].astype(
+        np.float64)
+    total = max(w.sum(), 1e-30)
+    q_mid = np.clip((np.cumsum(w) - w / 2) / total, 1e-9, 1 - 1e-9)
+    kk = k / np.pi * np.arcsin(2 * q_mid - 1) + k / 2
+    cluster = np.clip(kk.astype(np.int64), 0, k - 1)
+    wsum = np.bincount(cluster, weights=w, minlength=k)
+    msum = np.bincount(cluster, weights=m * w, minlength=k)
+    nz = wsum > 0
+    return ((msum[nz] / wsum[nz]).astype(np.float32),
+            wsum[nz].astype(np.float32))
+
+
+def digest_quantile(means: np.ndarray, weights: np.ndarray,
+                    qs) -> np.ndarray:
+    """Quantiles by interpolating centroid centers (numpy twin of
+    ops.sketches.tdigest_quantile, support-clamped)."""
+    if len(means) == 0:
+        return np.full(len(np.atleast_1d(qs)), np.nan)
+    order = np.argsort(means, kind="stable")
+    m = means[order].astype(np.float64)
+    w = weights[order].astype(np.float64)
+    centers = (np.cumsum(w) - w / 2) / max(w.sum(), 1e-30)
+    qs = np.clip(np.atleast_1d(np.asarray(qs, np.float64)), 0.0, 1.0)
+    return np.interp(qs, centers, m)
+
+
+def hll_update(regs: np.ndarray, items: np.ndarray) -> None:
+    """Fold hashed items into uint8 registers in place (numpy twin of
+    ops.sketches.hll_add: same murmur3 finalizer, so host- and
+    device-folded registers merge coherently)."""
+    p = int(np.log2(len(regs)))
+    h = items.astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    h *= np.uint32(0x85EBCA6B)
+    h ^= h >> np.uint32(13)
+    h *= np.uint32(0xC2B2AE35)
+    h ^= h >> np.uint32(16)
+    idx = (h >> np.uint32(32 - p)).astype(np.int64)
+    w = (h << np.uint32(p)) >> np.uint32(p)
+    bits = np.zeros(len(w), np.int64)
+    nz = w > 0
+    bits[nz] = np.frexp(w[nz].astype(np.float64))[1]  # floor(log2)+1
+    rank = np.where(nz, (32 - p) - (bits - 1), (32 - p) + 1)
+    np.maximum.at(regs, idx, rank.astype(np.uint8))
+
+
+def hll_estimate(regs: np.ndarray) -> float:
+    """Cardinality estimate with the small/large-range corrections of
+    ops.sketches.hll_estimate."""
+    m = len(regs)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    inv = np.sum(np.exp2(-regs.astype(np.float64)))
+    raw = alpha * m * m / inv
+    zeros = float(np.sum(regs == 0))
+    if raw <= 2.5 * m and zeros > 0:
+        est = m * np.log(m / zeros)
+    else:
+        est = raw
+    two32 = 2.0 ** 32
+    if est > two32 / 30.0:
+        est = -two32 * np.log1p(-est / two32)
+    return float(est)
+
+
+def sketch_encode(means: np.ndarray, weights: np.ndarray,
+                  regs: np.ndarray | None) -> bytes:
+    """Serialize one window's sketch cell: digest centroids + optional
+    HLL registers (p=0 marks absent)."""
+    n = len(means)
+    p = int(np.log2(len(regs))) if regs is not None else 0
+    return (struct.pack("<BHB", 1, n, p)
+            + means.astype("<f4").tobytes()
+            + weights.astype("<f4").tobytes()
+            + (regs.astype(np.uint8).tobytes() if regs is not None
+               else b""))
+
+
+def sketch_decode(blob: bytes):
+    """Inverse of sketch_encode -> (means, weights, regs | None)."""
+    ver, n, p = struct.unpack_from("<BHB", blob, 0)
+    if ver != 1:
+        raise ValueError(f"unknown rollup sketch version {ver}")
+    off = 4
+    means = np.frombuffer(blob, "<f4", n, off)
+    weights = np.frombuffer(blob, "<f4", n, off + 4 * n)
+    off += 8 * n
+    regs = (np.frombuffer(blob, np.uint8, 1 << p, off)
+            if p else None)
+    return means, weights, regs
+
+
+def window_sketches(ts: np.ndarray, vals: np.ndarray, res: int,
+                    digest_k: int, hll_p: int):
+    """Per-window sketch cells for one series: (bases, [blob]).
+    Digest over the window's float32-cast values; HLL over their bit
+    patterns (distinct-value estimates; hashable ints for hll_update).
+    """
+    n = len(ts)
+    if n == 0:
+        return np.empty(0, np.int64), []
+    v32 = vals.astype(np.float32)
+    bases = ts - ts % res
+    starts = np.concatenate(([0], np.flatnonzero(np.diff(bases)) + 1))
+    ends = np.concatenate((starts[1:], [n]))
+    blobs = []
+    for s, e in zip(starts, ends):
+        seg = v32[s:e]
+        m, w = digest_compress(seg.astype(np.float64),
+                               np.ones(e - s), digest_k)
+        regs = None
+        if hll_p:
+            regs = np.zeros(1 << hll_p, np.uint8)
+            hll_update(regs, seg.view(np.uint32))
+        blobs.append(sketch_encode(m, w, regs))
+    return bases[starts], blobs

@@ -1,0 +1,737 @@
+"""RollupTier — the materialized multi-resolution summary tier.
+
+A parallel per-shard storage tier holding one summary record per
+(series, coarse window) at each configured resolution (default 1h and
+1d), computed at checkpoint-spill time and served by the query
+planner's rollup step (rollup/planner.py) so long-range downsampled
+queries cost O(windows) instead of O(points).
+
+Layout
+------
+Each raw shard gets sibling rollup stores::
+
+    <dir>/shard-<i>/rollup-<res>/wal[.sst...]     (sharded stores)
+    <wal>.rollup-<res>/wal[.sst...]               (single MemKVStore)
+
+Every rollup store is a plain ``MemKVStore`` — WAL durability, crash
+replay, sstable spill, and replica semantics are inherited, not
+re-implemented. Rollup rows reuse the raw row-key SHAPE
+(``[metric:3][base:4][tagk tagv]*``) with the base-time slot holding a
+*superwindow* start (``resolution * pack`` seconds), so the sharded
+store's series-hash routing and the scan regexps built for raw keys
+apply unchanged; one row packs ``pack`` consecutive windows as cells
+(qualifier = (window idx, kind)).
+
+Consistency contract ("stale degrades, never lies")
+---------------------------------------------------
+A raw point is ALWAYS in at least one of: (a) the memtable/frozen tier
+(its row key is in ``store.pending_keys``), (b) a window in the tier's
+in-flight set (spilled but the fold hasn't committed), or (c) a rollup
+record. The planner treats (a)+(b) windows as *dirty* and stitches
+them from raw, so a summary is only ever served for windows whose
+every point it covers. Records are REPLACED from a full re-read of the
+window's raw rows (never incrementally merged on the write path), so
+re-folds after WAL replay, duplicate ingest, out-of-order backfill,
+and deletes are all idempotent.
+
+Crash safety: ``ROLLUP.json`` flips to ``pending`` before each
+checkpoint's spill and back to ``ok`` only after the fold commits; a
+crash in between leaves ``pending`` and the next open schedules a
+full rebuild (the catch-up daemon) while queries fall back to raw. A
+missing/foreign-config tier rebuilds the same way.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+from typing import Iterable
+
+import numpy as np
+
+from opentsdb_tpu.core import codec, codec_np
+from opentsdb_tpu.core.const import MAX_TIMESPAN, TIMESTAMP_BYTES, UID_WIDTH
+from opentsdb_tpu.core.errors import IllegalDataError
+from opentsdb_tpu.rollup import summary
+from opentsdb_tpu.rollup.summary import (QUAL_MOMENTS, QUAL_SKETCH,
+                                         REC_DTYPE, REC_SIZE,
+                                         ROLLUP_FAMILY)
+from opentsdb_tpu.storage.kv import MemKVStore
+
+LOG = logging.getLogger(__name__)
+
+STATE_NAME = "ROLLUP.json"
+
+# Raw data family (core/tsdb.py FAMILY; duplicated to avoid importing
+# the TSDB module from the tier it instantiates).
+_RAW_FAMILY = b"t"
+
+_FLUSH_CELLS = 1 << 16
+
+
+def _u32(v: int) -> bytes:
+    return int(v).to_bytes(4, "big")
+
+
+def _metric_stop(metric_uid: bytes) -> bytes:
+    """Smallest key after every row of this metric."""
+    n = int.from_bytes(metric_uid, "big") + 1
+    if n >= 1 << (8 * len(metric_uid)):
+        return b"\xff" * (len(metric_uid) + TIMESTAMP_BYTES + 1)
+    return n.to_bytes(len(metric_uid), "big")
+
+
+def res_label(res: int) -> str:
+    if res % 86400 == 0:
+        return f"{res // 86400}d"
+    if res % 3600 == 0:
+        return f"{res // 3600}h"
+    return f"{res}s"
+
+
+class _MapBuffer:
+    """Accumulates per-superrow window maps and flushes them as ONE
+    map cell per (row, kind) via read-modify-write put_many batches.
+
+    The RMW (merge with the stored map, new windows replacing same-idx
+    entries) is safe because every writer — checkpoint folds and the
+    catch-up rebuild — serializes on the tier's fold lock; a fold that
+    touches a superrow across two of its own flushes reads its first
+    flush back from the store's memtable."""
+
+    def __init__(self, tier: "RollupTier") -> None:
+        self.tier = tier
+        # (res, shard) -> {row key -> (moment entries, sketch entries)}
+        self.maps: dict[tuple[int, int], dict] = {}
+        self.total = 0
+        self.written = 0
+
+    def entries(self, res: int, key: bytes) -> tuple[dict, dict]:
+        si = self.tier._shard_of(key)
+        rows = self.maps.get((res, si))
+        if rows is None:
+            rows = self.maps[(res, si)] = {}
+        ent = rows.get(key)
+        if ent is None:
+            ent = rows[key] = ({}, {})
+        return ent
+
+    def count(self, n: int) -> None:
+        self.total += n
+        if self.total >= _FLUSH_CELLS:
+            self.flush()
+
+    def flush(self) -> None:
+        table, fam = self.tier.table, ROLLUP_FAMILY
+        for (res, si), rows in self.maps.items():
+            store = self.tier.stores[res][si]
+            cells = []
+            for key, (moments, sketches) in rows.items():
+                cur_m = cur_s = None
+                # RMW only for PARTIAL maps (a map covering every
+                # window of the superrow replaces outright), decided
+                # per kind — moments can be complete while sketches
+                # aren't.
+                need_m = moments and len(moments) < self.tier.pack
+                need_s = sketches and len(sketches) < self.tier.pack
+                if need_m or need_s:
+                    for c in store.get(table, key, fam):
+                        if c.qualifier == QUAL_MOMENTS and need_m:
+                            cur_m = c.value
+                        elif c.qualifier == QUAL_SKETCH and need_s:
+                            cur_s = c.value
+                if moments:
+                    blob = (summary.merge_moment_map(cur_m, moments)
+                            if cur_m else
+                            summary.pack_moment_map(moments))
+                    cells.append((key, QUAL_MOMENTS, blob))
+                    self.written += len(moments)
+                if sketches:
+                    blob = (summary.merge_sketch_map(cur_s, sketches)
+                            if cur_s else
+                            summary.pack_sketch_map(sketches))
+                    cells.append((key, QUAL_SKETCH, blob))
+            if cells:
+                store.put_many(table, fam, cells)
+        self.maps = {}
+        self.total = 0
+
+
+class RollupTier:
+    def __init__(self, tsdb, config) -> None:
+        self.tsdb = tsdb
+        self.table = config.table
+        res = tuple(sorted(int(r) for r in config.rollup_resolutions))
+        if not res:
+            raise ValueError("rollup_resolutions must not be empty")
+        for i, r in enumerate(res):
+            if r % MAX_TIMESPAN != 0:
+                raise ValueError(
+                    f"rollup resolution {r} is not a multiple of the "
+                    f"row span ({MAX_TIMESPAN}s)")
+            if i and res[i] % res[i - 1] != 0:
+                raise ValueError(
+                    f"rollup resolutions must nest (each divides the "
+                    f"next): {res}")
+        self.resolutions = res
+        self.pack = int(config.rollup_pack)
+        if not 1 <= self.pack <= 0xFFFF:
+            raise ValueError(f"rollup_pack out of range: {self.pack}")
+        self.digest_k = int(config.rollup_digest_k)
+        self.hll_p = int(config.rollup_hll_p)
+        self.sketch_min_res = int(config.rollup_sketch_min_res)
+
+        store = tsdb.store
+        self._sharded = hasattr(store, "shards") and hasattr(store, "_route")
+        base_dirs: list[str]
+        if self._sharded:
+            root = store._dir
+            base_dirs = [os.path.join(root, f"shard-{i}")
+                         for i in range(store.shard_count)]
+            self.state_path = os.path.join(root, STATE_NAME)
+        else:
+            wal = store._wal_path
+            base_dirs = [wal]  # suffixed below, not a directory itself
+            self.state_path = wal + ".rollup.json"
+        self.shard_count = len(base_dirs)
+
+        # Counters (exported via collect_stats; best-effort, unlocked).
+        self.hits: dict[int, int] = {r: 0 for r in res}
+        self.misses = 0
+        self.fallbacks: dict[str, int] = {}
+        self.folds = 0
+        self.records_written = 0
+        self.rebuilds = 0
+
+        self._ready = False
+        # True while a full catch-up is owed (crash/foreign state):
+        # per-checkpoint folds must not flip the tier ready — only a
+        # completed rebuild covers the pre-existing spilled history.
+        self._behind = False
+        self._rebuilding = False
+        self._rebuild_error: BaseException | None = None
+        self._rebuild_thread: threading.Thread | None = None
+        self._fold_lock = threading.Lock()
+        self._defer_lock = threading.Lock()
+        self._deferred: list[bytes] = []
+        self._inflight: frozenset[int] = frozenset()
+        self._dirty_cache: tuple[int, np.ndarray] | None = None
+
+        self._dirs: dict[int, list[str]] = {}
+        for r in res:
+            if self._sharded:
+                self._dirs[r] = [os.path.join(d, f"rollup-{r}")
+                                 for d in base_dirs]
+            else:
+                self._dirs[r] = [f"{base_dirs[0]}.rollup-{r}"]
+
+        st = self._read_state()
+        needs_rebuild = self._needs_rebuild(st)
+        if needs_rebuild:
+            for dirs in self._dirs.values():
+                for d in dirs:
+                    shutil.rmtree(d, ignore_errors=True)
+        self.stores: dict[int, list[MemKVStore]] = {}
+        try:
+            for r in res:
+                self.stores[r] = []
+                for d in self._dirs[r]:
+                    s = MemKVStore(wal_path=os.path.join(d, "wal"))
+                    s.ensure_table(self.table)
+                    self.stores[r].append(s)
+        except BaseException:
+            self.close()
+            raise
+        store.record_spill_keys = True
+        if needs_rebuild:
+            self._behind = True
+            self._write_state(pending=True)
+            mode = getattr(config, "rollup_catchup", "background")
+            if mode == "sync":
+                self._rebuilding = True
+                self._rebuild()
+            elif mode == "background":
+                self._rebuilding = True
+                self._rebuild_thread = threading.Thread(
+                    target=self._rebuild, daemon=True,
+                    name="rollup-catchup")
+                self._rebuild_thread.start()
+            # "off": stays pending/not-ready; planner serves raw.
+        else:
+            self._write_state(pending=False)
+            self._ready = True
+
+    # -- state file --------------------------------------------------------
+
+    def _config_dict(self) -> dict:
+        return {"version": 2, "resolutions": list(self.resolutions),
+                "pack": self.pack, "digest_k": self.digest_k,
+                "hll_p": self.hll_p,
+                "sketch_min_res": self.sketch_min_res}
+
+    def _read_state(self) -> dict | None:
+        try:
+            with open(self.state_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write_state(self, pending: bool) -> None:
+        rec = self._config_dict()
+        rec["pending"] = pending
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.state_path)
+
+    def _needs_rebuild(self, st: dict | None) -> bool:
+        if st is None:
+            # No state: a store that already spilled data has raw
+            # history no fold will ever cover; a fresh store starts
+            # complete (its whole history is memtable-dirty).
+            return bool(getattr(self.tsdb.store, "spilled", False))
+        if st.get("pending", True):
+            return True
+        cfg = self._config_dict()
+        return any(st.get(k) != v for k, v in cfg.items())
+
+    # -- planner surface ---------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        return self._ready
+
+    def wait_ready(self, timeout: float | None = None) -> bool:
+        t = self._rebuild_thread
+        if t is not None:
+            t.join(timeout)
+        if self._rebuild_error is not None:
+            raise RuntimeError("rollup catch-up failed") \
+                from self._rebuild_error
+        return self._ready
+
+    def pick_resolution(self, interval: int) -> int | None:
+        """Coarsest resolution whose windows nest exactly into the
+        downsample buckets."""
+        best = None
+        for r in self.resolutions:
+            if r <= interval and interval % r == 0:
+                best = r
+        return best
+
+    def sketch_resolution(self, span: int) -> int | None:
+        """Coarsest sketch-bearing resolution not wider than the range."""
+        best = None
+        if not self.digest_k:
+            return None
+        for r in self.resolutions:
+            if r >= self.sketch_min_res and r <= span:
+                best = r
+        return best
+
+    def note_hit(self, res: int) -> None:
+        self.hits[res] = self.hits.get(res, 0) + 1
+
+    def note_fallback(self, reason: str) -> None:
+        self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
+
+    def note_miss(self) -> None:
+        self.misses += 1
+
+    def dirty_hour_bases(self) -> np.ndarray:
+        """Sorted hour bases whose raw rows are not (yet) covered by
+        rollup records: memtable + frozen rows, plus windows in flight
+        between a spill and its fold commit. Cached per store mutation
+        sequence — an unchanged seq means the memtable cannot have
+        changed (stale-cache staleness is only ever conservative: tier
+        transitions shrink the set, every growth bumps the seq)."""
+        store = self.tsdb.store
+        seq = store.mutation_seq
+        cached = self._dirty_cache
+        if cached is not None and cached[0] == seq:
+            base = cached[1]
+        else:
+            keys = store.pending_keys(self.table)
+            if keys:
+                lo, hi = UID_WIDTH, UID_WIDTH + TIMESTAMP_BYTES
+                blob = b"".join(k[lo:hi] for k in keys)
+                base = np.unique(
+                    np.frombuffer(blob, ">u4").astype(np.int64))
+            else:
+                base = np.empty(0, np.int64)
+            self._dirty_cache = (seq, base)
+        infl = self._inflight
+        if infl:
+            base = np.union1d(
+                base, np.fromiter(infl, np.int64, len(infl)))
+        return base
+
+    def scan_records(self, res: int, metric_uid: bytes, w_lo: int,
+                     w_hi: int, key_regexp: bytes | None = None,
+                     want_sketches: bool = False) -> dict:
+        """All rollup records of ``metric`` with window base in
+        [w_lo, w_hi], keyed by series. Returns
+        ``{series_key: (bases int64[W], records REC_DTYPE[W],
+        sketches [(base, blob)])}`` with zero-count (deleted) records
+        dropped. Shards are scanned independently — a series' rows all
+        live in one shard, so per-series ordering needs no merge."""
+        span = res * self.pack
+        start_key = metric_uid + _u32(w_lo - w_lo % span)
+        stop_hi = w_hi - w_hi % span + span
+        stop_key = (_metric_stop(metric_uid) if stop_hi > 0xFFFFFFFF
+                    else metric_uid + _u32(stop_hi))
+        # One map cell per (row, kind): a whole superrow of window
+        # records decodes with a single frombuffer — the per-window
+        # cell layout this replaced made reads sstable-unpack-bound.
+        acc: dict[bytes, tuple[list, list, list]] = {}
+        for s in self.stores[res]:
+            for key, items in s.scan_raw(self.table, start_key, stop_key,
+                                         family=ROLLUP_FAMILY,
+                                         key_regexp=key_regexp):
+                sb = codec.key_base_time(key)
+                skey = codec.series_key(key)
+                ent = acc.get(skey)
+                if ent is None:
+                    ent = acc[skey] = ([], [], [])
+                for q, v in items:
+                    if q == QUAL_MOMENTS:
+                        if len(v) % summary.ENTRY_SIZE:
+                            continue  # foreign/corrupt: skip
+                        e = summary.decode_moment_map(v)
+                        wb = sb + e["idx"].astype(np.int64) * res
+                        keep = (wb >= w_lo) & (wb <= w_hi)
+                        if keep.any():
+                            ent[0].append(wb[keep])
+                            ent[1].append(e["rec"][keep])
+                    elif q == QUAL_SKETCH and want_sketches:
+                        for idx, blob in summary.decode_sketch_map(v):
+                            wb1 = sb + idx * res
+                            if w_lo <= wb1 <= w_hi:
+                                ent[2].append((wb1, blob))
+        out: dict[bytes, tuple] = {}
+        for skey, (bases, recs, sk) in acc.items():
+            if not bases and not sk:
+                continue
+            if bases:
+                base_arr = np.concatenate(bases)
+                rec = (np.concatenate(recs) if len(recs) > 1
+                       else np.asarray(recs[0]))
+                live = rec["count"] > 0
+                if not live.all():
+                    base_arr, rec = base_arr[live], rec[live]
+            else:
+                base_arr = np.empty(0, np.int64)
+                rec = np.empty(0, REC_DTYPE)
+            if len(base_arr) or sk:
+                out[skey] = (base_arr, rec, sk)
+        return out
+
+    # -- checkpoint integration (called by TSDB.checkpoint) ---------------
+
+    def begin_spill(self) -> None:
+        """Before the raw spill: remember every currently-dirty window
+        as in-flight (the spill moves its rows out of pending_keys, the
+        fold hasn't covered them yet) and mark the tier pending on
+        disk so a crash mid-window rebuilds."""
+        if self._rebuilding or self._behind:
+            return  # state is already pending
+        bases = self.dirty_hour_bases()
+        self._inflight = self._inflight | frozenset(
+            int(b) for b in bases)
+        self._write_state(pending=True)
+
+    def fold_after_spill(self) -> None:
+        """After the raw spill: fold the spilled keys into summary
+        records, commit, and clear the in-flight set. During a rebuild
+        the keys are deferred — the catch-up pass drains them."""
+        keys = self.tsdb.store.take_spill_keys().get(self.table, [])
+        with self._defer_lock:
+            if self._rebuilding:
+                self._deferred.extend(keys)
+                return
+            if self._behind:
+                # Full catch-up owed but not running (rollup_catchup
+                # "off" / crashed): its eventual full scan covers these
+                # keys; folding now could flip state to ok early.
+                return
+        try:
+            self._fold(keys)
+        except IllegalDataError as e:
+            # Corrupt raw data (the fsck signal): leave the tier
+            # not-ready (state stays pending) so the planner serves
+            # raw; never wedge the checkpoint itself.
+            LOG.warning("rollup fold skipped (corrupt data): %s", e)
+            self._ready = False
+            self.note_fallback("corrupt")
+            return
+        for stores in self.stores.values():
+            for s in stores:
+                s.checkpoint()   # bound the rollup WALs
+        self._write_state(pending=False)
+        self._inflight = frozenset()
+        self._ready = True
+        self.folds += 1
+
+    # -- fold core ---------------------------------------------------------
+
+    def _shard_of(self, key: bytes) -> int:
+        if self._sharded:
+            return self.tsdb.store._route(self.table, key)
+        return 0
+
+    def _fold(self, keys: list[bytes]) -> None:
+        """Recompute every rollup record whose window holds one of the
+        spilled ``keys`` (replace-from-raw; module docstring). Keys
+        whose rows vanished (row tombstones / deletes) get zero
+        records so stale summaries cannot outlive their points."""
+        if not keys:
+            return
+        with self._fold_lock:
+            coarse = self.resolutions[-1]
+            per_metric: dict[bytes, set[int]] = {}
+            must: set[bytes] = set()
+            for k in keys:
+                if len(k) < UID_WIDTH + TIMESTAMP_BYTES:
+                    continue
+                must.add(bytes(k))
+                hb = codec.key_base_time(k)
+                per_metric.setdefault(
+                    bytes(k[:UID_WIDTH]), set()).add(hb - hb % coarse)
+            buf = _MapBuffer(self)
+            seen: set[bytes] = set()
+            # Bound one scan chunk to ~4 days of coarse windows.
+            chunk = max(1, (4 * 86400) // coarse)
+            for metric_uid, cbases in per_metric.items():
+                bases = sorted(cbases)
+                i = 0
+                while i < len(bases):
+                    j = i
+                    while (j + 1 < len(bases) and j - i + 1 < chunk
+                           and bases[j + 1] == bases[j] + coarse):
+                        j += 1
+                    self._rollup_span(metric_uid, bases[i],
+                                      bases[j] + coarse, buf, seen)
+                    i = j + 1
+            self._zero_leftovers(must - seen, buf)
+            buf.flush()
+            self.records_written += buf.written
+
+    def _zero_leftovers(self, leftovers: Iterable[bytes],
+                        buf: _MapBuffer) -> None:
+        """Write count-0 records for spilled rows that no longer hold
+        points (deleted): the planner skips them, replacing whatever
+        stale summary the window had."""
+        zero = np.zeros(1, REC_DTYPE).tobytes()
+        empty_sketch = summary.sketch_encode(
+            np.empty(0, np.float32), np.empty(0, np.float32), None)
+        for k in leftovers:
+            skey = codec.series_key(k)
+            hb = codec.key_base_time(k)
+            for r in self.resolutions:
+                wb = hb - hb % r
+                span = r * self.pack
+                sb = wb - wb % span
+                key = skey[:UID_WIDTH] + _u32(sb) + skey[UID_WIDTH:]
+                idx = (wb - sb) // r
+                moments, sketches = buf.entries(r, key)
+                moments[idx] = zero
+                if self._sketchy(r):
+                    sketches[idx] = empty_sketch
+                buf.count(1)
+
+    def _sketchy(self, res: int) -> bool:
+        return bool(self.digest_k) and res >= self.sketch_min_res
+
+    def _rollup_span(self, metric_uid: bytes, lo: int, hi: int,
+                     buf: _MapBuffer, seen: set | None = None) -> None:
+        """Recompute records for every raw point of ``metric`` with row
+        base in [lo, hi) — streamed one coarsest window at a time (raw
+        keys are base-major within a metric, so a coarse window's rows
+        are contiguous in the scan)."""
+        coarse = self.resolutions[-1]
+        start_key = metric_uid + _u32(max(lo, 0))
+        stop_key = (_metric_stop(metric_uid) if hi > 0xFFFFFFFF
+                    else metric_uid + _u32(hi))
+        rows: list[tuple[bytes, list]] = []
+        cur = None
+        for key, items in self.tsdb.store.scan_raw(
+                self.table, start_key, stop_key, family=_RAW_FAMILY):
+            cb = codec.key_base_time(key)
+            cb -= cb % coarse
+            if cur is not None and cb != cur and rows:
+                self._summarize_group(rows, buf, seen)
+                rows = []
+            cur = cb
+            rows.append((key, items))
+        if rows:
+            self._summarize_group(rows, buf, seen)
+
+    def _summarize_group(self, rows: list, buf: _MapBuffer,
+                         seen: set | None) -> None:
+        """Decode one coarse window's rows into per-series sorted
+        columns (the scan_series recipe: one batched decode + one
+        lexsort + vectorized dedup) and emit records at every
+        resolution."""
+        quals: list[bytes] = []
+        vals: list[bytes] = []
+        bases: list[int] = []
+        cell_sid: list[int] = []
+        skeys: list[bytes] = []
+        skey_index: dict[bytes, int] = {}
+        for key, items in rows:
+            base = codec.key_base_time(key)
+            skey = codec.series_key(key)
+            si = skey_index.get(skey)
+            if si is None:
+                si = skey_index[skey] = len(skeys)
+                skeys.append(skey)
+            kept = 0
+            for q, v in items:
+                if len(q) % 2 != 0 or not q:
+                    continue
+                quals.append(q)
+                vals.append(v)
+                bases.append(base)
+                cell_sid.append(si)
+                kept += 1
+            if kept and seen is not None:
+                seen.add(bytes(key))
+        if not quals:
+            return
+        ts, f, i, isf, cop = codec_np.decode_cells_flat(
+            quals, vals, np.asarray(bases, np.int64))
+        sid = np.asarray(cell_sid, np.int64)[cop]
+        order = np.lexsort((ts, sid))
+        ts, f, i, isf, sid = (ts[order], f[order], i[order], isf[order],
+                              sid[order])
+        if len(ts) > 1:
+            dup = (sid[1:] == sid[:-1]) & (ts[1:] == ts[:-1])
+            if dup.any():
+                same = ((isf[1:] == isf[:-1])
+                        & np.where(isf[1:], f[1:] == f[:-1],
+                                   i[1:] == i[:-1]))
+                if (dup & ~same).any():
+                    bad = int(ts[1:][dup & ~same][0])
+                    raise IllegalDataError(
+                        f"Found out of order or duplicate data: "
+                        f"ts={bad} -- run an fsck.")
+                keep = np.concatenate(([True], ~dup))
+                ts, f, sid = ts[keep], f[keep], sid[keep]
+        bounds = np.searchsorted(sid, np.arange(len(skeys) + 1))
+        for s, (a, b) in enumerate(zip(bounds[:-1], bounds[1:])):
+            if b <= a:
+                continue
+            self._emit_series(skeys[s], ts[a:b], f[a:b], buf)
+
+    def _emit_series(self, skey: bytes, ts: np.ndarray, vals: np.ndarray,
+                     buf: _MapBuffer) -> None:
+        head, tail = skey[:UID_WIDTH], skey[UID_WIDTH:]
+        for r in self.resolutions:
+            wb, recs = summary.window_summaries(ts, vals, r)
+            blob = recs.tobytes()
+            span = r * self.pack
+            # Window emission is the fold's per-record hot loop: hoist
+            # the row key (and its shard route + map lookup) per
+            # superrow run — wb is sorted, so runs are contiguous.
+            sbs = wb - wb % span
+            idxs = ((wb - sbs) // r).astype(np.int64)
+            run_starts = np.concatenate(
+                ([0], np.flatnonzero(np.diff(sbs)) + 1, [len(wb)]))
+            for a, b in zip(run_starts[:-1], run_starts[1:]):
+                key = head + _u32(int(sbs[a])) + tail
+                moments = buf.entries(r, key)[0]
+                for j in range(a, b):
+                    moments[int(idxs[j])] = \
+                        blob[j * REC_SIZE:(j + 1) * REC_SIZE]
+                buf.count(b - a)
+            if self._sketchy(r):
+                sb_arr, blobs = summary.window_sketches(
+                    ts, vals, r, self.digest_k, self.hll_p)
+                for j, sblob in enumerate(blobs):
+                    w = int(sb_arr[j])
+                    sb = w - w % span
+                    key = head + _u32(sb) + tail
+                    buf.entries(r, key)[1][(w - sb) // r] = sblob
+                    buf.count(1)
+
+    # -- catch-up daemon ---------------------------------------------------
+
+    def _rebuild(self) -> None:
+        """Full tier rebuild from the raw store (crash / foreign state
+        recovery). Runs on the catch-up thread; checkpoints folding in
+        the meantime defer their spilled keys, drained at the end."""
+        try:
+            buf = _MapBuffer(self)
+            with self._fold_lock:
+                names = self.tsdb.metrics.suggest("", limit=1 << 30)
+                for name in names:
+                    uid = self.tsdb.metrics.get_id(name)
+                    self._rollup_span(uid, 0, 1 << 33, buf)
+                buf.flush()
+                self.records_written += buf.written
+            while True:
+                with self._defer_lock:
+                    keys, self._deferred = self._deferred, []
+                    if not keys:
+                        # Both flags flip under the defer lock so a
+                        # racing fold either lands in _deferred (drained
+                        # here) or proceeds as a normal fold — never
+                        # drops keys in between.
+                        self._rebuilding = False
+                        self._behind = False
+                        break
+                self._fold(keys)
+            for stores in self.stores.values():
+                for s in stores:
+                    s.checkpoint()
+            self._write_state(pending=False)
+            self._inflight = frozenset()
+            self._ready = True
+            self.rebuilds += 1
+        except BaseException as e:
+            self._rebuilding = False
+            self._rebuild_error = e
+            LOG.exception("rollup catch-up failed; tier stays raw-only")
+
+    # -- stats / lifecycle -------------------------------------------------
+
+    def collect_stats(self, collector) -> None:
+        collector.record("rollup.ready", int(self._ready))
+        collector.record("rollup.folds", self.folds)
+        collector.record("rollup.records", self.records_written)
+        collector.record("rollup.rebuilds", self.rebuilds)
+        collector.record("rollup.miss", self.misses)
+        for r in self.resolutions:
+            collector.record("rollup.hit", self.hits.get(r, 0),
+                             f"res={res_label(r)}")
+        for reason, n in sorted(self.fallbacks.items()):
+            collector.record("rollup.fallback", n, f"reason={reason}")
+
+    def flush(self) -> None:
+        for stores in self.stores.values():
+            for s in stores:
+                s.flush()
+
+    def close(self) -> None:
+        first: BaseException | None = None
+        for stores in getattr(self, "stores", {}).values():
+            for s in stores:
+                try:
+                    s.close()
+                except BaseException as e:
+                    if first is None:
+                        first = e
+        if first is not None:
+            raise first
+
+    def _simulate_crash(self) -> None:
+        """TEST HOOK: drop every rollup store's writer lock the way
+        process death does (pairs with the raw store's hook)."""
+        for stores in self.stores.values():
+            for s in stores:
+                s._simulate_crash()

@@ -692,6 +692,7 @@ class TSDServer:
         # m= params (reference GraphHandler.doGraph :155-187).
         os_ = params.get("o", [])
         result_opts: list[str] = []
+        result_plans: list[str] = []
         for mi, m in enumerate(ms):
             parsed = parse_m(m)
             spec = QuerySpec(
@@ -704,13 +705,17 @@ class TSDServer:
                 self._pool, self.executor.run, spec, start, end)
             results.extend(rs)
             result_opts.extend([os_[mi] if mi < len(os_) else ""] * len(rs))
+            # Planner choice for this sub-query ("raw", "resident", or
+            # a rollup resolution label) — surfaced in JSON metadata.
+            result_plans.extend([self.executor.last_plan] * len(rs))
 
         extra: dict = {}
         if "ascii" in q:
             body = self._ascii_output(results).encode()
             ctype = "text/plain"
         elif "json" in q:
-            body = json.dumps(self._json_output(results)).encode()
+            body = json.dumps(
+                self._json_output(results, result_plans)).encode()
             ctype = "application/json"
         else:
             t0 = time.time()
@@ -770,14 +775,15 @@ class TSDServer:
                 out.append(line + (" " + tag_str if tag_str else ""))
         return "\n".join(out) + ("\n" if out else "")
 
-    def _json_output(self, results):
+    def _json_output(self, results, plans=None):
         return [{
             "metric": r.metric,
             "tags": r.tags,
             "aggregateTags": r.aggregated_tags,
+            "rollup": (plans[i] if plans and i < len(plans) else "raw"),
             "dps": {str(int(t)): float(v)
                     for t, v in zip(r.timestamps, r.values)},
-        } for r in results]
+        } for i, r in enumerate(results)]
 
     def _render_png(self, results, start, end, q,
                     result_opts=None) -> tuple[bytes, dict]:
@@ -816,7 +822,11 @@ class TSDServer:
         Without ``start`` (or with ``stream`` set), answered from the
         streaming per-(metric, tagk) HLL registers updated at ingest —
         all-time, no storage rescan, staleness bounded by the sketch
-        flush threshold. With a time range, the scan-based path runs.
+        flush threshold. With a time range and no tag filter, the
+        rollup tier serves an exact count from record presence
+        (O(windows); executor.sketch_distinct falls back to the exact
+        scan when the tier can't cover the range); with a tag filter
+        the scan-based path runs.
         """
         for req in ("metric", "tagk"):
             if req not in q:
@@ -841,11 +851,20 @@ class TSDServer:
         if "tags" in q and q["tags"]:
             for t in q["tags"].split(","):
                 tags_mod.parse(tag_map, t)
-        n = await loop.run_in_executor(
-            self._pool, self.executor.distinct_tagv, q["metric"], tag_map,
-            q["tagk"], start, end)
+        if not tag_map:
+            n = await loop.run_in_executor(
+                self._pool, self.executor.sketch_distinct, q["metric"],
+                q["tagk"], start, end)
+            # What actually answered: the executor falls back to the
+            # exact scan whenever the tier can't cover the range.
+            source = self.executor.last_sketch_source
+        else:
+            n = await loop.run_in_executor(
+                self._pool, self.executor.distinct_tagv, q["metric"],
+                tag_map, q["tagk"], start, end)
+            source = "scan"
         body = json.dumps({"metric": q["metric"], "tagk": q["tagk"],
-                           "distinct": n, "source": "scan"}).encode()
+                           "distinct": n, "source": source}).encode()
         return 200, "application/json", body, {}
 
     async def _sketch(self, q) -> tuple:
@@ -883,10 +902,23 @@ class TSDServer:
                     f"bad quantile: {part}") from None
             if not 0.0 <= qs[-1] <= 1.0:
                 raise BadRequestError(f"quantile out of range: {part}")
+        # Optional time range: served from the rollup tier's per-window
+        # digest columns (exact raw fallback) instead of the all-time
+        # streaming digests.
+        start = end = None
+        if "start" in q:
+            now = int(time.time())
+            start = timeparse.parse_date(q["start"], now=now)
+            end = (timeparse.parse_date(q["end"], now=now)
+                   if "end" in q else now)
+        elif "end" in q:
+            raise BadRequestError(
+                "sketch range needs start= (end= alone would silently "
+                "answer all-time)")
         loop = asyncio.get_running_loop()
         out = await loop.run_in_executor(
             self._pool, self.executor.sketch_quantiles, metric, tag_map,
-            qs)
+            qs, start, end)
         return 200, "application/json", json.dumps(out).encode(), {}
 
     async def _forecast(self, q, params) -> tuple:

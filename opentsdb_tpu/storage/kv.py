@@ -348,6 +348,18 @@ class MemKVStore(KVStore):
         # acknowledged cells whose WAL records may not have reached the
         # OS with no exception having told anyone.
         self.wal_swallowed_flush_errors = 0
+        # Monotonic mutation counter (bumped per mutating CALL, not per
+        # cell, plus checkpoint tier transitions): consumers that derive
+        # state from memtable contents (the rollup tier's dirty-window
+        # set) key their caches on it — unchanged seq means the
+        # memtable cannot have changed.
+        self.mutation_seq = 0
+        # Rollup-tier hook: when set, checkpoint() records the row keys
+        # of every spilled frozen tier (including row tombstones) so
+        # the materialized-summary fold covers exactly what left the
+        # memtable; take_spill_keys() drains the record.
+        self.record_spill_keys = False
+        self._last_spill_keys: dict[str, list[bytes]] = {}
         # Immutable middle tier while a checkpoint merge is in flight.
         self._frozen: dict[str, _Table] | None = None
         self._lockfd: int | None = None
@@ -645,6 +657,36 @@ class MemKVStore(KVStore):
         on top of its snapshot."""
         with self._lock:
             return list(self._table(table).rows)
+
+    def pending_keys(self, table: str) -> list[bytes]:
+        """Row keys (and row tombstones) NOT yet in the sstable tier:
+        the live memtable plus a frozen mid-checkpoint tier. This is
+        the rollup planner's dirty-window source — every raw point not
+        yet covered by a materialized summary lives under one of these
+        keys (spilled-but-not-yet-folded keys are tracked separately by
+        the tier's in-flight set)."""
+        with self._lock:
+            t = self._table(table)
+            out = list(t.rows)
+            out.extend(t.row_tombs)
+            if self._frozen is not None:
+                ft = self._frozen.get(table)
+                if ft is not None:
+                    out.extend(ft.rows)
+                    out.extend(ft.row_tombs)
+            return out
+
+    def take_spill_keys(self) -> dict[str, list[bytes]]:
+        """Drain the spilled-key record (see record_spill_keys)."""
+        with self._lock:
+            out, self._last_spill_keys = self._last_spill_keys, {}
+            return out
+
+    @property
+    def spilled(self) -> bool:
+        """Whether any sstable generation exists (data outside the
+        WAL-replayable memtable)."""
+        return bool(self._ssts)
 
     def memtable_cells(self, table: str, key: bytes,
                        family: bytes | None = None) -> list[Cell]:
@@ -1035,6 +1077,7 @@ class MemKVStore(KVStore):
                 return 0  # merge already in flight
             self._frozen = self._tables
             self._tables = {name: _Table() for name in self._frozen}
+            self.mutation_seq += 1
             if self._wal is not None:
                 self._wal.close()
                 if os.path.exists(old_path):
@@ -1067,6 +1110,16 @@ class MemKVStore(KVStore):
                     os.replace(self._wal_path, old_path)
                     self._wal = open(self._wal_path, "ab")
             frozen = self._frozen
+            spill_keys = None
+            if self.record_spill_keys:
+                # Keys leaving the memtable this checkpoint (row
+                # tombstones included: a delete of spilled data must
+                # reach the rollup fold too, or stale summaries would
+                # keep serving the deleted points).
+                spill_keys = {
+                    name: list(ft.rows) + list(ft.row_tombs)
+                    for name, ft in frozen.items()
+                    if ft.rows or ft.row_tombs}
             gens = list(self._ssts)
             tombstoned = any(ft.row_tombs or ft.tombs
                              for ft in frozen.values())
@@ -1092,6 +1145,7 @@ class MemKVStore(KVStore):
             # empty generation file accreted per call.
             with self._lock:
                 self._frozen = None
+                self.mutation_seq += 1
                 if os.path.exists(old_path):
                     os.unlink(old_path)
             return 0
@@ -1185,6 +1239,10 @@ class MemKVStore(KVStore):
                 self._thaw_frozen_locked()
                 raise
             self._frozen = None
+            self.mutation_seq += 1
+            if spill_keys is not None:
+                for name, ks in spill_keys.items():
+                    self._last_spill_keys.setdefault(name, []).extend(ks)
             for g in dropped:
                 path = g.path
                 g.close()
@@ -1262,6 +1320,7 @@ class MemKVStore(KVStore):
             for k in ft.rows:
                 live.note_insert(k)
         self._frozen = None
+        self.mutation_seq += 1
 
     # -- mutation ---------------------------------------------------------
 
@@ -1317,6 +1376,7 @@ class MemKVStore(KVStore):
         self._check_writable()
         with self._lock:
             self._check_throttle(table, key)
+            self.mutation_seq += 1
             if durable:
                 self._wal_append(_OP_PUT, table.encode(), key, family,
                                  qualifier, value)
@@ -1337,6 +1397,7 @@ class MemKVStore(KVStore):
             return existed
         tenc = table.encode()
         with self._lock:
+            self.mutation_seq += 1
             t = self._table(table)
             rows = t.rows
             # With no lower tiers the memtable is the whole truth, so
@@ -1546,6 +1607,7 @@ class MemKVStore(KVStore):
         else:
             keys = [key_blob[i:i + L] for i in range(0, n * L, L)]
         with self._lock:
+            self.mutation_seq += 1
             t = self._table(table)
             wal = self._wal is not None and durable
             fast = self._try_fast_batch(
@@ -1562,6 +1624,7 @@ class MemKVStore(KVStore):
                qualifiers: list[bytes]) -> None:
         self._check_writable()
         with self._lock:
+            self.mutation_seq += 1
             self._wal_append(_OP_DELETE, table.encode(), key, family,
                              *qualifiers)
             self._apply_delete(table, key, family, qualifiers)
@@ -1569,6 +1632,7 @@ class MemKVStore(KVStore):
     def delete_row(self, table: str, key: bytes) -> None:
         self._check_writable()
         with self._lock:
+            self.mutation_seq += 1
             self._wal_append(_OP_DELETE_ROW, table.encode(), key)
             self._apply_delete_row(table, key)
 
@@ -1770,6 +1834,7 @@ class MemKVStore(KVStore):
             cur = row.get((family, qualifier)) if row else None
             value = (struct.unpack(">q", cur)[0] if cur else 0) + amount
             packed = struct.pack(">q", value)
+            self.mutation_seq += 1
             self._wal_append(_OP_PUT, table.encode(), key, family, qualifier,
                              packed)
             self._apply_put(table, key, family, qualifier, packed)
@@ -1786,6 +1851,7 @@ class MemKVStore(KVStore):
             cur = row.get((family, qualifier)) if row else None
             if cur != expected:
                 return False
+            self.mutation_seq += 1
             self._wal_append(_OP_PUT, table.encode(), key, family, qualifier,
                              value)
             self._apply_put(table, key, family, qualifier, value)

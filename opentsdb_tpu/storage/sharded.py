@@ -381,6 +381,36 @@ class ShardedKVStore(KVStore):
             out.extend(s.memtable_keys(table))
         return out
 
+    def pending_keys(self, table: str) -> list[bytes]:
+        out: list[bytes] = []
+        for s in self.shards:
+            out.extend(s.pending_keys(table))
+        return out
+
+    def take_spill_keys(self) -> dict[str, list[bytes]]:
+        out: dict[str, list[bytes]] = {}
+        for s in self.shards:
+            for name, ks in s.take_spill_keys().items():
+                out.setdefault(name, []).extend(ks)
+        return out
+
+    @property
+    def mutation_seq(self) -> int:
+        return sum(s.mutation_seq for s in self.shards)
+
+    @property
+    def record_spill_keys(self) -> bool:
+        return all(s.record_spill_keys for s in self.shards)
+
+    @record_spill_keys.setter
+    def record_spill_keys(self, value: bool) -> None:
+        for s in self.shards:
+            s.record_spill_keys = value
+
+    @property
+    def spilled(self) -> bool:
+        return any(s.spilled for s in self.shards)
+
     def memtable_cells(self, table: str, key: bytes,
                        family: bytes | None = None) -> list[Cell]:
         return self.shards[self._route(table, key)].memtable_cells(

@@ -49,6 +49,17 @@ def common_args(p: argparse.ArgumentParser) -> None:
                         "explicit N that disagrees with the manifest is "
                         "a hard error")
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument("--rollups", action="store_true",
+                   help="maintain the materialized rollup tier "
+                        "(opentsdb_tpu/rollup/): per-series 1h/1d "
+                        "summaries computed at checkpoint spill and "
+                        "served by the query planner for window-aligned "
+                        "downsamples. Writer daemons with --wal only; "
+                        "a stale/missing tier degrades to raw scans")
+    p.add_argument("--rollup-resolutions", default=None,
+                   help="comma-separated rollup window sizes in seconds "
+                        "(ascending, each a multiple of 3600 dividing "
+                        "the next; default 3600,86400)")
     p.add_argument("--auto-metric", action="store_true",
                    help="automatically create metric UIDs (ingest)")
     p.add_argument("--read-only", action="store_true",
@@ -102,6 +113,37 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
     cfg = Config(
         table=args.table, uidtable=args.uidtable, wal_path=args.wal,
         backend=args.backend, auto_create_metrics=args.auto_metric)
+    if getattr(args, "rollups", False):
+        cfg.enable_rollups = True
+    if getattr(args, "rollup_resolutions", None):
+        cfg.rollup_resolutions = tuple(
+            int(r) for r in args.rollup_resolutions.split(","))
+    elif args.wal:
+        # Auto-adopt an existing rollup tier (the SHARDS.json
+        # precedent): ANY writer that spills a rollup-backed store
+        # without folding would leave summaries silently stale — so
+        # offline tools (import/fsck/scan --delete) must keep the tier
+        # current whenever its state file exists, flag or no flag. The
+        # state file's own layout wins over Config defaults.
+        import json as _json
+
+        from opentsdb_tpu.rollup.tier import STATE_NAME
+        for sp in (os.path.join(args.wal, STATE_NAME),
+                   args.wal + ".rollup.json"):
+            if os.path.exists(sp):
+                cfg.enable_rollups = True
+                try:
+                    with open(sp) as f:
+                        rec = _json.load(f)
+                    cfg.rollup_resolutions = tuple(rec["resolutions"])
+                    cfg.rollup_pack = int(rec["pack"])
+                    cfg.rollup_digest_k = int(rec["digest_k"])
+                    cfg.rollup_hll_p = int(rec["hll_p"])
+                    cfg.rollup_sketch_min_res = int(
+                        rec["sketch_min_res"])
+                except (OSError, ValueError, KeyError):
+                    pass  # unreadable state: tier opens and rebuilds
+                break
     # The device-resident hot window serves long-lived query traffic;
     # one-shot tools (import/scan/fsck/uid/query) would only pay its
     # warm-up scan and uploads to throw them away on exit.
@@ -409,11 +451,35 @@ def cmd_fsck(args) -> int:
                       f"{len(qual)}")
                 continue
             try:
-                codec.explode_cell(qual, val)
+                points = codec.explode_cell(qual, val)
             except IllegalDataError as e:
                 errors += 1
                 bad = True
                 print(f"ERROR: row {key.hex()}: {e}")
+                continue
+            if codec.is_compacted_qualifier(qual):
+                # Reference Fsck.java detection depth: a compacted
+                # cell's qualifiers must be strictly increasing.
+                # compact_cells() sorts before checking, so duplicate
+                # and out-of-order points INSIDE one compacted cell
+                # would otherwise pass silently (and other readers —
+                # explode-based iteration, the reference's own Span
+                # assembly — see them in stored order).
+                deltas = [c.delta for c in points]
+                for j in range(1, len(deltas)):
+                    if deltas[j] == deltas[j - 1]:
+                        errors += 1
+                        bad = True
+                        print(f"ERROR: row {key.hex()}: compacted cell "
+                              f"has duplicate timestamp (delta="
+                              f"{deltas[j]}, qualifier #{j})")
+                    elif deltas[j] < deltas[j - 1]:
+                        errors += 1
+                        bad = True
+                        print(f"ERROR: row {key.hex()}: compacted cell "
+                              f"has out-of-order timestamps (delta="
+                              f"{deltas[j]} after {deltas[j - 1]}, "
+                              f"qualifier #{j})")
         if not bad:
             try:
                 codec.compact_cells(

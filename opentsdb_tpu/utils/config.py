@@ -38,6 +38,20 @@ class Config:
     compaction_max_concurrent_flushes: int = 10_000
     compaction_flush_speed: int = 2
 
+    # Materialized rollup tier (opentsdb_tpu/rollup/): per-series
+    # coarse-window summaries (count/sum/min/max/first/last + t-digest
+    # and HLL sketch columns) computed at checkpoint-spill time into a
+    # parallel per-shard store, served by the query planner for
+    # window-aligned downsamples. Writer daemons with a persistent
+    # store only; a stale or missing tier degrades to raw scans.
+    enable_rollups: bool = False
+    rollup_resolutions: tuple = (3600, 86400)  # ascending, each divides next
+    rollup_pack: int = 48          # windows packed per rollup row
+    rollup_digest_k: int = 64      # t-digest centroids per window (0=off)
+    rollup_hll_p: int = 8          # HLL registers exponent per window
+    rollup_sketch_min_res: int = 86400  # sketch columns at res >= this
+    rollup_catchup: str = "background"  # background | sync | off
+
     # streaming sketches: device-resident per-series t-digests and
     # per-(metric, tagk) HyperLogLogs folded in at ingest (north star;
     # replaces the reference's Histogram.java streaming-stats role)

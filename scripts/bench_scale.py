@@ -115,13 +115,24 @@ def write_artifacts(out: dict) -> None:
     pts = out["ingest"]["points"]
     # An explicit --shards (1 included) marks a sharding-comparison
     # run: it gets its own _S<N> name so a shards=1 control never
-    # clobbers the legacy default-engine artifact for that size.
+    # clobbers the legacy default-engine artifact for that size. A
+    # rollup-enabled run gets _R too — its ingest pays fold costs the
+    # plain artifacts must not inherit.
     ssfx = (f"_S{out['shards']}" if out.get("shards") else "")
+    if out.get("rollup") is not None:
+        ssfx += "_R"
     suffixed = os.path.join(
         REPO, f"BENCH_SCALE_{pts // 1_000_000}M{ssfx}.json")
     with open(suffixed, "w") as f:
         json.dump(out, f, indent=2)
     canonical = os.path.join(REPO, "BENCH_SCALE.json")
+    if out.get("rollup") is not None:
+        # A rollup run's ingest pays fold costs no plain run pays; it
+        # must never become the canonical cross-round artifact no
+        # matter its size.
+        log("rollup run: canonical BENCH_SCALE.json left alone "
+            f"(this run in {os.path.basename(suffixed)})")
+        return
     prev_pts = -1
     try:
         with open(canonical) as f:
@@ -159,6 +170,12 @@ def main() -> int:
                          "value (1 included) writes a _S<N>-suffixed "
                          "artifact; the default keeps the legacy "
                          "single-store naming")
+    ap.add_argument("--rollup", action="store_true",
+                    help="maintain the materialized rollup tier "
+                         "(opentsdb_tpu/rollup/) during ingest and "
+                         "record long-range query latency raw vs "
+                         "rollup into BENCH_ROLLUP.json (both legs on "
+                         "this host/config)")
     ap.add_argument("--workdir", default="/tmp/tsdb_scale")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
@@ -202,7 +219,8 @@ def main() -> int:
                    if os.path.exists(p))
 
     cfg = Config(auto_create_metrics=True, wal_path=wal,
-                 shards=max(args.shards, 1))
+                 shards=max(args.shards, 1),
+                 enable_rollups=args.rollup, rollup_catchup="sync")
     tsdb = TSDB(store, cfg, start_compaction_thread=False)
     tune_for_ingest()
 
@@ -485,6 +503,65 @@ def main() -> int:
         "wal_bytes_after": wal_bytes(),
     }
     log(f"checkpoint: {out['checkpoint']}")
+
+    # Rollup tier: long-range downsampled queries raw vs rollup on the
+    # SAME host/config (both legs cold-path: devwindow detached), plus
+    # what the tier cost to maintain. Written to BENCH_ROLLUP.json.
+    if args.rollup and tsdb.rollups is not None:
+        tsdb.rollups.wait_ready()
+        rq: dict = {"resolutions": list(tsdb.rollups.resolutions),
+                    "records": tsdb.rollups.records_written,
+                    "folds": tsdb.rollups.folds}
+        rq["tier_bytes"] = sum(
+            du(d) for dirs in tsdb.rollups._dirs.values() for d in dirs)
+        dwx, tsdb.devwindow = tsdb.devwindow, None
+        try:
+            for label, span, interval in (
+                    ("1day_1h", 86400, 3600),
+                    ("1week_1h", 7 * 86400, 3600),
+                    ("1month_1d", 30 * 86400, 86400)):
+                if span > done_pps * step:
+                    continue
+                spec = QuerySpec("scale.metric", {}, "sum",
+                                 downsample=(interval, "avg"))
+                lo = end - span
+                ex.run(spec, lo, end)  # warm (jit + uid caches)
+                t0 = time.perf_counter()
+                r_roll = ex.run(spec, lo, end)
+                troll = time.perf_counter() - t0
+                plan = ex.last_plan
+                hold, tsdb.rollups = tsdb.rollups, None
+                try:
+                    t0 = time.perf_counter()
+                    r_raw = ex.run(spec, lo, end)
+                    traw = time.perf_counter() - t0
+                finally:
+                    tsdb.rollups = hold
+                same = (len(r_roll) == len(r_raw) and all(
+                    np.array_equal(a.timestamps, b.timestamps)
+                    and np.allclose(a.values, b.values,
+                                    rtol=2e-4, atol=1e-3)
+                    for a, b in zip(r_roll, r_raw)))
+                rq[label] = {
+                    "raw_s": round(traw, 4),
+                    "rollup_s": round(troll, 4),
+                    "speedup": round(traw / max(troll, 1e-9), 1),
+                    "plan": plan, "answers_match": bool(same)}
+                log(f"rollup {label}: raw {traw:.3f}s -> rollup "
+                    f"{troll:.3f}s ({traw / max(troll, 1e-9):.1f}x, "
+                    f"plan={plan}, match={same})")
+        finally:
+            tsdb.devwindow = dwx
+        out["rollup"] = rq
+        roll_art = {
+            "device": str(dev), "shards": args.shards,
+            "series": args.series, "points": total,
+            "step_s": step, "span_s": done_pps * step,
+            "native_ext": native_ext is not None,
+            "host": out["host"], **rq}
+        with open(os.path.join(REPO, "BENCH_ROLLUP.json"), "w") as f:
+            json.dump(roll_art, f, indent=2)
+        log(f"rollup artifact: {roll_art}")
 
     write_artifacts(out)
     print(json.dumps({"points": total,

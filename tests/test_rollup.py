@@ -1,0 +1,420 @@
+"""Rollup tier tests: golden parity vs raw scans, edge/dirty stitching,
+crash safety, degradation, sketch-range endpoints, stats/metadata.
+
+The golden-parity contract (ISSUE 2 acceptance): rollup-served answers
+EQUAL raw-scan answers bit-exactly for sum/count/min/max/avg group-bys
+on the float64 CPU backend — at shards=1 and shards=4, including the
+partial windows at range edges — and within the existing sketch
+tolerances for p95/distinct. A stale or missing tier must degrade to
+raw scans, never to wrong answers.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.stats.collector import StatsCollector
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.storage.sharded import ShardedKVStore
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1356998400
+METRIC = "roll.metric"
+
+
+def make_tsdb(path, shards=1, **over):
+    os.makedirs(path, exist_ok=True)
+    wal = os.path.join(path, "wal")
+    kw = dict(auto_create_metrics=True, wal_path=wal,
+              enable_rollups=True, enable_sketches=False,
+              device_window=False, backend="cpu",
+              rollup_catchup="sync", shards=shards)
+    kw.update(over)
+    cfg = Config(**kw)
+    store = (ShardedKVStore(path, shards=shards) if shards > 1
+             else MemKVStore(wal_path=wal))
+    return TSDB(store, cfg, start_compaction_thread=False)
+
+
+def ingest(tsdb, series=5, days=3, step=600, seed=0, metric=METRIC,
+           int_values=False):
+    rng = np.random.default_rng(seed)
+    for i in range(series):
+        ts = (BASE + np.arange(0, days * 86400, step, dtype=np.int64)
+              + int(rng.integers(0, step // 4)))
+        if int_values:
+            vals = rng.integers(0, 1000, len(ts))
+        else:
+            vals = (np.cumsum(rng.normal(0, 1, len(ts)))
+                    + 50).astype(np.float32)
+        tsdb.add_batch(metric, ts, vals, {"host": f"h{i}"})
+
+
+def run_both(ex, spec, start, end):
+    """(rollup_results, rollup_plan, raw_results) on one executor."""
+    a = ex.run(spec, start, end)
+    plan = ex.last_plan
+    tier, ex.tsdb.rollups = ex.tsdb.rollups, None
+    try:
+        b = ex.run(spec, start, end)
+    finally:
+        ex.tsdb.rollups = tier
+    return a, plan, b
+
+
+def assert_equal_results(a, b, exact=True):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.tags == y.tags
+        assert x.aggregated_tags == y.aggregated_tags
+        np.testing.assert_array_equal(x.timestamps, y.timestamps)
+        if exact:
+            np.testing.assert_array_equal(x.values, y.values)
+        else:
+            np.testing.assert_allclose(x.values, y.values,
+                                       rtol=2e-4, atol=1e-3)
+
+
+class TestGoldenParity:
+    @pytest.mark.parametrize("shards", [1, 4])
+    def test_moment_dsaggs_bit_exact(self, tmp_path, shards):
+        tsdb = make_tsdb(str(tmp_path), shards=shards)
+        try:
+            ingest(tsdb)
+            tsdb.checkpoint()
+            assert tsdb.rollups.ready
+            ex = QueryExecutor(tsdb, backend="cpu")
+            # Edge-window stitching on purpose: start/end mid-window.
+            start, end = BASE + 1801, BASE + 3 * 86400 - 901
+            cases = [(3600, "sum"), (3600, "count"), (3600, "avg"),
+                     (7200, "min"), (7200, "max"), (86400, "avg"),
+                     (86400, "sum")]
+            for interval, dsagg in cases:
+                spec = QuerySpec(METRIC, {}, "sum",
+                                 downsample=(interval, dsagg))
+                a, plan, b = run_both(ex, spec, start, end)
+                assert plan in ("1h", "1d"), plan
+                assert_equal_results(a, b, exact=True)
+        finally:
+            tsdb.shutdown()
+
+    @pytest.mark.parametrize("shards", [1, 4])
+    def test_groupby_aggregators_bit_exact(self, tmp_path, shards):
+        tsdb = make_tsdb(str(tmp_path), shards=shards)
+        try:
+            ingest(tsdb)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            start, end = BASE + 1801, BASE + 3 * 86400 - 901
+            for group_agg in ("sum", "min", "max", "avg", "count",
+                              "dev", "p95"):
+                spec = QuerySpec(METRIC, {"host": "*"}, group_agg,
+                                 downsample=(3600, "avg"))
+                a, plan, b = run_both(ex, spec, start, end)
+                assert plan == "1h"
+                assert_equal_results(a, b, exact=True)
+        finally:
+            tsdb.shutdown()
+
+    def test_integer_values_exact(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, int_values=True)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum", downsample=(7200, "sum"))
+            a, plan, b = run_both(ex, spec, BASE, BASE + 3 * 86400)
+            assert plan == "1h"
+            assert_equal_results(a, b, exact=True)
+        finally:
+            tsdb.shutdown()
+
+    def test_tpu_backend_tolerance(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path), backend="tpu")
+        try:
+            ingest(tsdb)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="tpu")
+            start, end = BASE + 1801, BASE + 3 * 86400 - 901
+            for agg in ("sum", "p95"):
+                spec = QuerySpec(METRIC, {"host": "*"}, agg,
+                                 downsample=(3600, "avg"))
+                a, plan, b = run_both(ex, spec, start, end)
+                assert plan == "1h"
+                assert_equal_results(a, b, exact=False)
+        finally:
+            tsdb.shutdown()
+
+    def test_dirty_window_backfill_stitches_raw(self, tmp_path):
+        """Out-of-order backfill into an already-folded window stays
+        memtable-resident: the planner must serve that window from raw
+        (a stale summary would miss the backfill)."""
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, step=450)
+            tsdb.checkpoint()
+            # Odd timestamps: never collide with the 450-step points.
+            tsdb.add_batch(METRIC,
+                           BASE + np.arange(3601, 7200, 100,
+                                            dtype=np.int64),
+                           np.full(36, 7.0, np.float32), {"host": "h0"})
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum", downsample=(3600, "sum"))
+            a, plan, b = run_both(ex, spec, BASE, BASE + 3 * 86400)
+            assert plan == "1h"
+            assert_equal_results(a, b, exact=True)
+        finally:
+            tsdb.shutdown()
+
+    def test_fallbacks(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            start, end = BASE, BASE + 3 * 86400
+            # rate, non-nesting interval, non-exact dsagg -> raw.
+            for spec in (
+                    QuerySpec(METRIC, {}, "sum", rate=True,
+                              downsample=(3600, "avg")),
+                    QuerySpec(METRIC, {}, "sum", downsample=(5400, "avg")),
+                    QuerySpec(METRIC, {}, "sum", downsample=(3600, "dev")),
+                    QuerySpec(METRIC, {}, "sum")):
+                ex.run(spec, start, end)
+                assert ex.last_plan == "raw"
+            fb = tsdb.rollups.fallbacks
+            assert fb.get("rate") == 1
+            assert fb.get("interval") == 1
+            assert fb.get("dsagg-dev") == 1
+            assert fb.get("no-downsample") == 1
+        finally:
+            tsdb.shutdown()
+
+
+class TestCrashSafety:
+    def test_crash_mid_spill_rebuilds(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path))
+        ingest(tsdb)
+        tsdb.checkpoint()
+        ingest(tsdb, seed=9, days=1)   # more data, then a torn window
+        tsdb.rollups.begin_spill()     # state flips to pending...
+        tsdb.store._simulate_crash()   # ...and the process "dies"
+        tsdb.rollups._simulate_crash()
+        tsdb2 = make_tsdb(str(tmp_path))
+        try:
+            assert tsdb2.rollups.rebuilds == 1
+            assert tsdb2.rollups.ready
+            ex = QueryExecutor(tsdb2, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum", downsample=(3600, "sum"))
+            a, plan, b = run_both(ex, spec, BASE, BASE + 3 * 86400)
+            assert plan == "1h"
+            assert_equal_results(a, b, exact=True)
+        finally:
+            tsdb2.shutdown()
+
+    def test_missing_tier_degrades_to_raw_then_catches_up(self, tmp_path):
+        # Build spilled history WITHOUT rollups...
+        tsdb = make_tsdb(str(tmp_path), enable_rollups=False)
+        ingest(tsdb)
+        tsdb.checkpoint()
+        tsdb.shutdown()
+        # ...enable them with catch-up off: planner must serve raw.
+        tsdb2 = make_tsdb(str(tmp_path), rollup_catchup="off")
+        assert not tsdb2.rollups.ready
+        ex = QueryExecutor(tsdb2, backend="cpu")
+        spec = QuerySpec(METRIC, {}, "sum", downsample=(3600, "sum"))
+        ex.run(spec, BASE, BASE + 3 * 86400)
+        assert ex.last_plan == "raw"
+        assert tsdb2.rollups.misses >= 1
+        # A checkpoint fold must NOT flip the tier ready while the
+        # full catch-up is still owed.
+        ingest(tsdb2, seed=5, days=1)
+        tsdb2.checkpoint()
+        assert not tsdb2.rollups.ready
+        tsdb2.shutdown()
+        # Re-open with the catch-up daemon: rebuild covers everything.
+        tsdb3 = make_tsdb(str(tmp_path))
+        try:
+            assert tsdb3.rollups.ready
+            ex3 = QueryExecutor(tsdb3, backend="cpu")
+            a, plan, b = run_both(ex3, spec, BASE, BASE + 3 * 86400)
+            assert plan == "1h"
+            assert_equal_results(a, b, exact=True)
+        finally:
+            tsdb3.shutdown()
+
+    def test_delete_reaches_rollups(self, tmp_path):
+        """Deleting spilled rows must zero their summaries at the next
+        checkpoint — a stale record would keep serving dead points."""
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, series=2)
+            tsdb.checkpoint()
+            key = tsdb.row_key_for(METRIC, {"host": "h0"}, BASE)
+            tsdb.store.delete_row(tsdb.table, key)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {"host": "h0"}, "sum",
+                             downsample=(3600, "sum"))
+            a, plan, b = run_both(ex, spec, BASE, BASE + 3 * 86400)
+            assert plan == "1h"
+            assert_equal_results(a, b, exact=True)
+            # And the deleted hour really is gone.
+            assert int(a[0].timestamps[0]) >= BASE + 3600
+        finally:
+            tsdb.shutdown()
+
+    def test_resolution_change_rebuilds(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path))
+        ingest(tsdb, days=1)
+        tsdb.checkpoint()
+        tsdb.shutdown()
+        tsdb2 = make_tsdb(str(tmp_path),
+                          rollup_resolutions=(7200, 86400))
+        try:
+            assert tsdb2.rollups.rebuilds == 1
+            ex = QueryExecutor(tsdb2, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum", downsample=(7200, "sum"))
+            a, plan, b = run_both(ex, spec, BASE, BASE + 86400)
+            assert plan == "2h"
+            assert_equal_results(a, b, exact=True)
+        finally:
+            tsdb2.shutdown()
+
+
+class TestSketchRange:
+    def test_quantiles_range_matches_exact(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path), rollup_sketch_min_res=3600)
+        try:
+            ingest(tsdb, series=4, days=4, step=300, seed=3)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            start, end = BASE + 86400, BASE + 3 * 86400
+            est = ex.sketch_quantiles(METRIC, {}, [0.5, 0.95],
+                                      start, end)
+            assert est["rollup"] in ("1h", "1d")
+            tier, tsdb.rollups = tsdb.rollups, None
+            try:
+                exact = ex.sketch_quantiles(METRIC, {}, [0.5, 0.95],
+                                            start, end)
+            finally:
+                tsdb.rollups = tier
+            assert exact["rollup"] == "raw"
+            for q in ("0.5", "0.95"):
+                lo = abs(exact["quantiles"][q])
+                assert abs(est["quantiles"][q] - exact["quantiles"][q]) \
+                    <= 0.05 * max(lo, 1.0)
+        finally:
+            tsdb.shutdown()
+
+    def test_distinct_range_exact(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, series=6, days=2)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            n = ex.sketch_distinct(METRIC, "host", BASE,
+                                   BASE + 2 * 86400)
+            assert n == 6
+            # Range with no data.
+            n0 = ex.sketch_distinct(METRIC, "host",
+                                    BASE + 30 * 86400,
+                                    BASE + 31 * 86400)
+            assert n0 == 0
+        finally:
+            tsdb.shutdown()
+
+    def test_distinct_values_estimate(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path), rollup_sketch_min_res=3600)
+        try:
+            rng = np.random.default_rng(7)
+            ts = BASE + np.arange(0, 2 * 86400, 60, dtype=np.int64)
+            vals = rng.integers(0, 50, len(ts)).astype(np.float32)
+            tsdb.add_batch(METRIC, ts, vals, {"host": "h0"})
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            out = ex.sketch_distinct_values(METRIC, {}, BASE,
+                                            BASE + 2 * 86400)
+            assert out["rollup"] in ("1h", "1d")
+            # ~50 distinct values; HLL p=8 ~6.5% stderr.
+            assert 38 <= out["distinct_values"] <= 65
+        finally:
+            tsdb.shutdown()
+
+
+class TestStatsAndMetadata:
+    def test_counters_exported(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, days=1)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum", downsample=(3600, "sum"))
+            ex.run(spec, BASE, BASE + 86400)
+            assert ex.last_plan == "1h"
+            ex.run(QuerySpec(METRIC, {}, "sum", rate=True,
+                             downsample=(3600, "sum")), BASE, BASE + 86400)
+            c = StatsCollector("tsd", host_tag=False)
+            tsdb.collect_stats(c)
+            assert any("rollup.ready" in ln for ln in c.lines)
+            assert any("rollup.hit" in ln and "res=1h" in ln
+                       for ln in c.lines)
+            assert any("rollup.fallback" in ln and "reason=rate" in ln
+                       for ln in c.lines)
+            assert any("rollup.records" in ln for ln in c.lines)
+        finally:
+            tsdb.shutdown()
+
+    def test_json_metadata_label(self, tmp_path):
+        from opentsdb_tpu.server.tsd import TSDServer
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, days=1)
+            tsdb.checkpoint()
+            server = TSDServer.__new__(TSDServer)  # just _json_output
+            out = server._json_output(
+                [type("R", (), {"metric": METRIC, "tags": {},
+                                "aggregated_tags": [],
+                                "timestamps": np.array([BASE]),
+                                "values": np.array([1.0])})()],
+                ["1h"])
+            assert out[0]["rollup"] == "1h"
+        finally:
+            tsdb.shutdown()
+
+
+def test_rollup_smoke_small_corpus(tmp_path):
+    """Tier-1 smoke: a sharded store with mid-ingest checkpoints, the
+    1-week downsampled query answered from rollups, bit-exact vs raw."""
+    tsdb = make_tsdb(str(tmp_path), shards=4)
+    try:
+        rng = np.random.default_rng(11)
+        days, step, series = 10, 1200, 8
+        pts = np.arange(0, days * 86400, step, dtype=np.int64)
+        half = len(pts) // 2
+        for i in range(series):
+            vals = (np.cumsum(rng.normal(0, 1, len(pts)))
+                    + 100).astype(np.float32)
+            tsdb.add_batch(METRIC, BASE + pts[:half], vals[:half],
+                           {"host": f"h{i}"})
+        tsdb.checkpoint()
+        for i in range(series):
+            vals = (np.cumsum(rng.normal(0, 1, len(pts)))
+                    + 100).astype(np.float32)
+            tsdb.add_batch(METRIC, BASE + pts[half:], vals[half:],
+                           {"host": f"h{i}"})
+        tsdb.checkpoint()
+        assert tsdb.rollups.ready
+        assert tsdb.rollups.records_written > 0
+        ex = QueryExecutor(tsdb, backend="cpu")
+        end = BASE + days * 86400
+        spec = QuerySpec(METRIC, {"host": "*"}, "sum",
+                         downsample=(3600, "avg"))
+        a, plan, b = run_both(ex, spec, end - 7 * 86400 + 7, end)
+        assert plan == "1h"
+        assert_equal_results(a, b, exact=True)
+    finally:
+        tsdb.shutdown()

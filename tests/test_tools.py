@@ -139,6 +139,95 @@ class TestFsck:
         out = capsys.readouterr().out.strip().split("\n")
         assert out[-1] == f"m.dup {BT + 1} 1 a=b"  # first value kept
 
+    def _write_compacted(self, wal, deltas_vals, metric="m.cell"):
+        """Plant one COMPACTED cell with the given (delta, int value)
+        points in stored order — the reference Fsck.java corpus shape:
+        corruption lives inside a single compacted qualifier, not
+        across cells."""
+        from opentsdb_tpu.core import codec
+        from opentsdb_tpu.core.tsdb import FAMILY, TSDB
+        from opentsdb_tpu.storage.kv import MemKVStore
+        from opentsdb_tpu.utils.config import Config
+
+        tsdb = TSDB(MemKVStore(wal_path=wal),
+                    Config(auto_create_metrics=True, wal_path=wal),
+                    start_compaction_thread=False)
+        try:
+            key = tsdb.row_key_for(metric, {"a": "b"}, BT)
+            cells = []
+            for delta, value in deltas_vals:
+                buf, flags = codec.encode_long(value)
+                cells.append(codec.Cell(
+                    codec.encode_qualifier(delta, flags), buf))
+            qual, val = codec.merge_cells(cells)
+            tsdb.store.put(tsdb.table, key, FAMILY, qual, val)
+        finally:
+            tsdb.shutdown()
+
+    def test_golden_duplicate_inside_compacted_cell(self, wal, capsys):
+        """A compacted cell carrying the SAME delta twice decodes
+        cleanly (compact_cells sorts + dedups), so the pre-deepening
+        fsck passed it — the reference's Fsck.java flags it. Golden:
+        detect, report, --fix, clean."""
+        self._write_compacted(wal, [(1, 7), (1, 7), (9, 8)])
+        capsys.readouterr()
+        assert main(["fsck", "--wal", wal]) == 1
+        out = capsys.readouterr().out
+        assert "duplicate timestamp" in out
+        assert "Found 1 errors" in out
+        assert main(["fsck", "--wal", wal, "--fix"]) == 0
+        capsys.readouterr()
+        assert main(["fsck", "--wal", wal]) == 0
+        assert "Found 0 errors" in capsys.readouterr().out
+        # Fixed row still serves the survivors.
+        main(["query", "--wal", wal, str(BT), str(BT + 100), "sum",
+              "m.cell"])
+        lines = capsys.readouterr().out.strip().split("\n")
+        assert lines == [f"m.cell {BT + 1} 7 a=b",
+                         f"m.cell {BT + 9} 8 a=b"]
+
+    def test_golden_out_of_order_inside_compacted_cell(self, wal,
+                                                       capsys):
+        """Out-of-order qualifiers INSIDE one compacted cell: sorted
+        readers mask it, explode-order readers (scan --import, the
+        reference's Span assembly) see misordered points. Golden:
+        detect, report both inversions, --fix rewrites sorted."""
+        self._write_compacted(wal, [(30, 3), (10, 1), (20, 2)],
+                              metric="m.ooo")
+        capsys.readouterr()
+        assert main(["fsck", "--wal", wal]) == 1
+        out = capsys.readouterr().out
+        assert "out-of-order timestamps" in out
+        assert "Found 1 errors" in out
+        assert main(["fsck", "--wal", wal, "--fix"]) == 0
+        capsys.readouterr()
+        assert main(["fsck", "--wal", wal]) == 0
+        capsys.readouterr()
+        main(["query", "--wal", wal, str(BT), str(BT + 100), "sum",
+              "m.ooo"])
+        lines = capsys.readouterr().out.strip().split("\n")
+        assert lines == [f"m.ooo {BT + 10} 1 a=b",
+                         f"m.ooo {BT + 20} 2 a=b",
+                         f"m.ooo {BT + 30} 3 a=b"]
+
+    def test_golden_dup_and_ooo_value_conflict(self, wal, capsys):
+        """Same delta, DIFFERENT values inside one compacted cell —
+        the case compact_cells would reject at query time with
+        IllegalDataError. fsck flags the in-cell duplicate; --fix
+        keeps the first value (reference Fsck semantics)."""
+        self._write_compacted(wal, [(5, 1), (5, 2)], metric="m.conf")
+        capsys.readouterr()
+        assert main(["fsck", "--wal", wal]) == 1
+        out = capsys.readouterr().out
+        assert "duplicate timestamp" in out
+        assert "Found 1 errors" in out
+        assert main(["fsck", "--wal", wal, "--fix"]) == 0
+        capsys.readouterr()
+        main(["query", "--wal", wal, str(BT), str(BT + 100), "sum",
+              "m.conf"])
+        lines = capsys.readouterr().out.strip().split("\n")
+        assert lines == [f"m.conf {BT + 5} 1 a=b"]
+
 
 class TestUid:
     def test_assign_lookup_grep(self, wal, capsys):

