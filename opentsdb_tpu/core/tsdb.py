@@ -49,6 +49,17 @@ class TSDB:
         self.compactionq = CompactionQueue(
             self, start_thread=start_compaction_thread)
         self._lock = threading.Lock()
+        # Serializes checkpoint() end to end so the rollup tier's spill
+        # bracketing (begin_spill ... fold_after_spill) pairs 1:1 with
+        # an actual store spill. Without it, a manual checkpoint racing
+        # the compaction thread's timer checkpoint gets rows=0 from the
+        # store ("merge already in flight"), drains empty spill keys,
+        # and then clears the CONCURRENT checkpoint's in-flight window
+        # set and flips the tier state to ok while that spill is still
+        # uncommitted — windows neither pending nor in-flight nor
+        # folded, so stale summaries get served (and a crash in the gap
+        # skips the rebuild).
+        self._checkpoint_lock = threading.Lock()
         # Optional deregistration hook: the CLI's open-TSDB sweep list
         # (tools/cli._OPEN_TSDBS) sets this so shutdown() removes the
         # entry — embedders calling make_tsdb() outside main() would
@@ -620,22 +631,26 @@ class TSDB:
             # A replica owns neither the sketch snapshot nor the spill
             # tier; writing either would race the writer daemon.
             return 0
-        path = self._sketch_path()
-        if self.sketches is not None and path:
-            self.sketches.save(path)
-        # Rollup tier brackets the spill: mark the about-to-spill
-        # windows in flight (and the tier pending on disk) BEFORE the
-        # raw spill, fold the spilled keys into summary records after —
-        # a crash in between leaves the pending marker and the next
-        # open rebuilds (rollup/tier.py consistency contract).
-        rollups = getattr(self, "rollups", None)  # early-timer safety
-        if rollups is not None:
-            rollups.begin_spill()
-        ckpt = getattr(self.store, "checkpoint", None)
-        rows = ckpt() if ckpt else 0
-        if rollups is not None:
-            rollups.fold_after_spill()
-        return rows
+        # One checkpoint at a time (see _checkpoint_lock): the rollup
+        # bracketing below is only sound when THIS call's store spill is
+        # the one between its begin_spill and fold_after_spill.
+        with self._checkpoint_lock:
+            path = self._sketch_path()
+            if self.sketches is not None and path:
+                self.sketches.save(path)
+            # Rollup tier brackets the spill: mark the about-to-spill
+            # windows in flight (and the tier pending on disk) BEFORE the
+            # raw spill, fold the spilled keys into summary records after —
+            # a crash in between leaves the pending marker and the next
+            # open rebuilds (rollup/tier.py consistency contract).
+            rollups = getattr(self, "rollups", None)  # early-timer safety
+            if rollups is not None:
+                rollups.begin_spill()
+            ckpt = getattr(self.store, "checkpoint", None)
+            rows = ckpt() if ckpt else 0
+            if rollups is not None:
+                rollups.fold_after_spill()
+            return rows
 
     def shutdown(self) -> None:
         # Idempotent: the CLI dispatcher sweeps any TSDB a command
@@ -654,18 +669,25 @@ class TSDB:
                 self.checkpoint()
             self.store.flush()
         finally:
-            # The store MUST close even when checkpoint/flush raise
-            # (ENOSPC is a first-class path): close releases the WAL's
-            # single-writer flock, without which every later open of
-            # this path in the process is refused.
+            # Rollups close FIRST: their close() stops + joins the
+            # catch-up thread, which READS the raw store — closing the
+            # store before the thread stops would make the rebuild die
+            # on closed fds with _stop unset and be misrecorded as a
+            # catch-up FAILURE (spurious _rebuild_error) instead of an
+            # orderly shutdown abort.
             try:
-                close = getattr(self.store, "close", None)
-                if close:
-                    close()
+                if getattr(self, "rollups", None) is not None:
+                    self.rollups.close()
             finally:
+                # The store MUST close even when checkpoint/flush (or
+                # the rollup close) raise — ENOSPC is a first-class
+                # path: close releases the WAL's single-writer flock,
+                # without which every later open of this path in the
+                # process is refused.
                 try:
-                    if getattr(self, "rollups", None) is not None:
-                        self.rollups.close()
+                    close = getattr(self.store, "close", None)
+                    if close:
+                        close()
                 finally:
                     dereg, self._deregister = self._deregister, None
                     if dereg:

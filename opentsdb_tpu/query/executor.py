@@ -86,14 +86,12 @@ class QueryExecutor:
         from opentsdb_tpu.stats.collector import LatencyDigest
         self.scan_latency = LatencyDigest()
         # Planner choice of the most recent run(): "raw", "resident"
-        # (device window), or a rollup resolution label ("1h"/"1d") —
-        # and the most recent ranged sketch_distinct's actual source
-        # ("rollup" vs "scan" fallback). Surfaced in /q and /distinct
-        # JSON metadata and informational only — a server sharing one
-        # executor across worker threads may see a neighbor query's
-        # label under contention.
+        # (device window), or a rollup resolution label ("1h"/"1d").
+        # A single-threaded convenience mirror (tests, benches); the
+        # server reads the label run_with_plan() RETURNS instead —
+        # concurrent requests sharing one executor would otherwise
+        # report a neighbor query's label in JSON metadata.
         self.last_plan = "raw"
-        self.last_sketch_source = "scan"
 
     # ------------------------------------------------------------------
     # Planning: scan + span assembly + grouping
@@ -195,6 +193,20 @@ class QueryExecutor:
 
     def run(self, spec: QuerySpec, start: int, end: int,
             ) -> list[QueryResult]:
+        return self.run_with_plan(spec, start, end)[0]
+
+    def run_with_plan(self, spec: QuerySpec, start: int, end: int,
+                      ) -> tuple[list[QueryResult], str]:
+        """run() plus the planner-choice label for THIS call ("raw",
+        "resident", or a rollup resolution like "1h"). Returned rather
+        than stashed on the executor so server threads sharing one
+        executor can't read a neighbor query's label."""
+        results, plan = self._run_planned(spec, start, end)
+        self.last_plan = plan
+        return results, plan
+
+    def _run_planned(self, spec: QuerySpec, start: int, end: int,
+                     ) -> tuple[list[QueryResult], str]:
         if end <= start:
             raise BadRequestError(
                 f"end time {end} is <= start time {start}")
@@ -205,8 +217,7 @@ class QueryExecutor:
                 "cardinality queries")
         dev = self._run_devwindow(spec, start, end, agg)
         if dev is not None:
-            self.last_plan = "resident"
-            return dev
+            return dev, "resident"
         # Rollup planner step: serve window-aligned downsamples from
         # the materialized summary tier (rollup/planner.py), with raw
         # stitching over edge/dirty windows. The returned spans are
@@ -217,14 +228,13 @@ class QueryExecutor:
         if planned is not None:
             groups, spec2, res = planned
             from opentsdb_tpu.rollup.tier import res_label
-            self.last_plan = res_label(res)
-            return self._execute_groups(spec2, groups, start, end)
-        self.last_plan = "raw"
+            return (self._execute_groups(spec2, groups, start, end),
+                    res_label(res))
         import time as _time
         t0 = _time.time()
         groups = self._find_spans(spec, start, end)
         self.scan_latency.add((_time.time() - t0) * 1000)
-        return self._execute_groups(spec, groups, start, end)
+        return self._execute_groups(spec, groups, start, end), "raw"
 
     def _plan_rollup(self, spec: QuerySpec, start: int, end: int):
         if getattr(self.tsdb, "rollups", None) is None:
@@ -953,9 +963,12 @@ class QueryExecutor:
         res, records, raw_parts, dirty = sel
         means: list[np.ndarray] = []
         weights: list[np.ndarray] = []
-        nseries = 0
+        # Series counted by CONTRIBUTION (digest or raw values), not by
+        # which map they appear in: a series whose rollup windows are
+        # all dirty contributes only through raw_parts but is still in
+        # records, so map-membership tests undercount it.
+        contributing: set[bytes] = set()
         for skey, (bases, recs, sketches) in records.items():
-            used = False
             for wb, blob in sketches:
                 if wb in dirty:
                     continue
@@ -963,15 +976,12 @@ class QueryExecutor:
                 if len(m):
                     means.append(m.astype(np.float64))
                     weights.append(w.astype(np.float64))
-                    used = True
-            if used:
-                nseries += 1
+                    contributing.add(skey)
         for skey, (ts, vals) in raw_parts.items():
             if len(vals):
                 means.append(vals.astype(np.float32).astype(np.float64))
                 weights.append(np.ones(len(vals)))
-                if skey not in records:
-                    nseries += 1
+                contributing.add(skey)
         if not means:
             raise BadRequestError(
                 f"no data for metric {metric} in range")
@@ -980,7 +990,7 @@ class QueryExecutor:
         if len(m) > (1 << 16):
             m, w = rsummary.digest_compress(m, w, 4096)
         est = rsummary.digest_quantile(m, w, qs)
-        return {"metric": metric, "series": nseries,
+        return {"metric": metric, "series": len(contributing),
                 "rollup": res_label(res),
                 "quantiles": {f"{q:g}": float(v)
                               for q, v in zip(qs, est)}}
@@ -997,6 +1007,17 @@ class QueryExecutor:
         With [start, end]: EXACT count over the series with data in
         the range, selected from rollup-record presence (O(windows))
         plus raw stitches — or a raw scan when the tier can't serve."""
+        return self.sketch_distinct_with_source(metric, tagk,
+                                                start, end)[0]
+
+    def sketch_distinct_with_source(
+            self, metric: str, tagk: str, start: int | None = None,
+            end: int | None = None) -> tuple[int | None, str]:
+        """sketch_distinct() plus the label of what actually answered
+        THIS call: "stream" (no range), "rollup" (record presence), or
+        "scan" (exact fallback). Returned rather than stashed on the
+        executor — /distinct reports the source in its JSON, and a
+        shared attribute could carry a concurrent request's label."""
         if start is not None or end is not None:
             if start is None or end is None or end <= start:
                 raise BadRequestError(
@@ -1004,27 +1025,29 @@ class QueryExecutor:
             return self._sketch_distinct_range(metric, tagk, start, end)
         sk = self.tsdb.sketches
         if sk is None:
-            return None
+            return None, "stream"
         from opentsdb_tpu.core.errors import NoSuchUniqueName
         try:
-            return sk.distinct(self.tsdb.metrics.get_id(metric),
-                               self.tsdb.tagk.get_id(tagk))
+            return (sk.distinct(self.tsdb.metrics.get_id(metric),
+                                self.tsdb.tagk.get_id(tagk)), "stream")
         except NoSuchUniqueName:
-            return None
+            return None, "stream"
 
-    def _sketch_distinct_range(self, metric: str, tagk: str,
-                               start: int, end: int) -> int:
+    def _sketch_distinct_range(self, metric: str, tagk: str, start: int,
+                               end: int) -> tuple[int, str]:
         from opentsdb_tpu.core import codec as _codec
         from opentsdb_tpu.rollup import planner as rplanner
 
         tagk_uid = self.tsdb.tagk.get_id(tagk)
         tier = getattr(self.tsdb, "rollups", None)
-        sel = rplanner.sketch_windows(self, tier, metric, {}, start, end)
+        # Presence-only: record existence at ANY resolution answers
+        # "which series had data", so short ranges and digest-free
+        # tiers still serve from rollups instead of a full exact scan.
+        sel = rplanner.sketch_windows(self, tier, metric, {}, start, end,
+                                      presence_only=True)
         if sel is None:
-            self.last_sketch_source = "scan"
-            return self.distinct_tagv(metric, {}, tagk, start, end,
-                                      exact=True)
-        self.last_sketch_source = "rollup"
+            return (self.distinct_tagv(metric, {}, tagk, start, end,
+                                       exact=True), "scan")
         _, records, raw_parts, dirty = sel
         vals: set[bytes] = set()
         for skey, (bases, recs, _sk) in records.items():
@@ -1039,7 +1062,7 @@ class QueryExecutor:
             v = _codec.series_tag_uids(skey).get(tagk_uid)
             if v is not None:
                 vals.add(v)
-        return len(vals)
+        return len(vals), "rollup"
 
     def sketch_distinct_values(self, metric: str, tags: dict[str, str],
                                start: int, end: int) -> dict:

@@ -94,47 +94,26 @@ def plan(executor, spec, start: int, end: int):
     if not tier.ready:
         tier.note_miss()
         return None
-    w_lo, w_hi, edges = window_split(start, end, res)
-    if w_hi < w_lo:
-        tier.note_fallback("short-range")
+    sel = _select_windows(executor, tier, spec.metric, spec.tags,
+                          start, end, res, want_sketches=False)
+    if sel is None:
         return None
-
-    # Dirty windows: any raw row of the window still outside the
-    # folded tier (for ANY series — window granularity keeps the set
-    # small and the stitch scans contiguous).
-    hours = tier.dirty_hour_bases()
-    dirty = np.unique(hours - hours % res) if len(hours) else hours
-    dirty = dirty[(dirty >= w_lo) & (dirty <= w_hi)]
-    n_windows = (w_hi - w_lo) // res + 1
-    if len(dirty) > _MAX_DIRTY_FRACTION * n_windows:
-        tier.note_fallback("mostly-dirty")
-        return None
-
-    # Raw path setup shared with the scan planner: same UID filters,
-    # same key regexp (rollup keys have the raw key shape).
-    metric_uid = tsdb.metrics.get_id(spec.metric)
-    exact, group_bys = executor._tag_filters(spec.tags)
-    group_by_keys = sorted(k for k, _ in group_bys)
-    regexp = executor._build_regexp(exact, group_bys)
-
-    records = tier.scan_records(res, metric_uid, w_lo, w_hi,
-                                key_regexp=regexp)
-    dirty_set = frozenset(int(b) for b in dirty)
-
-    raw_ranges = _coalesce(
-        edges + [(int(w), int(w) + res - 1) for w in dirty])
-    raw_parts = _scan_raw_parts(tsdb, metric_uid, regexp, raw_ranges)
+    records, raw_parts, dirty_set = sel
+    group_by_keys = sorted(
+        k for k, _ in executor._tag_filters(spec.tags)[1])
 
     from opentsdb_tpu.query.executor import _Span
 
+    dirty_arr = (np.fromiter(dirty_set, np.int64, len(dirty_set))
+                 if dirty_set else None)
     groups: dict[tuple, list] = {}
     for skey in sorted(set(records) | set(raw_parts)):
         bases_list, recs_list = [], []
         hit = records.get(skey)
         if hit is not None:
             bases, recs, _ = hit
-            if dirty_set:
-                keep = ~np.isin(bases, dirty)
+            if dirty_arr is not None:
+                keep = ~np.isin(bases, dirty_arr)
                 bases, recs = bases[keep], recs[keep]
             if len(bases):
                 bases_list.append(bases)
@@ -195,21 +174,54 @@ def _scan_raw_parts(tsdb, metric_uid: bytes, regexp: bytes | None,
 
 
 def sketch_windows(executor, tier, metric: str, tags: dict,
-                   start: int, end: int):
+                   start: int, end: int, presence_only: bool = False):
     """Shared selection for the range-limited sketch endpoints: pick a
     sketch-bearing resolution, split the range, and return
     ``(res, records, raw_parts, dirty_set)`` — records carry sketch
     blobs, raw_parts the edge/dirty points to fold in. None when the
     tier cannot serve the range (caller falls back to an exact raw
-    computation)."""
+    computation).
+
+    ``presence_only`` (ranged /distinct): the caller needs record
+    PRESENCE, not sketch columns — any resolution serves, so pick the
+    finest one that fits (narrowest raw edges), skip the sketch-bearing
+    gate (works with digest_k=0 / sub-sketch_min_res ranges, which
+    otherwise force a full exact scan), and don't decode blobs."""
     if tier is None or not tier.ready:
         if tier is not None:
             tier.note_miss()
         return None
-    res = tier.sketch_resolution(max(end - start + 1, 1))
-    if res is None:
-        tier.note_fallback("sketch-res")
+    span = max(end - start + 1, 1)
+    if presence_only:
+        if tier.resolutions[0] > span:
+            tier.note_fallback("short-range")  # no sketch gate involved
+            return None
+        res = tier.resolutions[0]
+    else:
+        res = tier.sketch_resolution(span)
+        if res is None:
+            tier.note_fallback("sketch-res")
+            return None
+    sel = _select_windows(executor, tier, metric, tags, start, end,
+                          res, want_sketches=not presence_only)
+    if sel is None:
         return None
+    records, raw_parts, dirty_set = sel
+    tier.note_hit(res)
+    return res, records, raw_parts, dirty_set
+
+
+def _select_windows(executor, tier, metric: str, tags: dict,
+                    start: int, end: int, res: int,
+                    want_sketches: bool):
+    """THE range selection, shared by plan() and sketch_windows() so
+    moment queries and sketch endpoints can never disagree on which
+    windows serve from the tier: split [start, end] into full windows
+    at ``res`` plus raw edges, derive the dirty-window set (any raw
+    row still outside the folded tier, window granularity), fall back
+    on short or mostly-dirty ranges, scan the tier's records, and
+    raw-scan the coalesced edge+dirty stitch ranges. Returns
+    ``(records, raw_parts, dirty_set)`` or None (caller serves raw)."""
     w_lo, w_hi, edges = window_split(start, end, res)
     if w_hi < w_lo:
         tier.note_fallback("short-range")
@@ -221,15 +233,17 @@ def sketch_windows(executor, tier, metric: str, tags: dict,
     if len(dirty) > _MAX_DIRTY_FRACTION * n_windows:
         tier.note_fallback("mostly-dirty")
         return None
+    # Raw path setup shared with the scan planner: same UID filters,
+    # same key regexp (rollup keys have the raw key shape).
     tsdb = executor.tsdb
     metric_uid = tsdb.metrics.get_id(metric)
     exact, group_bys = executor._tag_filters(tags)
     regexp = executor._build_regexp(exact, group_bys)
     records = tier.scan_records(res, metric_uid, w_lo, w_hi,
-                                key_regexp=regexp, want_sketches=True)
+                                key_regexp=regexp,
+                                want_sketches=want_sketches)
     dirty_set = frozenset(int(b) for b in dirty)
     raw_ranges = _coalesce(
         edges + [(int(w), int(w) + res - 1) for w in dirty_set])
     raw_parts = _scan_raw_parts(tsdb, metric_uid, regexp, raw_ranges)
-    tier.note_hit(res)
-    return res, records, raw_parts, dirty_set
+    return records, raw_parts, dirty_set

@@ -159,23 +159,6 @@ def window_summaries(ts: np.ndarray, vals: np.ndarray, res: int,
     return wbase, rec
 
 
-def merge_records(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """Merge two records of the SAME window (a earlier batch, b later —
-    rebuild accumulates partial windows across scan chunks). Sum adds
-    sequentially (associativity-tolerance only), min/max/count exact,
-    first/last ordered by their in-window deltas."""
-    out = a.copy()
-    out["count"] = a["count"] + b["count"]
-    out["sum"] = a["sum"] + b["sum"]
-    out["min"] = np.minimum(a["min"], b["min"])
-    out["max"] = np.maximum(a["max"], b["max"])
-    if b["first_dt"] < a["first_dt"]:
-        out["first"], out["first_dt"] = b["first"], b["first_dt"]
-    if b["last_dt"] >= a["last_dt"]:
-        out["last"], out["last_dt"] = b["last"], b["last_dt"]
-    return out
-
-
 # ---------------------------------------------------------------------------
 # Bucket combination (planner side)
 # ---------------------------------------------------------------------------

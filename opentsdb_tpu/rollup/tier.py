@@ -72,6 +72,10 @@ _RAW_FAMILY = b"t"
 _FLUSH_CELLS = 1 << 16
 
 
+class _TierClosed(Exception):
+    """Internal: the catch-up rebuild was aborted by close()."""
+
+
 def _u32(v: int) -> bytes:
     return int(v).to_bytes(4, "big")
 
@@ -102,12 +106,23 @@ class _MapBuffer:
     touches a superrow across two of its own flushes reads its first
     flush back from the store's memtable."""
 
-    def __init__(self, tier: "RollupTier") -> None:
+    def __init__(self, tier: "RollupTier",
+                 track_emitted: bool = False) -> None:
         self.tier = tier
         # (res, shard) -> {row key -> (moment entries, sketch entries)}
         self.maps: dict[tuple[int, int], dict] = {}
         self.total = 0
         self.written = 0
+        # Which window slots this buffer emitted a REAL record for,
+        # surviving flushes (maps are cleared at _FLUSH_CELLS, so the
+        # in-buffer state can't answer "did this fold cover that
+        # window?"): (res, superrow key) -> bitmask of emitted window
+        # idxs — a few bytes per superrow where a per-slot tuple set
+        # cost ~64 bytes per RECORD (hundreds of MB on big folds).
+        # Only folds track it — it gates _zero_leftovers, which the
+        # full rebuild never runs.
+        self.emitted: dict[tuple[int, bytes], int] | None = (
+            {} if track_emitted else None)
 
     def entries(self, res: int, key: bytes) -> tuple[dict, dict]:
         si = self.tier._shard_of(key)
@@ -214,6 +229,11 @@ class RollupTier:
         self._rebuilding = False
         self._rebuild_error: BaseException | None = None
         self._rebuild_thread: threading.Thread | None = None
+        # close() sets this and joins the catch-up thread: letting the
+        # thread race the closing stores would discard the whole
+        # rebuild into _rebuild_error (hours of work at scale) and
+        # possibly trip mid-write fd races inside MemKVStore.close.
+        self._stop = threading.Event()
         self._fold_lock = threading.Lock()
         self._defer_lock = threading.Lock()
         self._deferred: list[bytes] = []
@@ -266,11 +286,41 @@ class RollupTier:
 
     # -- state file --------------------------------------------------------
 
+    STATE_VERSION = 2
+
     def _config_dict(self) -> dict:
-        return {"version": 2, "resolutions": list(self.resolutions),
+        return {"version": self.STATE_VERSION,
+                "resolutions": list(self.resolutions),
                 "pack": self.pack, "digest_k": self.digest_k,
                 "hll_p": self.hll_p,
                 "sketch_min_res": self.sketch_min_res}
+
+    @classmethod
+    def adopt_config(cls, state_path: str, config) -> bool:
+        """Copy an existing tier's layout (ROLLUP.json, the inverse of
+        _config_dict) onto ``config`` — the CLI's tier auto-adopt, kept
+        HERE so the state-file schema has one owner. Returns False
+        (config untouched) for an unreadable, foreign-version, or
+        malformed file; the tier then opens on Config defaults and the
+        config-mismatch check schedules a rebuild."""
+        try:
+            with open(state_path) as f:
+                rec = json.load(f)
+            if rec.get("version") != cls.STATE_VERSION:
+                return False
+            resolutions = tuple(int(r) for r in rec["resolutions"])
+            pack = int(rec["pack"])
+            digest_k = int(rec["digest_k"])
+            hll_p = int(rec["hll_p"])
+            sketch_min_res = int(rec["sketch_min_res"])
+        except (OSError, ValueError, TypeError, KeyError):
+            return False
+        config.rollup_resolutions = resolutions
+        config.rollup_pack = pack
+        config.rollup_digest_k = digest_k
+        config.rollup_hll_p = hll_p
+        config.rollup_sketch_min_res = sketch_min_res
+        return True
 
     def _read_state(self) -> dict | None:
         try:
@@ -356,9 +406,14 @@ class RollupTier:
         if cached is not None and cached[0] == seq:
             base = cached[1]
         else:
-            keys = store.pending_keys(self.table)
+            lo, hi = UID_WIDTH, UID_WIDTH + TIMESTAMP_BYTES
+            # Malformed/short keys (a stray delete_row from a tool)
+            # carry no base time to mark dirty — skip them like the
+            # fold paths do, or the frombuffer below would raise on
+            # every query until a checkpoint drains the key.
+            keys = [k for k in store.pending_keys(self.table)
+                    if len(k) >= hi]
             if keys:
-                lo, hi = UID_WIDTH, UID_WIDTH + TIMESTAMP_BYTES
                 blob = b"".join(k[lo:hi] for k in keys)
                 base = np.unique(
                     np.frombuffer(blob, ">u4").astype(np.int64))
@@ -449,7 +504,23 @@ class RollupTier:
         """After the raw spill: fold the spilled keys into summary
         records, commit, and clear the in-flight set. During a rebuild
         the keys are deferred — the catch-up pass drains them."""
-        keys = self.tsdb.store.take_spill_keys().get(self.table, [])
+        store = self.tsdb.store
+        # Rows ingested between begin_spill's dirty snapshot and the
+        # store's memtable freeze were spilled WITHOUT being in the
+        # pre-spill in-flight set. Mark their windows in flight from a
+        # non-draining PEEK, while their keys still read as pending
+        # (pending_keys includes the undrained spill record), so no
+        # instant exists where a spilled-but-unfolded window is in
+        # neither set; only then drain.
+        peek = getattr(store, "peek_spill_keys", None)
+        if peek is not None:
+            extra = frozenset(
+                int(codec.key_base_time(k))
+                for k in peek().get(self.table, ())
+                if len(k) >= UID_WIDTH + TIMESTAMP_BYTES)
+            if not extra <= self._inflight:
+                self._inflight = self._inflight | extra
+        keys = store.take_spill_keys().get(self.table, [])
         with self._defer_lock:
             if self._rebuilding:
                 self._deferred.extend(keys)
@@ -464,8 +535,18 @@ class RollupTier:
         except IllegalDataError as e:
             # Corrupt raw data (the fsck signal): leave the tier
             # not-ready (state stays pending) so the planner serves
-            # raw; never wedge the checkpoint itself.
+            # raw; never wedge the checkpoint itself. The drained keys
+            # are lost, so mark a full rebuild owed (_behind): without
+            # it the NEXT clean fold would clear _inflight, write
+            # pending=false, and flip ready while THESE windows were
+            # never folded — stale summaries served, and pending=false
+            # on disk means a restart would skip the rebuild too. The
+            # rebuild runs at the next open (state is still pending);
+            # it aborts on the same corrupt rows until fsck --fix, and
+            # queries serve raw throughout.
             LOG.warning("rollup fold skipped (corrupt data): %s", e)
+            with self._defer_lock:
+                self._behind = True
             self._ready = False
             self.note_fallback("corrupt")
             return
@@ -502,7 +583,7 @@ class RollupTier:
                 hb = codec.key_base_time(k)
                 per_metric.setdefault(
                     bytes(k[:UID_WIDTH]), set()).add(hb - hb % coarse)
-            buf = _MapBuffer(self)
+            buf = _MapBuffer(self, track_emitted=True)
             seen: set[bytes] = set()
             # Bound one scan chunk to ~4 days of coarse windows.
             chunk = max(1, (4 * 86400) // coarse)
@@ -525,10 +606,18 @@ class RollupTier:
                         buf: _MapBuffer) -> None:
         """Write count-0 records for spilled rows that no longer hold
         points (deleted): the planner skips them, replacing whatever
-        stale summary the window had."""
+        stale summary the window had. Only slots the fold's rescan
+        emitted NOTHING for are zeroed — a coarse window (say 1d) of a
+        deleted hourly row usually still holds the series' surviving
+        hours, and its record was just recomputed from them; zeroing it
+        too would drop the whole day from rollup serving while raw
+        scans keep returning the survivors ("stale degrades, never
+        lies")."""
         zero = np.zeros(1, REC_DTYPE).tobytes()
         empty_sketch = summary.sketch_encode(
             np.empty(0, np.float32), np.empty(0, np.float32), None)
+        emitted = buf.emitted
+        assert emitted is not None, "_zero_leftovers needs a tracking buffer"
         for k in leftovers:
             skey = codec.series_key(k)
             hb = codec.key_base_time(k)
@@ -538,6 +627,8 @@ class RollupTier:
                 sb = wb - wb % span
                 key = skey[:UID_WIDTH] + _u32(sb) + skey[UID_WIDTH:]
                 idx = (wb - sb) // r
+                if emitted.get((r, key), 0) >> idx & 1:
+                    continue
                 moments, sketches = buf.entries(r, key)
                 moments[idx] = zero
                 if self._sketchy(r):
@@ -548,11 +639,15 @@ class RollupTier:
         return bool(self.digest_k) and res >= self.sketch_min_res
 
     def _rollup_span(self, metric_uid: bytes, lo: int, hi: int,
-                     buf: _MapBuffer, seen: set | None = None) -> None:
+                     buf: _MapBuffer, seen: set | None = None,
+                     stoppable: bool = False) -> None:
         """Recompute records for every raw point of ``metric`` with row
         base in [lo, hi) — streamed one coarsest window at a time (raw
         keys are base-major within a metric, so a coarse window's rows
-        are contiguous in the scan)."""
+        are contiguous in the scan). ``stoppable`` (the rebuild path)
+        aborts at coarse-window boundaries once close() set _stop;
+        checkpoint folds never abort — their caller owns shutdown
+        ordering and an aborted fold would drop spilled keys."""
         coarse = self.resolutions[-1]
         start_key = metric_uid + _u32(max(lo, 0))
         stop_key = (_metric_stop(metric_uid) if hi > 0xFFFFFFFF
@@ -564,6 +659,8 @@ class RollupTier:
             cb = codec.key_base_time(key)
             cb -= cb % coarse
             if cur is not None and cb != cur and rows:
+                if stoppable and self._stop.is_set():
+                    raise _TierClosed()
                 self._summarize_group(rows, buf, seen)
                 rows = []
             cur = cb
@@ -642,12 +739,19 @@ class RollupTier:
             idxs = ((wb - sbs) // r).astype(np.int64)
             run_starts = np.concatenate(
                 ([0], np.flatnonzero(np.diff(sbs)) + 1, [len(wb)]))
+            emitted = buf.emitted
             for a, b in zip(run_starts[:-1], run_starts[1:]):
                 key = head + _u32(int(sbs[a])) + tail
                 moments = buf.entries(r, key)[0]
+                mask = 0
                 for j in range(a, b):
-                    moments[int(idxs[j])] = \
+                    idx = int(idxs[j])
+                    moments[idx] = \
                         blob[j * REC_SIZE:(j + 1) * REC_SIZE]
+                    mask |= 1 << idx
+                if emitted is not None:
+                    ek = (r, key)
+                    emitted[ek] = emitted.get(ek, 0) | mask
                 buf.count(b - a)
             if self._sketchy(r):
                 sb_arr, blobs = summary.window_sketches(
@@ -670,33 +774,73 @@ class RollupTier:
             with self._fold_lock:
                 names = self.tsdb.metrics.suggest("", limit=1 << 30)
                 for name in names:
+                    if self._stop.is_set():
+                        raise _TierClosed()
                     uid = self.tsdb.metrics.get_id(name)
-                    self._rollup_span(uid, 0, 1 << 33, buf)
+                    self._rollup_span(uid, 0, 1 << 33, buf,
+                                      stoppable=True)
                 buf.flush()
                 self.records_written += buf.written
+            # Completion commits under the TSDB's checkpoint lock: the
+            # flag flip + state write must not interleave with a
+            # checkpoint's begin_spill/fold_after_spill bracket, or this
+            # thread's pending=false + _inflight clear would land while
+            # that checkpoint's spill is uncommitted (the same torn
+            # bracket TSDB._checkpoint_lock closes for checkpoint vs
+            # checkpoint). Lock order everywhere: checkpoint lock, then
+            # defer lock, then fold lock — _fold and the rollup-store
+            # spills below run with NEITHER outer lock held, so
+            # checkpoints keep draining into _deferred instead of
+            # blocking behind this thread's longest work.
+            # Direct attribute access on purpose: a TSDB-like owner
+            # without the lock must fail loudly here, not hand the
+            # commit a private lock nobody else holds (which would
+            # silently disable the torn-bracket protection).
+            ckpt_lock = self.tsdb._checkpoint_lock
             while True:
+                if self._stop.is_set():
+                    raise _TierClosed()
                 with self._defer_lock:
                     keys, self._deferred = self._deferred, []
-                    if not keys:
-                        # Both flags flip under the defer lock so a
-                        # racing fold either lands in _deferred (drained
-                        # here) or proceeds as a normal fold — never
-                        # drops keys in between.
+                if keys:
+                    self._fold(keys)
+                    continue
+                # Bound the rollup WALs BEFORE taking the checkpoint
+                # lock: a full-tier spill can run for minutes at scale
+                # and is WAL-durable regardless — only the flag flips
+                # and the state write belong inside the bracket. A fold
+                # sneaking in after these spills just re-checkpoints a
+                # small delta on the next pass.
+                for stores in self.stores.values():
+                    for s in stores:
+                        s.checkpoint()
+                with ckpt_lock:
+                    with self._defer_lock:
+                        if self._deferred:
+                            continue  # a fold snuck in before the lock
+                        # Both flags flip under the defer lock (and with
+                        # no checkpoint mid-bracket) so a racing fold
+                        # either lands in _deferred (drained here) or
+                        # proceeds as a normal fold — never drops keys.
                         self._rebuilding = False
                         self._behind = False
-                        break
-                self._fold(keys)
-            for stores in self.stores.values():
-                for s in stores:
-                    s.checkpoint()
-            self._write_state(pending=False)
-            self._inflight = frozenset()
-            self._ready = True
-            self.rebuilds += 1
+                    self._write_state(pending=False)
+                    self._inflight = frozenset()
+                    self._ready = True
+                    self.rebuilds += 1
+                break
         except BaseException as e:
             self._rebuilding = False
-            self._rebuild_error = e
-            LOG.exception("rollup catch-up failed; tier stays raw-only")
+            if isinstance(e, _TierClosed) or self._stop.is_set():
+                # Orderly close() abort (the stores may already be
+                # closing under us): state stays pending and the next
+                # open rebuilds — not a failure.
+                LOG.info("rollup catch-up aborted by close(); the next "
+                         "open rebuilds")
+            else:
+                self._rebuild_error = e
+                LOG.exception(
+                    "rollup catch-up failed; tier stays raw-only")
 
     # -- stats / lifecycle -------------------------------------------------
 
@@ -718,6 +862,15 @@ class RollupTier:
                 s.flush()
 
     def close(self) -> None:
+        # Stop + join the catch-up thread BEFORE closing its stores:
+        # racing it would discard the whole rebuild into _rebuild_error
+        # and close WAL fds out from under its writes.
+        stop = getattr(self, "_stop", None)
+        if stop is not None:
+            stop.set()
+        t = getattr(self, "_rebuild_thread", None)
+        if t is not None and t.is_alive():
+            t.join()
         first: BaseException | None = None
         for stores in getattr(self, "stores", {}).values():
             for s in stores:

@@ -701,13 +701,16 @@ class TSDServer:
                 downsample=parsed.downsample, counter=parsed.counter,
                 counter_max=parsed.counter_max,
                 reset_value=parsed.reset_value)
-            rs = await loop.run_in_executor(
-                self._pool, self.executor.run, spec, start, end)
-            results.extend(rs)
-            result_opts.extend([os_[mi] if mi < len(os_) else ""] * len(rs))
             # Planner choice for this sub-query ("raw", "resident", or
             # a rollup resolution label) — surfaced in JSON metadata.
-            result_plans.extend([self.executor.last_plan] * len(rs))
+            # Returned with the results: reading it back off the shared
+            # executor after the pool hop could pick up a CONCURRENT
+            # request's label.
+            rs, plan = await loop.run_in_executor(
+                self._pool, self.executor.run_with_plan, spec, start, end)
+            results.extend(rs)
+            result_opts.extend([os_[mi] if mi < len(os_) else ""] * len(rs))
+            result_plans.extend([plan] * len(rs))
 
         extra: dict = {}
         if "ascii" in q:
@@ -833,6 +836,12 @@ class TSDServer:
                 raise BadRequestError(f"Missing parameter: {req}")
         loop = asyncio.get_running_loop()
         if "stream" in q or "start" not in q:
+            if "end" in q and "stream" not in q:
+                # Mirror /sketch: end= alone must not silently answer
+                # the all-time streaming estimate for a ranged intent.
+                raise BadRequestError(
+                    "distinct range needs start= (end= alone would "
+                    "silently answer all-time)")
             n = await loop.run_in_executor(
                 self._pool, self.executor.sketch_distinct, q["metric"],
                 q["tagk"])
@@ -852,12 +861,12 @@ class TSDServer:
             for t in q["tags"].split(","):
                 tags_mod.parse(tag_map, t)
         if not tag_map:
-            n = await loop.run_in_executor(
-                self._pool, self.executor.sketch_distinct, q["metric"],
-                q["tagk"], start, end)
-            # What actually answered: the executor falls back to the
-            # exact scan whenever the tier can't cover the range.
-            source = self.executor.last_sketch_source
+            # What actually answered ("rollup" or the exact-scan
+            # fallback), returned alongside the count so concurrent
+            # /distinct requests can't mislabel each other.
+            n, source = await loop.run_in_executor(
+                self._pool, self.executor.sketch_distinct_with_source,
+                q["metric"], q["tagk"], start, end)
         else:
             n = await loop.run_in_executor(
                 self._pool, self.executor.distinct_tagv, q["metric"],

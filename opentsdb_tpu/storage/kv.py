@@ -659,12 +659,14 @@ class MemKVStore(KVStore):
             return list(self._table(table).rows)
 
     def pending_keys(self, table: str) -> list[bytes]:
-        """Row keys (and row tombstones) NOT yet in the sstable tier:
-        the live memtable plus a frozen mid-checkpoint tier. This is
-        the rollup planner's dirty-window source — every raw point not
-        yet covered by a materialized summary lives under one of these
-        keys (spilled-but-not-yet-folded keys are tracked separately by
-        the tier's in-flight set)."""
+        """Row keys (and row tombstones) NOT yet covered by the rollup
+        fold: the live memtable, a frozen mid-checkpoint tier, and the
+        UNDRAINED spilled-key record. This is the rollup planner's
+        dirty-window source. Spilled keys count as pending until the
+        fold drains them (take_spill_keys) precisely so no instant
+        exists where a spilled-but-unfolded window is in neither this
+        set nor the tier's in-flight set — the fold marks its windows
+        in flight BEFORE draining (rollup/tier.py fold_after_spill)."""
         with self._lock:
             t = self._table(table)
             out = list(t.rows)
@@ -674,7 +676,16 @@ class MemKVStore(KVStore):
                 if ft is not None:
                     out.extend(ft.rows)
                     out.extend(ft.row_tombs)
+            out.extend(self._last_spill_keys.get(table, ()))
             return out
+
+    def peek_spill_keys(self) -> dict[str, list[bytes]]:
+        """Non-draining copy of the spilled-key record: the rollup fold
+        reads it to mark windows in flight while their keys still read
+        as pending, THEN drains with take_spill_keys."""
+        with self._lock:
+            return {name: list(ks)
+                    for name, ks in self._last_spill_keys.items()}
 
     def take_spill_keys(self) -> dict[str, list[bytes]]:
         """Drain the spilled-key record (see record_spill_keys)."""

@@ -387,6 +387,13 @@ class ShardedKVStore(KVStore):
             out.extend(s.pending_keys(table))
         return out
 
+    def peek_spill_keys(self) -> dict[str, list[bytes]]:
+        out: dict[str, list[bytes]] = {}
+        for s in self.shards:
+            for name, ks in s.peek_spill_keys().items():
+                out.setdefault(name, []).extend(ks)
+        return out
+
     def take_spill_keys(self) -> dict[str, list[bytes]]:
         out: dict[str, list[bytes]] = {}
         for s in self.shards:

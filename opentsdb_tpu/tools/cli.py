@@ -116,6 +116,11 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
     if getattr(args, "rollups", False):
         cfg.enable_rollups = True
     if getattr(args, "rollup_resolutions", None):
+        # An explicit layout implies the tier: without this, a writer
+        # invoked with --rollup-resolutions but not --rollups would
+        # spill a rollup-backed store without folding (skipping the
+        # auto-adopt below too) and leave summaries silently stale.
+        cfg.enable_rollups = True
         cfg.rollup_resolutions = tuple(
             int(r) for r in args.rollup_resolutions.split(","))
     elif args.wal:
@@ -125,24 +130,13 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         # offline tools (import/fsck/scan --delete) must keep the tier
         # current whenever its state file exists, flag or no flag. The
         # state file's own layout wins over Config defaults.
-        import json as _json
-
-        from opentsdb_tpu.rollup.tier import STATE_NAME
+        from opentsdb_tpu.rollup.tier import STATE_NAME, RollupTier
         for sp in (os.path.join(args.wal, STATE_NAME),
                    args.wal + ".rollup.json"):
             if os.path.exists(sp):
                 cfg.enable_rollups = True
-                try:
-                    with open(sp) as f:
-                        rec = _json.load(f)
-                    cfg.rollup_resolutions = tuple(rec["resolutions"])
-                    cfg.rollup_pack = int(rec["pack"])
-                    cfg.rollup_digest_k = int(rec["digest_k"])
-                    cfg.rollup_hll_p = int(rec["hll_p"])
-                    cfg.rollup_sketch_min_res = int(
-                        rec["sketch_min_res"])
-                except (OSError, ValueError, KeyError):
-                    pass  # unreadable state: tier opens and rebuilds
+                # Unreadable/foreign state: tier opens and rebuilds.
+                RollupTier.adopt_config(sp, cfg)
                 break
     # The device-resident hot window serves long-lived query traffic;
     # one-shot tools (import/scan/fsck/uid/query) would only pay its

@@ -10,6 +10,7 @@ raw scans, never to wrong answers.
 """
 
 import os
+import threading
 
 import numpy as np
 import pytest
@@ -55,8 +56,7 @@ def ingest(tsdb, series=5, days=3, step=600, seed=0, metric=METRIC,
 
 def run_both(ex, spec, start, end):
     """(rollup_results, rollup_plan, raw_results) on one executor."""
-    a = ex.run(spec, start, end)
-    plan = ex.last_plan
+    a, plan = ex.run_with_plan(spec, start, end)
     tier, ex.tsdb.rollups = ex.tsdb.rollups, None
     try:
         b = ex.run(spec, start, end)
@@ -264,6 +264,182 @@ class TestCrashSafety:
             assert_equal_results(a, b, exact=True)
             # And the deleted hour really is gone.
             assert int(a[0].timestamps[0]) >= BASE + 3600
+            # The COARSE record of the deleted row's day must keep the
+            # surviving 23 hours: zeroing every resolution for the
+            # deleted key overwrote the 1d record the same fold just
+            # recomputed, silently dropping the whole day from
+            # rollup-served daily queries while raw scans returned it.
+            spec_d = QuerySpec(METRIC, {"host": "h0"}, "sum",
+                               downsample=(86400, "sum"))
+            a, plan, b = run_both(ex, spec_d, BASE, BASE + 3 * 86400)
+            assert plan == "1d"
+            assert_equal_results(a, b, exact=True)
+            assert len(a[0].timestamps) == 3  # all three days served
+        finally:
+            tsdb.shutdown()
+
+    def test_short_row_key_does_not_break_planner(self, tmp_path):
+        """A malformed/short pending key (stray delete_row from a tool)
+        must be skipped by the dirty-window derivation, not crash every
+        query until a checkpoint drains it."""
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, days=1)
+            tsdb.checkpoint()
+            tsdb.store.delete_row(tsdb.table, b"junk")
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum", downsample=(3600, "sum"))
+            a, plan, b = run_both(ex, spec, BASE, BASE + 86400)
+            assert plan == "1h"
+            assert_equal_results(a, b, exact=True)
+        finally:
+            tsdb.shutdown()
+
+    def test_fold_marks_spilled_windows_inflight(self, tmp_path):
+        """Rows spilled WITHOUT being in begin_spill's pre-freeze dirty
+        snapshot (ingested in the snapshot-to-freeze gap) must be
+        marked in-flight by the fold itself — they left pending_keys at
+        the spill commit, and an unmarked window would serve its stale
+        record for the whole fold."""
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, days=1)
+            tier = tsdb.rollups
+            seen = {}
+            orig = tier._fold
+
+            def spy(keys):
+                seen["during"] = set(tier._inflight)
+                return orig(keys)
+
+            tier._fold = spy
+            # Raw spill with no begin_spill bracket: every spilled row
+            # simulates one that missed the pre-spill snapshot.
+            tsdb.store.checkpoint()
+            tier.fold_after_spill()
+            assert len(seen["during"]) == 24    # the day's hour bases
+            assert tier._inflight == frozenset()  # cleared on commit
+        finally:
+            tsdb.shutdown()
+
+    def test_close_mid_rebuild_aborts_orderly(self, tmp_path):
+        """close() during a background catch-up must stop + join the
+        rebuild thread (not race the closing stores): state stays
+        pending, no _rebuild_error, and the next open rebuilds."""
+        from opentsdb_tpu.rollup.tier import RollupTier, _TierClosed
+        tsdb = make_tsdb(str(tmp_path))
+        ingest(tsdb, days=1)
+        tsdb.checkpoint()
+        tsdb.shutdown()
+        os.remove(os.path.join(str(tmp_path), "wal.rollup.json"))
+        orig_span = RollupTier._rollup_span
+        entered = threading.Event()
+
+        def slow_span(self, *a, **k):
+            entered.set()
+            self._stop.wait(10)     # block until close() signals
+            if self._stop.is_set():
+                raise _TierClosed()
+            return orig_span(self, *a, **k)
+
+        RollupTier._rollup_span = slow_span
+        try:
+            tsdb2 = make_tsdb(str(tmp_path), rollup_catchup="background")
+            try:
+                assert entered.wait(5)
+            finally:
+                tsdb2.shutdown()     # joins the rebuild thread
+        finally:
+            RollupTier._rollup_span = orig_span
+        assert tsdb2.rollups._rebuild_error is None
+        assert not tsdb2.rollups.ready
+        # State stayed pending: the next (unpatched) open rebuilds.
+        tsdb3 = make_tsdb(str(tmp_path))
+        try:
+            assert tsdb3.rollups.rebuilds == 1
+            assert tsdb3.rollups.ready
+        finally:
+            tsdb3.shutdown()
+
+    def test_corrupt_fold_keeps_tier_unready_until_rebuild(self, tmp_path):
+        """A fold aborted on corrupt raw data loses its drained spill
+        keys, so the tier must owe a full rebuild: a LATER clean fold
+        flipping the tier ready (pending=false, in-flight cleared)
+        would serve summaries that never covered the aborted windows."""
+        from opentsdb_tpu.core.errors import IllegalDataError
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, days=1)
+            orig = tsdb.rollups._fold
+
+            def corrupt_fold(keys):
+                raise IllegalDataError("duplicate data -- run an fsck")
+
+            tsdb.rollups._fold = corrupt_fold
+            tsdb.checkpoint()              # fold aborts, keys dropped
+            assert not tsdb.rollups.ready
+            tsdb.rollups._fold = orig
+            ingest(tsdb, seed=3, days=1)
+            tsdb.checkpoint()              # clean fold: must NOT flip ready
+            assert not tsdb.rollups.ready
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum", downsample=(3600, "sum"))
+            a, plan, b = run_both(ex, spec, BASE, BASE + 86400)
+            assert plan == "raw"           # degrades, never lies
+            assert_equal_results(a, b, exact=True)
+        finally:
+            tsdb.shutdown()
+        # State stayed pending on disk: the next open rebuilds and the
+        # tier serves again.
+        tsdb2 = make_tsdb(str(tmp_path))
+        try:
+            assert tsdb2.rollups.rebuilds == 1
+            assert tsdb2.rollups.ready
+            # Shutdown's compaction flush re-wrote merged rows, which
+            # replay as memtable-pending (the whole day dirty => raw);
+            # fold them so the planner can serve the rebuilt records.
+            tsdb2.checkpoint()
+            ex2 = QueryExecutor(tsdb2, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum", downsample=(3600, "sum"))
+            a, plan, b = run_both(ex2, spec, BASE, BASE + 86400)
+            assert plan == "1h"
+            assert_equal_results(a, b, exact=True)
+        finally:
+            tsdb2.shutdown()
+
+    def test_concurrent_checkpoints_keep_tier_consistent(self, tmp_path):
+        """Manual checkpoints racing the compaction timer's must not
+        let a no-op caller (store says "merge already in flight") clear
+        the real spill's in-flight windows or flip the tier state while
+        that spill is uncommitted: TSDB serializes checkpoint() so the
+        rollup bracketing pairs 1:1 with actual spills."""
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, days=1)
+            errs: list[BaseException] = []
+
+            def spin():
+                try:
+                    for _ in range(5):
+                        tsdb.checkpoint()
+                except BaseException as e:  # pragma: no cover
+                    errs.append(e)
+
+            threads = [threading.Thread(target=spin) for _ in range(3)]
+            for t in threads:
+                t.start()
+            ingest(tsdb, seed=7, days=1)   # ingest while spilling
+            for t in threads:
+                t.join()
+            assert not errs
+            tsdb.checkpoint()              # fold the late ingest
+            assert tsdb.rollups.ready
+            assert tsdb.rollups._inflight == frozenset()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum", downsample=(3600, "sum"))
+            a, plan, b = run_both(ex, spec, BASE, BASE + 86400)
+            assert plan == "1h"
+            assert_equal_results(a, b, exact=True)
         finally:
             tsdb.shutdown()
 
@@ -316,14 +492,30 @@ class TestSketchRange:
             ingest(tsdb, series=6, days=2)
             tsdb.checkpoint()
             ex = QueryExecutor(tsdb, backend="cpu")
-            n = ex.sketch_distinct(METRIC, "host", BASE,
-                                   BASE + 2 * 86400)
+            n, source = ex.sketch_distinct_with_source(
+                METRIC, "host", BASE, BASE + 2 * 86400)
             assert n == 6
+            assert source == "rollup"
             # Range with no data.
             n0 = ex.sketch_distinct(METRIC, "host",
                                     BASE + 30 * 86400,
                                     BASE + 31 * 86400)
             assert n0 == 0
+            # Without the tier the exact scan answers — and SAYS so.
+            tier, tsdb.rollups = tsdb.rollups, None
+            try:
+                n2, source2 = ex.sketch_distinct_with_source(
+                    METRIC, "host", BASE, BASE + 2 * 86400)
+            finally:
+                tsdb.rollups = tier
+            assert n2 == 6
+            assert source2 == "scan"
+            # Ranges below sketch_min_res serve from record PRESENCE at
+            # the finest resolution — they used to force an exact scan.
+            n3, source3 = ex.sketch_distinct_with_source(
+                METRIC, "host", BASE + 3600, BASE + 10 * 3600)
+            assert n3 == 6
+            assert source3 == "rollup"
         finally:
             tsdb.shutdown()
 

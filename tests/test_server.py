@@ -292,13 +292,13 @@ class TestHttp:
         tsdb.metrics.get_or_create_id("m.empty")
         target = f"/q?start={BT}&end={BT + 10}&m=sum:m.empty&ascii"
         calls = {"n": 0}
-        real_run = server.executor.run
+        real_run = server.executor.run_with_plan
 
         def counting_run(*a, **k):
             calls["n"] += 1
             return real_run(*a, **k)
 
-        server.executor.run = counting_run
+        server.executor.run_with_plan = counting_run
 
         async def drive(port):
             first = await http_get(port, target)
@@ -372,6 +372,20 @@ class TestHttp:
 
         _, _, body = run_async(server, drive)
         assert json.loads(body)["distinct"] == 3
+
+    def test_distinct_end_without_start_rejected(self, server_env):
+        """end= alone must not silently answer the all-time streaming
+        estimate (mirrors /sketch's guard)."""
+        server, tsdb = server_env
+        tsdb.add_batch("m.x2", np.array([BT + 1]), np.array([1]),
+                       {"host": "a"})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/distinct?metric=m.x2&tagk=host&end={BT + 10}")
+
+        status, _, _ = run_async(server, drive)
+        assert status == 400
 
     def test_static_file_and_traversal(self, server_env):
         server, _ = server_env

@@ -50,6 +50,35 @@ class TestImportQuery:
         with pytest.raises(Exception):
             main(["import", "--wal", wal, f])
 
+    def test_rollup_resolutions_implies_tier(self, tmp_path, wal):
+        """--rollup-resolutions without --rollups must still enable the
+        tier: a writer invoked with only the layout flag would
+        otherwise spill without folding and strand stale summaries."""
+        import json
+        import os
+
+        f = write_datafile(tmp_path / "d.txt", [
+            f"m.rr {BT + i * 10} {i} a=b" for i in range(6)
+        ])
+        assert main(["import", "--wal", wal,
+                     "--rollup-resolutions", "7200,86400", f]) == 0
+        state = wal + ".rollup.json"
+        assert os.path.exists(state)
+        with open(state) as fh:
+            rec = json.load(fh)
+        assert rec["resolutions"] == [7200, 86400]
+        assert rec["pending"] is False
+        # A later flag-less writer auto-adopts that layout and keeps
+        # the tier current (RollupTier.adopt_config).
+        f2 = write_datafile(tmp_path / "d2.txt", [
+            f"m.rr {BT + 86400 + i * 10} {i} a=b" for i in range(6)
+        ])
+        assert main(["import", "--wal", wal, f2]) == 0
+        with open(state) as fh:
+            rec2 = json.load(fh)
+        assert rec2["resolutions"] == [7200, 86400]
+        assert rec2["pending"] is False
+
     def test_query_downsample(self, tmp_path, wal, capsys):
         f = write_datafile(tmp_path / "d.txt", [
             f"m.ds {BT + i * 10} {i} a=b" for i in range(12)
