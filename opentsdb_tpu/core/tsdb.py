@@ -265,6 +265,9 @@ class TSDB:
         metric_uid, pairs = self._row_parts(metric, tag_map)
         row = codec.row_key(metric_uid, base_ts, pairs)
         qual = codec.encode_qualifier(timestamp - base_ts, flags)
+        # Directory registration precedes the put (see add_batch).
+        if self.sketches is not None:
+            self.sketches.note_series(codec.series_key(row))
         self.store.put(self.table, row, FAMILY, qual, buf, durable=durable)
         if self.config.enable_compactions:
             self.compactionq.add(row)
@@ -338,6 +341,16 @@ class TSDB:
         keys[:, UID_WIDTH:UID_WIDTH + TIMESTAMP_BYTES] = (
             base[row_starts].astype(">u4").view(np.uint8).reshape(-1, 4))
         kb = keys.tobytes()
+        # The series enters the sketch slot DIRECTORY before any row
+        # becomes visible in storage: the executor's bloom-pruning
+        # hint treats the directory as a complete superset of series
+        # with stored data, and registering after the put would leave
+        # a window where a concurrent query prunes the shard holding
+        # this series' first rows. (Values fold after the put as
+        # before; over-registering an unapplied series is harmless.)
+        skey = codec.series_key(kb[:L])
+        if self.sketches is not None:
+            self.sketches.note_series(skey)
         # Rows that already held cells BEFORE the put become multi-cell
         # and must be queued so the per-batch compacted cells merge into
         # one; the store reports that per row in a single locked pass.
@@ -372,7 +385,6 @@ class TSDB:
         # batch raised above); values as stored, floats and ints alike.
         # One float32 conversion shared by both consumers (the digests
         # quantize to f32 anyway; the window stores f32).
-        skey = codec.series_key(kb[:L])
         if self.sketches is not None or self.devwindow is not None:
             f32 = f_s.astype(np.float32)
             self._observe(skey, metric_uid, pairs, f32)
@@ -455,6 +467,7 @@ class TSDB:
     def scan_columns(self, start_key: bytes, stop_key: bytes,
                      key_regexp: bytes | None = None,
                      batch_cells: int = 1 << 16,
+                     series_hint=None,
                      ) -> Iterator[tuple[bytes, codec.Columns]]:
         """Batched scan decode: same rows as scan_rows, but cells decode
         in vectorized passes of ~``batch_cells`` cells
@@ -493,7 +506,8 @@ class TSDB:
 
         for key, items in self.store.scan_raw(
                 self.table, start_key, stop_key,
-                family=FAMILY, key_regexp=key_regexp):
+                family=FAMILY, key_regexp=key_regexp,
+                series_hint=series_hint):
             base = codec.key_base_time(key)
             kept = 0
             for q, v in items:
@@ -512,7 +526,8 @@ class TSDB:
 
     def scan_series(self, start_key: bytes, stop_key: bytes,
                     key_regexp: bytes | None = None,
-                    batch_cells: int = 1 << 18):
+                    batch_cells: int = 1 << 18,
+                    series_hint=None):
         """Whole-range columnar scan regrouped BY SERIES in vectorized
         passes: returns (series_keys, per_series Columns dict) with one
         global (series, timestamp) lexsort + one vectorized dedup pass
@@ -542,7 +557,8 @@ class TSDB:
 
         for key, items in self.store.scan_raw(
                 self.table, start_key, stop_key,
-                family=FAMILY, key_regexp=key_regexp):
+                family=FAMILY, key_regexp=key_regexp,
+                series_hint=series_hint):
             base = codec.key_base_time(key)
             skey = codec.series_key(key)
             si = skey_index.get(skey)
@@ -710,6 +726,16 @@ class TSDB:
         nshards = getattr(self.store, "shard_count", None)
         if nshards is not None:
             collector.record("storage.shards", nshards)
+        bloom_files = getattr(self.store, "bloom_files_skipped", None)
+        if bloom_files is not None:
+            collector.record("bloom.files_skipped", bloom_files)
+        bloom_shards = getattr(self.store, "bloom_shards_skipped", None)
+        if bloom_shards is not None:
+            collector.record("bloom.shards_skipped", bloom_shards)
+        dirty = getattr(self.store, "dirty_bases", None)
+        if dirty is not None:
+            collector.record("dirty_set.size",
+                             int(len(dirty(self.table))))
         cq = self.compactionq
         collector.record("compaction.count", cq.written_cells)
         collector.record("compaction.deleted_cells", cq.deleted_cells)

@@ -39,6 +39,8 @@ from opentsdb_tpu.core.const import MAX_TIMESPAN, TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.core.errors import BadRequestError
 from opentsdb_tpu.ops import kernels, oracle, sketches
 from opentsdb_tpu.query.aggregators import Aggregators
+from opentsdb_tpu.storage.sstable import series_hash
+from opentsdb_tpu.utils.lru import LRUCache
 
 
 class QuerySpec(NamedTuple):
@@ -92,6 +94,29 @@ class QueryExecutor:
         # concurrent requests sharing one executor would otherwise
         # report a neighbor query's label in JSON metadata.
         self.last_plan = "raw"
+        cfg = tsdb.config
+        # Fragment cache (the query fast path): decoded per-(selector,
+        # aligned time-chunk) columnar spans, validated against the
+        # store's content epochs + dirty-base set (_scan_selector).
+        # Bounded by cached POINTS, not entries — fragments range from
+        # bytes to megabytes.
+        self._frag_cache = LRUCache(
+            int(getattr(cfg, "qcache_fragments", 1024)),
+            max_cost=int(getattr(cfg, "qcache_points", 1 << 24)))
+        # Candidate-series hint per (metric, filter): identity hashes
+        # from the sketch directory, revalidated on the metric's
+        # directory growth; cost-bounded in total cached hashes (an
+        # unfiltered hint for a high-cardinality metric is a multi-MB
+        # array).
+        self._ident_cache = LRUCache(256, max_cost=1 << 21)
+        # Devwindow caches (previously ad-hoc dicts with wholesale
+        # clear-at-cap eviction).
+        self._dw_mask_cache = LRUCache(128)
+        self._dw_plan_cache = LRUCache(128)
+        self._dw_stage_cache = LRUCache(4)
+        self.qcache_hits = 0
+        self.qcache_misses = 0
+        self.qcache_bypasses = 0
 
     # ------------------------------------------------------------------
     # Planning: scan + span assembly + grouping
@@ -142,20 +167,19 @@ class QueryExecutor:
                 exact.append((k, self.tsdb.tagv.get_id(value)))
         return exact, group_bys
 
-    def _find_spans(self, spec: QuerySpec, start: int, end: int):
+    def _find_spans(self, spec: QuerySpec, start: int, end: int,
+                    info: dict | None = None):
         """Scan matching rows into per-series columnar spans, grouped by
-        the distinct combinations of group-by tag values."""
+        the distinct combinations of group-by tag values. ``info``, when
+        given, receives {"cached": bool} — True iff every fragment of
+        the range served from the warm cache."""
         metric_uid = self.tsdb.metrics.get_id(spec.metric)
         exact, group_bys = self._tag_filters(spec.tags)
         group_by_keys = sorted(k for k, _ in group_bys)
-
-        start_key = metric_uid + _u32(codec.base_time(max(start, 0)))
-        stop_key = metric_uid + _u32(
-            min(codec.base_time(end) + MAX_TIMESPAN, 0xFFFFFFFF))
         regexp = self._build_regexp(exact, group_bys)
 
-        _, per_series = self.tsdb.scan_series(start_key, stop_key,
-                                              key_regexp=regexp)
+        per_series = self._scan_selector(metric_uid, exact, group_bys,
+                                         regexp, start, end, info)
         groups: dict[tuple, list[_Span]] = {}
         for skey, cat in per_series.items():
             m = (cat.timestamps >= start) & (cat.timestamps <= end)
@@ -169,6 +193,155 @@ class QueryExecutor:
             groups.setdefault(gkey, []).append(_Span(
                 skey, named, cat.timestamps[m], cat.values[m]))
         return groups
+
+    # -- fragment cache (the query fast path) --------------------------
+
+    def _series_hint(self, metric_uid: bytes, exact, group_bys,
+                     ) -> np.ndarray | None:
+        """uint64 identity hashes of every KNOWN series matching the
+        selector — a pruning hint for the storage fan-out (shard
+        routing + per-generation series blooms). Sourced from the
+        streaming-sketch slot directory, which the WRITER's ingest
+        path keeps a complete superset of series with stored data
+        (TSDB.add_batch/add_point register via note_series BEFORE the
+        put, so no query can observe stored rows the directory lacks).
+        None — absence of a hint never prunes — when sketches are
+        disabled, nothing matches, or the store is a read-only
+        replica: a replica's directory reloads only on checkpoint
+        rebuilds, so it can lag WAL-suffix-replayed new series by a
+        whole checkpoint interval."""
+        sk = getattr(self.tsdb, "sketches", None)
+        if sk is None or getattr(self.tsdb.store, "read_only", False):
+            return None
+        fkey = (metric_uid, _filter_key(exact, group_bys))
+        # Revalidate on THIS metric's directory size (monotonic): a new
+        # series under another metric leaves the cached hint valid, and
+        # a rebuild touches only this metric's keys.
+        count = sk.metric_series_count(metric_uid)
+        ent = self._ident_cache.get(fkey)
+        if ent is not None and ent[0] == count:
+            return ent[1]
+        regexp = self._build_regexp(exact, group_bys, prefix=UID_WIDTH)
+        pattern = re.compile(regexp, re.S) if regexp else None
+        hashes = [series_hash(k) for k in sk.metric_series_keys(metric_uid)
+                  if pattern is None or pattern.match(k)]
+        hint = np.asarray(hashes, np.uint64) if hashes else None
+        self._ident_cache.put(fkey, (count, hint),
+                              cost=max(len(hashes), 1))
+        return hint
+
+    def _scan_chunk(self, metric_uid: bytes, regexp, hint,
+                    c_lo: int, c_hi: int) -> dict:
+        """Scan + decode one [c_lo, c_hi) base-time chunk into a
+        per-series Columns dict (the cacheable fragment unit)."""
+        start_key = metric_uid + _u32(c_lo)
+        stop_key = metric_uid + _u32(min(c_hi, 0xFFFFFFFF))
+        return self.tsdb.scan_series(start_key, stop_key,
+                                     key_regexp=regexp,
+                                     series_hint=hint)[1]
+
+    def _scan_selector(self, metric_uid: bytes, exact, group_bys,
+                       regexp, start: int, end: int,
+                       info: dict | None = None) -> dict:
+        """Per-series columns for a selector over [start, end] (full
+        covering row range — the caller masks to the exact bounds).
+
+        The range splits into row-span-aligned chunks; each chunk
+        serves from the fragment cache when (a) no shard has
+        memtable-resident ("dirty") rows in it right now and (b) no
+        base in it carries a row-create/remove transition stamp newer
+        than the fragment (per-base stamps + replica-rebuild floor,
+        MemKVStore.chunk_state — stamps outlive refcounts, so a
+        create-then-delete that nets a chunk back to clean still
+        invalidates fragments built during the window). Dirty chunks
+        BYPASS the cache both ways — scanned fresh, never stored — so
+        a live-ingest tail is re-read every time while frozen history
+        hits RAM, and answers stay bit-identical to a cold scan:
+        chunks align to the row span, so per-chunk decode + concat
+        reproduces the whole-range decode order exactly."""
+        tsdb = self.tsdb
+        cfg = tsdb.config
+        store = tsdb.store
+        hint = self._series_hint(metric_uid, exact, group_bys)
+        b_lo = codec.base_time(max(start, 0))
+        b_hi = min(codec.base_time(min(end, 0xFFFFFFFF)), 0xFFFFFFFF)
+
+        def full_scan() -> dict:
+            start_key = metric_uid + _u32(b_lo)
+            stop_key = metric_uid + _u32(
+                min(b_hi + MAX_TIMESPAN, 0xFFFFFFFF))
+            return tsdb.scan_series(start_key, stop_key,
+                                    key_regexp=regexp,
+                                    series_hint=hint)[1]
+
+        chunk_s = int(getattr(cfg, "qcache_chunk_s", 0) or 0)
+        chunk_s -= chunk_s % MAX_TIMESPAN
+        state_fn = getattr(store, "chunk_state", None)
+        if (not getattr(cfg, "qcache", True) or state_fn is None
+                or chunk_s <= 0 or b_hi < b_lo):
+            return full_scan()
+        c0 = b_lo - b_lo % chunk_s
+        nchunks = (b_hi - c0) // chunk_s + 1
+        if nchunks > int(getattr(cfg, "qcache_max_chunks", 512)):
+            # All-time-style ranges: per-chunk scan setup would cost
+            # more than it saves, and caching them would flush the
+            # dashboard working set.
+            return full_scan()
+        table = tsdb.table
+        fkey = (metric_uid, _filter_key(exact, group_bys))
+        chunks = [c0 + i * chunk_s for i in range(nchunks)]
+        # States read BEFORE each scan: content can only get newer
+        # between the state read and the scan, so a racing mutation
+        # stamps its bases past the fragment's tagged seq and the next
+        # lookup conservatively invalidates — never the reverse.
+        states = [state_fn(table, c, c + chunk_s) for c in chunks]
+        if all(st[3] for st in states):
+            # Nothing cacheable (all-memtable store / fully-hot range):
+            # one unchunked scan beats per-chunk setup.
+            self.qcache_bypasses += nchunks
+            if info is not None:
+                info["cached"] = False
+            return full_scan()
+        parts: dict[bytes, list] = {}
+        all_hit = True
+        for c, (seqs, floors, stamps, dirty) in zip(chunks, states):
+            key = (fkey, c, chunk_s)
+            if dirty:
+                self.qcache_bypasses += 1
+                all_hit = False
+                frag = self._scan_chunk(metric_uid, regexp, hint,
+                                        c, c + chunk_s)
+            else:
+                ent = self._frag_cache.get(key)
+                if ent is not None and all(
+                        e >= f and m <= e
+                        for e, f, m in zip(ent[0], floors, stamps)):
+                    self.qcache_hits += 1
+                    frag = ent[1]
+                else:
+                    self.qcache_misses += 1
+                    all_hit = False
+                    frag = self._scan_chunk(metric_uid, regexp, hint,
+                                            c, c + chunk_s)
+                    cost = sum(len(cols.timestamps)
+                               for cols in frag.values())
+                    self._frag_cache.put(key, (seqs, frag),
+                                         cost=max(cost, 1))
+            for skey, cols in frag.items():
+                parts.setdefault(skey, []).append(cols)
+        if info is not None:
+            info["cached"] = all_hit
+        out: dict[bytes, codec.Columns] = {}
+        for skey, lst in parts.items():
+            if len(lst) == 1:
+                out[skey] = lst[0]
+            else:
+                out[skey] = codec.Columns(
+                    np.concatenate([c.timestamps for c in lst]),
+                    np.concatenate([c.values for c in lst]),
+                    np.concatenate([c.int_values for c in lst]),
+                    np.concatenate([c.is_float for c in lst]))
+        return out
 
     @staticmethod
     def _group_tags(spans: list[_Span]):
@@ -196,17 +369,18 @@ class QueryExecutor:
         return self.run_with_plan(spec, start, end)[0]
 
     def run_with_plan(self, spec: QuerySpec, start: int, end: int,
-                      ) -> tuple[list[QueryResult], str]:
+                      ) -> tuple[list[QueryResult], str, bool]:
         """run() plus the planner-choice label for THIS call ("raw",
-        "resident", or a rollup resolution like "1h"). Returned rather
-        than stashed on the executor so server threads sharing one
-        executor can't read a neighbor query's label."""
-        results, plan = self._run_planned(spec, start, end)
+        "resident", or a rollup resolution like "1h") and whether the
+        answer came ENTIRELY from the warm fragment cache. Returned
+        rather than stashed on the executor so server threads sharing
+        one executor can't read a neighbor query's labels."""
+        results, plan, cached = self._run_planned(spec, start, end)
         self.last_plan = plan
-        return results, plan
+        return results, plan, cached
 
     def _run_planned(self, spec: QuerySpec, start: int, end: int,
-                     ) -> tuple[list[QueryResult], str]:
+                     ) -> tuple[list[QueryResult], str, bool]:
         if end <= start:
             raise BadRequestError(
                 f"end time {end} is <= start time {start}")
@@ -217,7 +391,7 @@ class QueryExecutor:
                 "cardinality queries")
         dev = self._run_devwindow(spec, start, end, agg)
         if dev is not None:
-            return dev, "resident"
+            return dev, "resident", False
         # Rollup planner step: serve window-aligned downsamples from
         # the materialized summary tier (rollup/planner.py), with raw
         # stitching over edge/dirty windows. The returned spans are
@@ -229,12 +403,14 @@ class QueryExecutor:
             groups, spec2, res = planned
             from opentsdb_tpu.rollup.tier import res_label
             return (self._execute_groups(spec2, groups, start, end),
-                    res_label(res))
+                    res_label(res), False)
         import time as _time
         t0 = _time.time()
-        groups = self._find_spans(spec, start, end)
+        info: dict = {}
+        groups = self._find_spans(spec, start, end, info)
         self.scan_latency.add((_time.time() - t0) * 1000)
-        return self._execute_groups(spec, groups, start, end), "raw"
+        return (self._execute_groups(spec, groups, start, end), "raw",
+                bool(info.get("cached")))
 
     def _plan_rollup(self, spec: QuerySpec, start: int, end: int):
         if getattr(self.tsdb, "rollups", None) is None:
@@ -349,9 +525,7 @@ class QueryExecutor:
         # when the series directory grows (generation bump invalidates;
         # instance_id guards against a replacement window whose counters
         # restart at 0 — devstore's cache-keying contract).
-        mask_cache = getattr(self, "_dw_mask_cache", None)
-        if mask_cache is None:
-            mask_cache = self._dw_mask_cache = {}
+        mask_cache = self._dw_mask_cache
         fk = _filter_key(exact, group_bys)
         mkey = (dw.instance_id, metric_uid, fk)
         hit = mask_cache.get(mkey)
@@ -365,12 +539,10 @@ class QueryExecutor:
                     include[sid] = True
                     gmap[sid] = gi
             include, gmap = jax.device_put(include), jax.device_put(gmap)
-            if len(mask_cache) > 128:
-                mask_cache.clear()
             # Generation lives in the VALUE (the _dw_plan_cache
             # pattern): a directory growth overwrites in place, so dead
             # generations never accumulate device arrays.
-            mask_cache[mkey] = (cols.generation, include, gmap)
+            mask_cache.put(mkey, (cols.generation, include, gmap))
         lo32 = np.int32(min(max(start - cols.epoch, imin), imax))
         hi32 = np.int32(min(max(end - cols.epoch, imin), imax))
         shift32 = np.int32(qbase - cols.epoch)
@@ -387,9 +559,7 @@ class QueryExecutor:
         # ~N-scatter cost per panel and ~dispatch-floor per panel.
         skey = (dw.instance_id, metric_uid, cols.version, start, end,
                 interval, dsagg, tuple(sorted(rate_kw.items())))
-        cache = getattr(self, "_dw_stage_cache", None)
-        if cache is None:
-            cache = self._dw_stage_cache = {}
+        cache = self._dw_stage_cache
         stage = cache.get(skey)
         if stage is None:
             try:
@@ -409,15 +579,13 @@ class QueryExecutor:
             # Stages of this metric's EARLIER data versions can never
             # hit again (version is monotonic) but each pins [S, B]
             # grids in HBM the devwindow's own budget can't see — drop
-            # them before the size cap so active ingest (a version bump
+            # them before the LRU cap so active ingest (a version bump
             # per flush) doesn't strand dead grids on device.
-            for k in [k for k in cache
-                      if k[:2] == (dw.instance_id, metric_uid)
-                      and k[2] != cols.version]:
-                del cache[k]
-            if len(cache) >= 4:  # a handful of HBM-sized stages
-                cache.clear()
-            cache[skey] = stage
+            for k in cache.keys():
+                if k[:2] == (dw.instance_id, metric_uid) \
+                        and k[2] != cols.version:
+                    cache.pop(k)
+            cache.put(skey, stage)
         sv, sm, filled, in_range, presence_dev = stage[:5]
         # Shrink-wrap the fetch: clip to the live group/bucket counts
         # (64-quantized so statics don't churn recompiles) and bit-pack
@@ -497,9 +665,7 @@ class QueryExecutor:
         window's instance_id)."""
         fkey = (dw.instance_id, metric_uid,
                 _filter_key(exact, group_bys))
-        cache = getattr(self, "_dw_plan_cache", None)
-        if cache is None:
-            cache = self._dw_plan_cache = {}
+        cache = self._dw_plan_cache
         hit = cache.get(fkey)
         if hit is not None and hit[0] == cols.generation:
             return hit[1], hit[2]
@@ -526,9 +692,7 @@ class QueryExecutor:
             named[sid] = {
                 self.tsdb.tagk.get_name(k): self.tsdb.tagv.get_name(v)
                 for k, v in tag_uids.items()}
-        if len(cache) > 128:
-            cache.clear()
-        cache[fkey] = (cols.generation, groups, named)
+        cache.put(fkey, (cols.generation, groups, named))
         return groups, named
 
     # -- CPU oracle backend -------------------------------------------

@@ -150,7 +150,8 @@ def plan(executor, spec, start: int, end: int):
 
 
 def _scan_raw_parts(tsdb, metric_uid: bytes, regexp: bytes | None,
-                    ranges: list[tuple[int, int]]):
+                    ranges: list[tuple[int, int]],
+                    series_hint=None):
     """Targeted raw scans over the stitch ranges -> per-series sorted
     (ts, float64 values), filtered to the ranges."""
     parts: dict[bytes, list] = {}
@@ -160,7 +161,8 @@ def _scan_raw_parts(tsdb, metric_uid: bytes, regexp: bytes | None,
         stop_key = (_metric_stop(metric_uid) if stop > 0xFFFFFFFF
                     else metric_uid + _u32(stop))
         _, per_series = tsdb.scan_series(start_key, stop_key,
-                                         key_regexp=regexp)
+                                         key_regexp=regexp,
+                                         series_hint=series_hint)
         for skey, cols in per_series.items():
             m = (cols.timestamps >= lo) & (cols.timestamps <= hi)
             if not m.any():
@@ -245,5 +247,7 @@ def _select_windows(executor, tier, metric: str, tags: dict,
     dirty_set = frozenset(int(b) for b in dirty)
     raw_ranges = _coalesce(
         edges + [(int(w), int(w) + res - 1) for w in dirty_set])
-    raw_parts = _scan_raw_parts(tsdb, metric_uid, regexp, raw_ranges)
+    raw_parts = _scan_raw_parts(
+        tsdb, metric_uid, regexp, raw_ranges,
+        executor._series_hint(metric_uid, exact, group_bys))
     return records, raw_parts, dirty_set

@@ -238,7 +238,13 @@ class RollupTier:
         self._defer_lock = threading.Lock()
         self._deferred: list[bytes] = []
         self._inflight: frozenset[int] = frozenset()
-        self._dirty_cache: tuple[int, np.ndarray] | None = None
+        # Debug oracle (Config.rollup_sweep_check): derive the dirty
+        # set BOTH ways and fail loudly on divergence. Only meaningful
+        # at quiescent instants — the two derivations are separate
+        # lock acquisitions, so concurrent ingest between them is a
+        # benign difference, and tests quiesce before comparing.
+        self.sweep_check = bool(getattr(config, "rollup_sweep_check",
+                                        False))
 
         self._dirs: dict[int, list[str]] = {}
         for r in res:
@@ -395,36 +401,49 @@ class RollupTier:
 
     def dirty_hour_bases(self) -> np.ndarray:
         """Sorted hour bases whose raw rows are not (yet) covered by
-        rollup records: memtable + frozen rows, plus windows in flight
-        between a spill and its fold commit. Cached per store mutation
-        sequence — an unchanged seq means the memtable cannot have
-        changed (stale-cache staleness is only ever conservative: tier
-        transitions shrink the set, every growth bumps the seq)."""
+        rollup records: memtable + frozen rows + the undrained spill
+        record, plus windows in flight between a spill and its fold
+        commit. Served from the store's incrementally-maintained
+        dirty-base index (MemKVStore.dirty_bases, O(1) amortized per
+        mutation) — the old implementation re-swept the ENTIRE
+        memtable key list under the store lock on every
+        rollup-eligible query, so planning cost scaled with memtable
+        size under live ingest (the ROADMAP follow-on this closes).
+        ``rollup_sweep_check`` keeps the sweep as a cross-check
+        oracle."""
         store = self.tsdb.store
-        seq = store.mutation_seq
-        cached = self._dirty_cache
-        if cached is not None and cached[0] == seq:
-            base = cached[1]
+        db = getattr(store, "dirty_bases", None)
+        if db is None:
+            base = self._sweep_dirty_bases()
         else:
-            lo, hi = UID_WIDTH, UID_WIDTH + TIMESTAMP_BYTES
-            # Malformed/short keys (a stray delete_row from a tool)
-            # carry no base time to mark dirty — skip them like the
-            # fold paths do, or the frombuffer below would raise on
-            # every query until a checkpoint drains the key.
-            keys = [k for k in store.pending_keys(self.table)
-                    if len(k) >= hi]
-            if keys:
-                blob = b"".join(k[lo:hi] for k in keys)
-                base = np.unique(
-                    np.frombuffer(blob, ">u4").astype(np.int64))
-            else:
-                base = np.empty(0, np.int64)
-            self._dirty_cache = (seq, base)
+            base = db(self.table)
+            if self.sweep_check:
+                swept = self._sweep_dirty_bases()
+                if not np.array_equal(base, swept):
+                    raise AssertionError(
+                        f"incremental dirty set diverged from the "
+                        f"sweep oracle: "
+                        f"incremental={base.tolist()} "
+                        f"swept={swept.tolist()}")
         infl = self._inflight
         if infl:
             base = np.union1d(
                 base, np.fromiter(infl, np.int64, len(infl)))
         return base
+
+    def _sweep_dirty_bases(self) -> np.ndarray:
+        """The legacy O(memtable) derivation: sweep every pending key
+        and collect base times. Kept as the sweep_check oracle (and
+        the fallback for stores without the incremental index).
+        Malformed/short keys (a stray delete_row from a tool) carry no
+        base time to mark dirty — skip them like the fold paths do."""
+        lo, hi = UID_WIDTH, UID_WIDTH + TIMESTAMP_BYTES
+        keys = [k for k in self.tsdb.store.pending_keys(self.table)
+                if len(k) >= hi]
+        if not keys:
+            return np.empty(0, np.int64)
+        blob = b"".join(k[lo:hi] for k in keys)
+        return np.unique(np.frombuffer(blob, ">u4").astype(np.int64))
 
     def scan_records(self, res: int, metric_uid: bytes, w_lo: int,
                      w_hi: int, key_regexp: bytes | None = None,

@@ -693,6 +693,7 @@ class TSDServer:
         os_ = params.get("o", [])
         result_opts: list[str] = []
         result_plans: list[str] = []
+        result_cached: list[bool] = []
         for mi, m in enumerate(ms):
             parsed = parse_m(m)
             spec = QuerySpec(
@@ -706,11 +707,12 @@ class TSDServer:
             # Returned with the results: reading it back off the shared
             # executor after the pool hop could pick up a CONCURRENT
             # request's label.
-            rs, plan = await loop.run_in_executor(
+            rs, plan, cached = await loop.run_in_executor(
                 self._pool, self.executor.run_with_plan, spec, start, end)
             results.extend(rs)
             result_opts.extend([os_[mi] if mi < len(os_) else ""] * len(rs))
             result_plans.extend([plan] * len(rs))
+            result_cached.extend([cached] * len(rs))
 
         extra: dict = {}
         if "ascii" in q:
@@ -718,7 +720,8 @@ class TSDServer:
             ctype = "text/plain"
         elif "json" in q:
             body = json.dumps(
-                self._json_output(results, result_plans)).encode()
+                self._json_output(results, result_plans,
+                                  result_cached)).encode()
             ctype = "application/json"
         else:
             t0 = time.time()
@@ -778,12 +781,16 @@ class TSDServer:
                 out.append(line + (" " + tag_str if tag_str else ""))
         return "\n".join(out) + ("\n" if out else "")
 
-    def _json_output(self, results, plans=None):
+    def _json_output(self, results, plans=None, cached=None):
         return [{
             "metric": r.metric,
             "tags": r.tags,
             "aggregateTags": r.aggregated_tags,
             "rollup": (plans[i] if plans and i < len(plans) else "raw"),
+            # Fragment-cache provenance: True iff this sub-query's
+            # whole range served from warm decoded fragments.
+            "cached": bool(cached[i]) if cached and i < len(cached)
+            else False,
             "dps": {str(int(t)): float(v)
                     for t, v in zip(r.timestamps, r.values)},
         } for i, r in enumerate(results)]
@@ -1182,6 +1189,9 @@ class TSDServer:
         c.record("scan.latency", self.executor.scan_latency, "type=query")
         c.record("http.graph.requests", self.cache_hits, "cache=hit")
         c.record("http.graph.requests", self.cache_misses, "cache=miss")
+        c.record("qcache.hit", self.executor.qcache_hits)
+        c.record("qcache.miss", self.executor.qcache_misses)
+        c.record("qcache.bypass", self.executor.qcache_bypasses)
         c.record("uptime", int(time.time()) - self.start_time)
         self.tsdb.collect_stats(c)
         return c.lines

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from opentsdb_tpu.core.const import UID_WIDTH
 from opentsdb_tpu.ops import sketches
 
 _PAD_MIN = 8
@@ -86,6 +87,10 @@ class LiveSketches:
         # slot maps: key -> row in the device stacks
         self._td_slots: dict[bytes, int] = {}
         self._hll_slots: dict[tuple[bytes, bytes], int] = {}
+        # Per-metric series directory (keys grouped by their metric
+        # UID prefix): the executor's candidate-series hint reads one
+        # metric's keys instead of filtering the whole directory.
+        self._metric_series: dict[bytes, list[bytes]] = {}
         # device stacks ([capacity(+1 trash implied by scatter clamp), ...])
         self._td_means = jnp.zeros((_PAD_MIN, compression), jnp.float32)
         self._td_weights = jnp.zeros((_PAD_MIN, compression), jnp.float32)
@@ -108,6 +113,8 @@ class LiveSketches:
         if slot is None:
             slot = len(self._td_slots)
             self._td_slots[series_key] = slot
+            self._metric_series.setdefault(
+                series_key[:UID_WIDTH], []).append(series_key)
         return slot
 
     def _hll_slot(self, metric_uid: bytes, tagk_uid: bytes) -> int:
@@ -135,6 +142,31 @@ class LiveSketches:
                            1 << self.hll_p), jnp.int32)])
 
     # -- ingest-side API ---------------------------------------------------
+
+    def note_series(self, series_key: bytes) -> None:
+        """Register a series in the slot directory WITHOUT folding any
+        values. The write path calls this BEFORE the storage put
+        (core/tsdb.add_batch/add_point): the executor's bloom-pruning
+        hint treats the directory as a complete superset of series
+        with stored data, so no query may ever observe stored rows the
+        directory lacks — including mid-batch-throttle aborts, whose
+        applied cells would otherwise never register. The empty slot
+        folds real values on the next successful batch."""
+        with self._lock:
+            self._td_slot(series_key)
+
+    def metric_series_count(self, metric_uid: bytes) -> int:
+        """Directory size for one metric (the hint cache's cheap
+        revalidation key — a new series under a DIFFERENT metric no
+        longer invalidates this metric's cached hint)."""
+        with self._lock:
+            return len(self._metric_series.get(metric_uid, ()))
+
+    def metric_series_keys(self, metric_uid: bytes) -> list[bytes]:
+        """Snapshot of one metric's series keys (no whole-directory
+        filtering)."""
+        with self._lock:
+            return list(self._metric_series.get(metric_uid, ()))
 
     def observe(self, series_key: bytes, values: np.ndarray,
                 tag_uids: list[tuple[bytes, bytes, bytes]]) -> None:
@@ -388,6 +420,8 @@ class LiveSketches:
         self._td_weights = jnp.asarray(z["td_weights"])
         self._hll_regs = jnp.asarray(z["hll_regs"])
         self._td_slots = {bytes(k): i for i, k in enumerate(z["td_keys"])}
+        for k in self._td_slots:
+            self._metric_series.setdefault(k[:UID_WIDTH], []).append(k)
         self._hll_slots = {
             (bytes(m), bytes(t)): i
             for i, (m, t) in enumerate(zip(z["hll_metric"], z["hll_tagk"]))}

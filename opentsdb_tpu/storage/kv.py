@@ -51,6 +51,7 @@ from typing import Iterator, NamedTuple
 
 import numpy as np
 
+from opentsdb_tpu.core.const import TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.core.errors import (PleaseThrottleError,
                                        ReadOnlyStoreError)
 from opentsdb_tpu.storage.sstable import (SSTable, merge_sstables,
@@ -58,6 +59,15 @@ from opentsdb_tpu.storage.sstable import (SSTable, merge_sstables,
 from opentsdb_tpu.utils.nativeext import ext as _EXT
 
 _REC = struct.Struct(">BI")  # op, payload length
+
+# Row-key byte range holding the base time (data-table layout,
+# core/codec.row_key). The incremental dirty-base index slices it per
+# NEW ROW so consumers (the rollup planner's dirty-window set, the
+# executor's fragment cache) never have to sweep the whole key list;
+# keys too short to carry it (UID-table names, stray tool deletes) are
+# simply not indexed — matching the sweep's own filter.
+_BASE_LO = UID_WIDTH
+_BASE_HI = UID_WIDTH + TIMESTAMP_BYTES
 
 
 class Cell(NamedTuple):
@@ -136,12 +146,19 @@ class KVStore:
     def scan_raw(self, table: str, start: bytes, stop: bytes,
                  family: bytes | None = None,
                  key_regexp: bytes | None = None,
+                 series_hint: "np.ndarray | None" = None,
                  ) -> Iterator[tuple[bytes, list[tuple[bytes, bytes]]]]:
         """Scan for bulk decode: (key, [(qualifier, value), ...]) rows,
         qualifiers sorted — no Cell objects. Default adapts scan();
         stores override with a batched implementation (the columnar
         read path calls this per row-HOUR, so per-row allocation and
-        locking overhead multiplies by the whole scanned range)."""
+        locking overhead multiplies by the whole scanned range).
+
+        ``series_hint``: optional uint64 array of series-identity
+        hashes (sstable.series_hash) that is a SUPERSET of the series
+        the caller will keep — a pure pruning hint. Stores may use it
+        to skip sstable generations (bloom prefilter) or whole shards
+        (routing); ignoring it is always correct."""
         for cells in self.scan(table, start, stop, family=family,
                                key_regexp=key_regexp):
             yield cells[0].key, [(c.qualifier, c.value) for c in cells]
@@ -203,7 +220,7 @@ class _Table:
     """
 
     __slots__ = ("rows", "base", "delta", "pending", "stale", "row_tombs",
-                 "tombs")
+                 "tombs", "dirty", "touch")
 
     def __init__(self) -> None:
         # Cell value None = tombstone masking a spilled sstable cell.
@@ -218,12 +235,61 @@ class _Table:
         # tombstones cannot mask lower-generation cells, so spilling it
         # as a new generation needs no merge).
         self.tombs = 0
+        # Incremental dirty-base index: base-time -> refcount of keys
+        # (rows + row_tombs entries, counted separately — a key can be
+        # in both) whose base-time bytes name it. Maintained O(1) per
+        # row insert/remove so ``dirty_bases`` never sweeps the key
+        # list (the planner used to re-sweep the whole memtable under
+        # this lock on every rollup-eligible query).
+        self.dirty: dict[int, int] = {}
+        # Touch sequence per base: the store mutation_seq of the last
+        # row-create/remove transition. A create-then-full-delete nets
+        # the refcount back to zero — the base reads CLEAN again — but
+        # a fragment scanned DURING that window may hold the transient
+        # row; the touch value outlives the refcount so such fragments
+        # can never validate (fragment-cache contract,
+        # MemKVStore.chunk_state).
+        self.touch: dict[int, int] = {}
 
     def note_insert(self, key: bytes) -> None:
         self.pending.add(key)
 
     def note_delete(self) -> None:
         self.stale += 1
+
+    def dirty_add(self, key: bytes, seq: int) -> None:
+        if len(key) >= _BASE_HI:
+            b = int.from_bytes(key[_BASE_LO:_BASE_HI], "big")
+            d = self.dirty
+            d[b] = d.get(b, 0) + 1
+            self.touch[b] = seq
+
+    def dirty_sub(self, key: bytes, seq: int) -> None:
+        if len(key) >= _BASE_HI:
+            b = int.from_bytes(key[_BASE_LO:_BASE_HI], "big")
+            d = self.dirty
+            n = d.get(b, 0) - 1
+            if n <= 0:
+                d.pop(b, None)
+            else:
+                d[b] = n
+            self.touch[b] = seq
+
+    def rebuild_dirty(self, seq: int) -> None:
+        """Recompute the dirty-base index from scratch (the thaw path,
+        where refcount bookkeeping through the merge-back would be
+        error-prone for an exceptional branch). Every involved base's
+        touch jumps to ``seq`` — conservative invalidation of any
+        fragment built across the thaw."""
+        d: dict[int, int] = {}
+        for ks in (self.rows, self.row_tombs):
+            for k in ks:
+                if len(k) >= _BASE_HI:
+                    b = int.from_bytes(k[_BASE_LO:_BASE_HI], "big")
+                    d[b] = d.get(b, 0) + 1
+        for b in d:
+            self.touch[b] = seq
+        self.dirty = d
 
     def _absorb(self) -> None:
         """Fold pending inserts into delta; compact when thresholds hit.
@@ -360,6 +426,34 @@ class MemKVStore(KVStore):
         # memtable; take_spill_keys() drains the record.
         self.record_spill_keys = False
         self._last_spill_keys: dict[str, list[bytes]] = {}
+        # Dirty-base refcounts of the UNDRAINED spill record (the
+        # frozen tier's dirty index, carried over at phase 3 and summed
+        # across checkpoints like _last_spill_keys): spilled keys count
+        # as dirty until the rollup fold drains them, so dirty_bases
+        # never has to derive bases from the (possibly huge) key list.
+        self._spill_dirty: dict[str, dict[int, int]] = {}
+        # The fragment cache's invalidation spine: per (table, base),
+        # the mutation_seq of the last row-create/remove transition
+        # that touched it — folded here from each tier's ``touch`` map
+        # when the tier retires (phase-3 drop, empty-checkpoint drop,
+        # thaw), so the signal outlives the memtable generation that
+        # produced it. A fragment built at store seq E over a CLEAN
+        # base range is still exact iff no base in the range carries a
+        # stamp > E and E >= _stamp_floor: rows only enter or leave
+        # the visible dataset through stamped memtable transitions
+        # (puts, deletes, tombstones), every checkpoint merely
+        # relocates them between tiers, and a replica rebuild — where
+        # what changed is unknown — jumps the floor instead.
+        self._base_stamps: dict[str, dict[int, int]] = {}
+        self._stamp_floor = 0
+        # Lazy snapshots for range queries (rebuilt when mutation_seq
+        # moves): table -> (seq, sorted bases, aligned stamps).
+        self._stamps_snap: dict[str, tuple[int, np.ndarray,
+                                           np.ndarray]] = {}
+        self._dirty_snap: dict[str, tuple[int, np.ndarray]] = {}
+        # Generations skipped by the series-bloom prefilter (scan_raw
+        # with a series_hint), exported as bloom.files_skipped.
+        self.bloom_files_skipped = 0
         # Immutable middle tier while a checkpoint merge is in flight.
         self._frozen: dict[str, _Table] | None = None
         self._lockfd: int | None = None
@@ -528,6 +622,11 @@ class MemKVStore(KVStore):
                 valid = self._replay_file(f, start=off)
             self._ro_state = {"wal": (ino, valid),
                               "old": state["old"]}
+            if valid > off:
+                # The replayed suffix mutated the memtable outside the
+                # put/delete entry points: consumers keying caches on
+                # mutation_seq must see it move.
+                self.mutation_seq += 1
             return valid > off
 
     def _rebuild_locked(self) -> None:
@@ -556,6 +655,14 @@ class MemKVStore(KVStore):
             self._ro_state = old_state
             raise
         self.rebuilds += 1
+        self.mutation_seq += 1
+        # A rebuild replaced the generation set wholesale; what changed
+        # inside it is unknown, so the stamp floor jumps and every
+        # fragment cached against an earlier seq is invalid.
+        self._stamp_floor = self.mutation_seq
+        self._base_stamps = {}
+        self._stamps_snap = {}
+        self._dirty_snap = {}
         for sst in old_ssts:
             sst.close()
 
@@ -691,6 +798,8 @@ class MemKVStore(KVStore):
         """Drain the spilled-key record (see record_spill_keys)."""
         with self._lock:
             out, self._last_spill_keys = self._last_spill_keys, {}
+            self._spill_dirty = {}
+            self.mutation_seq += 1  # the dirty-base set just shrank
             return out
 
     @property
@@ -698,6 +807,81 @@ class MemKVStore(KVStore):
         """Whether any sstable generation exists (data outside the
         WAL-replayable memtable)."""
         return bool(self._ssts)
+
+    @property
+    def mutation_seqs(self) -> tuple[int, ...]:
+        """Per-shard mutation sequence vector (a single store is one
+        shard). The sharded store's summed ``mutation_seq`` makes one
+        put anywhere invalidate everything derived from it; consumers
+        that can revalidate per shard key on this instead."""
+        return (self.mutation_seq,)
+
+    def dirty_bases(self, table: str) -> np.ndarray:
+        """Sorted unique base times whose rows are NOT fully covered by
+        the immutable sstable tiers: live memtable rows + row
+        tombstones, the frozen mid-checkpoint tier, and the undrained
+        spill record — maintained incrementally (O(1) amortized per
+        mutation, see _Table.dirty) so deriving it never sweeps the
+        key list. Cached per mutation_seq; the rollup planner's
+        dirty-window set and the fragment cache's bypass test both
+        read it."""
+        with self._lock:
+            snap = self._dirty_snap.get(table)
+            if snap is not None and snap[0] == self.mutation_seq:
+                return snap[1]
+            bases = set(self._table(table).dirty)
+            if self._frozen is not None:
+                ft = self._frozen.get(table)
+                if ft is not None:
+                    bases.update(ft.dirty)
+            sd = self._spill_dirty.get(table)
+            if sd:
+                bases.update(sd)
+            arr = np.fromiter(bases, np.int64, len(bases))
+            arr.sort()
+            self._dirty_snap[table] = (self.mutation_seq, arr)
+            return arr
+
+    def chunk_state(self, table: str, lo: int, hi: int,
+                    ) -> tuple[tuple[int, ...], tuple[int, ...],
+                               tuple[int, ...], bool]:
+        """Fragment-cache validation state for base range [lo, hi):
+        ``(seqs, floors, stamps, dirty)`` — one element per shard
+        (one, here). A fragment tagged with seq E over this range is
+        still exact iff the range is clean (not ``dirty``),
+        E >= floor, and no base in the range carries a transition
+        stamp > E (``stamps`` is the range's newest stamp across the
+        store-level map and every live tier's touch map). Rows only
+        enter or leave the visible dataset through stamped memtable
+        transitions, so an unchanged stamp range means unchanged
+        content — checkpoints merely relocate rows between tiers."""
+        d = self.dirty_bases(table)
+        dirty = bool(len(d)) and \
+            int(np.searchsorted(d, lo)) < int(np.searchsorted(d, hi))
+        with self._lock:
+            seq = self.mutation_seq
+            snap = self._stamps_snap.get(table)
+            if snap is None or snap[0] != seq:
+                m = dict(self._base_stamps.get(table, {}))
+                tiers = [self._table(table)]
+                if self._frozen is not None:
+                    ft = self._frozen.get(table)
+                    if ft is not None:
+                        tiers.append(ft)
+                for t in tiers:
+                    for b, v in t.touch.items():
+                        if m.get(b, -1) < v:
+                            m[b] = v
+                bases = np.fromiter(m.keys(), np.int64, len(m))
+                stamps = np.fromiter(m.values(), np.int64, len(m))
+                order = np.argsort(bases)
+                snap = (seq, bases[order], stamps[order])
+                self._stamps_snap[table] = snap
+            _, bases, stamps = snap
+            a = int(np.searchsorted(bases, lo))
+            b = int(np.searchsorted(bases, hi))
+            stamp = int(stamps[a:b].max()) if b > a else 0
+            return ((seq,), (self._stamp_floor,), (stamp,), dirty)
 
     def memtable_cells(self, table: str, key: bytes,
                        family: bytes | None = None) -> list[Cell]:
@@ -978,8 +1162,9 @@ class MemKVStore(KVStore):
                         mv[vo:vo + int(vl.sum())],
                         mv[lo + 8 * n:lo + 12 * n])
                     t = self._table(table)
-                    _EXT.upsert_cells(t.rows, keys, fam, quals,
-                                      vals, t.pending)
+                    existed = _EXT.upsert_cells(t.rows, keys, fam, quals,
+                                                vals, t.pending)
+                    self._dirty_add_new(t, keys, existed)
                     continue
                 apply_put = self._apply_put
                 for lk, lq, lv in zip(kl.tolist(), ql.tolist(),
@@ -1155,6 +1340,7 @@ class MemKVStore(KVStore):
             # timer checkpoints grow the WAL without bound while an
             # empty generation file accreted per call.
             with self._lock:
+                self._fold_touch_locked(self._frozen)
                 self._frozen = None
                 self.mutation_seq += 1
                 if os.path.exists(old_path):
@@ -1251,9 +1437,25 @@ class MemKVStore(KVStore):
                 raise
             self._frozen = None
             self.mutation_seq += 1
+            # The frozen tier retires: fold its transition stamps into
+            # the store-level map so fragments built while (or before)
+            # its rows were live keep invalidating — including bases a
+            # create-then-delete netted back to clean, which no longer
+            # appear in any dirty set but may sit inside a cached
+            # fragment.
+            self._fold_touch_locked(frozen)
             if spill_keys is not None:
                 for name, ks in spill_keys.items():
                     self._last_spill_keys.setdefault(name, []).extend(ks)
+                # The frozen tier's dirty index IS the spilled keys'
+                # base refcounts (rows + row tombstones): carry it as
+                # the undrained-spill dirty set, summed like the key
+                # record itself.
+                for name, ft in frozen.items():
+                    if ft.dirty:
+                        sd = self._spill_dirty.setdefault(name, {})
+                        for b, c in ft.dirty.items():
+                            sd[b] = sd.get(b, 0) + c
             for g in dropped:
                 path = g.path
                 g.close()
@@ -1308,6 +1510,17 @@ class MemKVStore(KVStore):
             i -= 1
         return gens[:i], gens[i:]
 
+    def _fold_touch_locked(self, tables: "dict[str, _Table]") -> None:
+        """Fold retiring tiers' transition stamps into the store-level
+        map (max wins). Caller holds the lock."""
+        for name, ft in tables.items():
+            if not ft.touch:
+                continue
+            st = self._base_stamps.setdefault(name, {})
+            for b, v in ft.touch.items():
+                if st.get(b, -1) < v:
+                    st[b] = v
+
     def _thaw_frozen_locked(self) -> None:
         """Fold the frozen middle tier back under the live memtable
         after a failed checkpoint (caller holds the lock). Live cells
@@ -1330,6 +1543,8 @@ class MemKVStore(KVStore):
             live.tombs += ft.tombs
             for k in ft.rows:
                 live.note_insert(k)
+            live.rebuild_dirty(self.mutation_seq + 1)
+        self._fold_touch_locked(self._frozen)
         self._frozen = None
         self.mutation_seq += 1
 
@@ -1342,6 +1557,7 @@ class MemKVStore(KVStore):
         if row is None:
             row = t.rows[key] = {}
             t.note_insert(key)
+            t.dirty_add(key, self.mutation_seq)
         row[(family, qualifier)] = value
 
     def _apply_delete(self, table: str, key: bytes, family: bytes,
@@ -1355,6 +1571,7 @@ class MemKVStore(KVStore):
                 return
             row = t.rows[key] = {}
             t.note_insert(key)
+            t.dirty_add(key, self.mutation_seq)
         for q in qualifiers:
             if spilled:
                 row[(family, q)] = None  # tombstone masks the sstable cell
@@ -1364,13 +1581,17 @@ class MemKVStore(KVStore):
         if not row:
             del t.rows[key]
             t.note_delete()
+            t.dirty_sub(key, self.mutation_seq)
 
     def _apply_delete_row(self, table: str, key: bytes) -> None:
         t = self._table(table)
         if t.rows.pop(key, None) is not None:
             t.note_delete()
-        if self._lower_tier_has(t, table, key):
+            t.dirty_sub(key, self.mutation_seq)
+        if self._lower_tier_has(t, table, key) \
+                and key not in t.row_tombs:
             t.row_tombs.add(key)
+            t.dirty_add(key, self.mutation_seq)
 
     def _check_throttle(self, table: str, key: bytes) -> None:
         # Only throttle puts that would create a NEW row: updates to
@@ -1444,6 +1665,7 @@ class MemKVStore(KVStore):
                     if row is None:
                         row = rows[key] = {}
                         t.note_insert(key)
+                        t.dirty_add(key, self.mutation_seq)
                     row[(family, qualifier)] = value
                     existed.append(e)
                 batch_ok = True
@@ -1489,6 +1711,17 @@ class MemKVStore(KVStore):
                             "durable", len(existed))
         return existed
 
+    def _dirty_add_new(self, t: _Table, keys: list[bytes],
+                       existed: list[bool]) -> None:
+        """Index the bases of the rows a bulk upsert CREATED (existed
+        False — the C pass reports intra-batch duplicates as existing,
+        so each new row counts exactly once)."""
+        add = t.dirty_add
+        seq = self.mutation_seq
+        for k, e in zip(keys, existed):
+            if not e:
+                add(k, seq)
+
     def _try_fast_batch(self, table: str, t: _Table, family: bytes,
                         keys: list[bytes], quals: list[bytes],
                         vals: list[bytes], wal_cb) -> "list[bool] | None":
@@ -1522,6 +1755,7 @@ class MemKVStore(KVStore):
             # so a trip is impossible inside the pass.
             existed = _EXT.upsert_cells(
                 rows, keys, family, quals, vals, t.pending)
+            self._dirty_add_new(t, keys, existed)
             if wal_cb is not None:
                 wal_cb()
             return existed
@@ -1554,6 +1788,7 @@ class MemKVStore(KVStore):
             # compaction that then no-ops.
             existed = _EXT.upsert_cells(
                 rows, keys, family, quals, vals, t.pending)
+            self._dirty_add_new(t, keys, existed)
             if wal_cb is not None:
                 wal_cb()
             return existed
@@ -1581,11 +1816,14 @@ class MemKVStore(KVStore):
                 rows.update((k, {(family, q): v})
                             for k, q, v in zip(keys, quals, vals))
             t.pending.update(ks)
+            for k in ks:
+                t.dirty_add(k, self.mutation_seq)
         else:
             for k, q, v in zip(keys, quals, vals):
                 row = rows.get(k)
                 if row is None:
                     rows[k] = {(family, q): v}
+                    t.dirty_add(k, self.mutation_seq)
                 else:
                     row[(family, q)] = v
             t.pending.update(ks - dups)
@@ -1661,11 +1899,14 @@ class MemKVStore(KVStore):
             return cells
 
     def _snapshot_keys(self, table: str, start: bytes,
-                       stop: bytes) -> list[bytes]:
+                       stop: bytes,
+                       skip_paths: "set[str] | None" = None,
+                       ) -> list[bytes]:
         """Key snapshot across all tiers (live memtable + frozen +
         sstable, tombstone-excluded). Caller holds the lock. One
         definition for scan() and scan_raw() so tier-merge fixes can't
-        diverge the two."""
+        diverge the two. ``skip_paths``: generations the caller's
+        series-bloom prefilter proved irrelevant."""
         t = self._table(table)
         keys = t.range_keys(start, stop)
         ft = self._frozen.get(table) if self._frozen else None
@@ -1674,6 +1915,8 @@ class MemKVStore(KVStore):
             extra.update(k for k in ft.range_keys(start, stop)
                          if k not in t.rows and k not in t.row_tombs)
         for sst in self._ssts:
+            if skip_paths and sst.path in skip_paths:
+                continue
             extra.update(
                 k for k in sst.scan_keys(table, start, stop)
                 if k not in t.rows and k not in t.row_tombs
@@ -1715,6 +1958,7 @@ class MemKVStore(KVStore):
     def scan_raw(self, table: str, start: bytes, stop: bytes,
                  family: bytes | None = None,
                  key_regexp: bytes | None = None, chunk: int = 1024,
+                 series_hint: "np.ndarray | None" = None,
                  ) -> Iterator[tuple[bytes, list[tuple[bytes, bytes]]]]:
         """Batched form of scan() for the columnar decode path: rows as
         (key, sorted [(qualifier, value), ...]), the lock taken once per
@@ -1722,10 +1966,30 @@ class MemKVStore(KVStore):
         as scan(); a 1M-point query scans ~100k+ row-hours, so the
         per-row lock/namedtuple/generator overhead of the cell API was
         the single largest host cost of the cold query path (profiled:
-        ~16 us/row, more than the vectorized decode itself)."""
+        ~16 us/row, more than the vectorized decode itself).
+
+        ``series_hint`` (see KVStore.scan_raw) prunes generations whose
+        series bloom excludes every candidate — on a high-file-count
+        store most generations hold disjoint time ranges OF THE SAME
+        series, but tag-filtered dashboards and sparse metrics leave
+        whole generations with nothing to say. Skips are decided ONCE
+        per scan against the then-current generation set and matched
+        by path thereafter: a generation swapped in mid-scan is simply
+        not skipped (conservative), and one dropped mid-scan vanishes
+        from self._ssts like any other scan."""
         pattern = re.compile(key_regexp, re.S) if key_regexp else None
         with self._lock:
-            keys = self._snapshot_keys(table, start, stop)
+            skip_paths: set[str] | None = None
+            if series_hint is not None and len(series_hint) \
+                    and self._ssts:
+                skip_paths = set()
+                for sst in self._ssts:
+                    if not sst.bloom_may_contain(table, series_hint):
+                        skip_paths.add(sst.path)
+                        self.bloom_files_skipped += 1
+                if not skip_paths:
+                    skip_paths = None
+            keys = self._snapshot_keys(table, start, stop, skip_paths)
         if pattern is not None:
             keys = [k for k in keys if pattern.match(k)]
         for i in range(0, len(keys), chunk):
@@ -1788,6 +2052,8 @@ class MemKVStore(KVStore):
                         masked = masked | ft.row_tombs
                     merged: dict[bytes, dict] = {}
                     for sst in self._ssts:
+                        if skip_paths and sst.path in skip_paths:
+                            continue
                         for key, cells in sst.iter_rows_range(
                                 table, lo, hi, skip=masked):
                             row = merged.get(key)

@@ -95,6 +95,9 @@ class ShardedKVStore(KVStore):
         self._dir = dir_path
         self.read_only = read_only
         self.data_table = data_table
+        # Whole shards dropped from a fan-out by the series-hint
+        # routing prefilter (scan_raw).
+        self.bloom_shards_skipped = 0
         created_manifest = False
         if dir_path is not None:
             man = manifest_path(dir_path)
@@ -368,9 +371,26 @@ class ShardedKVStore(KVStore):
     def scan_raw(self, table: str, start: bytes, stop: bytes,
                  family: bytes | None = None,
                  key_regexp: bytes | None = None,
+                 series_hint=None,
                  ) -> Iterator[tuple[bytes, list[tuple[bytes, bytes]]]]:
+        """Fan-in scan; with a ``series_hint`` (uint64 series-identity
+        hashes, a superset of the series the caller keeps) the fan-out
+        first drops shards no candidate routes to — the routing hash
+        IS the identity hash (sstable.series_hash), so ``h % N`` is
+        exact, not probabilistic — then each shard's own series blooms
+        prune generations."""
+        shards = self.shards
+        if (series_hint is not None and len(series_hint)
+                and table == self.data_table and self.shard_count > 1):
+            live = np.unique(series_hint
+                             % np.uint64(self.shard_count)).tolist()
+            if len(live) < self.shard_count:
+                self.bloom_shards_skipped += \
+                    self.shard_count - len(live)
+                shards = [self.shards[int(i)] for i in live]
         its = [s.scan_raw(table, start, stop, family=family,
-                          key_regexp=key_regexp) for s in self.shards]
+                          key_regexp=key_regexp,
+                          series_hint=series_hint) for s in shards]
         return heapq.merge(*its, key=lambda row: row[0])
 
     # -- memtable introspection (sketch recovery re-fold) ------------------
@@ -404,6 +424,41 @@ class ShardedKVStore(KVStore):
     @property
     def mutation_seq(self) -> int:
         return sum(s.mutation_seq for s in self.shards)
+
+    @property
+    def mutation_seqs(self) -> tuple[int, ...]:
+        """Per-shard mutation sequence vector: lets consumers
+        revalidate per shard instead of treating one put anywhere as
+        invalidating everything (the summed ``mutation_seq`` above)."""
+        return tuple(s.mutation_seq for s in self.shards)
+
+    def dirty_bases(self, table: str) -> np.ndarray:
+        """Union of every shard's incrementally-maintained dirty-base
+        set (see MemKVStore.dirty_bases), sorted unique."""
+        arrs = [a for a in (s.dirty_bases(table) for s in self.shards)
+                if len(a)]
+        if not arrs:
+            return np.empty(0, np.int64)
+        if len(arrs) == 1:
+            return arrs[0]
+        return np.unique(np.concatenate(arrs))
+
+    def chunk_state(self, table: str, lo: int, hi: int):
+        """Per-shard fragment-cache validation vectors (see
+        MemKVStore.chunk_state); ``dirty`` is the OR across shards —
+        a fan-in fragment merges every shard's rows, so one dirty
+        shard taints the chunk."""
+        epochs: list[int] = []
+        floors: list[int] = []
+        marks: list[int] = []
+        dirty = False
+        for s in self.shards:
+            e, f, m, d = s.chunk_state(table, lo, hi)
+            epochs.extend(e)
+            floors.extend(f)
+            marks.extend(m)
+            dirty = dirty or d
+        return tuple(epochs), tuple(floors), tuple(marks), dirty
 
     @property
     def record_spill_keys(self) -> bool:
@@ -460,6 +515,10 @@ class ShardedKVStore(KVStore):
     @property
     def rebuilds(self) -> int:
         return sum(s.rebuilds for s in self.shards)
+
+    @property
+    def bloom_files_skipped(self) -> int:
+        return sum(s.bloom_files_skipped for s in self.shards)
 
     @property
     def wal_swallowed_flush_errors(self) -> int:

@@ -6,8 +6,8 @@ which the WAL is truncated — bounding both recovery time and memtable
 RAM for long-running daemons (SURVEY §5.4, §7.2: "enough LSM to sustain
 ingest while scans run, without rebuilding HBase").
 
-File layout v2 (all integers big-endian):
-    magic  b"TSST2"
+File layout v3 (all integers big-endian):
+    magic  b"TSST3"
     record*  :=  [u16 table_len][table][u16 key_len][key][u32 ncells]
                  ([u16 fam_len][fam][u16 q_len][q][u32 v_len][v])*
     records sorted by (table, key); one record per row.
@@ -15,13 +15,30 @@ File layout v2 (all integers big-endian):
                    [u16 table_len][table][u32 nkeys]
                    [key_lens: nkeys x u32][offsets: nkeys x u64]
                    [keys blob]
-    trailer  :=  [u32 ntables][u64 footer_start]
+    bloom    :=  per table (same order as footer):
+                   [u16 table_len][table][u8 k][u64 nbits][bits]
+                   (k == 0, nbits == 0 => table has no bloom)
+    trailer  :=  [u32 ntables][u64 footer_start][u64 bloom_start]
 
 The footer exists because opening a file by scanning every row record
 cost ~3 us/row in Python — 10+ s per 4.4M-row generation, paid on every
-checkpoint swap-in AND at every daemon start. v2 opens with two numpy
-frombuffer calls and one C pass over the key blob. v1 files (magic
-TSST1, no footer) are still read via the legacy full scan.
+checkpoint swap-in AND at every daemon start. It opens with two numpy
+frombuffer calls and one C pass over the key blob. v2 files (magic
+TSST2, no bloom section, 12-byte trailer) and v1 files (magic TSST1,
+no footer, full-scan index) are still read; they simply never prune.
+
+The bloom section holds one FIXED-SIZE (BLOOM_BITS) bloom filter per
+table over the SERIES IDENTITIES of its row keys — metric UID + tag
+UID pairs with the base-time bytes excluded, hashed with the same
+crc32 chain the series sharder routes by — so shard fan-out readers
+can skip whole generations that cannot contain any requested series
+(query/executor._series_hint). Fixed-size on purpose: compaction
+merges blooms by OR-ing the source generations' bit arrays instead of
+re-hashing millions of relocated keys (only the frozen memtable's keys
+— bounded per checkpoint — are ever hashed at write time). A table
+whose source blooms are missing (v1/v2 input) or whose keys are too
+short to carry a series identity gets k == 0: readers treat that as
+"may contain anything".
 
 The reader mmaps the file and keeps only (key -> offset) indexes in
 RAM; cell payloads are decoded lazily per row, so a spilled store
@@ -33,21 +50,91 @@ from __future__ import annotations
 import mmap
 import os
 import struct
+import zlib
 from bisect import bisect_left
 from typing import Iterable, Iterator
 
 import numpy as np
 
+from opentsdb_tpu.core.const import TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.utils.nativeext import ext as _EXT
 
 _MAGIC_V1 = b"TSST1"
-_MAGIC = b"TSST2"
+_MAGIC_V2 = b"TSST2"
+_MAGIC = b"TSST3"
 _U16 = struct.Struct(">H")
 _U32 = struct.Struct(">I")
-_TRAILER = struct.Struct(">IQ")   # ntables, footer_start
+_TRAILER = struct.Struct(">IQ")     # v2: ntables, footer_start
+_TRAILER_V3 = struct.Struct(">IQQ")  # ntables, footer_start, bloom_start
+_BLOOM_HDR = struct.Struct(">BQ")   # k, nbits
+
+# Series-identity byte ranges of a data row key (the base-time bytes
+# between them are excluded — the sharder's routing identity,
+# storage/sharded.py _route). Keys shorter than _IDENT_HI carry no
+# identity and make their table bloomless.
+_IDENT_LO = UID_WIDTH
+_IDENT_HI = UID_WIDTH + TIMESTAMP_BYTES
+
+# Fixed per-table bloom geometry (see module docstring: fixed so
+# compaction can OR source blooms). 2^20 bits = 128 KiB per table per
+# generation; at 2k series and k=2 the false-positive rate is ~1e-5,
+# and a false positive only costs one needless generation scan.
+BLOOM_BITS = 1 << 20
+BLOOM_K = 2
+
+# Tests set this to 2 to produce bloomless legacy-format files; the
+# reader handles both forever (mixed-format stores are first-class:
+# old generations age out through compaction).
+WRITE_FORMAT = 3
 
 # row := (table, key, [(family, qualifier, value), ...])
 Row = tuple[str, bytes, list[tuple[bytes, bytes, bytes]]]
+
+
+def series_hash(series_key: bytes) -> int:
+    """The 32-bit series-identity hash shared by the shard router, the
+    sstable blooms, and the executor's candidate-series hint: crc32 of
+    (metric UID + tag UID pairs). For a full ROW key, hash
+    key[:_IDENT_LO] and key[_IDENT_HI:] chained — crc32 chaining equals
+    crc32 of the concatenation, so both spellings agree."""
+    return zlib.crc32(series_key)
+
+
+def _bloom_positions(h1: "np.ndarray") -> "np.ndarray":
+    """[n, BLOOM_K] bit positions from 32-bit identity hashes. The
+    second probe derives from h1 (Kirsch-Mitzenmacher with a mixed
+    h2): 32-bit identity collisions collapse the pair, which costs a
+    handful of false positives at million-series scale — never a false
+    negative."""
+    h1 = h1.astype(np.uint64)
+    h2 = (h1 * np.uint64(0x9E3779B1) + np.uint64(0x7FEB352D)) \
+        & np.uint64(0xFFFFFFFF)
+    ks = np.arange(BLOOM_K, dtype=np.uint64)
+    return (h1[:, None] + ks * h2[:, None]) % np.uint64(BLOOM_BITS)
+
+
+def _bloom_bits_from_hashes(h1s: "list[int] | np.ndarray",
+                            ) -> "np.ndarray":
+    """BLOOM_BITS-bit array (packed uint8, little bit order) with the
+    hashes' positions set."""
+    bits = np.zeros(BLOOM_BITS, bool)
+    if len(h1s):
+        pos = _bloom_positions(np.asarray(h1s, np.uint64))
+        bits[pos.ravel().astype(np.int64)] = True
+    return np.packbits(bits, bitorder="little")
+
+
+def _bloom_hashes_for_keys(keys: "Iterable[bytes]") -> "list[int] | None":
+    """Identity hashes for a table's row keys; None when any key is too
+    short to carry a series identity (that table gets no bloom — a
+    filter that cannot cover every key would hide rows)."""
+    crc = zlib.crc32
+    out: set[int] = set()
+    for k in keys:
+        if len(k) < _IDENT_HI:
+            return None
+        out.add(crc(k[_IDENT_HI:], crc(k[:_IDENT_LO])))
+    return list(out)
 
 
 def _slice_varlen(blob: bytes, lens_be: bytes) -> list[bytes]:
@@ -59,9 +146,39 @@ def _slice_varlen(blob: bytes, lens_be: bytes) -> list[bytes]:
     return [blob[a:b] for a, b in zip(starts.tolist(), ends.tolist())]
 
 
+def _write_bloom_and_trailer(
+        f, ntables: int, footer_start: int,
+        blooms: "dict[str, np.ndarray | None]") -> None:
+    """Write the bloom section (format 3) and the trailer, then make
+    the file durable. ``blooms`` maps table -> packed bit array or
+    None (no bloom); at WRITE_FORMAT 2 the section and the extended
+    trailer fields are omitted entirely (legacy layout)."""
+    if WRITE_FORMAT < 3:
+        f.write(_TRAILER.pack(ntables, footer_start))
+    else:
+        bloom_start = f.tell()
+        for table in sorted(blooms):
+            tb = table.encode()
+            bits = blooms[table]
+            f.write(_U16.pack(len(tb)) + tb)
+            if bits is None:
+                f.write(_BLOOM_HDR.pack(0, 0))
+            else:
+                f.write(_BLOOM_HDR.pack(BLOOM_K, BLOOM_BITS))
+                f.write(bits.tobytes())
+        f.write(_TRAILER_V3.pack(ntables, footer_start, bloom_start))
+    f.flush()
+    os.fsync(f.fileno())
+
+
 def _finish_file(f, index: dict[str, tuple[list[bytes], list[int]]],
-                 footer_start: int) -> None:
-    """Write the v2 footer + trailer and make the file durable."""
+                 footer_start: int,
+                 blooms: "dict[str, np.ndarray | None] | None" = None,
+                 ) -> None:
+    """Write the footer (+ bloom section + trailer) and make the file
+    durable. ``blooms`` overrides the per-table bloom bits (the
+    copy-merge passes OR-ed source blooms); by default each table's
+    bloom is built from its index keys."""
     for table in sorted(index):
         keys, offs = index[table]
         tb = table.encode()
@@ -69,9 +186,17 @@ def _finish_file(f, index: dict[str, tuple[list[bytes], list[int]]],
         f.write(np.fromiter(map(len, keys), ">u4", len(keys)).tobytes())
         f.write(np.asarray(offs, ">u8").tobytes())
         f.write(b"".join(keys))
-    f.write(_TRAILER.pack(len(index), footer_start))
-    f.flush()
-    os.fsync(f.fileno())
+    if blooms is None:
+        blooms = {}
+        for table, (keys, _) in index.items():
+            hs = _bloom_hashes_for_keys(keys)
+            blooms[table] = (None if hs is None
+                             else _bloom_bits_from_hashes(hs))
+    else:
+        # One bloom entry per indexed table, always (the reader parses
+        # the section by the trailer's table count).
+        blooms = {t: blooms.get(t) for t in index}
+    _write_bloom_and_trailer(f, len(index), footer_start, blooms)
 
 
 def _durable_rename(tmp: str, path: str) -> None:
@@ -112,7 +237,7 @@ def write_sstable_bulk(path: str,
     tmp = path + ".tmp"
     n = 0
     with open(tmp, "wb") as f:
-        f.write(_MAGIC)
+        f.write(_MAGIC if WRITE_FORMAT >= 3 else _MAGIC_V2)
         off = len(_MAGIC)
         footer: dict[str, tuple[bytes, bytes, list[bytes]]] = {}
         for table in sorted(tables):
@@ -128,6 +253,7 @@ def write_sstable_bulk(path: str,
             n += len(keys)
             footer[table] = (offs_be, klens_be, keys)
         footer_start = off
+        blooms: dict[str, "np.ndarray | None"] = {}
         for table in sorted(footer):
             offs_be, klens_be, keys = footer[table]
             tb = table.encode()
@@ -135,9 +261,10 @@ def write_sstable_bulk(path: str,
             f.write(klens_be)
             f.write(offs_be)
             f.write(b"".join(keys))
-        f.write(_TRAILER.pack(len(footer), footer_start))
-        f.flush()
-        os.fsync(f.fileno())
+            hs = _bloom_hashes_for_keys(keys)
+            blooms[table] = (None if hs is None
+                             else _bloom_bits_from_hashes(hs))
+        _write_bloom_and_trailer(f, len(footer), footer_start, blooms)
     _durable_rename(tmp, path)
     return n
 
@@ -152,7 +279,7 @@ def write_sstable(path: str, rows: Iterable[Row]) -> int:
     n = 0
     index: dict[str, tuple[list[bytes], list[int]]] = {}
     with open(tmp, "wb") as f:
-        f.write(_MAGIC)
+        f.write(_MAGIC if WRITE_FORMAT >= 3 else _MAGIC_V2)
         off = len(_MAGIC)
         for table, key, cells in rows:
             tb = table.encode()
@@ -214,8 +341,9 @@ def merge_sstables(path: str, gens: "list[SSTable]",
     tmp = path + ".tmp"
     n = 0
     index: dict[str, tuple[list[bytes], list[int]]] = {}
+    blooms: dict[str, "np.ndarray | None"] = {}
     with open(tmp, "wb") as f:
-        f.write(_MAGIC)
+        f.write(_MAGIC if WRITE_FORMAT >= 3 else _MAGIC_V2)
         off = len(_MAGIC)
         for name in sorted(names):
             rows_f, row_tombs, has_tombs = frozen.get(
@@ -320,7 +448,33 @@ def merge_sstables(path: str, gens: "list[SSTable]",
             pairs.sort()
             index[name] = ([p[0] for p in pairs], [p[1] for p in pairs])
             n += len(pairs)
-        _finish_file(f, index, off)
+            # Bloom for the merged table: OR the source generations'
+            # fixed-size blooms (records relocate verbatim, so their
+            # identities carry over; keys a tombstone just dropped
+            # leave stale bits — false positives only) and hash in the
+            # frozen tier's keys. Any bloomless source (v1/v2 file,
+            # short keys) makes the output bloomless: a bloom that
+            # does not cover every key would hide rows from pruned
+            # scans.
+            bloom: "np.ndarray | None" = np.zeros(BLOOM_BITS // 8,
+                                                 np.uint8)
+            for g in gens:
+                if g.key_count(name) == 0:
+                    continue
+                gb = g.bloom_bits(name)
+                if gb is None:
+                    bloom = None
+                    break
+                np.bitwise_or(bloom, gb, out=bloom)
+            if bloom is not None and rows_f:
+                hs = _bloom_hashes_for_keys(rows_f)
+                if hs is None:
+                    bloom = None
+                else:
+                    np.bitwise_or(bloom, _bloom_bits_from_hashes(hs),
+                                  out=bloom)
+            blooms[name] = bloom
+        _finish_file(f, index, off, blooms)
     _durable_rename(tmp, path)
     return n
 
@@ -335,19 +489,28 @@ class SSTable:
         self._mm = mmap.mmap(self._f.fileno(), size, access=mmap.ACCESS_READ)
         # table -> (sorted keys, parallel row offsets)
         self._index: dict[str, tuple[list[bytes], list[int]]] = {}
+        # table -> packed BLOOM_BITS bit array (absent = no pruning)
+        self._blooms: dict[str, np.ndarray] = {}
         self._all_starts = None  # record_extents' sorted-start cache
         head = self._mm[:len(_MAGIC)]
         if head == _MAGIC:
-            self._load_footer()
+            self._load_footer(v3=True)
+        elif head == _MAGIC_V2:
+            self._load_footer(v3=False)
         elif head == _MAGIC_V1:
             self._build_index_v1()
         else:
             raise IOError(f"{path}: bad sstable magic")
 
-    def _load_footer(self) -> None:
+    def _load_footer(self, v3: bool) -> None:
         mm = self._mm
-        ntables, footer_start = _TRAILER.unpack_from(
-            mm, len(mm) - _TRAILER.size)
+        if v3:
+            ntables, footer_start, bloom_start = _TRAILER_V3.unpack_from(
+                mm, len(mm) - _TRAILER_V3.size)
+        else:
+            ntables, footer_start = _TRAILER.unpack_from(
+                mm, len(mm) - _TRAILER.size)
+            bloom_start = None
         self._data_end = footer_start
         off = footer_start
         for _ in range(ntables):
@@ -365,6 +528,28 @@ class SSTable:
             keys = _slice_varlen(mm[off:off + blob_len], lens_be)
             off += blob_len
             self._index[table] = (keys, offs)
+        if bloom_start is not None:
+            off = bloom_start
+            for _ in range(ntables):
+                (tlen,) = _U16.unpack_from(mm, off)
+                off += 2
+                table = mm[off:off + tlen].decode()
+                off += tlen
+                k, nbits = _BLOOM_HDR.unpack_from(mm, off)
+                off += _BLOOM_HDR.size
+                nbytes = nbits >> 3
+                if k:
+                    # Copied out of the mmap (a frombuffer VIEW would
+                    # pin the map open past close()); 128 KiB per
+                    # table.
+                    bits = np.frombuffer(mm, np.uint8, nbytes,
+                                         off).copy()
+                    off += nbytes
+                    # Foreign geometry (a build with different BLOOM
+                    # consts) reads fine but cannot be probed or
+                    # OR-merged — treat as bloomless.
+                    if k == BLOOM_K and nbits == BLOOM_BITS:
+                        self._blooms[table] = bits
 
     def _build_index_v1(self) -> None:
         self._data_end = len(self._mm)
@@ -414,6 +599,46 @@ class SSTable:
             return None
         keys = idx[0]
         return keys[0], keys[-1]
+
+    def bloom_bits(self, table: str) -> "np.ndarray | None":
+        """Packed bloom bit array for ``table`` (the copy-merge ORs
+        these), or None when the table has no usable bloom."""
+        return self._blooms.get(table)
+
+    def bloom_may_contain(self, table: str,
+                          h1s: "np.ndarray") -> bool:
+        """Can this generation hold ANY series whose identity hash is
+        in ``h1s`` (uint64 array of series_hash values)? True when the
+        table has no bloom (v1/v2 file, short keys, foreign geometry)
+        — absence of evidence never prunes."""
+        bits = self._blooms.get(table)
+        if bits is None or len(h1s) == 0:
+            return True
+        pos = _bloom_positions(h1s)
+        got = (bits[(pos >> np.uint64(3)).astype(np.int64)]
+               >> (pos & np.uint64(7)).astype(np.uint8)) & 1
+        return bool(got.all(axis=1).any())
+
+    def bloom_check(self, table: str) -> "int | None":
+        """fsck probe: how many of the table's indexed keys are NOT
+        covered by its bloom (must be 0 — a false negative silently
+        hides rows from pruned scans). None when the table has no
+        bloom."""
+        bits = self._blooms.get(table)
+        if bits is None:
+            return None
+        idx = self._index.get(table)
+        if not idx or not idx[0]:
+            return 0
+        hs = _bloom_hashes_for_keys(idx[0])
+        if hs is None:
+            # Short keys under a bloom: every such key is invisible to
+            # bloom-pruned scans — count them all as misses.
+            return sum(1 for k in idx[0] if len(k) < _IDENT_HI)
+        pos = _bloom_positions(np.asarray(hs, np.uint64))
+        got = (bits[(pos >> np.uint64(3)).astype(np.int64)]
+               >> (pos & np.uint64(7)).astype(np.uint8)) & 1
+        return int((~got.all(axis=1)).sum())
 
     def has_key(self, table: str, key: bytes) -> bool:
         idx = self._index.get(table)

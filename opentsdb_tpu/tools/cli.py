@@ -484,6 +484,31 @@ def cmd_fsck(args) -> int:
                 print(f"ERROR: row {key.hex()}: {e}")
         if bad and args.fix:
             fixed += _fix_row(tsdb, key, cells)
+    # SSTable format / series-bloom audit over every generation
+    # (mixed-format stores are first-class: TSST3 files carry blooms,
+    # v1/v2 files don't and simply never prune). A bloom FALSE
+    # NEGATIVE — an indexed key its table's bloom excludes — would
+    # silently hide rows from bloom-pruned scans, so it counts as a
+    # hard error.
+    stores = getattr(tsdb.store, "shards", None) or [tsdb.store]
+    bloomed = plain = bloom_misses = 0
+    for s in stores:
+        for sst in getattr(s, "_ssts", []):
+            any_bloom = False
+            for name in sst.tables():
+                miss = sst.bloom_check(name)
+                if miss is None:
+                    continue
+                any_bloom = True
+                if miss:
+                    errors += miss
+                    bloom_misses += miss
+                    print(f"ERROR: {sst.path}: series bloom for table "
+                          f"'{name}' excludes {miss} of its own keys")
+            bloomed += 1 if any_bloom else 0
+            plain += 0 if any_bloom else 1
+    print(f"sstables: {bloomed} with series blooms, {plain} "
+          f"bloomless/legacy, {bloom_misses} bloom false negatives")
     dt = max(time.time() - t0, 1e-9)
     print(f"{kvs} KVs (in {rows} rows) analyzed in {dt * 1000:.0f}ms "
           f"(~{kvs / dt:.0f} KV/s)")

@@ -51,6 +51,23 @@ class Config:
     rollup_hll_p: int = 8          # HLL registers exponent per window
     rollup_sketch_min_res: int = 86400  # sketch columns at res >= this
     rollup_catchup: str = "background"  # background | sync | off
+    # Debug oracle: derive the rollup planner's dirty-window set BOTH
+    # ways — the O(1)-maintained store index and the legacy full
+    # memtable-key sweep — and fail loudly on divergence. Test-only
+    # (the sweep is exactly the O(memtable) cost the index removes).
+    rollup_sweep_check: bool = False
+
+    # Query fast path (query/executor.py "fragment cache"): cache
+    # decoded per-(selector, aligned time-chunk) columnar span
+    # fragments, validated against the store's per-shard content
+    # epochs and dirty-base set — repeat dashboard queries re-decode
+    # only chunks with memtable-resident (dirty) data; frozen history
+    # serves from RAM. Answers are bit-identical to cold scans.
+    qcache: bool = True
+    qcache_chunk_s: int = 6 * 3600   # chunk width (rounded to row span)
+    qcache_points: int = 1 << 24     # total cached points across fragments
+    qcache_fragments: int = 1024     # max distinct fragments
+    qcache_max_chunks: int = 512     # wider ranges scan unchunked/uncached
 
     # streaming sketches: device-resident per-series t-digests and
     # per-(metric, tagk) HyperLogLogs folded in at ingest (north star;

@@ -121,16 +121,19 @@ def write_artifacts(out: dict) -> None:
     ssfx = (f"_S{out['shards']}" if out.get("shards") else "")
     if out.get("rollup") is not None:
         ssfx += "_R"
+    if out.get("qcache") is not None:
+        ssfx += "_Q"
     suffixed = os.path.join(
         REPO, f"BENCH_SCALE_{pts // 1_000_000}M{ssfx}.json")
     with open(suffixed, "w") as f:
         json.dump(out, f, indent=2)
     canonical = os.path.join(REPO, "BENCH_SCALE.json")
-    if out.get("rollup") is not None:
-        # A rollup run's ingest pays fold costs no plain run pays; it
-        # must never become the canonical cross-round artifact no
-        # matter its size.
-        log("rollup run: canonical BENCH_SCALE.json left alone "
+    if out.get("rollup") is not None or out.get("qcache") is not None:
+        # A rollup run's ingest pays fold costs no plain run pays, and
+        # a --repeat-queries run's ingest wall includes the mid-run
+        # dirty-set probes; neither may become the canonical
+        # cross-round artifact no matter its size.
+        log("rollup/qcache run: canonical BENCH_SCALE.json left alone "
             f"(this run in {os.path.basename(suffixed)})")
         return
     prev_pts = -1
@@ -176,6 +179,16 @@ def main() -> int:
                          "record long-range query latency raw vs "
                          "rollup into BENCH_ROLLUP.json (both legs on "
                          "this host/config)")
+    ap.add_argument("--repeat-queries", action="store_true",
+                    help="record the query fast path into "
+                         "BENCH_QCACHE.json: a warm-dashboard leg "
+                         "(cold vs warm repeat-query latency through "
+                         "the executor's fragment cache, byte-exact "
+                         "answer check) plus mid-ingest dirty-set "
+                         "derivation probes (incremental store index "
+                         "vs the legacy full memtable-key sweep). "
+                         "Writes _Q-suffixed scale artifacts so plain "
+                         "runs are never clobbered")
     ap.add_argument("--workdir", default="/tmp/tsdb_scale")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
@@ -271,6 +284,46 @@ def main() -> int:
     mid_ckpts: list[dict] = []
     next_ckpt = args.checkpoint_every or (1 << 62)
 
+    # Live-ingest dirty-set probes (--repeat-queries): time BOTH
+    # derivations of the rollup planner's dirty-window source at
+    # increasing memtable fills — the store's incremental index
+    # (storage/kv dirty_bases) vs the legacy full pending-key sweep —
+    # so the artifact shows which one scales with memtable size.
+    dirty_probes: list[dict] = []
+    probe_marks = ([max(int(args.points * f), 1)
+                    for f in (0.01, 0.03, 0.05, 0.5, 1.0)]
+                   if args.repeat_queries else [])
+
+    def probe_dirty(at_points: int) -> None:
+        from opentsdb_tpu.core.const import TIMESTAMP_BYTES, UID_WIDTH
+        lo, hi = UID_WIDTH, UID_WIDTH + TIMESTAMP_BYTES
+        store, table = tsdb.store, tsdb.table
+        t0 = time.perf_counter()
+        inc = store.dirty_bases(table)
+        t_inc = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        store.dirty_bases(table)
+        t_inc_cached = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        keys = [k for k in store.pending_keys(table) if len(k) >= hi]
+        blob = b"".join(k[lo:hi] for k in keys)
+        swept = (np.unique(np.frombuffer(blob, ">u4").astype(np.int64))
+                 if keys else np.empty(0, np.int64))
+        t_sweep = time.perf_counter() - t0
+        ck = ckpt["thread"]
+        if ck is None or not ck.is_alive():
+            # Only comparable when no overlapped spill can mutate the
+            # set between the two (unsynchronized) derivations.
+            assert np.array_equal(inc, swept), \
+                "incremental dirty set diverged from sweep"
+        rec = {"at_points": at_points, "pending_keys": len(keys),
+               "dirty_bases": int(len(inc)),
+               "incremental_s": round(t_inc, 6),
+               "incremental_cached_s": round(t_inc_cached, 6),
+               "sweep_s": round(t_sweep, 6)}
+        dirty_probes.append(rec)
+        log(f"  dirty probe @ {at_points:,}: {rec}")
+
     # GC pause attribution: the collector's stop-the-world time is part
     # of the unattributed wall unless measured directly.
     gc_acc = {"s": 0.0, "t0": 0.0}
@@ -363,6 +416,10 @@ def main() -> int:
                     ckpt["thread"] = t
                     t.start()
                     next_ckpt = total + args.checkpoint_every
+                if probe_marks and total >= probe_marks[0]:
+                    while probe_marks and total >= probe_marks[0]:
+                        probe_marks.pop(0)
+                    probe_dirty(total)
             now = time.perf_counter()
             r = rss_gb()
             peak_rss = max(peak_rss, r)
@@ -503,6 +560,94 @@ def main() -> int:
         "wal_bytes_after": wal_bytes(),
     }
     log(f"checkpoint: {out['checkpoint']}")
+
+    # Warm-dashboard leg (--repeat-queries): repeat-query latency cold
+    # (fragment cache cleared) vs warm (second+ run) on the spilled
+    # corpus, byte-exact answer check. Devwindow and rollups detached
+    # so the legs measure the FRAGMENT cache's scan-path win, per leg:
+    # jit/uid warmup on a same-span shifted range first, so "cold" is
+    # the scan+decode cost, not compilation.
+    if args.repeat_queries:
+        rq: dict = {
+            "chunk_s": int(getattr(tsdb.config, "qcache_chunk_s", 0)),
+            "qcache_points": int(getattr(tsdb.config, "qcache_points",
+                                         0))}
+        dwx, tsdb.devwindow = tsdb.devwindow, None
+        hold_roll = getattr(tsdb, "rollups", None)
+        tsdb.rollups = None
+        try:
+            exq = QueryExecutor(tsdb, backend="tpu")
+            legs = [
+                ("1day_1h_sum", 86400,
+                 QuerySpec("scale.metric", {}, "sum",
+                           downsample=(3600, "avg"))),
+                ("1week_1h_sum", 7 * 86400,
+                 QuerySpec("scale.metric", {}, "sum",
+                           downsample=(3600, "avg"))),
+                ("1week_1h_p95", 7 * 86400,
+                 QuerySpec("scale.metric", {}, "p95",
+                           downsample=(3600, "avg"))),
+                # Tag-filtered panel: exercises the series-hint fan-out
+                # pruning too (shard routing + sstable blooms).
+                ("1week_1h_host0", 7 * 86400,
+                 QuerySpec("scale.metric", {"host": "h0000"}, "sum",
+                           downsample=(3600, "avg"))),
+            ]
+            for label, span, spec in legs:
+                if span * 2 > done_pps * step:
+                    continue
+                lo = end - span
+                exq.run(spec, lo - span, end - span)   # jit/uid warm
+                exq._frag_cache.clear()
+                t0 = time.perf_counter()
+                r_cold, plan_c, cached_c = exq.run_with_plan(
+                    spec, lo, end)
+                t_cold = time.perf_counter() - t0
+                warms = []
+                r_warm = r_cold
+                cached_w = False
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    r_warm, _plan, cached_w = exq.run_with_plan(
+                        spec, lo, end)
+                    warms.append(time.perf_counter() - t0)
+                t_warm = sorted(warms)[len(warms) // 2]
+                ident = (len(r_cold) == len(r_warm) and all(
+                    np.array_equal(a.timestamps, b.timestamps)
+                    and np.array_equal(a.values, b.values)
+                    for a, b in zip(r_cold, r_warm)))
+                rq[label] = {
+                    "cold_s": round(t_cold, 4),
+                    "warm_s": round(t_warm, 4),
+                    "warm_all_s": [round(w, 4) for w in warms],
+                    "speedup": round(t_cold / max(t_warm, 1e-9), 1),
+                    "plan": plan_c, "warm_cached": bool(cached_w),
+                    "byte_identical": bool(ident)}
+                log(f"qcache {label}: cold {t_cold:.3f}s -> warm "
+                    f"{t_warm:.3f}s "
+                    f"({t_cold / max(t_warm, 1e-9):.1f}x, "
+                    f"cached={cached_w}, identical={ident})")
+            rq["counters"] = {
+                "hits": exq.qcache_hits, "misses": exq.qcache_misses,
+                "bypasses": exq.qcache_bypasses,
+                "cached_points": exq._frag_cache.cost,
+                "bloom_files_skipped": getattr(
+                    tsdb.store, "bloom_files_skipped", 0),
+                "bloom_shards_skipped": getattr(
+                    tsdb.store, "bloom_shards_skipped", 0)}
+        finally:
+            tsdb.devwindow = dwx
+            tsdb.rollups = hold_roll
+        rq["dirty_probes"] = dirty_probes
+        out["qcache"] = rq
+        qart = {"device": str(dev), "shards": args.shards,
+                "series": args.series, "points": total,
+                "step_s": step, "span_s": done_pps * step,
+                "native_ext": native_ext is not None,
+                "host": out["host"], **rq}
+        with open(os.path.join(REPO, "BENCH_QCACHE.json"), "w") as f:
+            json.dump(qart, f, indent=2)
+        log(f"qcache artifact: {qart}")
 
     # Rollup tier: long-range downsampled queries raw vs rollup on the
     # SAME host/config (both legs cold-path: devwindow detached), plus

@@ -1,0 +1,493 @@
+"""Query fast path: fragment cache golden parity, incremental
+dirty-set equivalence, and sstable series blooms.
+
+The contract under test (ISSUE 3 tentpole): warm-cache answers are
+BIT-IDENTICAL to cold scans through every mutation the engine supports
+— puts, deletes, out-of-order backfill, checkpoints (plain spills and
+tombstone merges), and the rollup tier's spill/fold bracketing — at
+shards=1 and shards=4; the store's incrementally-maintained dirty-base
+set always equals the legacy full-key sweep; and bloom-pruned scans
+return exactly what unpruned scans return while skipping generations
+that cannot hold the requested series.
+"""
+
+import asyncio
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.storage import sstable as sstable_mod
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.storage.sharded import ShardedKVStore
+from opentsdb_tpu.utils.config import Config
+from opentsdb_tpu.utils.lru import LRUCache
+
+BT = 1356998400
+HOUR = 3600
+
+
+def make_tsdb(tmp_path, shards, **cfg_kw):
+    cfg = Config(auto_create_metrics=True, device_window=False,
+                 shards=shards, qcache_chunk_s=2 * HOUR,
+                 rollup_sweep_check=True, **cfg_kw)
+    if shards > 1:
+        store = ShardedKVStore(str(tmp_path / "store"), shards=shards)
+    else:
+        store = MemKVStore(wal_path=str(tmp_path / "store" / "wal"))
+    return TSDB(store, cfg, start_compaction_thread=False)
+
+
+def ingest(tsdb, metric, n_series, start, n, step, offset=0.0):
+    ts = start + np.arange(n, dtype=np.int64) * step
+    for si in range(n_series):
+        vals = np.cumsum(np.ones(n)) * 0.25 + si + offset
+        tsdb.add_batch(metric, ts, vals, {"host": f"h{si:02d}"})
+    return int(ts[-1])
+
+
+BATTERY = [
+    QuerySpec("par.metric", {}, "sum"),
+    QuerySpec("par.metric", {}, "avg", downsample=(HOUR, "avg")),
+    QuerySpec("par.metric", {}, "p95", downsample=(HOUR, "sum")),
+    QuerySpec("par.metric", {"host": "*"}, "max",
+              downsample=(HOUR, "max")),
+    QuerySpec("par.metric", {"host": "h01"}, "sum"),
+    QuerySpec("par.metric", {}, "sum", rate=True),
+]
+
+
+def assert_warm_equals_cold(tsdb, ex, start, end, stage):
+    """Run the battery twice warm (populating then hitting the
+    fragment cache) and compare against the same executor with the
+    cache disabled — bit-identical, not approximately equal."""
+    for spec in BATTERY:
+        warm1 = ex.run(spec, start, end)
+        warm2 = ex.run(spec, start, end)
+        tsdb.config.qcache = False
+        try:
+            cold = ex.run(spec, start, end)
+        finally:
+            tsdb.config.qcache = True
+        for label, got in (("warm1", warm1), ("warm2", warm2)):
+            assert len(got) == len(cold), \
+                f"{stage}/{spec.aggregator}/{label}: group count"
+            for g, c in zip(got, cold):
+                assert g.tags == c.tags and \
+                    g.aggregated_tags == c.aggregated_tags
+                assert np.array_equal(g.timestamps, c.timestamps), \
+                    f"{stage}/{spec.aggregator}/{label}: grid"
+                assert np.array_equal(g.values, c.values), \
+                    f"{stage}/{spec.aggregator}/{label}: values"
+
+
+def sweep_bases(store, table):
+    """The legacy dirty-set derivation (the oracle)."""
+    from opentsdb_tpu.core.const import TIMESTAMP_BYTES, UID_WIDTH
+    lo, hi = UID_WIDTH, UID_WIDTH + TIMESTAMP_BYTES
+    keys = [k for k in store.pending_keys(table) if len(k) >= hi]
+    if not keys:
+        return np.empty(0, np.int64)
+    blob = b"".join(k[lo:hi] for k in keys)
+    return np.unique(np.frombuffer(blob, ">u4").astype(np.int64))
+
+
+class TestGoldenParity:
+    @pytest.mark.parametrize("shards", [1, 4])
+    def test_warm_equals_cold_through_mutations(self, tmp_path, shards):
+        tsdb = make_tsdb(tmp_path, shards, enable_rollups=True,
+                         rollup_catchup="sync")
+        try:
+            ex = QueryExecutor(tsdb, backend="cpu")
+            end = ingest(tsdb, "par.metric", 5, BT, 600, 60)
+            start = BT - 1
+            assert_warm_equals_cold(tsdb, ex, start, end, "memtable")
+
+            tsdb.checkpoint()
+            assert_warm_equals_cold(tsdb, ex, start, end, "spilled")
+            assert np.array_equal(sweep_bases(tsdb.store, tsdb.table),
+                                  tsdb.store.dirty_bases(tsdb.table))
+
+            # Live tail over frozen history.
+            end = ingest(tsdb, "par.metric", 5, BT + 600 * 60, 300, 60,
+                         offset=3.0)
+            assert_warm_equals_cold(tsdb, ex, start, end, "hot-tail")
+
+            # Out-of-order backfill into an already-cached cold chunk.
+            ingest(tsdb, "par.metric", 2, BT + 7, 50, 60, offset=9.0)
+            assert_warm_equals_cold(tsdb, ex, start, end, "backfill")
+            tsdb.checkpoint()
+            assert_warm_equals_cold(tsdb, ex, start, end,
+                                    "backfill-spilled")
+
+            # Delete a spilled row (cell tombstones) + a whole row.
+            row0 = tsdb.row_key_for("par.metric", {"host": "h00"},
+                                    BT - BT % HOUR)
+            tsdb.store.delete_row(tsdb.table, row0)
+            row1 = tsdb.row_key_for("par.metric", {"host": "h01"},
+                                    BT - BT % HOUR)
+            cells = tsdb.store.get(tsdb.table, row1, b"t")
+            tsdb.store.delete(tsdb.table, row1, b"t",
+                              [c.qualifier for c in cells[:1]])
+            assert_warm_equals_cold(tsdb, ex, start, end, "deleted")
+            tsdb.checkpoint()  # tombstone merge: content marks bump
+            assert_warm_equals_cold(tsdb, ex, start, end,
+                                    "deleted-merged")
+
+            assert np.array_equal(sweep_bases(tsdb.store, tsdb.table),
+                                  tsdb.store.dirty_bases(tsdb.table))
+            assert ex.qcache_hits > 0
+        finally:
+            tsdb.shutdown()
+
+    def test_rollup_and_raw_agree_warm(self, tmp_path):
+        """Rollup-planner interplay: a rollup-eligible query answered
+        from summaries must match the warm fragment-cache raw answer
+        bit for bit (dirty windows stitch from raw on both paths)."""
+        tsdb = make_tsdb(tmp_path, 1, enable_rollups=True,
+                         rollup_catchup="sync")
+        try:
+            ex = QueryExecutor(tsdb, backend="cpu")
+            end = ingest(tsdb, "par.metric", 4, BT, 26 * 60, 60)
+            tsdb.checkpoint()
+            tsdb.rollups.wait_ready()
+            spec = QuerySpec("par.metric", {}, "sum",
+                             downsample=(HOUR, "sum"))
+            roll, plan, _ = ex.run_with_plan(spec, BT - 1, end)
+            assert plan == "1h"
+            tier, tsdb.rollups = tsdb.rollups, None
+            try:
+                ex.run(spec, BT - 1, end)   # populate fragments
+                raw, plan2, cached = ex.run_with_plan(spec, BT - 1, end)
+                assert plan2 == "raw" and cached
+            finally:
+                tsdb.rollups = tier
+            assert len(roll) == len(raw) == 1
+            assert np.array_equal(roll[0].timestamps,
+                                  raw[0].timestamps)
+            assert np.array_equal(roll[0].values, raw[0].values)
+        finally:
+            tsdb.shutdown()
+
+
+class TestDirtySetEquivalence:
+    @pytest.mark.parametrize("shards", [1, 4])
+    def test_sequence_equivalence(self, tmp_path, shards):
+        """After every mutation kind, incremental == sweep exactly."""
+        tsdb = make_tsdb(tmp_path, shards)
+        try:
+            t = tsdb.table
+
+            def check(stage):
+                inc = tsdb.store.dirty_bases(t)
+                swp = sweep_bases(tsdb.store, t)
+                assert np.array_equal(inc, swp), \
+                    f"{stage}: {inc.tolist()} != {swp.tolist()}"
+
+            ingest(tsdb, "dirt.metric", 3, BT, 240, 60)
+            check("ingest")
+            tsdb.checkpoint()
+            check("checkpoint")
+            ingest(tsdb, "dirt.metric", 3, BT + 240 * 60, 120, 60)
+            check("more-ingest")
+            row = tsdb.row_key_for("dirt.metric", {"host": "h00"},
+                                   BT - BT % HOUR)
+            tsdb.store.delete_row(t, row)
+            check("delete-row")
+            # put-then-full-delete of a never-spilled row vanishes
+            # without residue.
+            far = tsdb.row_key_for("dirt.metric", {"host": "h00"},
+                                   BT + 4000 * HOUR)
+            tsdb.store.put(t, far, b"t", b"\x00\x10", b"\x05")
+            check("far-put")
+            tsdb.store.delete(t, far, b"t", [b"\x00\x10"])
+            check("far-delete")
+            tsdb.checkpoint()
+            check("final-checkpoint")
+        finally:
+            tsdb.shutdown()
+
+    def test_concurrent_ingest_equivalence(self, tmp_path):
+        """Chaos leg: ingest + delete + checkpoint threads while the
+        main thread compares incremental vs sweep ATOMICALLY (both
+        derivations under the single store's lock), then a final
+        quiescent comparison through the tier's sweep_check oracle."""
+        tsdb = make_tsdb(tmp_path, 1, enable_rollups=True,
+                         rollup_catchup="sync")
+        try:
+            t = tsdb.table
+            stop = threading.Event()
+            errors = []
+
+            def ingester(si):
+                i = 0
+                while not stop.is_set():
+                    ts = BT + (np.arange(50, dtype=np.int64)
+                               + i * 50) * 60
+                    try:
+                        tsdb.add_batch("con.metric", ts,
+                                       np.ones(50) * si,
+                                       {"host": f"c{si}"})
+                        if i % 7 == 3:
+                            row = tsdb.row_key_for(
+                                "con.metric", {"host": f"c{si}"},
+                                int(ts[0]) - int(ts[0]) % HOUR)
+                            tsdb.store.delete_row(t, row)
+                    except Exception as e:  # pragma: no cover
+                        errors.append(e)
+                        return
+                    i += 1
+
+            def checkpointer():
+                while not stop.is_set():
+                    try:
+                        tsdb.checkpoint()
+                    except Exception as e:  # pragma: no cover
+                        errors.append(e)
+                        return
+
+            threads = [threading.Thread(target=ingester, args=(si,))
+                       for si in range(3)]
+            threads.append(threading.Thread(target=checkpointer))
+            for th in threads:
+                th.start()
+            try:
+                for _ in range(200):
+                    with tsdb.store._lock:
+                        inc = tsdb.store.dirty_bases(t)
+                        swp = sweep_bases(tsdb.store, t)
+                    assert np.array_equal(inc, swp), \
+                        (inc.tolist(), swp.tolist())
+            finally:
+                stop.set()
+                for th in threads:
+                    th.join()
+            assert not errors, errors
+            # Quiescent: the tier's dirty_hour_bases runs its own
+            # sweep_check oracle (enabled by make_tsdb).
+            tsdb.rollups.dirty_hour_bases()
+            assert np.array_equal(sweep_bases(tsdb.store, t),
+                                  tsdb.store.dirty_bases(t))
+        finally:
+            tsdb.shutdown()
+
+
+class TestTransitionStamps:
+    def test_transient_row_invalidates_even_after_net_zero(
+            self, tmp_path):
+        """A create-then-full-delete nets the dirty refcount back to
+        zero — the chunk reads clean again — but a fragment scanned
+        during that window could hold the transient row. The per-base
+        transition stamp must therefore exceed any seq tagged before
+        the put, including across the checkpoint that retires the
+        (empty) frozen tier."""
+        tsdb = make_tsdb(tmp_path, 1)
+        try:
+            t = tsdb.table
+            store = tsdb.store
+            ingest(tsdb, "st.metric", 2, BT, 60, 60)
+            tsdb.checkpoint()
+            lo = BT - BT % (2 * HOUR)
+            seqs0, floors0, stamps0, dirty0 = store.chunk_state(
+                t, lo, lo + 2 * HOUR)
+            assert not dirty0
+            row = tsdb.row_key_for("st.metric", {"host": "h00"},
+                                   BT - BT % HOUR)
+            store.put(t, row + b"x" * 0, b"t", b"\xff\xf0", b"\x05")
+            assert store.chunk_state(t, lo, lo + 2 * HOUR)[3]  # dirty
+            store.delete(t, row, b"t", [b"\xff\xf0"])
+            # Net zero: the chunk may read clean or dirty depending on
+            # whether the base still holds spilled-row tombstone state;
+            # either way the stamp moved past the old seq, so a
+            # fragment tagged seqs0 can never validate.
+            s1 = store.chunk_state(t, lo, lo + 2 * HOUR)
+            assert s1[2][0] > seqs0[0]
+            tsdb.checkpoint()   # frozen tier retires; stamps must survive
+            s2 = store.chunk_state(t, lo, lo + 2 * HOUR)
+            assert not s2[3]
+            assert s2[2][0] > seqs0[0]
+        finally:
+            tsdb.shutdown()
+
+    def test_far_chunk_put_delete_no_residue_but_stamped(self, tmp_path):
+        """Same invariant for a never-spilled far chunk: the dirty set
+        drops back to empty, the stamp stays."""
+        tsdb = make_tsdb(tmp_path, 1)
+        try:
+            t = tsdb.table
+            store = tsdb.store
+            far = tsdb.row_key_for("st.metric", {"host": "h9"},
+                                   BT + 5000 * HOUR)
+            lo = (BT + 5000 * HOUR) - (BT + 5000 * HOUR) % (2 * HOUR)
+            base_state = store.chunk_state(t, lo, lo + 2 * HOUR)
+            store.put(t, far, b"t", b"\x00\x10", b"\x05")
+            store.delete(t, far, b"t", [b"\x00\x10"])
+            assert len(store.dirty_bases(t)) == 0
+            st = store.chunk_state(t, lo, lo + 2 * HOUR)
+            assert not st[3] and st[2][0] > base_state[0][0]
+        finally:
+            tsdb.shutdown()
+
+
+class TestSeriesBlooms:
+    def test_bloom_prunes_disjoint_generations(self, tmp_path):
+        """Two generations holding different metrics: a tag-filtered
+        query for one skips the other's generation outright, with
+        identical results."""
+        tsdb = make_tsdb(tmp_path, 1)
+        try:
+            end = ingest(tsdb, "bl.one", 3, BT, 200, 60)
+            tsdb.checkpoint()
+            ingest(tsdb, "bl.two", 3, BT, 200, 60)
+            tsdb.checkpoint()
+            assert len(tsdb.store._ssts) >= 2
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec("bl.one", {"host": "h01"}, "sum")
+            before = tsdb.store.bloom_files_skipped
+            res = ex.run(spec, BT - 1, end)
+            assert tsdb.store.bloom_files_skipped > before
+            tsdb.config.qcache = False
+            try:
+                # Hintless oracle: no sketch directory consulted.
+                sk, tsdb.sketches = tsdb.sketches, None
+                try:
+                    oracle = ex.run(spec, BT - 1, end)
+                finally:
+                    tsdb.sketches = sk
+            finally:
+                tsdb.config.qcache = True
+            assert len(res) == len(oracle) == 1
+            assert np.array_equal(res[0].timestamps,
+                                  oracle[0].timestamps)
+            assert np.array_equal(res[0].values, oracle[0].values)
+        finally:
+            tsdb.shutdown()
+
+    def test_mixed_format_store_serves_and_fscks(self, tmp_path):
+        """v2 (bloomless) and v3 generations coexisting in one store:
+        queries are exact, fsck exits clean, and a later full merge
+        over the mixed set stays correct (bloomless output)."""
+        from opentsdb_tpu.tools import cli
+
+        wal_dir = tmp_path / "store"
+        tsdb = make_tsdb(tmp_path, 1)
+        try:
+            old = sstable_mod.WRITE_FORMAT
+            sstable_mod.WRITE_FORMAT = 2
+            try:
+                ingest(tsdb, "mix.metric", 3, BT, 120, 60)
+                tsdb.checkpoint()
+            finally:
+                sstable_mod.WRITE_FORMAT = old
+            end = ingest(tsdb, "mix.metric", 3, BT + 120 * 60, 120, 60)
+            tsdb.checkpoint()
+            heads = set()
+            for sst in tsdb.store._ssts:
+                with open(sst.path, "rb") as f:
+                    heads.add(f.read(5))
+            assert heads == {b"TSST2", b"TSST3"}
+            ex = QueryExecutor(tsdb, backend="cpu")
+            res = ex.run(QuerySpec("mix.metric", {}, "sum"), BT - 1,
+                         end)
+            res2 = ex.run(QuerySpec("mix.metric", {}, "sum"), BT - 1,
+                          end)
+            assert np.array_equal(res[0].values, res2[0].values)
+            assert len(res[0].values) == 240
+            # Tombstone so the next checkpoint full-merges the mixed
+            # set (bloomless source => bloomless merged output; its
+            # data still serves).
+            row = tsdb.row_key_for("mix.metric", {"host": "h00"},
+                                   BT - BT % HOUR)
+            tsdb.store.delete_row(tsdb.table, row)
+            tsdb.checkpoint()
+            res3 = ex.run(QuerySpec("mix.metric", {}, "sum"), BT - 1,
+                          end)
+            assert len(res3[0].values) == 240
+        finally:
+            tsdb.shutdown()
+        rc = cli.main(["fsck", "--wal", str(wal_dir / "wal"),
+                       "--backend", "cpu"])
+        assert rc == 0
+
+    def test_bloom_check_catches_false_negative(self, tmp_path):
+        """A doctored bloom (bits cleared) is exactly what
+        SSTable.bloom_check must count."""
+        tsdb = make_tsdb(tmp_path, 1)
+        try:
+            ingest(tsdb, "fn.metric", 2, BT, 50, 60)
+            tsdb.checkpoint()
+            sst = tsdb.store._ssts[-1]
+            assert sst.bloom_check(tsdb.table) == 0
+            sst._blooms[tsdb.table] = np.zeros_like(
+                sst._blooms[tsdb.table])
+            assert sst.bloom_check(tsdb.table) > 0
+        finally:
+            tsdb.shutdown()
+
+
+class TestLRUCache:
+    def test_entry_and_cost_bounds(self):
+        c = LRUCache(3)
+        for i in range(4):
+            c.put(i, i)
+        assert 0 not in c and len(c) == 3
+        c.get(1)          # touch: 1 becomes newest
+        c.put(4, 4)
+        assert 2 not in c and 1 in c
+        cc = LRUCache(100, max_cost=10)
+        cc.put("a", 1, cost=6)
+        cc.put("b", 2, cost=6)   # evicts a
+        assert "a" not in cc and cc.cost == 6
+        cc.put("big", 3, cost=11)  # over budget: never cached
+        assert "big" not in cc and "b" in cc
+        cc.put("b", 9, cost=2)     # replace adjusts cost
+        assert cc.cost == 2 and cc.get("b") == 9
+
+
+class TestServerWarmPath:
+    def test_q_twice_identical_and_counters(self, tmp_path):
+        """Tier-1 smoke: drive the warm path end to end over HTTP —
+        second /q json response is byte-identical, reports
+        "cached": true, and qcache.hit advanced in /stats."""
+        from opentsdb_tpu.server.tsd import TSDServer
+        from tests.test_server import http_get, run_async
+
+        cfg = Config(auto_create_metrics=True, port=0,
+                     bind="127.0.0.1", device_window=False,
+                     backend="cpu", qcache_chunk_s=2 * HOUR)
+        tsdb = TSDB(MemKVStore(wal_path=str(tmp_path / "wal")), cfg,
+                    start_compaction_thread=False)
+        end = ingest(tsdb, "srv.metric", 3, BT, 300, 60)
+        tsdb.checkpoint()   # freeze history so chunks are cacheable
+        server = TSDServer(tsdb)
+        target = (f"/q?start={BT}&end={end}"
+                  f"&m=sum:1h-avg:srv.metric&json&nocache")
+
+        async def drive(port):
+            r1 = await http_get(port, target)
+            r2 = await http_get(port, target)
+            st = await http_get(port, "/stats")
+            return r1, r2, st
+
+        (s1, _, b1), (s2, _, b2), (ss, _, sb) = run_async(server, drive)
+        assert s1 == s2 == ss == 200
+        cold_doc, doc = json.loads(b1), json.loads(b2)
+        # Identical answers; only the provenance field flips.
+        assert doc and doc[0]["rollup"] == "raw"
+        assert doc[0]["cached"] is True
+        assert cold_doc[0]["cached"] is False
+        for a, b in zip(cold_doc, doc):
+            a = {k: v for k, v in a.items() if k != "cached"}
+            b = {k: v for k, v in b.items() if k != "cached"}
+            assert a == b, "warm response diverged from cold"
+        stats = sb.decode()
+        hit_lines = [ln for ln in stats.splitlines()
+                     if ln.startswith("tsd.qcache.hit")]
+        assert hit_lines and int(hit_lines[0].split()[2]) > 0
+        assert any(ln.startswith("tsd.dirty_set.size")
+                   for ln in stats.splitlines())
+        tsdb.shutdown()

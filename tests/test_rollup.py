@@ -56,7 +56,7 @@ def ingest(tsdb, series=5, days=3, step=600, seed=0, metric=METRIC,
 
 def run_both(ex, spec, start, end):
     """(rollup_results, rollup_plan, raw_results) on one executor."""
-    a, plan = ex.run_with_plan(spec, start, end)
+    a, plan, _cached = ex.run_with_plan(spec, start, end)
     tier, ex.tsdb.rollups = ex.tsdb.rollups, None
     try:
         b = ex.run(spec, start, end)
