@@ -732,6 +732,9 @@ class TSDB:
         bloom_shards = getattr(self.store, "bloom_shards_skipped", None)
         if bloom_shards is not None:
             collector.record("bloom.shards_skipped", bloom_shards)
+        bloom_points = getattr(self.store, "bloom_point_skips", None)
+        if bloom_points is not None:
+            collector.record("bloom.point_skips", bloom_points)
         dirty = getattr(self.store, "dirty_bases", None)
         if dirty is not None:
             collector.record("dirty_set.size",

@@ -1,0 +1,16 @@
+"""Fault-injection subsystem: failpoint registry + crash harness.
+
+- ``faultpoints``: named failpoints compiled into the durability
+  machinery (WAL append/fsync, checkpoint phases, sstable writes,
+  rollup spill bracketing, replica refresh); zero-overhead no-ops until
+  armed, then crash / tear / raise / delay on a deterministic schedule.
+- ``harness``: runs a seeded ingest/delete/checkpoint workload in a
+  child process, kills it at the armed point, reopens in the parent and
+  verifies the crash-consistency invariants (fsck clean, golden query
+  parity raw and rollup-served, replica refresh) against an in-memory
+  oracle, with automatic schedule shrinking to a minimal repro.
+
+``scripts/crashmatrix.py`` sweeps the (site x mode) scenario matrix and
+writes FAULT_MATRIX.json — the regression floor every durability change
+must pass.
+"""

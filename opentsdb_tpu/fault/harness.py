@@ -1,0 +1,854 @@
+"""Crash-consistency harness: scripted workload + child crash + verify.
+
+One scenario =
+
+1. spawn a CHILD process (``python -m opentsdb_tpu.fault.harness
+   --child``) that runs a seeded, deterministic ingest / backfill /
+   delete / checkpoint workload against a real store, with one
+   failpoint armed through ``TSDB_FAULTPOINTS`` (fault/faultpoints.py);
+2. the armed site kills the child (``os._exit`` — the flock drops, the
+   page cache survives: SIGKILL semantics);
+3. the PARENT reopens the store and verifies the crash-consistency
+   invariants:
+     - recovery succeeds and **fsck is clean** (tools/fsck.run_fsck —
+       literally the operator tool);
+     - **raw golden parity**: every stored point matches an in-memory
+       oracle replayed over the acknowledged ops (the progress log
+       names them; the one possibly-in-flight op is probed — each op
+       is a single WAL record, so it is present or absent atomically);
+     - **rollup query parity**: rollup-served answers are bit-identical
+       to raw-scan answers for the same queries (the "stale degrades,
+       never lies" contract after a crash anywhere in the spill
+       bracket);
+     - **replica refresh**: a read-only replica over the same files
+       refreshes across the writer's post-crash checkpoints (the WAL
+       rotation / fresh-inode machinery) and serves the same rows.
+
+Scenarios are deterministic given (seed, site, mode, skip): the
+sharded store spills serially while faults are armed, the workload is
+pure-seeded, and torn-write offsets derive from the arming seed. On an
+invariant failure the harness SHRINKS the schedule (geometrically
+fewer ops, same seed) to a minimal failing repro.
+
+``build_matrix()`` is the ≥40-scenario (site x mode x config) sweep
+``scripts/crashmatrix.py`` runs; ``FAST_LABELS`` names the tier-1
+subset. ``--bug`` deliberately re-introduces a historical durability
+bug in the child (e.g. the PR-2-era torn spill bracket) so tests can
+prove the matrix CATCHES it — the harness's own regression gate.
+
+The child imports only numpy-backed modules (core/storage/rollup), no
+jax — spawn cost stays ~0.5 s per scenario.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import random
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+
+from opentsdb_tpu.core import codec
+from opentsdb_tpu.core.errors import NoSuchUniqueName
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.fault import faultpoints
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+# Day-aligned workload epoch; forward hours allocate upward from here,
+# backfill hours downward — ranges never collide, so re-ingest can
+# never create the conflicting duplicates IllegalDataError flags.
+T0 = 1_600_000_000 - 1_600_000_000 % 86400
+
+_SERIES = [
+    ("sys.cpu.user", {"host": "web1", "dc": "east"}),
+    ("sys.cpu.user", {"host": "web2", "dc": "east"}),
+    ("sys.cpu.user", {"host": "db1", "dc": "west"}),
+    ("net.bytes", {"host": "web1"}),
+]
+
+# Hour bases reserved for the parent's post-crash replica phase — far
+# above anything the generated schedule can allocate.
+_EXTRA_HOUR = T0 + 5000 * 3600
+
+CHILD_TIMEOUT = 120.0
+
+BUGS = ("torn-bracket",)
+
+
+@dataclasses.dataclass
+class Scenario:
+    label: str
+    site: str
+    mode: str
+    skip: int = 0
+    count: int = 1
+    shards: int = 1
+    rollups: bool = True
+    seed: int = 1234
+    n_ops: int = 36
+    delete_heavy: bool = False
+    bug: str | None = None
+    kind: str = "crash"   # "crash" (child process) | "replica" (in-proc)
+
+
+# ---------------------------------------------------------------------------
+# Workload: deterministic op schedule + the in-memory oracle
+# ---------------------------------------------------------------------------
+
+def gen_ops(seed: int, n_ops: int,
+            delete_heavy: bool = False) -> list[tuple]:
+    """The scripted op sequence for one scenario. Pure function of its
+    arguments — the child executes it, the parent replays it into the
+    oracle. Ops: ("ingest", si, hour, n_hours, step, is_float, vbase),
+    ("delete_row"|"delete_cells", si, hour), ("checkpoint",)."""
+    rng = random.Random(seed)
+    fwd = [0] * len(_SERIES)
+    bwd = [1] * len(_SERIES)
+    ops: list[tuple] = []
+    live: list[tuple[int, int]] = []   # deletable (si, hour) pairs
+
+    def ingest(si: int, backfill: bool) -> None:
+        n_hours = rng.randint(1, 2)
+        if backfill:
+            hour = T0 - (bwd[si] + n_hours - 1) * 3600
+            bwd[si] += n_hours
+        else:
+            hour = T0 + fwd[si] * 3600
+            fwd[si] += n_hours
+        step = rng.choice((300, 600, 900))
+        is_float = 1 if rng.random() < 0.3 else 0
+        ops.append(("ingest", si, hour, n_hours, step, is_float,
+                    rng.randrange(1, 1000)))
+        for h in range(n_hours):
+            live.append((si, hour + h * 3600))
+
+    del_band = 0.85 if delete_heavy else 0.72
+    for i in range(n_ops):
+        r = rng.random()
+        if i < 4 or r < 0.45:
+            ingest(rng.randrange(len(_SERIES)), backfill=False)
+        elif r < 0.60:
+            ingest(rng.randrange(len(_SERIES)), backfill=True)
+        elif r < del_band and live:
+            si, hour = live.pop(rng.randrange(len(live)))
+            ops.append(("delete_row" if rng.random() < 0.5
+                        else "delete_cells", si, hour))
+        else:
+            ops.append(("checkpoint",))
+    # Deterministic tail: ≥2 checkpoints always happen (so every spill
+    # site is reachable) and the run ends with live memtable state
+    # (so WAL replay is exercised on every reopen).
+    ops.append(("checkpoint",))
+    ingest(0, backfill=False)
+    ops.append(("checkpoint",))
+    ingest(1, backfill=False)
+    return ops
+
+
+def points_for(op: tuple):
+    """(ts int64, values f64, int_values i64, is_float bool) for one
+    ingest op — derived purely from the op tuple, so the child's
+    add_batch and the parent's oracle can never disagree. Float values
+    are f32-exact (quarters), ints stay on the exact int path."""
+    _, _si, hour, n_hours, step, is_float, vbase = op
+    per = 3600 // step
+    ts = np.concatenate([
+        hour + h * 3600 + np.arange(per, dtype=np.int64) * step
+        for h in range(n_hours)])
+    idx = np.arange(len(ts))
+    if is_float:
+        f = (vbase % 97) + (idx % 40) * 0.25
+        return (ts, f.astype(np.float64),
+                np.zeros(len(ts), np.int64), np.ones(len(ts), bool))
+    iv = (vbase + idx % 997).astype(np.int64)
+    return ts, iv.astype(np.float64), iv, np.zeros(len(ts), bool)
+
+
+class Oracle:
+    """The ground truth: {series index: {ts: (is_float, value)}}."""
+
+    def __init__(self) -> None:
+        self.data: dict[int, dict[int, tuple[bool, float]]] = {
+            si: {} for si in range(len(_SERIES))}
+
+    def apply(self, op: tuple) -> None:
+        kind = op[0]
+        if kind == "ingest":
+            ts, f64, iv, fl = points_for(op)
+            d = self.data[op[1]]
+            for t, fv, i, isf in zip(ts.tolist(), f64.tolist(),
+                                     iv.tolist(), fl.tolist()):
+                d[t] = (bool(isf), fv if isf else i)
+        elif kind in ("delete_row", "delete_cells"):
+            _, si, hour = op
+            d = self.data[si]
+            for t in [t for t in d if hour <= t < hour + 3600]:
+                del d[t]
+
+    def state_hash(self) -> str:
+        h = hashlib.sha1()
+        for si in sorted(self.data):
+            for t in sorted(self.data[si]):
+                isf, v = self.data[si][t]
+                h.update(f"{si}:{t}:{int(isf)}:{v!r};".encode())
+        return h.hexdigest()
+
+    def bounds(self) -> tuple[int, int] | None:
+        ts = [t for d in self.data.values() for t in d]
+        if not ts:
+            return None
+        return min(ts), max(ts)
+
+
+# ---------------------------------------------------------------------------
+# Store plumbing shared by child and parent
+# ---------------------------------------------------------------------------
+
+def open_store(dirpath: str, shards: int, read_only: bool = False):
+    if shards > 1:
+        from opentsdb_tpu.storage.sharded import ShardedKVStore
+        return ShardedKVStore(dirpath, shards=shards,
+                              read_only=read_only)
+    return MemKVStore(wal_path=os.path.join(dirpath, "wal"),
+                      read_only=read_only)
+
+
+def open_tsdb(dirpath: str, shards: int, rollups: bool) -> TSDB:
+    """Writer TSDB with the harness profile: cpu backend, sketches and
+    device window off (the child must stay jax-free), compactions off
+    and no background threads (schedule determinism), rollup catch-up
+    SYNC so a post-crash reopen finishes its rebuild before verify
+    queries run."""
+    cfg = Config(
+        wal_path=dirpath, shards=shards, backend="cpu",
+        auto_create_metrics=True, enable_compactions=False,
+        enable_sketches=False, device_window=False,
+        enable_rollups=rollups, rollup_catchup="sync",
+        # Sub-day sketch columns so the 1h resolution carries digests
+        # too (more fold surface for the crash sites to land in).
+        rollup_sketch_min_res=3600)
+    store = open_store(dirpath, shards)
+    return TSDB(store, cfg, start_compaction_thread=False)
+
+
+def _row_key(tsdb: TSDB, si: int, hour: int) -> bytes:
+    metric, tags = _SERIES[si]
+    return tsdb.row_key_for(metric, tags, hour, create_metric=False,
+                            create_tags=False)
+
+
+def apply_op(tsdb: TSDB, op: tuple) -> None:
+    kind = op[0]
+    if kind == "ingest":
+        ts, f64, iv, fl = points_for(op)
+        metric, tags = _SERIES[op[1]]
+        tsdb.add_batch(metric, ts, f64, tags, is_float=fl,
+                       int_values=iv)
+    elif kind == "delete_row":
+        tsdb.store.delete_row(tsdb.table, _row_key(tsdb, op[1], op[2]))
+    elif kind == "delete_cells":
+        key = _row_key(tsdb, op[1], op[2])
+        cells = tsdb.store.get(tsdb.table, key, b"t")
+        if cells:
+            tsdb.store.delete(tsdb.table, key, b"t",
+                              [c.qualifier for c in cells])
+    elif kind == "checkpoint":
+        tsdb.checkpoint()
+    else:  # pragma: no cover - schedule bug
+        raise ValueError(f"unknown op {op!r}")
+
+
+def _op_applied(tsdb: TSDB, op: tuple) -> bool:
+    """Did the (possibly crash-interrupted) op reach durable storage?
+    Sound because every op is one series and lands as ONE WAL record
+    (columnar batch / delete record) in one shard: after recovery it is
+    either fully present or fully absent — probing one row decides."""
+    kind = op[0]
+    if kind == "checkpoint":
+        return False  # no oracle-visible footprint
+    try:
+        key = _row_key(tsdb, op[1], op[2])
+    except NoSuchUniqueName:
+        # UID creation precedes the data put; missing UIDs mean the
+        # op's data cannot be in storage either.
+        return kind != "ingest"  # a delete's target simply vanished
+    if kind == "ingest":
+        return tsdb.store.has_row(tsdb.table, key)
+    return tsdb.store.cell_count(tsdb.table, key) == 0
+
+
+def _apply_bug(bug: str) -> None:
+    """Deliberately re-introduce a historical durability bug in the
+    CHILD so tests can prove the matrix catches it (and stays able
+    to). ``torn-bracket`` is the PR-2-era class: the checkpoint's
+    rollup spill bracket never opens (no pending marker, no in-flight
+    windows), so a crash between the spill-key drain and the fold
+    leaves summaries stale with nothing owing a rebuild."""
+    if bug == "torn-bracket":
+        from opentsdb_tpu.rollup.tier import RollupTier
+        RollupTier.begin_spill = lambda self: None
+    else:
+        raise ValueError(f"unknown --bug {bug!r} (one of {BUGS})")
+
+
+# ---------------------------------------------------------------------------
+# Child entry point
+# ---------------------------------------------------------------------------
+
+def _child_main(args) -> int:
+    ops = gen_ops(args.seed, args.n_ops, args.delete_heavy)
+    if args.bug:
+        _apply_bug(args.bug)
+    tsdb = open_tsdb(args.dir, args.shards, args.rollups)
+    with open(args.progress, "a") as pf:
+        for i, op in enumerate(ops):
+            apply_op(tsdb, op)
+            # Flushed (page cache survives os._exit): every op the
+            # parent sees here was ACKNOWLEDGED, so its WAL record was
+            # flushed first and recovery must surface it.
+            pf.write(f"{i}\n")
+            pf.flush()
+        pf.write("end\n")
+        pf.flush()
+    tsdb.shutdown()
+    return 0
+
+
+def _read_progress(path: str) -> tuple[int, bool]:
+    """(ops completed, reached end-of-schedule)."""
+    try:
+        with open(path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return 0, False
+    done = 0
+    finished = False
+    for ln in lines:
+        if ln == "end":
+            finished = True
+        else:
+            done = max(done, int(ln) + 1)
+    return done, finished
+
+
+# ---------------------------------------------------------------------------
+# Parent: verification
+# ---------------------------------------------------------------------------
+
+def _dump_store(store, tables=("tsdb", "tsdb-uid")) -> dict:
+    out = {}
+    for table in tables:
+        for key, items in store.scan_raw(table, b"", b""):
+            out[(table, key)] = tuple(items)
+    return out
+
+
+def _check_raw_parity(tsdb: TSDB, oracle: Oracle) -> list[str]:
+    problems: list[str] = []
+    try:
+        _, per_series = tsdb.scan_series(b"", b"\xff" * 64)
+    except Exception as e:
+        return [f"raw scan failed: {e!r}"]
+    expected: dict[bytes, dict[int, tuple[bool, float]]] = {}
+    for si, pts in oracle.data.items():
+        if not pts:
+            continue
+        try:
+            key = _row_key(tsdb, si, 0)
+        except NoSuchUniqueName:
+            problems.append(f"series {si}: oracle has points but UIDs "
+                            f"are missing")
+            continue
+        expected[codec.series_key(key)] = pts
+    for skey, pts in expected.items():
+        cols = per_series.pop(skey, None)
+        if cols is None:
+            problems.append(f"series {skey.hex()}: {len(pts)} oracle "
+                            f"points missing from storage")
+            continue
+        ts_e = np.fromiter(sorted(pts), np.int64, len(pts))
+        if not np.array_equal(cols.timestamps, ts_e):
+            problems.append(
+                f"series {skey.hex()}: timestamp mismatch "
+                f"(engine {len(cols.timestamps)} vs oracle {len(ts_e)})")
+            continue
+        isf_e = np.array([pts[t][0] for t in ts_e.tolist()], bool)
+        if not np.array_equal(cols.is_float.astype(bool), isf_e):
+            problems.append(f"series {skey.hex()}: float-flag mismatch")
+            continue
+        vals_e = np.array([pts[t][1] for t in ts_e.tolist()],
+                          np.float64)
+        # Floats round-trip through the stored f32; ints are exact.
+        fbad = isf_e & (cols.values !=
+                        vals_e.astype(np.float32).astype(np.float64))
+        ibad = ~isf_e & (cols.int_values != vals_e.astype(np.int64))
+        if fbad.any() or ibad.any():
+            problems.append(f"series {skey.hex()}: value mismatch at "
+                            f"ts={int(ts_e[(fbad | ibad)][0])}")
+    for skey, cols in per_series.items():
+        if len(cols.timestamps):
+            problems.append(f"series {skey.hex()}: {len(cols.timestamps)}"
+                            f" stored points the oracle never wrote")
+    return problems
+
+
+def _query_specs():
+    from opentsdb_tpu.query.executor import QuerySpec
+    specs = [
+        QuerySpec("sys.cpu.user", {"host": "*"}, aggregator="sum",
+                  downsample=(3600, "sum")),
+        QuerySpec("sys.cpu.user", {}, aggregator="max",
+                  downsample=(86400, "max")),
+        QuerySpec("sys.cpu.user", {"dc": "east"}, aggregator="sum",
+                  downsample=(3600, "avg")),
+        QuerySpec("net.bytes", {}, aggregator="sum",
+                  downsample=(3600, "sum")),
+        QuerySpec("sys.cpu.user", {}, aggregator="p95",
+                  downsample=(3600, "sum")),
+    ]
+    return specs
+
+
+def _check_query_parity(tsdb: TSDB, oracle: Oracle,
+                        require_rollup: bool) -> list[str]:
+    """Rollup-served vs raw-scan answers must be BIT-identical for the
+    same spec (the golden-parity invariant after any crash)."""
+    from opentsdb_tpu.query.executor import QueryExecutor
+    bounds = oracle.bounds()
+    if bounds is None:
+        return []
+    lo, hi = bounds
+    hi = max(hi, lo + 1)
+    # A range too narrow to hold one aligned 1h window legitimately
+    # planner-falls-back everywhere (very early crashes).
+    require_rollup = require_rollup and hi - lo >= 2 * 3600
+    ex = QueryExecutor(tsdb, backend="cpu")
+    problems: list[str] = []
+    rollup_served = False
+    for spec in _query_specs():
+        try:
+            served, plan, _ = ex.run_with_plan(spec, lo, hi)
+            saved, tsdb.rollups = tsdb.rollups, None
+            try:
+                raw = ex.run(spec, lo, hi)
+            finally:
+                tsdb.rollups = saved
+        except NoSuchUniqueName:
+            # The crash can land before this metric's first ingest was
+            # acknowledged — then its UID legitimately doesn't exist.
+            # Only a metric the ORACLE holds data for must be
+            # queryable.
+            if any(pts for si, pts in oracle.data.items()
+                   if _SERIES[si][0] == spec.metric):
+                problems.append(f"query {spec.metric}: UID missing but "
+                                f"the oracle holds its points")
+            continue
+        except Exception as e:
+            problems.append(f"query {spec.aggregator}/{spec.downsample}"
+                            f" failed: {e!r}")
+            continue
+        if plan not in ("raw", "resident"):
+            rollup_served = True
+        k_s = {tuple(sorted(r.tags.items())): r for r in served}
+        k_r = {tuple(sorted(r.tags.items())): r for r in raw}
+        if set(k_s) != set(k_r):
+            problems.append(f"query {spec.aggregator} plan={plan}: "
+                            f"group sets differ")
+            continue
+        for gk, rs in k_s.items():
+            rr = k_r[gk]
+            if not (np.array_equal(rs.timestamps, rr.timestamps)
+                    and np.array_equal(rs.values, rr.values)):
+                problems.append(
+                    f"query {spec.aggregator}/{spec.downsample} "
+                    f"plan={plan} group={dict(gk)}: rollup-served "
+                    f"answer != raw answer")
+    if require_rollup and not rollup_served:
+        problems.append("rollup tier never served an eligible query "
+                        "(planner fell back everywhere)")
+    return problems
+
+
+def _check_replica(dirpath: str, sc: Scenario, tsdb: TSDB) -> list[str]:
+    """Replica-over-live-writer parity, across a post-crash writer
+    checkpoint cycle — the WAL rotation + <wal>.old append + fresh-
+    inode recreate machinery (the PR-1 replica inode-reuse regression
+    rides this check: a recycled inode would make the replica replay
+    mid-record garbage)."""
+    problems: list[str] = []
+    replica = open_store(dirpath, sc.shards, read_only=True)
+    try:
+        replica.refresh()
+        if _dump_store(replica) != _dump_store(tsdb.store):
+            problems.append("replica diverged after initial refresh")
+        # Writer keeps living: ingest + checkpoint (rotates the WAL; a
+        # crash-leftover <wal>.old takes the append + fresh-inode
+        # path), then a post-rotation suffix ingest.
+        for i, (hour_off, vb) in enumerate(((0, 7), (1, 9))):
+            apply_op(tsdb, ("ingest", i, _EXTRA_HOUR + hour_off * 3600,
+                            1, 300, 0, vb))
+            if i == 0:
+                tsdb.checkpoint()
+            replica.refresh()
+            if _dump_store(replica) != _dump_store(tsdb.store):
+                problems.append(
+                    f"replica diverged after post-crash "
+                    f"{'checkpoint' if i == 0 else 'suffix ingest'}")
+    finally:
+        replica.close()
+    return problems
+
+
+def verify(dirpath: str, sc: Scenario, ops: list[tuple],
+           ops_done: int) -> tuple[list[str], str]:
+    """Reopen after the crash and check every invariant. Returns
+    (problems, oracle state hash)."""
+    from opentsdb_tpu.tools.fsck import run_fsck
+    problems: list[str] = []
+    try:
+        tsdb = open_tsdb(dirpath, sc.shards, sc.rollups)
+    except Exception as e:
+        return [f"reopen failed: {e!r}"], ""
+    try:
+        rep = run_fsck(tsdb, log=problems.append)
+        if rep.errors:
+            problems.append(f"fsck: {rep.errors} errors")
+        oracle = Oracle()
+        for op in ops[:ops_done]:
+            oracle.apply(op)
+        if ops_done < len(ops) and _op_applied(tsdb, ops[ops_done]):
+            # The op the crash interrupted: atomic per op (one WAL
+            # record), so a single probe decides its fate.
+            oracle.apply(ops[ops_done])
+        problems += _check_raw_parity(tsdb, oracle)
+        problems += _check_replica(dirpath, sc, tsdb)
+        if sc.rollups:
+            # Fold the recovered (WAL-replayed) memtable so the tier
+            # covers the whole history, then demand bit-identical
+            # rollup-vs-raw answers. The replica phase above already
+            # extended the oracle-visible data; queries compare
+            # engine-vs-engine, so that extension is invisible here.
+            tsdb.checkpoint()
+            problems += _check_query_parity(tsdb, oracle,
+                                            require_rollup=True)
+        return problems, oracle.state_hash()
+    except Exception as e:  # verification machinery itself broke
+        import traceback
+        return (problems + [f"verify crashed: {e!r}",
+                            traceback.format_exc(limit=5)], "")
+    finally:
+        try:
+            tsdb.shutdown()
+        except Exception as e:
+            problems.append(f"shutdown after verify failed: {e!r}")
+
+
+# ---------------------------------------------------------------------------
+# Parent: scenario driver
+# ---------------------------------------------------------------------------
+
+def _repo_root() -> str:
+    import opentsdb_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(opentsdb_tpu.__file__)))
+
+
+def _run_once(sc: Scenario, workdir: str) -> dict:
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir, exist_ok=True)
+    store_dir = os.path.join(workdir, "store")
+    progress = os.path.join(workdir, "progress")
+    spec = faultpoints.format_spec(sc.site, sc.mode, skip=sc.skip,
+                                   count=sc.count, seed=sc.seed)
+    env = dict(os.environ)
+    env["TSDB_FAULTPOINTS"] = spec
+    env["JAX_PLATFORMS"] = "cpu"   # belt: the child never imports jax
+    env["PYTHONPATH"] = _repo_root() + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "opentsdb_tpu.fault.harness",
+           "--child", "--dir", store_dir, "--seed", str(sc.seed),
+           "--n-ops", str(sc.n_ops), "--shards", str(sc.shards),
+           "--progress", progress]
+    if sc.rollups:
+        cmd.append("--rollups")
+    if sc.delete_heavy:
+        cmd.append("--delete-heavy")
+    if sc.bug:
+        cmd += ["--bug", sc.bug]
+    result = {
+        "label": sc.label, "site": sc.site, "mode": sc.mode,
+        "skip": sc.skip, "shards": sc.shards, "rollups": sc.rollups,
+        "seed": sc.seed, "n_ops": sc.n_ops, "bug": sc.bug,
+        "problems": [], "ops_done": 0,
+    }
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              timeout=CHILD_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        result.update(status="child-error", child_exit=None,
+                      problems=["child timed out"])
+        return result
+    ops_done, finished = _read_progress(progress)
+    result["child_exit"] = proc.returncode
+    result["ops_done"] = ops_done
+    state_hash = ""
+    if proc.returncode == 0 and finished:
+        # The armed site never fired: a matrix scenario whose
+        # workload can't reach its failpoint is lying about coverage.
+        result["status"] = "not-hit"
+    elif proc.returncode != faultpoints.EXIT_CODE:
+        result.update(status="child-error", problems=[
+            f"child exit {proc.returncode}",
+            proc.stderr.decode(errors="replace")[-2000:]])
+    else:
+        ops = gen_ops(sc.seed, sc.n_ops, sc.delete_heavy)
+        problems, state_hash = verify(store_dir, sc, ops, ops_done)
+        result["problems"] = problems
+        result["status"] = "ok" if not problems else "invariant-failed"
+    result["fingerprint"] = hashlib.sha1(
+        f"{result['status']}|{result['child_exit']}|{ops_done}|"
+        f"{';'.join(result['problems'])}|{state_hash}".encode()
+    ).hexdigest()
+    result["repro"] = repro_command(sc)
+    return result
+
+
+def repro_command(sc: Scenario) -> str:
+    """A self-contained crashmatrix.py invocation that reproduces this
+    scenario from its explicit parameters — label-independent, so
+    ad-hoc/bug-injected scenarios (whose labels are not in the matrix)
+    reproduce too."""
+    out = (f"python scripts/crashmatrix.py --site {sc.site} "
+           f"--mode {sc.mode} --skip {sc.skip} --shards {sc.shards} "
+           f"--seed {sc.seed} --n-ops {sc.n_ops}")
+    if not sc.rollups:
+        out += " --no-rollups"
+    if sc.delete_heavy:
+        out += " --delete-heavy"
+    if sc.bug:
+        out += f" --bug {sc.bug}"
+    return out
+
+
+def _shrink(sc: Scenario, workdir: str) -> dict | None:
+    """Minimal failing repro: geometrically fewer ops, same seed/site.
+    Returns the smallest still-failing config, or None if only the
+    full schedule fails."""
+    best = None
+    n = sc.n_ops
+    tried = sorted({max(4, n // 2), max(4, n // 4), 8, 6, 4},
+                   reverse=True)
+    for cand in tried:
+        if cand >= n:
+            continue
+        r = _run_once(dataclasses.replace(sc, n_ops=cand),
+                      os.path.join(workdir, f"shrink-{cand}"))
+        if r["status"] == "invariant-failed":
+            best = {"n_ops": cand, "seed": sc.seed,
+                    "problems": r["problems"][:3]}
+            n = cand
+    return best
+
+
+def _run_replica_scenario(sc: Scenario, workdir: str) -> dict:
+    """In-process fault scenarios for the replica refresh path (no
+    child crash): an injected refresh/rebuild failure must leave the
+    replica serving its coherent pre-refresh view, and a later clean
+    refresh must fully converge."""
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir, exist_ok=True)
+    store_dir = os.path.join(workdir, "store")
+    problems: list[str] = []
+    tsdb = open_tsdb(store_dir, sc.shards, rollups=False)
+    try:
+        for op in gen_ops(sc.seed, 8):
+            apply_op(tsdb, op)
+        tsdb.checkpoint()
+        replica = open_store(store_dir, sc.shards, read_only=True)
+        try:
+            before = _dump_store(replica)
+            apply_op(tsdb, ("ingest", 2, _EXTRA_HOUR, 1, 300, 0, 3))
+            tsdb.checkpoint()   # forces the rebuild path on refresh
+            faultpoints.arm(sc.site, sc.mode, skip=sc.skip,
+                            count=sc.count, seed=sc.seed)
+            try:
+                replica.refresh()
+                problems.append(f"injected {sc.mode} at {sc.site} was "
+                                f"swallowed by refresh()")
+            except (faultpoints.FaultInjected, OSError):
+                pass
+            finally:
+                faultpoints.disarm(sc.site)
+            if _dump_store(replica) != before:
+                problems.append("replica view changed across a FAILED "
+                                "refresh (torn rebuild served)")
+            replica.refresh()
+            if _dump_store(replica) != _dump_store(tsdb.store):
+                problems.append("replica did not converge on the clean "
+                                "refresh after an injected failure")
+        finally:
+            replica.close()
+    except Exception as e:
+        problems.append(f"replica scenario crashed: {e!r}")
+    finally:
+        faultpoints.disarm(sc.site)
+        tsdb.shutdown()
+    status = "ok" if not problems else "invariant-failed"
+    return {"label": sc.label, "site": sc.site, "mode": sc.mode,
+            "skip": sc.skip, "shards": sc.shards, "rollups": False,
+            "seed": sc.seed, "n_ops": 8, "bug": None,
+            "child_exit": None, "ops_done": 8, "status": status,
+            "problems": problems,
+            "fingerprint": hashlib.sha1(
+                f"{status}|{';'.join(problems)}".encode()).hexdigest(),
+            "repro": f"python scripts/crashmatrix.py --only {sc.label}"}
+
+
+def run_scenario(sc: Scenario, work_root: str,
+                 shrink: bool = True) -> dict:
+    workdir = os.path.join(work_root, sc.label)
+    if sc.kind == "replica":
+        return _run_replica_scenario(sc, workdir)
+    if sc.mode not in ("crash", "torn"):
+        # Child scenarios are verified BY the crash: a raise/ioerror/
+        # delay child either errors out mid-workload or finishes
+        # cleanly, and _run_once would misreport both as
+        # child-error/not-hit. Those modes belong to in-process
+        # scenarios (kind="replica") and live-daemon arming — fail
+        # loudly instead of lying about coverage.
+        raise ValueError(
+            f"{sc.label}: child crash scenarios support modes "
+            f"crash/torn, not {sc.mode!r} (use kind='replica' or arm "
+            f"a live process via /fault for in-process modes)")
+    res = _run_once(sc, workdir)
+    if res["status"] == "invariant-failed" and shrink:
+        res["min_repro"] = _shrink(sc, workdir)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# The matrix
+# ---------------------------------------------------------------------------
+
+# Tier-1 subset: one scenario per durability machine, cheapest configs.
+FAST_LABELS = (
+    "wal-append-torn-s1",
+    "ckpt-freeze-crash-s1",
+    "ckpt-commit-crash-s1",
+    "sst-body-torn-s1",
+    "rollup-foldstart-crash-s1",
+    "rollup-flip-crash-s1",
+    "rollup-folddel-crash-s1",
+    "shard-join-crash-k2",
+)
+
+
+def build_matrix() -> list[Scenario]:
+    """The full (site x mode x config) sweep — ≥40 scenarios across
+    WAL / checkpoint / sstable / rollup / sharded-spill / replica."""
+    scens: list[Scenario] = []
+
+    def add(label: str, site: str, mode: str, **kw) -> None:
+        scens.append(Scenario(label=label, site=site, mode=mode, **kw))
+
+    for shards in (1, 4):
+        t = f"s{shards}"
+        c = dict(shards=shards, rollups=True, seed=1000 + shards)
+        add(f"wal-append-crash-{t}", "kv.wal.append", "crash",
+            skip=2, **c)
+        add(f"wal-append-torn-{t}", "kv.wal.append", "torn",
+            skip=2, **c)
+        add(f"wal-append-torn-late-{t}", "kv.wal.append", "torn",
+            skip=11, **c)
+        add(f"wal-fsync-crash-{t}", "kv.wal.fsync", "crash",
+            skip=4, **c)
+        add(f"ckpt-freeze-crash-{t}", "kv.checkpoint.freeze", "crash",
+            **c)
+        add(f"ckpt-freeze-crash2-{t}", "kv.checkpoint.freeze", "crash",
+            skip=2, **c)
+        add(f"ckpt-commit-crash-{t}", "kv.checkpoint.commit", "crash",
+            **c)
+        add(f"ckpt-commit-crash2-{t}", "kv.checkpoint.commit", "crash",
+            skip=2, **c)
+        add(f"ckpt-manifest-crash-{t}", "kv.checkpoint.manifest",
+            "crash", **c)
+        add(f"sst-body-crash-{t}", "sst.write.body", "crash", **c)
+        add(f"sst-body-torn-{t}", "sst.write.body", "torn", **c)
+        add(f"sst-rename-crash-{t}", "sst.rename", "crash", **c)
+        add(f"rollup-begin-crash-{t}", "rollup.begin_spill", "crash",
+            **c)
+        add(f"rollup-foldstart-crash-{t}", "rollup.fold.start",
+            "crash", **c)
+        add(f"rollup-foldflush-crash-{t}", "rollup.fold.flush",
+            "crash", **c)
+        add(f"rollup-foldcommit-crash-{t}", "rollup.fold.commit",
+            "crash", **c)
+        add(f"rollup-flip-crash-{t}", "rollup.bracket.flip", "crash",
+            **c)
+        # Delete-heavy fold crashes: the deleted-row rollup-clobber
+        # class (zero records vs surviving coarse windows).
+        add(f"rollup-folddel-crash-{t}", "rollup.fold.flush", "crash",
+            delete_heavy=True, **{**c, "seed": 77 + shards})
+    # Partial cross-shard spills: crash after exactly k of 4 shards.
+    for k in (1, 2, 3):
+        add(f"shard-join-crash-k{k}", "sharded.spill.shard", "crash",
+            skip=k - 1, shards=4, rollups=True, seed=2000 + k)
+    # Rollup-less raw stores (the pre-rollup durability surface).
+    add("wal-append-crash-norollup", "kv.wal.append", "crash", skip=3,
+        shards=1, rollups=False, seed=3001)
+    add("ckpt-commit-crash-norollup", "kv.checkpoint.commit", "crash",
+        shards=1, rollups=False, seed=3002)
+    # Replica refresh faults (in-process, no child crash).
+    add("replica-refresh-ioerror", "replica.refresh", "ioerror",
+        shards=1, kind="replica", seed=3101)
+    add("replica-rebuild-raise", "replica.rebuild", "raise",
+        shards=1, kind="replica", seed=3102)
+    add("replica-rebuild-raise-s4", "replica.rebuild", "raise",
+        shards=4, kind="replica", seed=3103)
+    return scens
+
+
+def fast_matrix() -> list[Scenario]:
+    by_label = {s.label: s for s in build_matrix()}
+    return [by_label[lb] for lb in FAST_LABELS]
+
+
+def run_matrix(scens, work_root: str, shrink: bool = True,
+               log=None) -> list[dict]:
+    results = []
+    for sc in scens:
+        r = run_scenario(sc, work_root, shrink=shrink)
+        if log:
+            log(f"{r['status']:17s} {sc.label} "
+                f"(ops_done={r['ops_done']})")
+        results.append(r)
+    return results
+
+
+# ---------------------------------------------------------------------------
+# module entry (the child)
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(prog="fault.harness")
+    p.add_argument("--child", action="store_true", required=True)
+    p.add_argument("--dir", required=True)
+    p.add_argument("--seed", type=int, required=True)
+    p.add_argument("--n-ops", type=int, required=True)
+    p.add_argument("--shards", type=int, default=1)
+    p.add_argument("--rollups", action="store_true")
+    p.add_argument("--delete-heavy", action="store_true")
+    p.add_argument("--progress", required=True)
+    p.add_argument("--bug", default=None, choices=BUGS)
+    args = p.parse_args(argv)
+    return _child_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

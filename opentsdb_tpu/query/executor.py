@@ -29,6 +29,8 @@ Un-downsampled queries keep the exact 1.1 union-grid semantics.
 from __future__ import annotations
 
 import re
+import threading
+import weakref
 from typing import NamedTuple
 
 import jax
@@ -41,6 +43,38 @@ from opentsdb_tpu.ops import kernels, oracle, sketches
 from opentsdb_tpu.query.aggregators import Aggregators
 from opentsdb_tpu.storage.sstable import series_hash
 from opentsdb_tpu.utils.lru import LRUCache
+
+
+# One fragment cache PER STORE, shared by every QueryExecutor over it
+# (the ROADMAP cross-executor follow-on): CLI one-shot executors, the
+# server's executor, and test harnesses all warm the same LRU, so a
+# second executor over the same store starts hot instead of re-decoding
+# the working set. Keyed by store IDENTITY via a weak map — a closed
+# store's cache dies with it, and id() reuse can't alias two stores.
+# Fragment keys carry the table name, so two TSDBs sharing one store
+# under different tables can't cross-serve fragments.
+_FRAG_CACHES: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_FRAG_CACHES_LOCK = threading.Lock()
+
+
+def _shared_frag_cache(store, max_entries: int,
+                       max_points: int) -> LRUCache:
+    with _FRAG_CACHES_LOCK:
+        cache = _FRAG_CACHES.get(store)
+        if cache is None:
+            cache = LRUCache(max_entries, max_cost=max_points)
+            _FRAG_CACHES[store] = cache
+        elif (cache.max_entries != max_entries
+              or cache.max_cost != max_points):
+            # A later executor with different bounds REBOUNDS the
+            # shared instance in place (newest config wins) rather
+            # than replacing it: existing executors hold direct
+            # references, and swapping the map entry would strand them
+            # on an orphaned cache — two full-size caches per store
+            # and no cross-executor sharing, exactly what this
+            # registry exists to prevent.
+            cache.resize(max_entries, max_cost=max_points)
+        return cache
 
 
 class QuerySpec(NamedTuple):
@@ -99,10 +133,12 @@ class QueryExecutor:
         # aligned time-chunk) columnar spans, validated against the
         # store's content epochs + dirty-base set (_scan_selector).
         # Bounded by cached POINTS, not entries — fragments range from
-        # bytes to megabytes.
-        self._frag_cache = LRUCache(
+        # bytes to megabytes. ONE cache per store process-wide (see
+        # _shared_frag_cache), not per executor.
+        self._frag_cache = _shared_frag_cache(
+            tsdb.store,
             int(getattr(cfg, "qcache_fragments", 1024)),
-            max_cost=int(getattr(cfg, "qcache_points", 1 << 24)))
+            int(getattr(cfg, "qcache_points", 1 << 24)))
         # Candidate-series hint per (metric, filter): identity hashes
         # from the sketch directory, revalidated on the metric's
         # directory growth; cost-bounded in total cached hashes (an
@@ -288,7 +324,10 @@ class QueryExecutor:
             # dashboard working set.
             return full_scan()
         table = tsdb.table
-        fkey = (metric_uid, _filter_key(exact, group_bys))
+        # The table participates in the fragment key: the cache is
+        # per-store and shared across executors, and two TSDB facades
+        # over one store may serve different tables.
+        fkey = (table, metric_uid, _filter_key(exact, group_bys))
         chunks = [c0 + i * chunk_s for i in range(nchunks)]
         # States read BEFORE each scan: content can only get newer
         # between the state read and the scan, so a racing mutation

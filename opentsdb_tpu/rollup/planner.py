@@ -32,11 +32,9 @@ from __future__ import annotations
 import numpy as np
 
 from opentsdb_tpu.core import codec
-from opentsdb_tpu.core.const import MAX_TIMESPAN
 from opentsdb_tpu.query.aggregators import Aggregators
 from opentsdb_tpu.rollup import summary
 from opentsdb_tpu.rollup.summary import EXACT_DSAGGS
-from opentsdb_tpu.rollup.tier import _metric_stop, _u32
 
 # A range more dirty than this serves raw outright.
 _MAX_DIRTY_FRACTION = 0.5
@@ -149,20 +147,24 @@ def plan(executor, spec, start: int, end: int):
     return groups, spec2, res
 
 
-def _scan_raw_parts(tsdb, metric_uid: bytes, regexp: bytes | None,
+def _scan_raw_parts(executor, metric_uid: bytes, regexp: bytes | None,
                     ranges: list[tuple[int, int]],
-                    series_hint=None):
+                    exact, group_bys):
     """Targeted raw scans over the stitch ranges -> per-series sorted
-    (ts, float64 values), filtered to the ranges."""
+    (ts, float64 values), filtered to the ranges.
+
+    Routed through the executor's chunked fragment cache
+    (_scan_selector) instead of bespoke scan_series calls: dirty
+    windows bypass the cache by definition (they ARE the memtable-hot
+    ranges), but the clean EDGE windows of repeat dashboard queries —
+    re-stitched on every poll — now serve from the same warm decoded
+    fragments full raw scans use, and golden parity vs a cold stitch
+    holds because _scan_selector is bit-identical to an uncached scan
+    by the fragment-cache contract."""
     parts: dict[bytes, list] = {}
     for lo, hi in ranges:
-        start_key = metric_uid + _u32(codec.base_time(lo))
-        stop = codec.base_time(hi) + MAX_TIMESPAN
-        stop_key = (_metric_stop(metric_uid) if stop > 0xFFFFFFFF
-                    else metric_uid + _u32(stop))
-        _, per_series = tsdb.scan_series(start_key, stop_key,
-                                         key_regexp=regexp,
-                                         series_hint=series_hint)
+        per_series = executor._scan_selector(metric_uid, exact,
+                                             group_bys, regexp, lo, hi)
         for skey, cols in per_series.items():
             m = (cols.timestamps >= lo) & (cols.timestamps <= hi)
             if not m.any():
@@ -247,7 +249,6 @@ def _select_windows(executor, tier, metric: str, tags: dict,
     dirty_set = frozenset(int(b) for b in dirty)
     raw_ranges = _coalesce(
         edges + [(int(w), int(w) + res - 1) for w in dirty_set])
-    raw_parts = _scan_raw_parts(
-        tsdb, metric_uid, regexp, raw_ranges,
-        executor._series_hint(metric_uid, exact, group_bys))
+    raw_parts = _scan_raw_parts(executor, metric_uid, regexp,
+                                raw_ranges, exact, group_bys)
     return records, raw_parts, dirty_set

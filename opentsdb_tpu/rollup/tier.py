@@ -55,6 +55,7 @@ import numpy as np
 from opentsdb_tpu.core import codec, codec_np
 from opentsdb_tpu.core.const import MAX_TIMESPAN, TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.core.errors import IllegalDataError
+from opentsdb_tpu.fault.faultpoints import fire as _fault
 from opentsdb_tpu.rollup import summary
 from opentsdb_tpu.rollup.summary import (QUAL_MOMENTS, QUAL_SKETCH,
                                          REC_DTYPE, REC_SIZE,
@@ -171,6 +172,11 @@ class _MapBuffer:
                     cells.append((key, QUAL_SKETCH, blob))
             if cells:
                 store.put_many(table, fam, cells)
+        # Partial fold state: some (res, shard) flushes durable, the
+        # rest still buffered. A crash here leaves summary rows the
+        # pending bracket owes a rebuild for — the exact
+        # half-materialized shape the PR-2 review bugs lived in.
+        _fault("rollup.fold.flush")
         self.maps = {}
         self.total = 0
 
@@ -518,6 +524,9 @@ class RollupTier:
         self._inflight = self._inflight | frozenset(
             int(b) for b in bases)
         self._write_state(pending=True)
+        # Bracket opened (pending durable), raw spill not started:
+        # crash must rebuild at next open even though no data moved.
+        _fault("rollup.begin_spill", self.state_path)
 
     def fold_after_spill(self) -> None:
         """After the raw spill: fold the spilled keys into summary
@@ -550,6 +559,11 @@ class RollupTier:
                 # keys; folding now could flip state to ok early.
                 return
         try:
+            # Spill record drained, fold not yet run: the spilled keys
+            # exist ONLY in this process's memory — crash loses them
+            # and the pending bracket must force a full rebuild (the
+            # PR-2-era torn-bracket class).
+            _fault("rollup.fold.start", self.state_path)
             self._fold(keys)
         except IllegalDataError as e:
             # Corrupt raw data (the fsck signal): leave the tier
@@ -569,12 +583,18 @@ class RollupTier:
             self._ready = False
             self.note_fallback("corrupt")
             return
+        # Fold durable in the rollup WALs, bracket still pending:
+        # crash re-folds idempotently after the rebuild.
+        _fault("rollup.fold.commit", self.state_path)
         for stores in self.stores.values():
             for s in stores:
                 s.checkpoint()   # bound the rollup WALs
         self._write_state(pending=False)
         self._inflight = frozenset()
         self._ready = True
+        # Bracket flipped ok: a crash from here on must NOT rebuild —
+        # the tier is complete and the next open serves it as-is.
+        _fault("rollup.bracket.flip", self.state_path)
         self.folds += 1
 
     # -- fold core ---------------------------------------------------------
@@ -843,6 +863,10 @@ class RollupTier:
                         # proceeds as a normal fold — never drops keys.
                         self._rebuilding = False
                         self._behind = False
+                    # Catch-up complete in memory, completion not yet
+                    # durable: crash re-runs the whole rebuild at next
+                    # open (idempotent, never stale).
+                    _fault("rollup.catchup.commit", self.state_path)
                     self._write_state(pending=False)
                     self._inflight = frozenset()
                     self._ready = True

@@ -365,6 +365,7 @@ class TSDServer:
             "/distinct": lambda req: self._distinct(req.q),
             "/sketch": lambda req: self._sketch(req.q),
             "/forecast": lambda req: self._forecast(req.q, req.params),
+            "/fault": self._http_fault,
             "/dropcaches": self._http_dropcaches,
             "/diediedie": self._http_diediedie,
             "/favicon.ico": self._http_favicon,
@@ -609,6 +610,31 @@ class TSDServer:
                     json.dumps(logbuffer_lines).encode(), {})
         return (200, "text/plain",
                 ("\n".join(logbuffer_lines) + "\n").encode(), {})
+
+    def _http_fault(self, req) -> tuple:
+        """Fault-injection admin (fault/faultpoints.py): integration
+        tests arm failpoints on a LIVE tsd process.
+
+            GET /fault                     registry snapshot (JSON)
+            GET /fault?arm=site=mode:k=v   arm (spec grammar; crash
+                                           modes WILL kill the daemon
+                                           at the next hit — the point)
+            GET /fault?disarm=site         disarm one site
+            GET /fault?clear=1             disarm everything
+        """
+        from opentsdb_tpu.fault import faultpoints as fp
+        q = req.q
+        if "arm" in q:
+            try:
+                fp.install_spec(q["arm"])
+            except ValueError as e:
+                raise BadRequestError(str(e)) from None
+        if "disarm" in q:
+            fp.disarm(q["disarm"])
+        if "clear" in q:
+            fp.clear()
+        return (200, "application/json",
+                json.dumps(fp.status()).encode(), {})
 
     def _http_dropcaches(self, req) -> tuple:
         self.tsdb.drop_caches()
@@ -1192,6 +1218,12 @@ class TSDServer:
         c.record("qcache.hit", self.executor.qcache_hits)
         c.record("qcache.miss", self.executor.qcache_misses)
         c.record("qcache.bypass", self.executor.qcache_bypasses)
+        from opentsdb_tpu.fault import faultpoints as _fp
+        fstat = _fp.status()
+        c.record("fault.sites_armed", len(fstat["armed"]))
+        c.record("fault.fired", sum(fstat["fired"].values()))
+        for site, n in sorted(fstat["fired"].items()):
+            c.record("fault.fired_site", n, f"site={site}")
         c.record("uptime", int(time.time()) - self.start_time)
         self.tsdb.collect_stats(c)
         return c.lines

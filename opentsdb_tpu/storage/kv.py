@@ -46,6 +46,7 @@ import os
 import re
 import struct
 import threading
+import zlib
 from bisect import bisect_left
 from typing import Iterator, NamedTuple
 
@@ -54,6 +55,7 @@ import numpy as np
 from opentsdb_tpu.core.const import TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.core.errors import (PleaseThrottleError,
                                        ReadOnlyStoreError)
+from opentsdb_tpu.fault.faultpoints import fire as _fault
 from opentsdb_tpu.storage.sstable import (SSTable, merge_sstables,
                                           write_sstable_bulk)
 from opentsdb_tpu.utils.nativeext import ext as _EXT
@@ -454,6 +456,9 @@ class MemKVStore(KVStore):
         # Generations skipped by the series-bloom prefilter (scan_raw
         # with a series_hint), exported as bloom.files_skipped.
         self.bloom_files_skipped = 0
+        # Per-generation bisects skipped by the point-get bloom probe
+        # (_lower_tier_has), exported as bloom.point_skips.
+        self.bloom_point_skips = 0
         # Immutable middle tier while a checkpoint merge is in flight.
         self._frozen: dict[str, _Table] | None = None
         self._lockfd: int | None = None
@@ -587,6 +592,10 @@ class MemKVStore(KVStore):
             raise ValueError("refresh() is for read-only stores")
         if not self._wal_path:
             return False
+        # raise/ioerror here simulate a poll hitting writer churn or a
+        # flaky volume: the replica must keep serving its coherent
+        # pre-refresh view (delay widens the rebuild-vs-writer races).
+        _fault("replica.refresh", self._wal_path)
         with self._lock:
             man_now = self._generation_paths()
             if [s.path for s in self._ssts] != man_now:
@@ -639,6 +648,7 @@ class MemKVStore(KVStore):
         old_ssts = self._ssts
         old_tables = self._tables
         old_state = self._ro_state
+        _fault("replica.rebuild", self._wal_path)
         self._ssts = []
         self._ro_state = None
         try:
@@ -968,11 +978,31 @@ class MemKVStore(KVStore):
 
     def _lower_tier_has(self, t: _Table, table: str, key: bytes) -> bool:
         """Does any tier below the live memtable hold this key? (Decides
-        whether a delete must leave tombstones.)"""
+        whether a delete must leave tombstones.)
+
+        Consults each generation's series bloom BEFORE the key bisect:
+        generations whose bloom excludes the key's series identity
+        cannot hold the key (blooms cover every indexed key — fsck
+        audits the no-false-negative invariant), so point deletes over
+        high-generation-count stores skip most bisects. The probe hash
+        is the same crc32 chain the bloom writer uses, so present keys
+        always pass; a stale bit (tombstoned key) only costs one
+        needless bisect."""
         ft = self._frozen.get(table) if self._frozen else None
         if ft is not None and (key in ft.rows):
             return True
-        return any(sst.has_key(table, key) for sst in self._ssts)
+        if not self._ssts:
+            return False
+        h = None
+        if len(key) >= _BASE_HI:
+            h = zlib.crc32(key[_BASE_HI:], zlib.crc32(key[:_BASE_LO]))
+        for sst in self._ssts:
+            if h is not None and not sst.bloom_may_contain_hash(table, h):
+                self.bloom_point_skips += 1
+                continue
+            if sst.has_key(table, key):
+                return True
+        return False
 
     # -- WAL --------------------------------------------------------------
 
@@ -993,9 +1023,16 @@ class MemKVStore(KVStore):
         # boundary, not the record, is the durability promise).
         if flush:
             self._wal_flush()
+            _fault("kv.wal.append", self._wal_path,
+                   _REC.size + len(payload))
 
     def _wal_flush(self) -> None:
         self._wal.flush()
+        # Between the userspace flush and the (optional) fsync: crash
+        # here loses nothing on process death but everything on power
+        # loss — the gap the fsync=True deployments buy away; ioerror
+        # simulates the fsync itself failing (ENOSPC/EIO).
+        _fault("kv.wal.fsync", self._wal_path)
         if self._fsync:
             os.fsync(self._wal.fileno())
 
@@ -1069,6 +1106,8 @@ class MemKVStore(KVStore):
             self._wal.write(_REC.pack(_OP_PUT_BATCH, len(payload))
                             + payload)
         self._wal_flush()
+        _fault("kv.wal.append", self._wal_path,
+               _REC.size + len(payload))
 
     def _wal_append_batch_columnar(self, table: bytes, family: bytes,
                                    key_blob: bytes, n: int, key_len: int,
@@ -1096,6 +1135,8 @@ class MemKVStore(KVStore):
             self._wal.write(_REC.pack(_OP_PUT_BATCH, len(payload))
                             + payload)
         self._wal_flush()
+        _fault("kv.wal.append", self._wal_path,
+               _REC.size + len(payload))
 
     @staticmethod
     def _split_payload(payload: bytes) -> list[bytes]:
@@ -1370,6 +1411,11 @@ class MemKVStore(KVStore):
                         for name, ft in frozen.items()}
 
         try:
+            # End of phase 1: the WAL is rotated (<wal>.old holds every
+            # pre-checkpoint record), the memtable is frozen, nothing
+            # spilled yet. Crash here must recover purely from
+            # .old + WAL replay; raise exercises the thaw path below.
+            _fault("kv.checkpoint.freeze", self._wal_path)
             n = (merge_sstables(out_path, merge_gens, frozen_payload)
                  if use_merge
                  else write_sstable_bulk(out_path, spill_tables()))
@@ -1395,6 +1441,11 @@ class MemKVStore(KVStore):
             unlink_new = True
             try:
                 new_sst = SSTable(out_path)
+                # The new generation is durable but the manifest does
+                # not name it yet: crash leaves it a stray the next
+                # load deletes (.old still replays everything); raise
+                # exercises the unlink-and-thaw recovery below.
+                _fault("kv.checkpoint.commit", out_path)
                 # The new generation replaces exactly the merged
                 # age-contiguous suffix (all of them on a full merge,
                 # none on a plain spill), preserving overlay order:
@@ -1437,6 +1488,11 @@ class MemKVStore(KVStore):
                 raise
             self._frozen = None
             self.mutation_seq += 1
+            # Manifest durable, dropped generations + <wal>.old not yet
+            # unlinked: crash leaves strays (deleted at next load) and
+            # an idempotently-replayable .old. Safe for raise too — the
+            # commit is complete; only cleanup remains.
+            _fault("kv.checkpoint.manifest", self._wal_path)
             # The frozen tier retires: fold its transition stamps into
             # the store-level map so fragments built while (or before)
             # its rows were live keep invalidating — including bases a

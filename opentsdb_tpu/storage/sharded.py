@@ -58,6 +58,7 @@ import numpy as np
 
 from opentsdb_tpu.core.const import TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.core.errors import PleaseThrottleError
+from opentsdb_tpu.fault import faultpoints as _fp
 from opentsdb_tpu.storage.kv import Cell, KVStore, MemKVStore
 
 MANIFEST_NAME = "SHARDS.json"
@@ -497,6 +498,20 @@ class ShardedKVStore(KVStore):
         single-store history collapse. Returns total rows spilled."""
         if self.read_only:
             return 0
+        if _fp.active():
+            # Fault injection armed: spill serially so the failpoint
+            # hit schedule (and therefore the crash state) is
+            # deterministic — which shard a count=k crash lands after
+            # must not depend on pool scheduling. The per-shard join
+            # site fires AFTER each shard's spill completes, so a
+            # count=k crash leaves exactly k shards spilled and N-k
+            # still WAL-only (the no-cross-shard-atomic-cut contract
+            # the crash matrix verifies).
+            total = 0
+            for s in self.shards:
+                total += s.checkpoint()
+                _fp.fire("sharded.spill.shard", self._dir)
+            return total
         if self.shard_count == 1 or self._spill_workers <= 1:
             return sum(s.checkpoint() for s in self.shards)
         with ThreadPoolExecutor(
@@ -519,6 +534,10 @@ class ShardedKVStore(KVStore):
     @property
     def bloom_files_skipped(self) -> int:
         return sum(s.bloom_files_skipped for s in self.shards)
+
+    @property
+    def bloom_point_skips(self) -> int:
+        return sum(s.bloom_point_skips for s in self.shards)
 
     @property
     def wal_swallowed_flush_errors(self) -> int:

@@ -57,6 +57,7 @@ from typing import Iterable, Iterator
 import numpy as np
 
 from opentsdb_tpu.core.const import TIMESTAMP_BYTES, UID_WIDTH
+from opentsdb_tpu.fault.faultpoints import fire as _fault
 from opentsdb_tpu.utils.nativeext import ext as _EXT
 
 _MAGIC_V1 = b"TSST1"
@@ -200,7 +201,16 @@ def _finish_file(f, index: dict[str, tuple[list[bytes], list[int]]],
 
 
 def _durable_rename(tmp: str, path: str) -> None:
+    # Body complete in the page cache, not yet renamed: crash leaves a
+    # .tmp recovery ignores; torn cuts into the record/footer section
+    # (same outcome — the cut file never gets renamed).
+    _fault("sst.write.body", tmp, 1 << 12)
     os.replace(tmp, path)
+    # Rename visible, directory entry not yet fsynced: on process
+    # death (os._exit) the rename IS visible — the interesting state
+    # for crash recovery, which must treat the new file as a stray
+    # until a manifest names it.
+    _fault("sst.rename", path)
     # Make the rename itself durable before the caller truncates its
     # WAL: without the directory fsync a power loss could surface the
     # OLD generation alongside an already-truncated WAL.
@@ -618,6 +628,23 @@ class SSTable:
         got = (bits[(pos >> np.uint64(3)).astype(np.int64)]
                >> (pos & np.uint64(7)).astype(np.uint8)) & 1
         return bool(got.all(axis=1).any())
+
+    def bloom_may_contain_hash(self, table: str, h1: int) -> bool:
+        """Scalar bloom probe for ONE series-identity hash — the
+        point-get prefilter (_lower_tier_has skips this generation's
+        key bisect on False). Pure-int arithmetic, exactly
+        _bloom_positions' Kirsch-Mitzenmacher derivation, so it can
+        never disagree with the vectorized scan-path probe. True when
+        the table has no bloom."""
+        bits = self._blooms.get(table)
+        if bits is None:
+            return True
+        h2 = (h1 * 0x9E3779B1 + 0x7FEB352D) & 0xFFFFFFFF
+        for k in range(BLOOM_K):
+            pos = (h1 + k * h2) % BLOOM_BITS
+            if not (bits[pos >> 3] >> (pos & 7)) & 1:
+                return False
+        return True
 
     def bloom_check(self, table: str) -> "int | None":
         """fsck probe: how many of the table's indexed keys are NOT

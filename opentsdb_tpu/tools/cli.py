@@ -27,7 +27,7 @@ import time
 import numpy as np
 
 from opentsdb_tpu.core import codec, tags as tags_mod
-from opentsdb_tpu.core.errors import IllegalDataError, NoSuchUniqueName
+from opentsdb_tpu.core.errors import NoSuchUniqueName
 from opentsdb_tpu.core.tsdb import FAMILY, TSDB
 from opentsdb_tpu.storage.kv import MemKVStore
 from opentsdb_tpu.utils.config import Config
@@ -427,117 +427,30 @@ def cmd_scan(args) -> int:
 
 def cmd_fsck(args) -> int:
     """Table consistency check (Fsck.java): validates qualifiers, values,
-    meta bytes, duplicate/out-of-order points; --fix rewrites rows."""
+    meta bytes, duplicate/out-of-order points; --fix rewrites rows. The
+    actual checks live in tools/fsck.py (run_fsck) so the fault
+    harness's "fsck clean" invariant runs the operator tool verbatim.
+
+    ``--expect-clean`` makes "any error found" exit 2 even under --fix
+    (which otherwise reports success after salvaging) — the crash
+    matrix / CI contract: a store that NEEDED fixing after a crash is
+    a failed invariant, not a success."""
+    from opentsdb_tpu.tools.fsck import run_fsck
+
     tsdb = make_tsdb(args)
-    kvs = rows = errors = fixed = 0
     t0 = time.time()
-    for cells in tsdb.store.scan(tsdb.table, b"", b"", family=FAMILY):
-        rows += 1
-        key = cells[0].key
-        bad = False
-        for cell in cells:
-            kvs += 1
-            qual, val = cell.qualifier, cell.value
-            if len(qual) == 0 or len(qual) % 2 != 0:
-                errors += 1
-                bad = True
-                print(f"ERROR: row {key.hex()}: odd qualifier length "
-                      f"{len(qual)}")
-                continue
-            try:
-                points = codec.explode_cell(qual, val)
-            except IllegalDataError as e:
-                errors += 1
-                bad = True
-                print(f"ERROR: row {key.hex()}: {e}")
-                continue
-            if codec.is_compacted_qualifier(qual):
-                # Reference Fsck.java detection depth: a compacted
-                # cell's qualifiers must be strictly increasing.
-                # compact_cells() sorts before checking, so duplicate
-                # and out-of-order points INSIDE one compacted cell
-                # would otherwise pass silently (and other readers —
-                # explode-based iteration, the reference's own Span
-                # assembly — see them in stored order).
-                deltas = [c.delta for c in points]
-                for j in range(1, len(deltas)):
-                    if deltas[j] == deltas[j - 1]:
-                        errors += 1
-                        bad = True
-                        print(f"ERROR: row {key.hex()}: compacted cell "
-                              f"has duplicate timestamp (delta="
-                              f"{deltas[j]}, qualifier #{j})")
-                    elif deltas[j] < deltas[j - 1]:
-                        errors += 1
-                        bad = True
-                        print(f"ERROR: row {key.hex()}: compacted cell "
-                              f"has out-of-order timestamps (delta="
-                              f"{deltas[j]} after {deltas[j - 1]}, "
-                              f"qualifier #{j})")
-        if not bad:
-            try:
-                codec.compact_cells(
-                    [(c.qualifier, c.value) for c in cells])
-            except IllegalDataError as e:
-                errors += 1
-                bad = True
-                print(f"ERROR: row {key.hex()}: {e}")
-        if bad and args.fix:
-            fixed += _fix_row(tsdb, key, cells)
-    # SSTable format / series-bloom audit over every generation
-    # (mixed-format stores are first-class: TSST3 files carry blooms,
-    # v1/v2 files don't and simply never prune). A bloom FALSE
-    # NEGATIVE — an indexed key its table's bloom excludes — would
-    # silently hide rows from bloom-pruned scans, so it counts as a
-    # hard error.
-    stores = getattr(tsdb.store, "shards", None) or [tsdb.store]
-    bloomed = plain = bloom_misses = 0
-    for s in stores:
-        for sst in getattr(s, "_ssts", []):
-            any_bloom = False
-            for name in sst.tables():
-                miss = sst.bloom_check(name)
-                if miss is None:
-                    continue
-                any_bloom = True
-                if miss:
-                    errors += miss
-                    bloom_misses += miss
-                    print(f"ERROR: {sst.path}: series bloom for table "
-                          f"'{name}' excludes {miss} of its own keys")
-            bloomed += 1 if any_bloom else 0
-            plain += 0 if any_bloom else 1
-    print(f"sstables: {bloomed} with series blooms, {plain} "
-          f"bloomless/legacy, {bloom_misses} bloom false negatives")
+    rep = run_fsck(tsdb, fix=args.fix, log=print)
+    print(f"sstables: {rep.bloomed} with series blooms, {rep.plain} "
+          f"bloomless/legacy, {rep.bloom_misses} bloom false negatives")
     dt = max(time.time() - t0, 1e-9)
-    print(f"{kvs} KVs (in {rows} rows) analyzed in {dt * 1000:.0f}ms "
-          f"(~{kvs / dt:.0f} KV/s)")
-    print(f"Found {errors} errors." + (f" Fixed {fixed} rows."
-                                       if args.fix else ""))
+    print(f"{rep.kvs} KVs (in {rep.rows} rows) analyzed in "
+          f"{dt * 1000:.0f}ms (~{rep.kvs / dt:.0f} KV/s)")
+    print(f"Found {rep.errors} errors." + (f" Fixed {rep.fixed} rows."
+                                           if args.fix else ""))
     tsdb.shutdown()
-    return 1 if errors and not args.fix else 0
-
-
-def _fix_row(tsdb: TSDB, key: bytes, cells) -> int:
-    """Salvage: explode what decodes, keep first value per delta, rewrite."""
-    points: dict[int, codec.Cell] = {}
-    for cell in cells:
-        if len(cell.qualifier) == 0 or len(cell.qualifier) % 2 != 0:
-            continue
-        try:
-            for c in codec.explode_cell(cell.qualifier, cell.value):
-                points.setdefault(c.delta, c)
-        except IllegalDataError:
-            # Salvage per-point: walk the qualifier pairs manually.
-            continue
-    if not points:
-        tsdb.store.delete_row(tsdb.table, key)
-        return 1
-    ordered = [points[d] for d in sorted(points)]
-    qual, val = codec.merge_cells(ordered)
-    tsdb.store.delete_row(tsdb.table, key)
-    tsdb.store.put(tsdb.table, key, FAMILY, qual, val)
-    return 1
+    if getattr(args, "expect_clean", False) and rep.errors:
+        return 2
+    return 1 if rep.errors and not args.fix else 0
 
 
 # ---------------------------------------------------------------------------
@@ -700,6 +613,9 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("fsck", help="check table consistency")
     common_args(p)
     p.add_argument("--fix", action="store_true")
+    p.add_argument("--expect-clean", action="store_true",
+                   help="exit 2 if ANY error is found (even with "
+                        "--fix) — the crash-harness/CI contract")
     p.set_defaults(fn=cmd_fsck)
 
     p = sub.add_parser("uid", help="UID administration")

@@ -1,0 +1,139 @@
+"""Storage fsck as a LIBRARY (the CLI's ``fsck`` subcommand and the
+fault harness share one implementation, so the crash matrix's
+"fsck clean" invariant is literally the operator tool).
+
+Checks (parity: reference src/tools/Fsck.java depth, plus the local
+format's audits):
+- qualifier framing (non-empty, even length) and value decode;
+- duplicate / out-of-order timestamps INSIDE compacted cells;
+- whole-row compactability (conflicting duplicate points across cells);
+- sstable series blooms: a FALSE NEGATIVE (an indexed key its own
+  table's bloom excludes) would silently hide rows from bloom-pruned
+  scans and point-get prefilters — hard error.
+
+``fix=True`` salvages rows (explode what decodes, first value per
+delta, rewrite); the CLI's ``--expect-clean`` maps "any error" to a
+distinct exit code for harness/CI use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from opentsdb_tpu.core import codec
+from opentsdb_tpu.core.errors import IllegalDataError
+from opentsdb_tpu.core.tsdb import FAMILY
+
+
+@dataclasses.dataclass
+class FsckReport:
+    kvs: int = 0
+    rows: int = 0
+    errors: int = 0
+    fixed: int = 0
+    bloomed: int = 0        # sstables carrying at least one bloom
+    plain: int = 0          # bloomless / legacy-format sstables
+    bloom_misses: int = 0   # bloom false negatives (counted in errors)
+
+    @property
+    def clean(self) -> bool:
+        return self.errors == 0
+
+
+def run_fsck(tsdb, fix: bool = False, log=None) -> FsckReport:
+    """Scan the data table + audit sstable blooms; returns the report.
+    ``log`` (callable) receives one line per finding; None = silent."""
+    say = log if log is not None else (lambda *_: None)
+    rep = FsckReport()
+    for cells in tsdb.store.scan(tsdb.table, b"", b"", family=FAMILY):
+        rep.rows += 1
+        key = cells[0].key
+        bad = False
+        for cell in cells:
+            rep.kvs += 1
+            qual, val = cell.qualifier, cell.value
+            if len(qual) == 0 or len(qual) % 2 != 0:
+                rep.errors += 1
+                bad = True
+                say(f"ERROR: row {key.hex()}: odd qualifier length "
+                    f"{len(qual)}")
+                continue
+            try:
+                points = codec.explode_cell(qual, val)
+            except IllegalDataError as e:
+                rep.errors += 1
+                bad = True
+                say(f"ERROR: row {key.hex()}: {e}")
+                continue
+            if codec.is_compacted_qualifier(qual):
+                # A compacted cell's qualifiers must be strictly
+                # increasing; compact_cells() sorts before checking, so
+                # in-cell duplicates/out-of-order points would pass
+                # silently without this.
+                deltas = [c.delta for c in points]
+                for j in range(1, len(deltas)):
+                    if deltas[j] == deltas[j - 1]:
+                        rep.errors += 1
+                        bad = True
+                        say(f"ERROR: row {key.hex()}: compacted cell "
+                            f"has duplicate timestamp (delta="
+                            f"{deltas[j]}, qualifier #{j})")
+                    elif deltas[j] < deltas[j - 1]:
+                        rep.errors += 1
+                        bad = True
+                        say(f"ERROR: row {key.hex()}: compacted cell "
+                            f"has out-of-order timestamps (delta="
+                            f"{deltas[j]} after {deltas[j - 1]}, "
+                            f"qualifier #{j})")
+        if not bad:
+            try:
+                codec.compact_cells(
+                    [(c.qualifier, c.value) for c in cells])
+            except IllegalDataError as e:
+                rep.errors += 1
+                bad = True
+                say(f"ERROR: row {key.hex()}: {e}")
+        if bad and fix:
+            rep.fixed += _fix_row(tsdb, key, cells)
+    # SSTable format / series-bloom audit over every generation
+    # (mixed-format stores are first-class: TSST3 files carry blooms,
+    # v1/v2 files don't and simply never prune).
+    stores = getattr(tsdb.store, "shards", None) or [tsdb.store]
+    for s in stores:
+        for sst in getattr(s, "_ssts", []):
+            any_bloom = False
+            for name in sst.tables():
+                miss = sst.bloom_check(name)
+                if miss is None:
+                    continue
+                any_bloom = True
+                if miss:
+                    rep.errors += miss
+                    rep.bloom_misses += miss
+                    say(f"ERROR: {sst.path}: series bloom for table "
+                        f"'{name}' excludes {miss} of its own keys")
+            rep.bloomed += 1 if any_bloom else 0
+            rep.plain += 0 if any_bloom else 1
+    return rep
+
+
+def _fix_row(tsdb, key: bytes, cells) -> int:
+    """Salvage: explode what decodes, keep first value per delta,
+    rewrite."""
+    points: dict[int, codec.Cell] = {}
+    for cell in cells:
+        if len(cell.qualifier) == 0 or len(cell.qualifier) % 2 != 0:
+            continue
+        try:
+            for c in codec.explode_cell(cell.qualifier, cell.value):
+                points.setdefault(c.delta, c)
+        except IllegalDataError:
+            continue
+    if not points:
+        tsdb.store.delete_row(tsdb.table, key)
+        return 1
+    ordered = [points[d] for d in sorted(points)]
+    qual, val = codec.merge_cells(ordered)
+    tsdb.store.delete_row(tsdb.table, key)
+    tsdb.store.put(tsdb.table, key, FAMILY, qual, val)
+    return 1

@@ -69,6 +69,23 @@ class LRUCache:
                 self._cost -= self._d.pop(oldest)[1]
                 self.evictions += 1
 
+    def resize(self, max_entries: int,
+               max_cost: int | None = None) -> None:
+        """Rebound the cache IN PLACE (evicting oldest entries down to
+        the new limits): callers that share one cache instance keep
+        their reference valid across a config change."""
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1: {max_entries}")
+        with self._lock:
+            self.max_entries = max_entries
+            self.max_cost = max_cost
+            while len(self._d) > self.max_entries or (
+                    self.max_cost is not None
+                    and self._cost > self.max_cost):
+                oldest = next(iter(self._d))
+                self._cost -= self._d.pop(oldest)[1]
+                self.evictions += 1
+
     def pop(self, key: Hashable, default: Any = None) -> Any:
         with self._lock:
             ent = self._d.pop(key, None)

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Crash-consistency matrix runner.
+
+Sweeps the fault-injection scenario matrix (opentsdb_tpu/fault/
+harness.py build_matrix: ≥40 (failpoint x mode) scenarios across the
+WAL, checkpoint phases, sstable writes, rollup spill bracketing,
+cross-shard spill joins and replica refresh), one child crash + parent
+verify per scenario, and writes a FAULT_MATRIX.json artifact with
+per-scenario pass/fail, the repro seed, and — for failures — the
+shrunken minimal schedule.
+
+This is the regression floor for durability changes: run it after
+touching storage/kv, storage/sstable, storage/sharded, rollup/tier or
+replica refresh.
+
+    python scripts/crashmatrix.py --json FAULT_MATRIX.json   # full sweep
+    python scripts/crashmatrix.py --fast                     # tier-1 subset
+    python scripts/crashmatrix.py --only rollup-flip-crash-s1
+    python scripts/crashmatrix.py --list
+
+Exit code 0 iff every selected scenario passed its invariants (fsck
+clean via the --expect-clean contract, golden raw/rollup/replica
+parity, deterministic child crash at the armed point).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from opentsdb_tpu.fault import harness  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--json", default="FAULT_MATRIX.json",
+                   help="artifact path (default FAULT_MATRIX.json)")
+    p.add_argument("--fast", action="store_true",
+                   help="run only the curated tier-1 subset")
+    p.add_argument("--only", action="append", default=[],
+                   help="run only scenarios whose label contains this "
+                        "(repeatable)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="override every scenario's seed")
+    p.add_argument("--n-ops", type=int, default=None,
+                   help="override every scenario's op count")
+    # Ad-hoc scenario flags (the self-contained per-failure repro line
+    # the artifact records): --site builds ONE scenario from explicit
+    # parameters instead of selecting from the matrix.
+    p.add_argument("--site", default=None,
+                   help="run one ad-hoc scenario at this failpoint "
+                        "site (with --mode/--skip/--shards/...)")
+    p.add_argument("--mode", default="crash",
+                   choices=("crash", "torn"))
+    p.add_argument("--skip", type=int, default=0)
+    p.add_argument("--shards", type=int, default=1)
+    p.add_argument("--no-rollups", action="store_true")
+    p.add_argument("--delete-heavy", action="store_true")
+    p.add_argument("--bug", default=None,
+                   help="deliberately re-introduce a historical bug in "
+                        "the child (harness.BUGS) — for harness "
+                        "self-tests; expect invariant failures")
+    p.add_argument("--work-dir", default=None,
+                   help="scenario scratch root (default: a tempdir)")
+    p.add_argument("--no-shrink", action="store_true",
+                   help="skip minimal-repro shrinking on failure")
+    p.add_argument("--list", action="store_true",
+                   help="print the scenario labels and exit")
+    args = p.parse_args(argv)
+
+    import dataclasses
+    if args.site:
+        scens = [harness.Scenario(
+            label=f"adhoc-{args.site.replace('.', '-')}-{args.mode}",
+            site=args.site, mode=args.mode, skip=args.skip,
+            shards=args.shards, rollups=not args.no_rollups,
+            delete_heavy=args.delete_heavy, bug=args.bug)]
+    else:
+        scens = (harness.fast_matrix() if args.fast
+                 else harness.build_matrix())
+        if args.only:
+            scens = [s for s in scens
+                     if any(o in s.label for o in args.only)]
+        if args.bug:
+            scens = [dataclasses.replace(s, bug=args.bug)
+                     for s in scens if s.kind == "crash"]
+    if args.seed is not None or args.n_ops is not None:
+        scens = [dataclasses.replace(
+            s,
+            seed=args.seed if args.seed is not None else s.seed,
+            n_ops=args.n_ops if args.n_ops is not None else s.n_ops)
+            for s in scens]
+    if args.list:
+        for s in scens:
+            print(f"{s.label:32s} {s.site}={s.mode} skip={s.skip} "
+                  f"shards={s.shards} rollups={s.rollups}")
+        return 0
+    if not scens:
+        print("no scenarios match", file=sys.stderr)
+        return 2
+
+    work = args.work_dir or tempfile.mkdtemp(prefix="crashmatrix-")
+    t0 = time.time()
+    results = harness.run_matrix(scens, work,
+                                 shrink=not args.no_shrink, log=print)
+    dt = time.time() - t0
+    passed = sum(1 for r in results if r["status"] == "ok")
+    artifact = {
+        "scenarios": len(results),
+        "passed": passed,
+        "failed": len(results) - passed,
+        "wall_seconds": round(dt, 2),
+        "fast": bool(args.fast),
+        "results": results,
+    }
+    with open(args.json, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"\n{passed}/{len(results)} scenarios passed in {dt:.1f}s "
+          f"-> {args.json}")
+    for r in results:
+        if r["status"] != "ok":
+            print(f"  FAIL {r['label']}: {r['status']} "
+                  f"{r['problems'][:2]}")
+            print(f"       repro: {r['repro']}")
+    return 0 if passed == len(results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,10 @@ def pytest_configure(config):
         "markers",
         "tpu: requires a real TPU chip (run with RUN_TPU_TESTS=1; "
         "excluded from the default CPU suite)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running sweep (full crash matrix); excluded from "
+        "tier-1 via -m 'not slow'")
 
 
 def pytest_collection_modifyitems(config, items):

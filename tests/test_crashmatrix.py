@@ -1,0 +1,176 @@
+"""Crash-consistency matrix: the tier-1 fast subset, determinism, the
+caught-reintroduced-bug gate, and the full ≥40-scenario sweep (slow).
+
+Every scenario spawns a child process that runs the seeded workload,
+dies at the armed failpoint (os._exit — SIGKILL semantics), and is
+verified by the parent: recovery + fsck clean + golden raw parity vs
+the oracle + bit-identical rollup-vs-raw answers + replica refresh
+across post-crash checkpoints (fault/harness.py)."""
+
+import dataclasses
+import json
+
+import pytest
+
+from opentsdb_tpu.fault import faultpoints, harness
+
+
+def _by_label():
+    return {s.label: s for s in harness.build_matrix()}
+
+
+class TestMatrixShape:
+    def test_at_least_forty_scenarios(self):
+        scens = harness.build_matrix()
+        assert len(scens) >= 40
+        assert len({s.label for s in scens}) == len(scens)
+        sites = {s.site for s in scens}
+        # Every durability machine is covered.
+        for want in ("kv.wal.append", "kv.checkpoint.freeze",
+                     "kv.checkpoint.commit", "sst.write.body",
+                     "sharded.spill.shard", "rollup.fold.start",
+                     "rollup.bracket.flip", "replica.refresh"):
+            assert want in sites, f"matrix lost coverage of {want}"
+
+    def test_fast_subset_resolves(self):
+        fast = harness.fast_matrix()
+        assert len(fast) == len(harness.FAST_LABELS) == 8
+
+
+class TestFastSubset:
+    """The tier-1 leg: one scenario per durability machine."""
+
+    @pytest.mark.parametrize(
+        "label", harness.FAST_LABELS,
+        ids=[lb for lb in harness.FAST_LABELS])
+    def test_scenario(self, label, tmp_path):
+        sc = _by_label()[label]
+        res = harness.run_scenario(sc, str(tmp_path), shrink=False)
+        assert res["status"] == "ok", (res["problems"], res)
+        # crash-kind scenarios must actually have crashed at the site.
+        if sc.kind == "crash":
+            assert res["child_exit"] == faultpoints.EXIT_CODE
+
+
+class TestDeterminism:
+    def test_same_seed_same_fingerprint(self, tmp_path):
+        sc = _by_label()["rollup-foldstart-crash-s1"]
+        r1 = harness.run_scenario(sc, str(tmp_path / "a"), shrink=False)
+        r2 = harness.run_scenario(sc, str(tmp_path / "b"), shrink=False)
+        assert r1["status"] == r2["status"] == "ok"
+        assert r1["fingerprint"] == r2["fingerprint"]
+        assert r1["ops_done"] == r2["ops_done"]
+
+    def test_workload_is_pure_function_of_seed(self):
+        assert harness.gen_ops(7, 24) == harness.gen_ops(7, 24)
+        assert harness.gen_ops(7, 24) != harness.gen_ops(8, 24)
+
+
+class TestHarnessHonesty:
+    def test_child_scenarios_reject_inprocess_modes(self, tmp_path):
+        """raise/ioerror/delay children would finish (or die) in ways
+        _run_once cannot classify as covered — the harness refuses
+        them loudly instead of misreporting coverage."""
+        sc = harness.Scenario(label="bad-mode", site="kv.wal.fsync",
+                              mode="delay")
+        with pytest.raises(ValueError, match="crash/torn"):
+            harness.run_scenario(sc, str(tmp_path))
+
+    def test_unreachable_site_reports_not_hit(self, tmp_path):
+        """A scenario whose failpoint never fires must be flagged, not
+        silently counted as covered."""
+        sc = harness.Scenario(label="unreachable",
+                              site="rollup.fold.start", mode="crash",
+                              shards=1, rollups=False, n_ops=10)
+        res = harness.run_scenario(sc, str(tmp_path), shrink=False)
+        assert res["status"] == "not-hit"
+
+    def test_reintroduced_torn_bracket_bug_is_caught(self, tmp_path):
+        """THE acceptance gate: deliberately re-introduce the PR-2-era
+        torn spill bracket in the child (begin_spill never opens the
+        pending bracket) and crash between the spill-key drain and the
+        fold — the matrix must catch the resulting stale rollup
+        answers, and shrinking must produce a smaller failing repro."""
+        sc = dataclasses.replace(
+            _by_label()["rollup-foldstart-crash-s1"],
+            label="bug-torn-bracket", bug="torn-bracket")
+        res = harness.run_scenario(sc, str(tmp_path), shrink=True)
+        assert res["status"] == "invariant-failed", res
+        assert any("rollup-served answer != raw answer" in p
+                   or "group sets differ" in p
+                   for p in res["problems"]), res["problems"]
+        assert res.get("min_repro"), "shrinker found no smaller repro"
+        assert res["min_repro"]["n_ops"] < sc.n_ops
+        # The recorded repro is self-contained (site/mode/seed/--bug),
+        # not label-bound: ad-hoc scenarios reproduce too.
+        assert "--site rollup.fold.start" in res["repro"]
+        assert "--bug torn-bracket" in res["repro"]
+
+    def test_clean_run_with_same_seed_passes(self, tmp_path):
+        """The bug test above is meaningful only if the same scenario
+        WITHOUT the bug passes (the failure is the bug, not the
+        harness)."""
+        sc = _by_label()["rollup-foldstart-crash-s1"]
+        res = harness.run_scenario(sc, str(tmp_path), shrink=False)
+        assert res["status"] == "ok", res["problems"]
+
+
+class TestMatrixRunnerScript:
+    def test_json_artifact(self, tmp_path):
+        """crashmatrix.py --json writes the per-scenario artifact with
+        pass/fail + repro seed (run on one cheap scenario)."""
+        import subprocess
+        import sys
+        out = tmp_path / "FAULT_MATRIX.json"
+        proc = subprocess.run(
+            [sys.executable, "scripts/crashmatrix.py",
+             "--only", "ckpt-freeze-crash-s1",
+             "--json", str(out), "--work-dir", str(tmp_path / "w")],
+            capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        art = json.loads(out.read_text())
+        assert art["scenarios"] == art["passed"] == 1
+        (r,) = art["results"]
+        assert r["status"] == "ok"
+        assert "seed" in r and "repro" in r and "fingerprint" in r
+
+
+class TestHistoricalRegressions:
+    """Named failpoint regressions for the durability bugs CHANGES.md
+    records — each historical bug maps to a matrix scenario that would
+    have caught it (the torn-bracket one is proven catchable in
+    TestHarnessHonesty via deliberate re-introduction)."""
+
+    def test_replica_inode_reuse_regression(self, tmp_path):
+        """PR 1: a crash-recovered <wal>.old made the next checkpoint
+        recreate the WAL; an in-place truncate reused the inode and
+        replicas replayed mid-record garbage. Scenario: crash at the
+        SECOND checkpoint's freeze (a .old survives), then verify()'s
+        replica phase drives the writer's post-crash checkpoint through
+        the append-to-.old + fresh-inode rotation with a live replica
+        keyed on the WAL inode."""
+        sc = _by_label()["ckpt-freeze-crash2-s1"]
+        res = harness.run_scenario(sc, str(tmp_path), shrink=False)
+        assert res["status"] == "ok", res["problems"]
+
+    def test_deleted_row_rollup_clobber_regression(self, tmp_path):
+        """PR 2 review: _zero_leftovers used to zero EVERY resolution's
+        record for a deleted row, dropping a whole day's rollup while
+        raw kept the surviving hours. Scenario: delete-heavy workload,
+        crash mid-fold-flush; verify demands bit-identical
+        rollup-vs-raw answers (incl. the 1d downsample) after the
+        rebuild re-folds the deletes."""
+        sc = _by_label()["rollup-folddel-crash-s1"]
+        res = harness.run_scenario(sc, str(tmp_path), shrink=False)
+        assert res["status"] == "ok", res["problems"]
+
+
+@pytest.mark.slow
+class TestFullMatrix:
+    def test_every_scenario_passes(self, tmp_path):
+        results = harness.run_matrix(harness.build_matrix(),
+                                     str(tmp_path), shrink=False)
+        bad = [(r["label"], r["status"], r["problems"][:2])
+               for r in results if r["status"] != "ok"]
+        assert len(results) >= 40
+        assert not bad, bad

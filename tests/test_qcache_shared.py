@@ -1,0 +1,215 @@
+"""Query-fast-path follow-ons (ISSUE 4 satellites): the cross-executor
+shared fragment cache, fragment-cache reuse for the rollup planner's
+raw-stitch ranges, and the bloom-aware point-get path."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.query import executor as executor_mod
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.storage.sharded import ShardedKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400 - 1356998400 % 86400
+HOUR = 3600
+
+
+def make_tsdb(tmp_path, shards=1, name="store", **cfg_kw):
+    cfg = Config(auto_create_metrics=True, device_window=False,
+                 shards=shards, qcache_chunk_s=2 * HOUR, **cfg_kw)
+    if shards > 1:
+        store = ShardedKVStore(str(tmp_path / name), shards=shards)
+    else:
+        store = MemKVStore(wal_path=str(tmp_path / name / "wal"))
+    return TSDB(store, cfg, start_compaction_thread=False)
+
+
+def ingest(tsdb, metric, n_series, start, n, step):
+    ts = start + np.arange(n, dtype=np.int64) * step
+    for si in range(n_series):
+        vals = np.cumsum(np.ones(n)) * 0.25 + si
+        tsdb.add_batch(metric, ts, vals, {"host": f"h{si:02d}"})
+    return int(ts[-1])
+
+
+class TestSharedFragmentCache:
+    def test_second_executor_starts_warm(self, tmp_path):
+        tsdb = make_tsdb(tmp_path)
+        end = ingest(tsdb, "m.shared", 3, BT, 500, 60)
+        tsdb.checkpoint()   # freeze history so chunks are cacheable
+        spec = QuerySpec("m.shared", {}, "sum",
+                         downsample=(HOUR, "sum"))
+        ex1 = QueryExecutor(tsdb, backend="cpu")
+        r1 = ex1.run(spec, BT, end)
+        assert ex1.qcache_misses > 0
+        ex2 = QueryExecutor(tsdb, backend="cpu")
+        assert ex2._frag_cache is ex1._frag_cache
+        r2 = ex2.run(spec, BT, end)
+        assert ex2.qcache_hits > 0 and ex2.qcache_misses == 0, \
+            "second executor over the same store did not share the cache"
+        for a, b in zip(r1, r2):
+            assert np.array_equal(a.timestamps, b.timestamps)
+            assert np.array_equal(a.values, b.values)
+        tsdb.shutdown()
+
+    def test_mutation_invalidates_for_every_executor(self, tmp_path):
+        tsdb = make_tsdb(tmp_path)
+        end = ingest(tsdb, "m.inval", 2, BT, 300, 60)
+        tsdb.checkpoint()
+        spec = QuerySpec("m.inval", {}, "sum")
+        ex1 = QueryExecutor(tsdb, backend="cpu")
+        ex2 = QueryExecutor(tsdb, backend="cpu")
+        ex1.run(spec, BT, end)
+        before = ex2.run(spec, BT, end)
+        # A put through ANY path must be visible to the other
+        # executor's next (shared-cache) run.
+        tsdb.add_point("m.inval", BT + 30, 1000.0, {"host": "h00"})
+        after = ex2.run(spec, BT, end)
+        assert not np.array_equal(before[0].values, after[0].values)
+        cold = ex1.run(spec, BT, end)
+        assert np.array_equal(after[0].values, cold[0].values)
+        tsdb.shutdown()
+
+    def test_distinct_stores_do_not_share(self, tmp_path):
+        t1 = make_tsdb(tmp_path, name="s1")
+        t2 = make_tsdb(tmp_path, name="s2")
+        e1 = QueryExecutor(t1, backend="cpu")
+        e2 = QueryExecutor(t2, backend="cpu")
+        assert e1._frag_cache is not e2._frag_cache
+        t1.shutdown()
+        t2.shutdown()
+
+    def test_config_change_rebounds_shared_cache_in_place(self,
+                                                          tmp_path):
+        """A later executor with different qcache bounds must RESIZE
+        the shared instance, not replace it — replacing would strand
+        earlier executors on an orphaned cache and end sharing."""
+        tsdb = make_tsdb(tmp_path, name="sres")
+        ex1 = QueryExecutor(tsdb, backend="cpu")
+        tsdb.config.qcache_points = 12345
+        ex2 = QueryExecutor(tsdb, backend="cpu")
+        assert ex2._frag_cache is ex1._frag_cache
+        assert ex1._frag_cache.max_cost == 12345
+        tsdb.shutdown()
+
+    def test_cache_dies_with_store(self, tmp_path):
+        import gc
+        tsdb = make_tsdb(tmp_path, name="s3")
+        QueryExecutor(tsdb, backend="cpu")
+        n0 = len(executor_mod._FRAG_CACHES)
+        assert tsdb.store in executor_mod._FRAG_CACHES
+        tsdb.shutdown()
+        del tsdb
+        gc.collect()
+        assert len(executor_mod._FRAG_CACHES) <= n0
+
+
+class TestRollupStitchCaching:
+    @pytest.mark.parametrize("shards", [1, 4])
+    def test_stitch_parity_and_edge_reuse(self, tmp_path, shards):
+        """Rollup-served queries whose edges stitch from raw must be
+        bit-identical with the fragment cache on vs off (cold stitch),
+        and repeat dashboard polls must HIT the cache for the stitch
+        ranges."""
+        tsdb = make_tsdb(tmp_path, shards=shards, name=f"r{shards}",
+                         enable_rollups=True, rollup_digest_k=0)
+        end = ingest(tsdb, "m.stitch", 3, BT, 60 * 30, 120)  # 60h span
+        tsdb.checkpoint()   # spill + fold: tier covers the history
+        assert tsdb.rollups.wait_ready(10)
+        ex = QueryExecutor(tsdb, backend="cpu")
+        spec = QuerySpec("m.stitch", {"host": "*"}, "sum",
+                         downsample=(HOUR, "sum"))
+        # Unaligned range => both edges stitch raw points.
+        lo, hi = BT + 1800, end - 1800
+        warm1, plan, _ = ex.run_with_plan(spec, lo, hi)
+        assert plan == "1h", f"tier did not serve (plan={plan})"
+        hits0 = ex.qcache_hits
+        warm2, plan2, _ = ex.run_with_plan(spec, lo, hi)
+        assert plan2 == "1h"
+        assert ex.qcache_hits > hits0, \
+            "repeat stitch did not reuse cached fragments"
+        tsdb.config.qcache = False
+        try:
+            cold, plan3, _ = ex.run_with_plan(spec, lo, hi)
+        finally:
+            tsdb.config.qcache = True
+        assert plan3 == "1h"
+        for got, label in ((warm1, "warm1"), (warm2, "warm2")):
+            assert len(got) == len(cold)
+            for g, c in zip(got, cold):
+                assert g.tags == c.tags
+                assert np.array_equal(g.timestamps, c.timestamps), label
+                assert np.array_equal(g.values, c.values), label
+        # And the rollup answer equals the pure-raw answer.
+        saved, tsdb.rollups = tsdb.rollups, None
+        try:
+            raw = ex.run(spec, lo, hi)
+        finally:
+            tsdb.rollups = saved
+        for g, c in zip(cold, raw):
+            assert np.array_equal(g.timestamps, c.timestamps)
+            assert np.array_equal(g.values, c.values)
+        tsdb.shutdown()
+
+
+class TestBloomPointGet:
+    def _store_with_generations(self, tmp_path, n_gens=4):
+        """A store whose sstable tier holds several generations of
+        disjoint series."""
+        tsdb = make_tsdb(tmp_path, name="bp")
+        keys = []
+        for g in range(n_gens):
+            ts = BT + np.arange(8, dtype=np.int64) * 300
+            tsdb.add_batch("m.bloom", ts, np.arange(8.0),
+                           {"host": f"g{g}"})
+            keys.append(tsdb.row_key_for("m.bloom", {"host": f"g{g}"},
+                                         BT))
+            tsdb.checkpoint()
+        return tsdb, keys
+
+    def test_parity_with_bisect_oracle(self, tmp_path):
+        tsdb, keys = self._store_with_generations(tmp_path)
+        store = tsdb.store
+        assert len(store._ssts) >= 2
+        t = store._table(tsdb.table)
+        probe = [(k, True) for k in keys]
+        # Absent keys: same metric, unseen hosts (valid key shape so
+        # the bloom path engages).
+        for g in range(8, 12):
+            probe.append((tsdb.row_key_for("m.bloom",
+                                           {"host": f"g{g}"}, BT), False))
+        for key, expect in probe:
+            oracle = any(sst.has_key(tsdb.table, key)
+                         for sst in store._ssts)
+            assert oracle is expect
+            assert store._lower_tier_has(t, tsdb.table, key) is expect, \
+                f"bloom point-get diverged from bisect for {key.hex()}"
+        assert store.bloom_point_skips > 0, \
+            "bloom never pruned a point probe"
+        tsdb.shutdown()
+
+    def test_delete_over_spilled_rows_still_tombstones(self, tmp_path):
+        """The consumer that must never regress: delete() decides
+        tombstone-vs-drop via _lower_tier_has; a wrong bloom skip would
+        resurrect spilled cells."""
+        tsdb, keys = self._store_with_generations(tmp_path)
+        tsdb.store.delete_row(tsdb.table, keys[0])
+        assert not tsdb.store.has_row(tsdb.table, keys[0])
+        tsdb.checkpoint()   # tombstone merge
+        assert not tsdb.store.has_row(tsdb.table, keys[0])
+        assert tsdb.store.has_row(tsdb.table, keys[1])
+        tsdb.shutdown()
+
+    def test_scalar_probe_matches_vector_probe(self, tmp_path):
+        from opentsdb_tpu.storage import sstable as sst_mod
+        tsdb, keys = self._store_with_generations(tmp_path)
+        store = tsdb.store
+        hashes = [sst_mod.series_hash(k[:3] + k[7:]) for k in keys]
+        for sst in store._ssts:
+            for h in hashes:
+                vec = sst.bloom_may_contain(
+                    tsdb.table, np.asarray([h], np.uint64))
+                assert sst.bloom_may_contain_hash(tsdb.table, h) == vec
+        tsdb.shutdown()
