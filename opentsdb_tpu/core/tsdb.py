@@ -726,6 +726,14 @@ class TSDB:
         nshards = getattr(self.store, "shard_count", None)
         if nshards is not None:
             collector.record("storage.shards", nshards)
+        rows_fn = getattr(self.store, "memtable_row_counts", None)
+        if rows_fn is not None:
+            # Live-memtable row count per shard: the skew view (one
+            # hot shard = one slow spill join) the per-shard spill
+            # timers explain after the fact; this shows it live.
+            for i, n in enumerate(rows_fn(self.table)):
+                collector.record("storage.memtable.rows", n,
+                                 f"shard={i}")
         bloom_files = getattr(self.store, "bloom_files_skipped", None)
         if bloom_files is not None:
             collector.record("bloom.files_skipped", bloom_files)

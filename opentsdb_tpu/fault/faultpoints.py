@@ -135,7 +135,15 @@ def _fire_armed(site: str, path: str | None, rec_bytes: int) -> None:
     if mode == "ioerror":
         raise OSError(f"injected I/O error at failpoint {site}")
     if mode == "delay":
-        time.sleep(delay)
+        # A traced request passing through an armed delay site records
+        # a fault.delay child span under whatever span is current —
+        # the deterministic proof that exactly one stage stretched
+        # (obs/trace.py). Imported lazily: fault/ must stay importable
+        # in the harness's jax-free child processes even if obs ever
+        # grows heavier deps.
+        from opentsdb_tpu.obs import trace as _obs_trace
+        with _obs_trace.span("fault.delay", site=site):
+            time.sleep(delay)
 
 
 def _tear(path: str | None, rec_bytes: int, k: int) -> None:

@@ -515,7 +515,19 @@ def verify(dirpath: str, sc: Scenario, ops: list[tuple],
     except Exception as e:
         return [f"reopen failed: {e!r}"], ""
     try:
+        # Instrumentation canary: the reopen-and-verify fsck must land
+        # a tsd.fsck.duration timer sample in the metrics registry.
+        # Every crash scenario exercises this, so observability that
+        # dies on recovery paths (half-open store, pending rollup
+        # bracket) fails the whole matrix — not just a dashboard.
+        from opentsdb_tpu.obs.registry import METRICS
+        fsck_timer = METRICS.timer("fsck.duration")
+        fsck_count0 = fsck_timer.count
         rep = run_fsck(tsdb, log=problems.append)
+        if fsck_timer.count <= fsck_count0:
+            problems.append(
+                "fsck ran but recorded no tsd.fsck.duration timer "
+                "sample (metrics registry broken on recovery path)")
         if rep.errors:
             problems.append(f"fsck: {rep.errors} errors")
         oracle = Oracle()

@@ -1,0 +1,24 @@
+"""End-to-end observability: metrics registry, trace spans, slow-query
+log, self-monitoring.
+
+Four pieces, one contract — observability must be ~free when idle
+(the faultpoints dict-check discipline):
+
+- ``registry``: the process-wide metrics registry (counters, gauges,
+  LatencyDigest-backed timers). Engine modules register their
+  instruments at import time; exports flow into both the classic
+  ``/stats`` line format and the Prometheus text ``/metrics`` endpoint.
+- ``trace``: per-query span trees threaded through the executor,
+  planner, and storage fan-out. Inactive (no trace requested, no
+  slow-query threshold configured) every hot-path hook is one global
+  integer check.
+- ``ring``: the bounded trace ring behind ``/api/traces`` plus the
+  one-line-JSON slow-query log (``Config.slow_query_ms``).
+- ``selfmon``: the reference's signature pattern (src/stats/ — the
+  TSDB monitors itself): a background loop snapshots the ``/stats``
+  lines and ingests them into the store as ``tsd.*`` series, so the
+  engine's own telemetry is queryable through ``/q``, rollup-eligible,
+  and graphable like any other metric.
+"""
+
+from opentsdb_tpu.obs.registry import METRICS, MetricsRegistry  # noqa: F401

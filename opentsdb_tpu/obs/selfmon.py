@@ -1,0 +1,136 @@
+"""Self-monitoring ingest: the TSDB stores its own telemetry.
+
+The reference's signature pattern (PAPER.md §1, src/stats/): the
+StatsCollector emits stats in OpenTSDB's own text-import line format
+*precisely so the TSDB can monitor itself*. This loop closes that
+circle: every ``interval_s`` it snapshots the daemon's ``/stats``
+lines (server counters + engine stats + the metrics registry) and
+ingests them into the store as ``tsd.*`` series — so ``/q``, rollups,
+the fragment cache, and dashboards work on the engine's own telemetry
+with zero extra plumbing.
+
+Reentrancy: the ingest itself bumps the very counters the next
+snapshot reads (wal.appends, datapoints.added, ...) — that is
+*feedback*, not recursion, and it is exactly what monitoring a live
+system looks like. The ``_busy`` guard closes the one true recursion
+hazard: a run_once triggered while a previous one is still inside the
+ingest path (slow fsync, a stats callback that itself snapshots) is
+refused instead of nesting through its own instrumentation.
+
+Timestamps are forced strictly monotonic per cycle: two snapshots in
+the same epoch second would write conflicting duplicate points (same
+series, same timestamp, different value) — the IllegalDataError shape
+fsck exists to flag.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+
+from opentsdb_tpu.core import tags as tags_mod
+from opentsdb_tpu.core.errors import ReadOnlyStoreError
+
+LOG = logging.getLogger(__name__)
+_HOST = socket.gethostname()
+
+
+class SelfMonitor:
+    """Background stats-snapshot → self-ingest loop.
+
+    ``stats_fn`` returns the classic stats lines
+    (``tsd.name timestamp value tag=v ...``); each line becomes one
+    data point of the metric named by its first token (UID created on
+    demand — self-monitoring must not depend on auto_create_metrics).
+    """
+
+    def __init__(self, tsdb, stats_fn, interval_s: float) -> None:
+        self.tsdb = tsdb
+        self.stats_fn = stats_fn
+        self.interval_s = float(interval_s)
+        self.cycles = 0
+        self.points = 0
+        self.errors = 0
+        self._busy = False
+        self._last_ts = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- one deterministic cycle (tests call this directly) -------------
+
+    def run_once(self) -> int:
+        """Snapshot + ingest one cycle; returns points written.
+        Refused (0) while a previous cycle is still ingesting — the
+        reentrancy guard — or on a read-only replica."""
+        if self._busy or getattr(self.tsdb.store, "read_only", False):
+            return 0
+        self._busy = True
+        try:
+            lines = self.stats_fn()
+            # One shared timestamp per cycle, strictly after the
+            # previous cycle's: duplicate (series, ts) points with
+            # different values are corrupt data by this engine's rules.
+            ts = max(int(time.time()), self._last_ts + 1)
+            self._last_ts = ts
+            n = 0
+            for line in lines:
+                parts = line.split()
+                if len(parts) < 3:
+                    continue
+                name, value = parts[0], parts[2]
+                tag_map: dict[str, str] = {}
+                try:
+                    for t in parts[3:]:
+                        tags_mod.parse(tag_map, t)
+                    fval = float(value)
+                except ValueError:
+                    continue
+                if not tag_map:
+                    # The engine requires >= 1 tag per point; stats
+                    # collectors built without the host tag still
+                    # self-ingest under it (the reference tags every
+                    # stats line with host=).
+                    tag_map = {"host": _HOST}
+                try:
+                    self.tsdb.metrics.get_or_create_id(name)
+                    if fval.is_integer() and abs(fval) < 2**53:
+                        self.tsdb.add_point(name, ts, int(fval), tag_map)
+                    else:
+                        self.tsdb.add_point(name, ts, fval, tag_map)
+                    n += 1
+                except ReadOnlyStoreError:
+                    return n
+                except Exception:
+                    self.errors += 1
+                    LOG.exception("self-monitor ingest failed for %s",
+                                  name)
+            self.cycles += 1
+            self.points += n
+            return n
+        finally:
+            self._busy = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self.interval_s <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="selfmon", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.run_once()
+            except Exception:
+                self.errors += 1
+                LOG.exception("self-monitor cycle failed")

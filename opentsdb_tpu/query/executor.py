@@ -39,6 +39,8 @@ import numpy as np
 from opentsdb_tpu.core import codec
 from opentsdb_tpu.core.const import MAX_TIMESPAN, TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.core.errors import BadRequestError
+from opentsdb_tpu.fault.faultpoints import fire as _fault
+from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.ops import kernels, oracle, sketches
 from opentsdb_tpu.query.aggregators import Aggregators
 from opentsdb_tpu.storage.sstable import series_hash
@@ -298,6 +300,11 @@ class QueryExecutor:
         tsdb = self.tsdb
         cfg = tsdb.config
         store = tsdb.store
+        # Query-path failpoint (fault/faultpoints.py): delay/raise
+        # modes let tests stretch or break exactly the scan stage of a
+        # traced query — the deterministic span-timing proof. Unarmed:
+        # one empty-dict check per selector scan.
+        _fault("query.scan")
         hint = self._series_hint(metric_uid, exact, group_bys)
         b_lo = codec.base_time(max(start, 0))
         b_hi = min(codec.base_time(min(end, 0xFFFFFFFF)), 0xFFFFFFFF)
@@ -306,9 +313,10 @@ class QueryExecutor:
             start_key = metric_uid + _u32(b_lo)
             stop_key = metric_uid + _u32(
                 min(b_hi + MAX_TIMESPAN, 0xFFFFFFFF))
-            return tsdb.scan_series(start_key, stop_key,
-                                    key_regexp=regexp,
-                                    series_hint=hint)[1]
+            with obs_trace.span("chunk.decode", outcome="unchunked"):
+                return tsdb.scan_series(start_key, stop_key,
+                                        key_regexp=regexp,
+                                        series_hint=hint)[1]
 
         chunk_s = int(getattr(cfg, "qcache_chunk_s", 0) or 0)
         chunk_s -= chunk_s % MAX_TIMESPAN
@@ -340,28 +348,40 @@ class QueryExecutor:
             self.qcache_bypasses += nchunks
             if info is not None:
                 info["cached"] = False
+            sp = obs_trace.current_span()
+            if sp is not None:
+                sp.tags["qcache_bypass"] = (
+                    sp.tags.get("qcache_bypass", 0) + nchunks)
             return full_scan()
         parts: dict[bytes, list] = {}
         all_hit = True
+        n_hit = n_miss = n_byp = 0
         for c, (seqs, floors, stamps, dirty) in zip(chunks, states):
             key = (fkey, c, chunk_s)
             if dirty:
                 self.qcache_bypasses += 1
+                n_byp += 1
                 all_hit = False
-                frag = self._scan_chunk(metric_uid, regexp, hint,
-                                        c, c + chunk_s)
+                with obs_trace.span("chunk.decode", outcome="bypass",
+                                    base=int(c)):
+                    frag = self._scan_chunk(metric_uid, regexp, hint,
+                                            c, c + chunk_s)
             else:
                 ent = self._frag_cache.get(key)
                 if ent is not None and all(
                         e >= f and m <= e
                         for e, f, m in zip(ent[0], floors, stamps)):
                     self.qcache_hits += 1
+                    n_hit += 1
                     frag = ent[1]
                 else:
                     self.qcache_misses += 1
+                    n_miss += 1
                     all_hit = False
-                    frag = self._scan_chunk(metric_uid, regexp, hint,
-                                            c, c + chunk_s)
+                    with obs_trace.span("chunk.decode", outcome="miss",
+                                        base=int(c)):
+                        frag = self._scan_chunk(metric_uid, regexp,
+                                                hint, c, c + chunk_s)
                     cost = sum(len(cols.timestamps)
                                for cols in frag.values())
                     self._frag_cache.put(key, (seqs, frag),
@@ -370,6 +390,16 @@ class QueryExecutor:
                 parts.setdefault(skey, []).append(cols)
         if info is not None:
             info["cached"] = all_hit
+        # Fragment-cache outcome on the enclosing span (scan /
+        # raw.stitch): accumulated, because one query scans several
+        # selectors and stitch ranges. Cache HITS are ~free (a dict
+        # get), so they get a count, not a span.
+        sp = obs_trace.current_span()
+        if sp is not None:
+            t = sp.tags
+            t["qcache_hit"] = t.get("qcache_hit", 0) + n_hit
+            t["qcache_miss"] = t.get("qcache_miss", 0) + n_miss
+            t["qcache_bypass"] = t.get("qcache_bypass", 0) + n_byp
         out: dict[bytes, codec.Columns] = {}
         for skey, lst in parts.items():
             if len(lst) == 1:
@@ -408,13 +438,26 @@ class QueryExecutor:
         return self.run_with_plan(spec, start, end)[0]
 
     def run_with_plan(self, spec: QuerySpec, start: int, end: int,
-                      ) -> tuple[list[QueryResult], str, bool]:
+                      trace=None) -> tuple[list[QueryResult], str, bool]:
         """run() plus the planner-choice label for THIS call ("raw",
         "resident", or a rollup resolution like "1h") and whether the
         answer came ENTIRELY from the warm fragment cache. Returned
         rather than stashed on the executor so server threads sharing
-        one executor can't read a neighbor query's labels."""
-        results, plan, cached = self._run_planned(spec, start, end)
+        one executor can't read a neighbor query's labels.
+
+        ``trace`` (obs/trace.Trace): when given, the execution stages
+        — planner pick, rollup read / raw stitch, storage scan with
+        per-shard fan-out and per-chunk decode, aggregation — record
+        themselves as a span tree under ``trace.root``. None (the
+        default) costs one global-int check per hook."""
+        if trace is None:
+            results, plan, cached = self._run_planned(spec, start, end)
+        else:
+            with obs_trace.activate(trace):
+                results, plan, cached = self._run_planned(spec, start,
+                                                          end)
+            trace.root.tags["plan"] = plan
+            trace.root.tags["cached"] = bool(cached)
         self.last_plan = plan
         return results, plan, cached
 
@@ -428,28 +471,47 @@ class QueryExecutor:
             raise BadRequestError(
                 "use distinct_tagv() / the /distinct endpoint for "
                 "cardinality queries")
-        dev = self._run_devwindow(spec, start, end, agg)
-        if dev is not None:
-            return dev, "resident", False
         # Rollup planner step: serve window-aligned downsamples from
         # the materialized summary tier (rollup/planner.py), with raw
         # stitching over edge/dirty windows. The returned spans are
         # already per-bucket values, so the rewritten spec's downsample
         # stage is the identity and the shared group stage below runs
-        # unchanged on either backend.
-        planned = self._plan_rollup(spec, start, end)
+        # unchanged on either backend. The "planner.pick" span covers
+        # the whole resolution decision INCLUDING the tier reads and
+        # raw stitches it triggers (they appear as child spans), so a
+        # trace's top-level children tile the query wall time.
+        with obs_trace.span("planner.pick") as sp:
+            dev = self._run_devwindow(spec, start, end, agg)
+            planned = None
+            if dev is None:
+                planned = self._plan_rollup(spec, start, end)
+            if sp is not None:
+                if dev is not None:
+                    sp.tags["plan"] = "resident"
+                elif planned is not None:
+                    from opentsdb_tpu.rollup.tier import res_label
+                    sp.tags["plan"] = res_label(planned[2])
+                else:
+                    sp.tags["plan"] = "raw"
+        if dev is not None:
+            return dev, "resident", False
         if planned is not None:
             groups, spec2, res = planned
             from opentsdb_tpu.rollup.tier import res_label
-            return (self._execute_groups(spec2, groups, start, end),
-                    res_label(res), False)
+            with obs_trace.span("aggregate"):
+                results = self._execute_groups(spec2, groups, start, end)
+            return results, res_label(res), False
         import time as _time
         t0 = _time.time()
         info: dict = {}
-        groups = self._find_spans(spec, start, end, info)
+        with obs_trace.span("scan") as sp:
+            groups = self._find_spans(spec, start, end, info)
+            if sp is not None:
+                sp.tags["cached"] = bool(info.get("cached"))
         self.scan_latency.add((_time.time() - t0) * 1000)
-        return (self._execute_groups(spec, groups, start, end), "raw",
-                bool(info.get("cached")))
+        with obs_trace.span("aggregate"):
+            results = self._execute_groups(spec, groups, start, end)
+        return results, "raw", bool(info.get("cached"))
 
     def _plan_rollup(self, spec: QuerySpec, start: int, end: int):
         if getattr(self.tsdb, "rollups", None) is None:

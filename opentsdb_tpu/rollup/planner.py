@@ -32,6 +32,7 @@ from __future__ import annotations
 import numpy as np
 
 from opentsdb_tpu.core import codec
+from opentsdb_tpu.obs import trace as _trace
 from opentsdb_tpu.query.aggregators import Aggregators
 from opentsdb_tpu.rollup import summary
 from opentsdb_tpu.rollup.summary import EXACT_DSAGGS
@@ -163,8 +164,9 @@ def _scan_raw_parts(executor, metric_uid: bytes, regexp: bytes | None,
     by the fragment-cache contract."""
     parts: dict[bytes, list] = {}
     for lo, hi in ranges:
-        per_series = executor._scan_selector(metric_uid, exact,
-                                             group_bys, regexp, lo, hi)
+        with _trace.span("raw.stitch", lo=int(lo), hi=int(hi)):
+            per_series = executor._scan_selector(
+                metric_uid, exact, group_bys, regexp, lo, hi)
         for skey, cols in per_series.items():
             m = (cols.timestamps >= lo) & (cols.timestamps <= hi)
             if not m.any():
@@ -243,9 +245,13 @@ def _select_windows(executor, tier, metric: str, tags: dict,
     metric_uid = tsdb.metrics.get_id(metric)
     exact, group_bys = executor._tag_filters(tags)
     regexp = executor._build_regexp(exact, group_bys)
-    records = tier.scan_records(res, metric_uid, w_lo, w_hi,
-                                key_regexp=regexp,
-                                want_sketches=want_sketches)
+    with _trace.span("rollup.read", res=res) as sp:
+        records = tier.scan_records(res, metric_uid, w_lo, w_hi,
+                                    key_regexp=regexp,
+                                    want_sketches=want_sketches)
+        if sp is not None:
+            sp.tags["series"] = len(records)
+            sp.tags["dirty_windows"] = int(len(dirty))
     dirty_set = frozenset(int(b) for b in dirty)
     raw_ranges = _coalesce(
         edges + [(int(w), int(w) + res - 1) for w in dirty_set])

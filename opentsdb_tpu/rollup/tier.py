@@ -56,6 +56,7 @@ from opentsdb_tpu.core import codec, codec_np
 from opentsdb_tpu.core.const import MAX_TIMESPAN, TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.core.errors import IllegalDataError
 from opentsdb_tpu.fault.faultpoints import fire as _fault
+from opentsdb_tpu.obs.registry import METRICS as _metrics
 from opentsdb_tpu.rollup import summary
 from opentsdb_tpu.rollup.summary import (QUAL_MOMENTS, QUAL_SKETCH,
                                          REC_DTYPE, REC_SIZE,
@@ -71,6 +72,12 @@ STATE_NAME = "ROLLUP.json"
 _RAW_FAMILY = b"t"
 
 _FLUSH_CELLS = 1 << 16
+
+# Checkpoint-fold and catch-up latency timers (obs/registry.py): one
+# observation per fold / per completed rebuild, exported via /stats
+# and /metrics.
+_M_FOLD = _metrics.timer("rollup.fold")
+_M_CATCHUP = _metrics.timer("rollup.catchup")
 
 
 class _TierClosed(Exception):
@@ -564,7 +571,8 @@ class RollupTier:
             # and the pending bracket must force a full rebuild (the
             # PR-2-era torn-bracket class).
             _fault("rollup.fold.start", self.state_path)
-            self._fold(keys)
+            with _M_FOLD.time():
+                self._fold(keys)
         except IllegalDataError as e:
             # Corrupt raw data (the fsck signal): leave the tier
             # not-ready (state stays pending) so the planner serves
@@ -809,6 +817,8 @@ class RollupTier:
         recovery). Runs on the catch-up thread; checkpoints folding in
         the meantime defer their spilled keys, drained at the end."""
         try:
+            import time as _time
+            t_catchup0 = _time.perf_counter()
             buf = _MapBuffer(self)
             with self._fold_lock:
                 names = self.tsdb.metrics.suggest("", limit=1 << 30)
@@ -871,6 +881,8 @@ class RollupTier:
                     self._inflight = frozenset()
                     self._ready = True
                     self.rebuilds += 1
+                _M_CATCHUP.observe(
+                    (_time.perf_counter() - t_catchup0) * 1000.0)
                 break
         except BaseException as e:
             self._rebuilding = False

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import functools
 import hashlib
 import json
 import logging
@@ -35,6 +36,9 @@ from opentsdb_tpu.core.errors import (
     ReadOnlyStoreError,
 )
 from opentsdb_tpu.graph.plot import Plot
+from opentsdb_tpu.obs import trace as obs_trace
+from opentsdb_tpu.obs.registry import METRICS, read_rss_bytes
+from opentsdb_tpu.obs.ring import TraceRing, log_slow, make_record
 from opentsdb_tpu.query.aggregators import Aggregators
 from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
 from opentsdb_tpu.query.grammar import parse_m
@@ -152,6 +156,17 @@ class TSDServer:
         self.cache_hits = 0
         self.cache_misses = 0
         self.start_time = int(time.time())
+        # Observability (opentsdb_tpu/obs/): the trace ring holds the
+        # last N traced/slow queries for /api/traces; the self-monitor
+        # ingests the /stats snapshot into the store itself as tsd.*
+        # series every selfmon_interval_s (0 = off — constructed
+        # anyway so tests can run_once() deterministically).
+        self.trace_ring = TraceRing(
+            getattr(self.config, "trace_ring", 256))
+        from opentsdb_tpu.obs.selfmon import SelfMonitor
+        self.selfmon = SelfMonitor(
+            tsdb, self._collect_stats,
+            getattr(self.config, "selfmon_interval_s", 0.0))
         self._register_default_commands()
 
     # ------------------------------------------------------------------
@@ -161,6 +176,7 @@ class TSDServer:
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._handle_conn, self.config.bind, self.config.port)
+        self.selfmon.start()
         LOG.info("Ready to serve on %s:%d", self.config.bind,
                  self.config.port)
 
@@ -175,6 +191,7 @@ class TSDServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        self.selfmon.stop()
         self._pool.shutdown(wait=False)
         self.tsdb.shutdown()
         LOG.info("Server shut down")
@@ -366,6 +383,8 @@ class TSDServer:
             "/sketch": lambda req: self._sketch(req.q),
             "/forecast": lambda req: self._forecast(req.q, req.params),
             "/fault": self._http_fault,
+            "/metrics": self._http_metrics,
+            "/api/traces": self._http_traces,
             "/dropcaches": self._http_dropcaches,
             "/diediedie": self._http_diediedie,
             "/favicon.ico": self._http_favicon,
@@ -388,9 +407,13 @@ class TSDServer:
             writer.write(f"unknown command: {words[0]}\n".encode())
             await writer.drain()
             return True
-        out = handler(words, writer)
-        if asyncio.iscoroutine(out):
-            out = await out
+        # Per-command latency timer (the HTTP _route twin). The bulk
+        # put pipeline bypasses this dispatcher by design — it's
+        # covered by rpc.latency/put and the wal.* instruments.
+        with METRICS.timer("telnet.handler", {"cmd": words[0]}).time():
+            out = handler(words, writer)
+            if asyncio.iscoroutine(out):
+                out = await out
         # Per-command backpressure: a slow reader pipelining commands
         # must throttle the loop, not grow the transport buffer.
         await writer.drain()
@@ -561,9 +584,13 @@ class TSDServer:
             return 404, "text/plain", b"Page Not Found\n", {}
         req = HttpRequest(method=method, path=path, q=q, params=params,
                           query_string=parsed.query)
-        out = handler(req)
-        if asyncio.iscoroutine(out):
-            out = await out
+        # Per-endpoint latency timer: tagged by the ROUTE (a bounded
+        # label set), never the raw path — /metrics cardinality must
+        # not scale with request strings.
+        with METRICS.timer("http.handler", {"endpoint": route}).time():
+            out = handler(req)
+            if asyncio.iscoroutine(out):
+                out = await out
         return out
 
     # -- built-in HTTP handlers ----------------------------------------
@@ -636,6 +663,24 @@ class TSDServer:
         return (200, "application/json",
                 json.dumps(fp.status()).encode(), {})
 
+    def _http_metrics(self, req) -> tuple:
+        """Prometheus text exposition: the metrics registry (typed —
+        counters, gauges, timer summaries) merged with the classic
+        /stats lines (untyped gauges, deduplicated against the
+        registry's families) so one scrape covers both worlds."""
+        body = METRICS.prometheus_text(extra_lines=self._collect_stats())
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                body.encode(), {})
+
+    def _http_traces(self, req) -> tuple:
+        """The trace ring: the last Config.trace_ring traced queries
+        (explicit ?trace=1 requests + every slow query), newest last.
+        ``?slow=1`` filters to slow-flagged records."""
+        records = self.trace_ring.snapshot()
+        if "slow" in req.q and req.q["slow"] not in ("", "0"):
+            records = [r for r in records if r.get("slow")]
+        return 200, "application/json", json.dumps(records).encode(), {}
+
     def _http_dropcaches(self, req) -> tuple:
         self.tsdb.drop_caches()
         return 200, "text/plain", b"Caches dropped.\n", {}
@@ -680,7 +725,19 @@ class TSDServer:
         if not ms:
             raise BadRequestError("Missing parameter: m")
 
-        cache_path = self._cache_path(query_string, q)
+        # Tracing: requested explicitly (?trace=1) or implied for
+        # every query when a slow-query threshold is configured (the
+        # span tree is what makes the slow-query record debuggable).
+        # The per-hook cost is one global-int check when off and a
+        # perf_counter pair per STAGE when on — never per point.
+        want_trace = q.get("trace", "0") not in ("", "0")
+        slow_ms = float(getattr(self.config, "slow_query_ms", 0) or 0)
+        do_trace = want_trace or slow_ms > 0
+        # An explicitly traced request bypasses the /q disk cache both
+        # ways: a cached body carries no trace, and a trace of a disk
+        # read would claim the query cost nothing.
+        cache_path = (None if want_trace
+                      else self._cache_path(query_string, q))
         if cache_path and self._cache_fresh(cache_path, q, end, now):
             with open(cache_path, "rb") as f:
                 body = f.read()
@@ -720,6 +777,7 @@ class TSDServer:
         result_opts: list[str] = []
         result_plans: list[str] = []
         result_cached: list[bool] = []
+        result_traces: list[dict | None] = []
         for mi, m in enumerate(ms):
             parsed = parse_m(m)
             spec = QuerySpec(
@@ -733,12 +791,31 @@ class TSDServer:
             # Returned with the results: reading it back off the shared
             # executor after the pool hop could pick up a CONCURRENT
             # request's label.
+            trace = obs_trace.Trace(m) if do_trace else None
             rs, plan, cached = await loop.run_in_executor(
-                self._pool, self.executor.run_with_plan, spec, start, end)
+                self._pool,
+                functools.partial(self.executor.run_with_plan,
+                                  spec, start, end, trace))
+            tdict = None
+            if trace is not None:
+                rec = make_record(
+                    m, trace, plan, cached, slow_ms,
+                    getattr(self.tsdb.store, "shard_count", 1) or 1,
+                    bool(getattr(self.tsdb.store, "read_only", False)))
+                tdict = rec["trace"]
+                # The ring holds what an operator would want to SEE at
+                # /api/traces: every explicit trace, every slow query.
+                # Threshold-only tracing of fast queries stays out —
+                # it would flush the ring with noise between incidents.
+                if want_trace or rec["slow"]:
+                    self.trace_ring.add(rec)
+                if rec["slow"]:
+                    log_slow(rec)
             results.extend(rs)
             result_opts.extend([os_[mi] if mi < len(os_) else ""] * len(rs))
             result_plans.extend([plan] * len(rs))
             result_cached.extend([cached] * len(rs))
+            result_traces.extend([tdict] * len(rs))
 
         extra: dict = {}
         if "ascii" in q:
@@ -746,8 +823,9 @@ class TSDServer:
             ctype = "text/plain"
         elif "json" in q:
             body = json.dumps(
-                self._json_output(results, result_plans,
-                                  result_cached)).encode()
+                self._json_output(
+                    results, result_plans, result_cached,
+                    result_traces if want_trace else None)).encode()
             ctype = "application/json"
         else:
             t0 = time.time()
@@ -807,8 +885,9 @@ class TSDServer:
                 out.append(line + (" " + tag_str if tag_str else ""))
         return "\n".join(out) + ("\n" if out else "")
 
-    def _json_output(self, results, plans=None, cached=None):
-        return [{
+    def _json_output(self, results, plans=None, cached=None,
+                     traces=None):
+        out = [{
             "metric": r.metric,
             "tags": r.tags,
             "aggregateTags": r.aggregated_tags,
@@ -820,6 +899,12 @@ class TSDServer:
             "dps": {str(int(t)): float(v)
                     for t, v in zip(r.timestamps, r.values)},
         } for i, r in enumerate(results)]
+        if traces is not None:
+            # ?trace=1 only: the per-sub-query span tree, inline.
+            for i, ent in enumerate(out):
+                if i < len(traces) and traces[i] is not None:
+                    ent["trace"] = traces[i]
+        return out
 
     def _render_png(self, results, start, end, q,
                     result_opts=None) -> tuple[bytes, dict]:
@@ -1187,6 +1272,8 @@ class TSDServer:
 <li>/q?start=1h-ago&amp;m=sum:metric&#123;tag=value&#125;&amp;ascii</li>
 <li>/suggest?type=metrics&amp;q=prefix</li>
 <li><a href="/stats">/stats</a></li>
+<li><a href="/metrics">/metrics</a></li>
+<li><a href="/api/traces">/api/traces</a></li>
 <li><a href="/version">/version</a></li>
 <li><a href="/logs">/logs</a></li>
 </ul></body></html>"""
@@ -1225,5 +1312,19 @@ class TSDServer:
         for site, n in sorted(fstat["fired"].items()):
             c.record("fault.fired_site", n, f"site={site}")
         c.record("uptime", int(time.time()) - self.start_time)
+        c.record("uptime_s", int(time.time()) - self.start_time)
+        rss = read_rss_bytes()
+        if rss:
+            c.record("process.rss_bytes", rss)
+        c.record("traces.recorded", self.trace_ring.recorded)
+        c.record("traces.slow", self.trace_ring.slow)
+        c.record("selfmon.cycles", self.selfmon.cycles)
+        c.record("selfmon.points", self.selfmon.points)
+        c.record("selfmon.errors", self.selfmon.errors)
         self.tsdb.collect_stats(c)
+        # Engine instruments (obs/registry.py): WAL append/fsync,
+        # checkpoint phases, per-shard spills, rollup folds, fsck,
+        # per-handler latency — timers expand to p50/p95/p99 +
+        # .count/.sum_ms lines.
+        METRICS.collect(c)
         return c.lines

@@ -47,6 +47,7 @@ import re
 import struct
 import threading
 import zlib
+from time import perf_counter as _perf
 from bisect import bisect_left
 from typing import Iterator, NamedTuple
 
@@ -56,11 +57,23 @@ from opentsdb_tpu.core.const import TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.core.errors import (PleaseThrottleError,
                                        ReadOnlyStoreError)
 from opentsdb_tpu.fault.faultpoints import fire as _fault
+from opentsdb_tpu.obs import trace as _trace
+from opentsdb_tpu.obs.registry import METRICS as _metrics
 from opentsdb_tpu.storage.sstable import (SSTable, merge_sstables,
                                           write_sstable_bulk)
 from opentsdb_tpu.utils.nativeext import ext as _EXT
 
 _REC = struct.Struct(">BI")  # op, payload length
+
+# Engine instruments (obs/registry.py): registered once at import, so
+# the hot paths pay one attribute increment / one perf_counter pair
+# per WAL *batch* or checkpoint phase — never per point.
+_M_WAL_APPENDS = _metrics.counter("wal.appends")
+_M_WAL_BYTES = _metrics.counter("wal.append_bytes")
+_M_WAL_APPEND = _metrics.timer("wal.append")
+_M_WAL_FSYNC = _metrics.timer("wal.fsync")
+_M_CKPT_PHASE = {ph: _metrics.timer("checkpoint.phase", {"phase": ph})
+                 for ph in ("freeze", "spill", "commit")}
 
 # Row-key byte range holding the base time (data-table layout,
 # core/codec.row_key). The incremental dirty-base index slices it per
@@ -775,6 +788,12 @@ class MemKVStore(KVStore):
         with self._lock:
             return list(self._table(table).rows)
 
+    def memtable_row_counts(self, table: str) -> list[int]:
+        """Live-memtable row count, one element per shard (one, here) —
+        the /stats per-shard memtable gauge."""
+        with self._lock:
+            return [len(self._table(table).rows)]
+
     def pending_keys(self, table: str) -> list[bytes]:
         """Row keys (and row tombstones) NOT yet covered by the rollup
         fold: the live memtable, a frozen mid-checkpoint tier, and the
@@ -1021,6 +1040,8 @@ class MemKVStore(KVStore):
         # Batch writers pass flush=False per record and call
         # _wal_flush() ONCE before the batch acknowledges (the ack
         # boundary, not the record, is the durability promise).
+        _M_WAL_APPENDS.inc()
+        _M_WAL_BYTES.inc(_REC.size + len(payload))
         if flush:
             self._wal_flush()
             _fault("kv.wal.append", self._wal_path,
@@ -1031,10 +1052,14 @@ class MemKVStore(KVStore):
         # Between the userspace flush and the (optional) fsync: crash
         # here loses nothing on process death but everything on power
         # loss — the gap the fsync=True deployments buy away; ioerror
-        # simulates the fsync itself failing (ENOSPC/EIO).
-        _fault("kv.wal.fsync", self._wal_path)
-        if self._fsync:
-            os.fsync(self._wal.fileno())
+        # simulates the fsync itself failing (ENOSPC/EIO). The trace
+        # span brackets the faultpoint too, so an armed delay here
+        # stretches exactly the wal.fsync span of a traced ingest.
+        with _trace.span("wal.fsync"):
+            _fault("kv.wal.fsync", self._wal_path)
+            if self._fsync:
+                with _M_WAL_FSYNC.time():
+                    os.fsync(self._wal.fileno())
 
     # _REC frames the payload with a u32 length, capping one record at
     # 4 GiB. Batches whose blobs approach that are split into multiple
@@ -1085,6 +1110,7 @@ class MemKVStore(KVStore):
         record the same crash semantics as a torn _OP_PUT."""
         if self._wal is None:
             return
+        t_app0 = _perf()
         n = len(cells)
         ks, qs, vs = zip(*cells)
         kl = np.fromiter(map(len, ks), ">u4", n)
@@ -1105,7 +1131,10 @@ class MemKVStore(KVStore):
                 b"".join(vs[lo:hi])))
             self._wal.write(_REC.pack(_OP_PUT_BATCH, len(payload))
                             + payload)
+            _M_WAL_APPENDS.inc()
+            _M_WAL_BYTES.inc(_REC.size + len(payload))
         self._wal_flush()
+        _M_WAL_APPEND.observe((_perf() - t_app0) * 1000.0)
         _fault("kv.wal.append", self._wal_path,
                _REC.size + len(payload))
 
@@ -1118,6 +1147,7 @@ class MemKVStore(KVStore):
         contiguous buffer) — no per-key slicing or re-join."""
         if self._wal is None:
             return
+        t_app0 = _perf()
         ql = np.fromiter(map(len, quals), ">u4", n)
         vl = np.fromiter(map(len, vals), ">u4", n)
         blob = n * key_len + int(ql.sum()) + int(vl.sum())
@@ -1134,7 +1164,10 @@ class MemKVStore(KVStore):
                 b"".join(quals[lo:hi]), b"".join(vals[lo:hi])))
             self._wal.write(_REC.pack(_OP_PUT_BATCH, len(payload))
                             + payload)
+            _M_WAL_APPENDS.inc()
+            _M_WAL_BYTES.inc(_REC.size + len(payload))
         self._wal_flush()
+        _M_WAL_APPEND.observe((_perf() - t_app0) * 1000.0)
         _fault("kv.wal.append", self._wal_path,
                _REC.size + len(payload))
 
@@ -1309,6 +1342,7 @@ class MemKVStore(KVStore):
         if self._sst_path is None or self.read_only:
             return 0
         old_path = self._wal_path + ".old"
+        t_p1 = _perf()
         with self._lock:
             if self._frozen is not None:
                 return 0  # merge already in flight
@@ -1371,6 +1405,7 @@ class MemKVStore(KVStore):
             empty = not any(ft.rows or ft.row_tombs
                             for ft in frozen.values())
             out_path = self._next_generation_path()
+        _M_CKPT_PHASE["freeze"].observe((_perf() - t_p1) * 1000.0)
 
         if empty:
             # Nothing to spill, but the WAL rotation above must still
@@ -1416,9 +1451,10 @@ class MemKVStore(KVStore):
             # spilled yet. Crash here must recover purely from
             # .old + WAL replay; raise exercises the thaw path below.
             _fault("kv.checkpoint.freeze", self._wal_path)
-            n = (merge_sstables(out_path, merge_gens, frozen_payload)
-                 if use_merge
-                 else write_sstable_bulk(out_path, spill_tables()))
+            with _M_CKPT_PHASE["spill"].time():
+                n = (merge_sstables(out_path, merge_gens, frozen_payload)
+                     if use_merge
+                     else write_sstable_bulk(out_path, spill_tables()))
         except Exception:
             # Disk full or similar mid-merge: thaw the frozen tier back
             # under the live memtable so the store isn't wedged (a stuck
@@ -1430,6 +1466,7 @@ class MemKVStore(KVStore):
                 self._thaw_frozen_locked()
             raise
 
+        t_p3 = _perf()
         with self._lock:
             # Phase 3 failures (sstable open, manifest tmp write right
             # after a near-full-disk spill) get the SAME recovery as a
@@ -1521,6 +1558,7 @@ class MemKVStore(KVStore):
                     pass
             if os.path.exists(old_path):
                 os.unlink(old_path)
+        _M_CKPT_PHASE["commit"].observe((_perf() - t_p3) * 1000.0)
         return n
 
     @staticmethod

@@ -59,6 +59,8 @@ import numpy as np
 from opentsdb_tpu.core.const import TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.core.errors import PleaseThrottleError
 from opentsdb_tpu.fault import faultpoints as _fp
+from opentsdb_tpu.obs import trace as _trace
+from opentsdb_tpu.obs.registry import METRICS as _metrics
 from opentsdb_tpu.storage.kv import Cell, KVStore, MemKVStore
 
 MANIFEST_NAME = "SHARDS.json"
@@ -392,6 +394,19 @@ class ShardedKVStore(KVStore):
         its = [s.scan_raw(table, start, stop, family=family,
                           key_regexp=key_regexp,
                           series_hint=series_hint) for s in shards]
+        parent = _trace.current_span()
+        if parent is not None:
+            # Per-shard fan-out spans: each shard's span accumulates
+            # only the time spent pulling from THAT shard's iterator
+            # (the heap merge interleaves them), attached to the span
+            # current at fan-out time when its iterator is exhausted.
+            idx_of = {id(s): i for i, s in enumerate(self.shards)}
+            its = [_trace.timed_iter(it, parent, "shard.scan",
+                                     {"shard": idx_of[id(s)]})
+                   for it, s in zip(its, shards)]
+            if len(shards) < self.shard_count:
+                parent.tags["shards_skipped"] = (
+                    self.shard_count - len(shards))
         return heapq.merge(*its, key=lambda row: row[0])
 
     # -- memtable introspection (sketch recovery re-fold) ------------------
@@ -401,6 +416,10 @@ class ShardedKVStore(KVStore):
         for s in self.shards:
             out.extend(s.memtable_keys(table))
         return out
+
+    def memtable_row_counts(self, table: str) -> list[int]:
+        """Live-memtable row count per shard (the /stats gauge)."""
+        return [s.memtable_row_counts(table)[0] for s in self.shards]
 
     def pending_keys(self, table: str) -> list[bytes]:
         out: list[bytes] = []
@@ -508,16 +527,27 @@ class ShardedKVStore(KVStore):
             # still WAL-only (the no-cross-shard-atomic-cut contract
             # the crash matrix verifies).
             total = 0
-            for s in self.shards:
-                total += s.checkpoint()
+            for i, s in enumerate(self.shards):
+                total += self._timed_spill(i, s)
                 _fp.fire("sharded.spill.shard", self._dir)
             return total
         if self.shard_count == 1 or self._spill_workers <= 1:
-            return sum(s.checkpoint() for s in self.shards)
+            return sum(self._timed_spill(i, s)
+                       for i, s in enumerate(self.shards))
         with ThreadPoolExecutor(
                 max_workers=self._spill_workers,
                 thread_name_prefix="shard-spill") as pool:
-            return sum(pool.map(MemKVStore.checkpoint, self.shards))
+            return sum(pool.map(self._timed_spill,
+                                range(self.shard_count), self.shards))
+
+    @staticmethod
+    def _timed_spill(i: int, shard: MemKVStore) -> int:
+        """One shard's checkpoint, timed per shard (the join a writer
+        can block on is one shard's largest merge — the per-shard
+        timer is what makes staggered-compaction skew visible)."""
+        with _metrics.timer("checkpoint.shard_spill",
+                            {"shard": str(i)}).time():
+            return shard.checkpoint()
 
     def refresh(self) -> bool:
         """Replica catch-up across every shard (each shard's refresh is

@@ -156,6 +156,8 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
             # read-only daemons (core/compaction.py).
             cfg.checkpoint_interval = 5.0
         cfg.mesh_devices = getattr(args, "mesh_devices", 0)
+        cfg.slow_query_ms = getattr(args, "slow_query_ms", 0.0)
+        cfg.selfmon_interval_s = getattr(args, "selfmon_interval", 0.0)
     read_only = getattr(args, "read_only", False)
     shards = getattr(args, "shards", 0) or 0
     from opentsdb_tpu.storage.sharded import manifest_path
@@ -558,6 +560,39 @@ def cmd_mkmetric(args) -> int:
     return 0
 
 
+def cmd_stats(args) -> int:
+    """Print the ``/stats`` lines (or ``--metrics`` Prometheus text)
+    from a live server (``--url``) or an opened store — the curl-free
+    path for restricted shells and cron probes.
+
+    Store mode opens the WAL like any offline tool (pass --read-only
+    against a live writer daemon: stats read fine over the replica
+    path and the writer keeps its flock) and reports engine + storage
+    stats; server-only counters (connections, RPC latency) need --url.
+    """
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/") + (
+            "/metrics" if args.metrics else "/stats")
+        with urllib.request.urlopen(url, timeout=15) as r:
+            sys.stdout.write(r.read().decode("utf-8", "replace"))
+        return 0
+    from opentsdb_tpu.obs.registry import METRICS
+    from opentsdb_tpu.stats.collector import StatsCollector
+
+    tsdb = make_tsdb(args)
+    c = StatsCollector("tsd")
+    tsdb.collect_stats(c)
+    METRICS.collect(c)
+    if args.metrics:
+        sys.stdout.write(METRICS.prometheus_text(extra_lines=c.lines))
+    elif c.lines:
+        print("\n".join(c.lines))
+    tsdb.shutdown()
+    return 0
+
+
 def cmd_version(args) -> int:
     from opentsdb_tpu.build_data import build_data, version_string
     print(version_string(), end="")
@@ -589,6 +624,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--mesh-devices", type=int, default=0,
                    help="shard fused queries over the first N local "
                         "chips (0 = single-device)")
+    p.add_argument("--slow-query-ms", type=float, default=0.0,
+                   help="trace every /q and log one-line JSON records "
+                        "(span tree + plan) for queries at/over this "
+                        "wall time; they land in /api/traces too "
+                        "(0 disables)")
+    p.add_argument("--selfmon-interval", type=float, default=0.0,
+                   help="seconds between self-monitoring cycles that "
+                        "ingest /stats into the store itself as tsd.* "
+                        "series (0 disables)")
     p.set_defaults(fn=cmd_tsd)
 
     p = sub.add_parser("import", help="bulk import text files")
@@ -627,6 +671,18 @@ def main(argv: list[str] | None = None) -> int:
     common_args(p)
     p.add_argument("names", nargs="+")
     p.set_defaults(fn=cmd_mkmetric)
+
+    p = sub.add_parser(
+        "stats", help="print /stats lines from a server or a store")
+    common_args(p)
+    p.add_argument("--url", default=None,
+                   help="base URL of a live tsd (e.g. "
+                        "http://localhost:4242): fetch its /stats "
+                        "instead of opening a store")
+    p.add_argument("--metrics", action="store_true",
+                   help="Prometheus text exposition (/metrics) instead "
+                        "of classic stats lines")
+    p.set_defaults(fn=cmd_stats)
 
     p = sub.add_parser("version", help="print build/version information")
     p.add_argument("--verbose", action="store_true")

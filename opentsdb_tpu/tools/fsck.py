@@ -23,6 +23,14 @@ import dataclasses
 from opentsdb_tpu.core import codec
 from opentsdb_tpu.core.errors import IllegalDataError
 from opentsdb_tpu.core.tsdb import FAMILY
+from opentsdb_tpu.obs.registry import METRICS
+
+# One observation per fsck run — exported as tsd.fsck.duration
+# (p50/p95/p99 + .count/.sum_ms). The fault harness asserts a sample
+# lands during crash-recovery verification, so instrumentation that
+# breaks on the recovery path (half-open store, mid-rebuild tier)
+# fails the whole matrix, not just a dashboard.
+_M_FSCK = METRICS.timer("fsck.duration")
 
 
 @dataclasses.dataclass
@@ -43,6 +51,11 @@ class FsckReport:
 def run_fsck(tsdb, fix: bool = False, log=None) -> FsckReport:
     """Scan the data table + audit sstable blooms; returns the report.
     ``log`` (callable) receives one line per finding; None = silent."""
+    with _M_FSCK.time():
+        return _run_fsck(tsdb, fix, log)
+
+
+def _run_fsck(tsdb, fix: bool, log) -> FsckReport:
     say = log if log is not None else (lambda *_: None)
     rep = FsckReport()
     for cells in tsdb.store.scan(tsdb.table, b"", b"", family=FAMILY):

@@ -100,6 +100,21 @@ class Config:
     # dashboard pixels, wrong for billing.
     wire_bf16: bool = False
 
+    # Observability (opentsdb_tpu/obs/):
+    # - slow_query_ms: /q requests slower than this are traced and
+    #   logged as one-line JSON records (span tree + plan labels +
+    #   shard/replica attribution) into the trace ring and the slow-
+    #   query logger. 0 disables; queries are then only traced when
+    #   explicitly asked (?trace=1).
+    # - selfmon_interval_s: period of the self-monitoring loop that
+    #   snapshots /stats and ingests it into the store itself as
+    #   tsd.* series (the reference's StatsCollector pattern). 0 = off.
+    # - trace_ring: bounded count of trace/slow-query records kept in
+    #   memory and served at /api/traces.
+    slow_query_ms: float = 0.0
+    selfmon_interval_s: float = 0.0
+    trace_ring: int = 256
+
     # compute backend: 'tpu' = jitted JAX kernels; 'cpu' = numpy oracle
     backend: str = "tpu"
     # device mesh for distributed query execution: 0 = single-device;

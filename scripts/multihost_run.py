@@ -66,6 +66,15 @@ def child(process_id: int, coordinator: str) -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # This jaxlib's CPU client defaults to NO cross-process collective
+    # transport ("Multiprocess computations aren't implemented on the
+    # CPU backend") — the gloo TCP transport must be opted into before
+    # the backend initializes. Builds without gloo are skipped by the
+    # capability probe in tests/test_multihost.py.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older/newer jax: no such knob; initialize() decides
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=N_PROC,
                                process_id=process_id)

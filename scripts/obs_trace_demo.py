@@ -1,0 +1,238 @@
+"""Acceptance demo for the observability layer at corpus scale.
+
+Builds the 100M-point / 4-shard / rollup-enabled corpus (the
+BENCH_SCALE shape: SERIES series, 10 s cadence, columnar ingest,
+checkpoint spills + folds), then drives the REAL server over a socket
+and verifies, writing OBS_TRACE_DEMO.json:
+
+1. ``/q?trace=1`` returns a span tree whose stage labels cover the
+   planner pick, rollup read vs raw stitch, per-shard fan-out, and the
+   fragment-cache outcome — and whose top-level span durations sum to
+   within 10% of the reported wall time (checked on a rollup-planned
+   dashboard query AND a raw scan).
+2. An armed ``delay`` faultpoint on ``kv.wal.fsync`` visibly lengthens
+   exactly the matching span of a traced ingest (armed over the live
+   ``/fault`` endpoint, observed through the span tree).
+3. The self-monitoring loop's ``tsd.*`` series answer through plain
+   ``/q`` on the same server.
+
+Usage: python scripts/obs_trace_demo.py [--points 100000000]
+       [--shards 4] [--out OBS_TRACE_DEMO.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASE = 1356998400
+STEP = 10
+SERIES = 500
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def build(dirpath: str, points: int, shards: int):
+    import numpy as np
+
+    from opentsdb_tpu.core.tsdb import TSDB
+    from opentsdb_tpu.storage.sharded import ShardedKVStore
+    from opentsdb_tpu.utils.config import Config
+
+    cfg = Config(auto_create_metrics=True, enable_sketches=True,
+                 device_window=False, backend="cpu",
+                 enable_rollups=True, rollup_catchup="sync",
+                 shards=shards, wal_path=dirpath,
+                 port=0, bind="127.0.0.1",
+                 selfmon_interval_s=0.0)   # driven manually below
+    store = ShardedKVStore(dirpath, shards=shards)
+    tsdb = TSDB(store, cfg, start_compaction_thread=False)
+    pps = points // SERIES
+    chunk = 2_000_000 // SERIES
+    rng = np.random.default_rng(7)
+    t0 = time.time()
+    done = 0
+    for lo in range(0, pps, chunk):
+        n = min(chunk, pps - lo)
+        ts = BASE + (lo + np.arange(n, dtype=np.int64)) * STEP
+        for s in range(SERIES):
+            vals = rng.normal(50.0 + s, 5.0, n).astype(np.float32)
+            tsdb.add_batch("demo.metric", ts, vals, {"host": f"h{s}"})
+        done += n * SERIES
+        if lo // chunk % 8 == 0:
+            dt = time.time() - t0
+            log(f"ingested {done / 1e6:.1f}M pts "
+                f"({done / max(dt, 1e-9) / 1e3:.0f}k dps)")
+            tsdb.checkpoint()
+    log("final checkpoint + fold ...")
+    tsdb.checkpoint()
+    log(f"corpus ready: {done / 1e6:.1f}M points in "
+        f"{time.time() - t0:.0f}s")
+    return tsdb
+
+
+async def http_get(port, target):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n"
+                 "Connection: close\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    return int(head.split(b" ", 2)[1]), body
+
+
+def walk(d):
+    yield d
+    for c in d.get("spans", ()):
+        yield from walk(c)
+
+
+def check_trace(tr: dict, want_stages) -> dict:
+    names = {s["name"] for s in walk(tr)}
+    missing = [w for w in want_stages if w not in names]
+    top = sum(s["ms"] for s in tr.get("spans", ()))
+    frac = top / tr["ms"] if tr["ms"] else 0.0
+    qtags = [k for s in walk(tr) for k in s.get("tags", {})
+             if k.startswith("qcache_") or k == "outcome"]
+    return {"stages": sorted(names), "missing": missing,
+            "top_level_sum_ms": round(top, 3),
+            "wall_ms": tr["ms"],
+            "sum_over_wall": round(frac, 4),
+            "sum_within_10pct": frac >= 0.9,
+            "fragment_cache_outcome_tags": sorted(set(qtags))}
+
+
+async def drive(server, tsdb, points, out_path):
+    from opentsdb_tpu.fault import faultpoints
+    from opentsdb_tpu.obs import trace as obs_trace
+
+    await server.start()
+    port = server.port
+    span = points // SERIES * STEP
+    week = min(7 * 86400, span)
+    report: dict = {"points": points,
+                    "shards": tsdb.store.shard_count}
+
+    # 1a. rollup-planned dashboard week at 1h.
+    st, body = await http_get(
+        port, f"/q?start={BASE}&end={BASE + week}"
+              "&m=sum:1h-avg:demo.metric&json&trace=1&nocache")
+    assert st == 200, body[:300]
+    out = json.loads(body)
+    tr = out[0]["trace"]
+    report["rollup_query"] = {
+        "plan": out[0]["rollup"],
+        **check_trace(tr, ("planner.pick", "rollup.read", "aggregate"))}
+    log(f"rollup-planned trace: plan={out[0]['rollup']} "
+        f"sum/wall={report['rollup_query']['sum_over_wall']}")
+
+    # 1b. raw tag-filtered scan (cold then warm: cache outcome flips).
+    for leg in ("cold", "warm"):
+        st, body = await http_get(
+            port, f"/q?start={BASE}&end={BASE + week}"
+                  "&m=sum:demo.metric{host=h7}&json&trace=1&nocache")
+        assert st == 200, body[:300]
+        out = json.loads(body)
+        tr = out[0]["trace"]
+        report[f"raw_query_{leg}"] = {
+            "cached": out[0]["cached"],
+            **check_trace(tr, ("planner.pick", "scan", "shard.scan",
+                               "chunk.decode", "aggregate"))}
+        log(f"raw {leg} trace: cached={out[0]['cached']} "
+            f"sum/wall={report[f'raw_query_{leg}']['sum_over_wall']}")
+
+    # 2. delay faultpoint on kv.wal.fsync armed over the LIVE /fault
+    # endpoint; a traced ingest stretches exactly the wal.fsync span.
+    st, _ = await http_get(
+        port, "/fault?arm=kv.wal.fsync%3Ddelay%3Adelay%3D0.25")
+    assert st == 200
+    tr_ing = obs_trace.Trace("ingest")
+    with obs_trace.activate(tr_ing):
+        tsdb.add_point("demo.metric", BASE + span + 60, 1.0,
+                       {"host": "h0"})
+    await http_get(port, "/fault?clear=1")
+    d = tr_ing.to_dict()
+    fsync = [s for s in d.get("spans", ()) if s["name"] == "wal.fsync"]
+    others = [s for s in d.get("spans", ()) if s["name"] != "wal.fsync"]
+    report["wal_fsync_delay"] = {
+        "fsync_span_ms": fsync[0]["ms"] if fsync else None,
+        "fault_delay_child": bool(
+            fsync and any(c["name"] == "fault.delay"
+                          for c in fsync[0].get("spans", ()))),
+        "stretched_only_matching_span": bool(
+            fsync and fsync[0]["ms"] >= 200
+            and all(s["ms"] < 100 for s in others)),
+        "trace": d}
+    log(f"wal.fsync delay span: {report['wal_fsync_delay']}"[:200])
+
+    # 3. self-monitoring: one cycle, then /q over a tsd.* series.
+    n = server.selfmon.run_once()
+    st, body = await http_get(
+        port, "/q?start=0&end=4102444800"
+              "&m=sum:tsd.datapoints.added&json&nocache")
+    out = json.loads(body)
+    report["selfmon"] = {
+        "points_ingested": n, "http_status": st,
+        "tsd_series_dps": out[0]["dps"] if out else {}}
+    log(f"selfmon: {n} points, tsd.* queryable={bool(out)}")
+
+    st, body = await http_get(port, "/api/traces")
+    report["api_traces_records"] = len(json.loads(body))
+    st, body = await http_get(port, "/metrics")
+    report["metrics_lines"] = len(body.decode().splitlines())
+
+    await server.stop()
+    ok = (report["rollup_query"]["sum_within_10pct"]
+          and not report["rollup_query"]["missing"]
+          and report["raw_query_cold"]["sum_within_10pct"]
+          and not report["raw_query_cold"]["missing"]
+          and report["wal_fsync_delay"]["stretched_only_matching_span"]
+          and report["selfmon"]["points_ingested"] > 0
+          and bool(report["selfmon"]["tsd_series_dps"]))
+    report["ok"] = ok
+    report["iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    log(f"wrote {out_path} ok={ok}")
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=int, default=100_000_000)
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--dir", default=None,
+                    help="corpus dir (default: fresh temp, removed)")
+    ap.add_argument("--out",
+                    default=os.path.join(REPO, "OBS_TRACE_DEMO.json"))
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+
+    from opentsdb_tpu.server.tsd import TSDServer
+
+    tmp = args.dir or tempfile.mkdtemp(prefix="obs_demo_")
+    try:
+        tsdb = build(tmp, args.points, args.shards)
+        server = TSDServer(tsdb)
+        return asyncio.run(drive(server, tsdb, args.points, args.out))
+    finally:
+        if args.dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
