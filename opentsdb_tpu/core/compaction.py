@@ -112,13 +112,13 @@ class CompactionQueue:
                     store = self._tsdb.store
                     if getattr(store, "read_only", False):
                         # Replica daemon: the timer polls the writer's
-                        # durable state instead of spilling. A REBUILD
-                        # means the writer checkpointed — its sketch
-                        # snapshot advanced too, so reload it.
-                        before = getattr(store, "rebuilds", 0)
-                        store.refresh()
-                        if getattr(store, "rebuilds", 0) != before:
-                            self._tsdb.reload_sketches()
+                        # durable state instead of spilling (raw
+                        # refresh + sketch reload on rebuild + the
+                        # read-only rollup tier, in contract order).
+                        # Serve-tier replicas (Config.role="replica")
+                        # run the SAME call from the WalTailer at
+                        # tail_interval_s instead.
+                        self._tsdb.refresh_replica()
                     else:
                         self._tsdb.checkpoint()
                     self._last_checkpoint = now

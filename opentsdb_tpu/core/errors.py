@@ -40,6 +40,22 @@ class ReadOnlyStoreError(Exception):
     """
 
 
+class OverloadedError(Exception):
+    """The admission controller shed this request (serve/admission.py).
+
+    Carries the Retry-After hint (seconds) and the HTTP status the
+    server should answer with: 429 for a per-tenant quota breach, 503
+    for process-wide load shedding. Raised by the query path when the
+    degraded (rollup-only) ladder step cannot serve a query at all.
+    """
+
+    def __init__(self, message: str, retry_after: float = 1.0,
+                 status: int = 503):
+        super().__init__(message)
+        self.retry_after = max(float(retry_after), 0.0)
+        self.status = status
+
+
 class NoSuchUniqueName(Exception):
     """Name -> UID lookup failed (reference src/uid/NoSuchUniqueName.java)."""
 

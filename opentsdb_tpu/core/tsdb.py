@@ -16,6 +16,7 @@ TPU-first departures:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Iterator
@@ -30,6 +31,8 @@ from opentsdb_tpu.core.errors import PleaseThrottleError
 from opentsdb_tpu.storage.kv import KVStore
 from opentsdb_tpu.uid.uniqueid import UniqueId
 from opentsdb_tpu.utils.config import Config
+
+LOG = logging.getLogger(__name__)
 
 FAMILY = b"t"
 
@@ -96,18 +99,30 @@ class TSDB:
                 staging_points=self.config.device_window_staging,
                 max_points=self.config.device_window_points)
             self._warm_devwindow()
-        # Materialized rollup tier (rollup/tier.py): writer daemons
-        # with a persistent store only — an in-memory store never
-        # spills, so every window would stay memtable-dirty and the
-        # planner could never serve a summary; a replica neither owns
-        # the fold nor the tier's state file.
+        # Materialized rollup tier (rollup/tier.py): daemons with a
+        # persistent store only — an in-memory store never spills, so
+        # every window would stay memtable-dirty and the planner could
+        # never serve a summary. Writers own the fold and the tier's
+        # state file; replicas open the same stores READ-ONLY
+        # (ReadOnlyRollupTier) so the planner serves summaries on the
+        # serve tier too, refreshed by refresh_replica().
         self.rollups = None
         if (self.config.enable_rollups
-                and not getattr(store, "read_only", False)
                 and getattr(store, "_wal_path", None)):
-            from opentsdb_tpu.rollup.tier import RollupTier
+            if getattr(store, "read_only", False):
+                from opentsdb_tpu.rollup.tier import ReadOnlyRollupTier
+                try:
+                    self.rollups = ReadOnlyRollupTier(self, self.config)
+                except Exception:
+                    # A replica must come up even when the writer's
+                    # tier is mid-rebuild/foreign: serve raw, let the
+                    # refresh cycle adopt the tier when it settles.
+                    LOG.exception("replica rollup tier unavailable; "
+                                  "serving raw")
+            else:
+                from opentsdb_tpu.rollup.tier import RollupTier
 
-            self.rollups = RollupTier(self, self.config)
+                self.rollups = RollupTier(self, self.config)
 
     def _warm_devwindow(self) -> None:
         """Mirror pre-existing storage (WAL-replayed memtable + sstable
@@ -170,6 +185,40 @@ class TSDB:
                 return
         # No snapshot (or unknown store shape): rebuild from everything.
         self._refold(self.scan_columns(b"", b"\xff" * 64))
+
+    def refresh_replica(self) -> bool:
+        """One full replica catch-up cycle: raw store refresh (WAL
+        suffix replay, or a rebuild when the writer checkpointed),
+        sketch snapshot reload when a rebuild happened, then the
+        read-only rollup tier — in THAT order, which is what makes
+        replica-served rollup answers safe (ReadOnlyRollupTier's
+        docstring carries the proof). The compaction timer (legacy
+        --read-only daemons) and the serve tier's WalTailer both
+        drive this. Returns True when the raw view changed."""
+        if not getattr(self.store, "read_only", False):
+            raise ValueError("refresh_replica() is for read-only "
+                             "replica stores")
+        before = getattr(self.store, "rebuilds", 0)
+        changed = self.store.refresh()
+        if getattr(self.store, "rebuilds", 0) != before:
+            self.reload_sketches()
+        tier = self.rollups
+        if (tier is None and self.config.enable_rollups
+                and getattr(self.store, "_wal_path", None)):
+            # Construction failed at boot (writer mid-rebuild, torn
+            # state file): keep trying each cycle so the tier is
+            # adopted once the writer settles — a replica must not
+            # serve raw forever over a transient boot race.
+            from opentsdb_tpu.rollup.tier import ReadOnlyRollupTier
+            try:
+                self.rollups = tier = ReadOnlyRollupTier(self,
+                                                         self.config)
+            except Exception as e:
+                LOG.debug("replica rollup tier still unavailable: %r",
+                          e)
+        if tier is not None and getattr(tier, "read_only", False):
+            tier.refresh()
+        return changed
 
     def reload_sketches(self) -> None:
         """Replica catch-up: re-load the writer's sketch snapshot and

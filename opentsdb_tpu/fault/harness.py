@@ -753,6 +753,7 @@ FAST_LABELS = (
     "ckpt-freeze-crash-s1",
     "ckpt-commit-crash-s1",
     "sst-body-torn-s1",
+    "sst-footer-torn-s1",
     "rollup-foldstart-crash-s1",
     "rollup-flip-crash-s1",
     "rollup-folddel-crash-s1",
@@ -791,6 +792,10 @@ def build_matrix() -> list[Scenario]:
             "crash", **c)
         add(f"sst-body-crash-{t}", "sst.write.body", "crash", **c)
         add(f"sst-body-torn-{t}", "sst.write.body", "torn", **c)
+        # Torn targeting the FOOTER section specifically (index +
+        # bloom + trailer, the bytes a half-durable file would parse
+        # garbage from): the fault-injection follow-on from PR 4.
+        add(f"sst-footer-torn-{t}", "sst.write.footer", "torn", **c)
         add(f"sst-rename-crash-{t}", "sst.rename", "crash", **c)
         add(f"rollup-begin-crash-{t}", "rollup.begin_spill", "crash",
             **c)

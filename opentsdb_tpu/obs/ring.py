@@ -67,6 +67,7 @@ def make_record(q: str, trace, plan: str, cached: bool,
     wall = float(trace.total_ms)
     return {
         "ts": int(time.time()),
+        "trace_id": getattr(trace, "trace_id", None),
         "q": q,
         "wall_ms": round(wall, 3),
         "plan": plan,

@@ -34,6 +34,14 @@ from contextlib import contextmanager
 
 _ACTIVE = 0                     # process-wide count of active traces
 _ACTIVE_LOCK = threading.Lock()
+
+
+def new_trace_id() -> str:
+    """16 hex chars of urandom — collision-safe across processes
+    (os.urandom, not random: child processes fork with copied PRNG
+    state and routers/replicas must never mint the same id)."""
+    import os
+    return os.urandom(8).hex()
 _CURRENT: contextvars.ContextVar = contextvars.ContextVar(
     "opentsdb_tpu_trace_span", default=None)
 
@@ -58,11 +66,20 @@ class Span:
 
 
 class Trace:
-    """One query's span tree; ``root.ms`` is set by ``activate``."""
+    """One query's span tree; ``root.ms`` is set by ``activate``.
 
-    def __init__(self, label: str, tags: dict | None = None) -> None:
+    ``trace_id`` is the cross-process correlation handle: the router
+    mints one per front-door request and passes it to every replica
+    hop (``?trace_parent=``), so the hop's ring record on the replica
+    and the assembled tree on the router carry the SAME id — one grep
+    finds a request's whole fan-out. Locally-originated traces mint
+    their own."""
+
+    def __init__(self, label: str, tags: dict | None = None,
+                 trace_id: str | None = None) -> None:
         self.root = Span("query", dict(tags or ()))
         self.root.tags["q"] = label
+        self.trace_id = trace_id or new_trace_id()
 
     @property
     def total_ms(self) -> float:

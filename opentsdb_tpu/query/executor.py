@@ -438,7 +438,8 @@ class QueryExecutor:
         return self.run_with_plan(spec, start, end)[0]
 
     def run_with_plan(self, spec: QuerySpec, start: int, end: int,
-                      trace=None) -> tuple[list[QueryResult], str, bool]:
+                      trace=None, rollup_only: bool = False,
+                      ) -> tuple[list[QueryResult], str, bool]:
         """run() plus the planner-choice label for THIS call ("raw",
         "resident", or a rollup resolution like "1h") and whether the
         answer came ENTIRELY from the warm fragment cache. Returned
@@ -449,19 +450,29 @@ class QueryExecutor:
         — planner pick, rollup read / raw stitch, storage scan with
         per-shard fan-out and per-chunk decode, aggregation — record
         themselves as a span tree under ``trace.root``. None (the
-        default) costs one global-int check per hook."""
+        default) costs one global-int check per hook.
+
+        ``rollup_only`` is the load-shedding ladder's degraded step
+        (serve/admission.py): serve from the materialized tier with NO
+        raw work — dirty/edge windows are omitted instead of stitched
+        (the caller tags the result "degraded") — and raise
+        OverloadedError for queries the tier cannot serve at all.
+        Device-resident answers stay allowed: they're exact and
+        storage-free."""
         if trace is None:
-            results, plan, cached = self._run_planned(spec, start, end)
+            results, plan, cached = self._run_planned(
+                spec, start, end, rollup_only=rollup_only)
         else:
             with obs_trace.activate(trace):
-                results, plan, cached = self._run_planned(spec, start,
-                                                          end)
+                results, plan, cached = self._run_planned(
+                    spec, start, end, rollup_only=rollup_only)
             trace.root.tags["plan"] = plan
             trace.root.tags["cached"] = bool(cached)
         self.last_plan = plan
         return results, plan, cached
 
     def _run_planned(self, spec: QuerySpec, start: int, end: int,
+                     rollup_only: bool = False,
                      ) -> tuple[list[QueryResult], str, bool]:
         if end <= start:
             raise BadRequestError(
@@ -484,7 +495,14 @@ class QueryExecutor:
             dev = self._run_devwindow(spec, start, end, agg)
             planned = None
             if dev is None:
-                planned = self._plan_rollup(spec, start, end)
+                planned = self._plan_rollup(spec, start, end,
+                                            rollup_only=rollup_only)
+            if dev is None and planned is None and rollup_only:
+                from opentsdb_tpu.core.errors import OverloadedError
+                raise OverloadedError(
+                    "shedding load: this query needs a raw scan "
+                    "(no eligible rollup resolution); retry shortly",
+                    retry_after=0.5, status=503)
             if sp is not None:
                 if dev is not None:
                     sp.tags["plan"] = "resident"
@@ -513,11 +531,13 @@ class QueryExecutor:
             results = self._execute_groups(spec, groups, start, end)
         return results, "raw", bool(info.get("cached"))
 
-    def _plan_rollup(self, spec: QuerySpec, start: int, end: int):
+    def _plan_rollup(self, spec: QuerySpec, start: int, end: int,
+                     rollup_only: bool = False):
         if getattr(self.tsdb, "rollups", None) is None:
             return None
         from opentsdb_tpu.rollup import planner
-        return planner.plan(self, spec, start, end)
+        return planner.plan(self, spec, start, end,
+                            rollup_only=rollup_only)
 
     def _execute_groups(self, spec: QuerySpec, groups: dict,
                         start: int, end: int) -> list[QueryResult]:

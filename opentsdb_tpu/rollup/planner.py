@@ -67,7 +67,13 @@ def window_split(start: int, end: int, res: int):
     return w_lo, w_hi, edges
 
 
-def plan(executor, spec, start: int, end: int):
+def plan(executor, spec, start: int, end: int,
+         rollup_only: bool = False):
+    """``rollup_only`` (load shedding's degraded step): serve from the
+    tier records alone — no raw stitching, no mostly-dirty bailout —
+    omitting dirty/edge windows instead of scanning them. The caller
+    tags such results degraded; queries the tier can't serve at all
+    still return None (the executor turns that into 503)."""
     tsdb = executor.tsdb
     tier = getattr(tsdb, "rollups", None)
     if tier is None:
@@ -94,7 +100,8 @@ def plan(executor, spec, start: int, end: int):
         tier.note_miss()
         return None
     sel = _select_windows(executor, tier, spec.metric, spec.tags,
-                          start, end, res, want_sketches=False)
+                          start, end, res, want_sketches=False,
+                          rollup_only=rollup_only)
     if sel is None:
         return None
     records, raw_parts, dirty_set = sel
@@ -219,7 +226,7 @@ def sketch_windows(executor, tier, metric: str, tags: dict,
 
 def _select_windows(executor, tier, metric: str, tags: dict,
                     start: int, end: int, res: int,
-                    want_sketches: bool):
+                    want_sketches: bool, rollup_only: bool = False):
     """THE range selection, shared by plan() and sketch_windows() so
     moment queries and sketch endpoints can never disagree on which
     windows serve from the tier: split [start, end] into full windows
@@ -236,7 +243,11 @@ def _select_windows(executor, tier, metric: str, tags: dict,
     dirty = np.unique(hours - hours % res) if len(hours) else hours
     dirty = dirty[(dirty >= w_lo) & (dirty <= w_hi)]
     n_windows = (w_hi - w_lo) // res + 1
-    if len(dirty) > _MAX_DIRTY_FRACTION * n_windows:
+    if (not rollup_only
+            and len(dirty) > _MAX_DIRTY_FRACTION * n_windows):
+        # A mostly-dirty range would degenerate into a slower raw
+        # scan. Under rollup_only the comparison is moot — there IS no
+        # raw path — so serve whatever clean windows exist.
         tier.note_fallback("mostly-dirty")
         return None
     # Raw path setup shared with the scan planner: same UID filters,
@@ -253,6 +264,10 @@ def _select_windows(executor, tier, metric: str, tags: dict,
             sp.tags["series"] = len(records)
             sp.tags["dirty_windows"] = int(len(dirty))
     dirty_set = frozenset(int(b) for b in dirty)
+    if rollup_only:
+        # Degraded: dirty and edge windows are OMITTED, not stitched —
+        # the whole point is spending zero raw-scan work per query.
+        return records, {}, dirty_set
     raw_ranges = _coalesce(
         edges + [(int(w), int(w) + res - 1) for w in dirty_set])
     raw_parts = _scan_raw_parts(executor, metric_uid, regexp,

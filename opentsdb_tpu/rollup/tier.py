@@ -190,6 +190,53 @@ class _MapBuffer:
 
 class RollupTier:
     def __init__(self, tsdb, config) -> None:
+        self._init_layout(tsdb, config)
+        store = tsdb.store
+        st = self._read_state()
+        needs_rebuild = self._needs_rebuild(st)
+        if needs_rebuild:
+            for dirs in self._dirs.values():
+                for d in dirs:
+                    shutil.rmtree(d, ignore_errors=True)
+        try:
+            for r in self.resolutions:
+                self.stores[r] = []
+                for d in self._dirs[r]:
+                    s = MemKVStore(wal_path=os.path.join(d, "wal"))
+                    s.ensure_table(self.table)
+                    self.stores[r].append(s)
+        except BaseException:
+            self.close()
+            raise
+        store.record_spill_keys = True
+        if needs_rebuild:
+            self._behind = True
+            self._write_state(pending=True)
+            mode = getattr(config, "rollup_catchup", "background")
+            if mode == "sync":
+                self._rebuilding = True
+                self._rebuild()
+            elif mode == "background":
+                self._rebuilding = True
+                self._rebuild_thread = threading.Thread(
+                    target=self._rebuild, daemon=True,
+                    name="rollup-catchup")
+                self._rebuild_thread.start()
+            # "off": stays pending/not-ready; planner serves raw.
+        else:
+            self._write_state(pending=False)
+            self._ready = True
+
+    # Writer tier unless ReadOnlyRollupTier overrides it: consumers
+    # (TSDB.refresh_replica, stats) branch on this, not on class.
+    read_only = False
+
+    def _init_layout(self, tsdb, config) -> None:
+        """Everything shared between the writer tier and the read-only
+        replica tier: config validation, per-shard directory layout,
+        state-file path, counters, and the planner-facing flags.
+        Leaves ``self.stores`` EMPTY — each subclass opens them with
+        its own store mode (writable vs read-only replica)."""
         self.tsdb = tsdb
         self.table = config.table
         res = tuple(sorted(int(r) for r in config.rollup_resolutions))
@@ -266,42 +313,7 @@ class RollupTier:
                                  for d in base_dirs]
             else:
                 self._dirs[r] = [f"{base_dirs[0]}.rollup-{r}"]
-
-        st = self._read_state()
-        needs_rebuild = self._needs_rebuild(st)
-        if needs_rebuild:
-            for dirs in self._dirs.values():
-                for d in dirs:
-                    shutil.rmtree(d, ignore_errors=True)
         self.stores: dict[int, list[MemKVStore]] = {}
-        try:
-            for r in res:
-                self.stores[r] = []
-                for d in self._dirs[r]:
-                    s = MemKVStore(wal_path=os.path.join(d, "wal"))
-                    s.ensure_table(self.table)
-                    self.stores[r].append(s)
-        except BaseException:
-            self.close()
-            raise
-        store.record_spill_keys = True
-        if needs_rebuild:
-            self._behind = True
-            self._write_state(pending=True)
-            mode = getattr(config, "rollup_catchup", "background")
-            if mode == "sync":
-                self._rebuilding = True
-                self._rebuild()
-            elif mode == "background":
-                self._rebuilding = True
-                self._rebuild_thread = threading.Thread(
-                    target=self._rebuild, daemon=True,
-                    name="rollup-catchup")
-                self._rebuild_thread.start()
-            # "off": stays pending/not-ready; planner serves raw.
-        else:
-            self._write_state(pending=False)
-            self._ready = True
 
     # -- state file --------------------------------------------------------
 
@@ -943,3 +955,169 @@ class RollupTier:
         for stores in self.stores.values():
             for s in stores:
                 s._simulate_crash()
+
+
+class ReadOnlyRollupTier(RollupTier):
+    """Replica-side rollup READS (the ROADMAP "read-only tier" item).
+
+    A replica daemon opens the writer's rollup stores read-only and
+    serves the same planner surface — ``scan_records`` /
+    ``pick_resolution`` / ``dirty_hour_bases`` — so long-range
+    downsamples cost O(windows) on replicas too, not just the writer.
+    It never folds, never rebuilds, never writes ROLLUP.json.
+
+    Correctness leans on refresh ORDER plus the writer's spill
+    bracket. ``refresh()`` must run AFTER the raw store's refresh:
+
+    1. The raw view is fixed at T_raw; every raw row it considers
+       clean (not memtable-resident) was spilled by a checkpoint that
+       STARTED before T_raw.
+    2. ``begin_spill`` writes ``pending`` durably BEFORE any raw
+       spill, and ``pending=false`` lands only after that spill's fold
+       is durable in the rollup WALs. So reading ``ok`` at T > T_raw
+       proves every spill the raw view contains has a durable fold.
+    3. Refreshing the rollup stores after that read therefore captures
+       a fold superset of the raw view's spilled data. Newer folds the
+       refresh may half-capture only touch windows whose rows are
+       still memtable-dirty in the raw view — windows the planner
+       stitches from raw anyway.
+
+    A ``pending`` state (writer mid-checkpoint, crashed bracket,
+    rebuild in progress) simply parks the tier not-ready: the planner
+    degrades to raw, exactly like a writer-side rebuild.
+    """
+
+    read_only = True
+
+    def __init__(self, tsdb, config) -> None:
+        if not getattr(tsdb.store, "read_only", False):
+            raise ValueError("ReadOnlyRollupTier serves a READ-ONLY "
+                             "replica store; writers own RollupTier")
+        self._init_layout(tsdb, config)
+        # Serializes refresh() against itself: a serve-tier replica
+        # can have BOTH the WalTailer and the compaction timer driving
+        # refresh_replica(), and interleaved open/adopt sequences
+        # would race store handles.
+        self._refresh_lock = threading.Lock()
+        # Stores retired by a layout adoption, closed only at
+        # close(): an in-flight query may still be scanning them, and
+        # a handful of leaked read-only handles across rare operator
+        # layout changes beats serving a 500 from a closed store.
+        self._retired: list[MemKVStore] = []
+        # Best effort at open: a missing/pending tier leaves the
+        # replica serving raw until the tailer's next cycle.
+        self.refresh()
+
+    # -- the replica surface ---------------------------------------------
+
+    def refresh(self) -> bool:
+        """One catch-up cycle (call AFTER the raw store's refresh; the
+        class docstring has the ordering proof). Returns the resulting
+        readiness. Any failure — state unreadable, store churn beyond
+        the open retries, injected fault — degrades to not-ready
+        rather than raising: replicas must keep serving.
+
+        Concurrency contract with in-flight queries: ``self.stores``
+        is only ever swapped WHOLE (never mutated in place) and
+        replaced stores are parked in ``_retired`` instead of closed,
+        so a query that passed the ``ready`` check keeps a coherent
+        (possibly one-cycle-stale) view; transient failures keep the
+        previous stores serving and merely drop ``ready``."""
+        with self._refresh_lock:
+            return self._refresh_locked()
+
+    def _refresh_locked(self) -> bool:
+        st = self._read_state()
+        if st is None or st.get("pending", True):
+            self._ready = False
+            return False
+        try:
+            if any(st.get(k) != v
+                   for k, v in self._config_dict().items()):
+                # The writer changed the tier layout (resolutions,
+                # pack, sketch knobs): adopt it and reopen from empty.
+                self._adopt_state(st)
+            if not self.stores:
+                self.stores = self._open_stores()
+            else:
+                for stores in self.stores.values():
+                    for s in stores:
+                        s.refresh()
+        except Exception as e:
+            LOG.warning("replica rollup refresh degraded to raw: %r", e)
+            self._ready = False
+            return False
+        # Re-read the state AFTER the store refresh: a writer that
+        # went pending (or started a layout-change rebuild, which
+        # rmtrees the dirs) mid-refresh may have fed us partial data —
+        # ok-before AND ok-after brackets a coherent capture.
+        st2 = self._read_state()
+        self._ready = (st2 is not None
+                       and not st2.get("pending", True)
+                       and st2 == st)
+        return self._ready
+
+    def _open_stores(self) -> dict[int, list[MemKVStore]]:
+        out: dict[int, list[MemKVStore]] = {}
+        try:
+            for r in self.resolutions:
+                out[r] = []
+                for d in self._dirs[r]:
+                    s = MemKVStore(wal_path=os.path.join(d, "wal"),
+                                   read_only=True)
+                    s.ensure_table(self.table)
+                    out[r].append(s)
+        except BaseException:
+            for stores in out.values():
+                for s in stores:
+                    try:
+                        s.close()
+                    except Exception:
+                        pass
+            raise
+        return out
+
+    def _adopt_state(self, st: dict) -> None:
+        """Re-derive the layout from the writer's new state file (the
+        in-place twin of ``adopt_config``): retire the old stores and
+        recompute the per-resolution directory lists."""
+        self._ready = False
+        for stores in self.stores.values():
+            self._retired.extend(stores)
+        self.stores = {}
+        self.resolutions = tuple(int(r) for r in st["resolutions"])
+        self.pack = int(st["pack"])
+        self.digest_k = int(st["digest_k"])
+        self.hll_p = int(st["hll_p"])
+        self.sketch_min_res = int(st["sketch_min_res"])
+        base = os.path.dirname(self.state_path)
+        self._dirs = {}
+        for r in self.resolutions:
+            if self._sharded:
+                self._dirs[r] = [
+                    os.path.join(base, f"shard-{i}", f"rollup-{r}")
+                    for i in range(self.shard_count)]
+            else:
+                wal = self.tsdb.store._wal_path
+                self._dirs[r] = [f"{wal}.rollup-{r}"]
+        self.hits = {r: self.hits.get(r, 0) for r in self.resolutions}
+
+    # -- writer entry points: refuse loudly ------------------------------
+
+    def begin_spill(self) -> None:
+        raise RuntimeError("read-only rollup tier cannot spill")
+
+    def fold_after_spill(self) -> None:
+        raise RuntimeError("read-only rollup tier cannot fold")
+
+    def close(self) -> None:
+        with self._refresh_lock:
+            for stores in self.stores.values():
+                self._retired.extend(stores)
+            self.stores = {}
+            retired, self._retired = self._retired, []
+        for s in retired:
+            try:
+                s.close()
+            except Exception:
+                pass

@@ -1,0 +1,23 @@
+"""Distributed serve tier: streaming replicas, query router, admission.
+
+The reference delegates scale-out and failover to HBase region servers
+(PAPER.md §1); this package is the engine-native replacement:
+
+- ``tailer.WalTailer``: turns a read-only replica daemon from a
+  checkpoint-interval poller into a continuous WAL tail with a
+  measured, bounded staleness contract (``replica.lag_ms`` vs
+  ``Config.max_staleness_ms``).
+- ``admission``: per-tenant token buckets, a bounded ingest queue, and
+  the query load-shedding ladder — the daemon sheds with
+  429/503 + Retry-After before memory does, and degrades query service
+  in declared steps instead of collapsing.
+- ``router.RouterServer``: the stateless front door that fans ``/q``
+  across replicas by series-hash ownership with per-hop deadlines,
+  retries on a different replica, hedged requests, and automatic
+  ejection/readmission via ``/healthz`` probes.
+"""
+
+from opentsdb_tpu.serve.admission import AdmissionController, TokenBucket
+from opentsdb_tpu.serve.tailer import WalTailer
+
+__all__ = ["AdmissionController", "TokenBucket", "WalTailer"]

@@ -1,0 +1,800 @@
+"""The front-door query router: fan ``/q`` across replicas and stay up.
+
+A stateless asyncio daemon (``tsd --role router``) in front of N
+replica daemons (and optionally the writer for forwarded puts). It
+holds no storage and imports no jax — a router restarts in well under
+a second, which is the point: the failure domain of the front door is
+as small as it can be.
+
+Request handling, in contract order:
+
+- **Ownership**: each ``m=`` sub-query routes to the replica that owns
+  its metric's series hash (``sstable.series_hash`` — the same crc32
+  chain the shard router and the blooms use), so repeat dashboards hit
+  the same replica's warm fragment cache instead of spreading cold
+  decodes over the fleet.
+- **Deadlines**: one budget per request (``Config.router_deadline_ms``);
+  every hop gets the remainder, so a wedged replica costs bounded time.
+- **Retries**: a failed/expired hop retries on the NEXT healthy
+  replica with capped exponential backoff (``router_retries``,
+  ``router_backoff_ms``) — never the same replica twice in a row.
+- **Hedging**: when a hop is slower than the hedge delay (fixed
+  ``router_hedge_ms``, or derived from the observed p95 hop latency
+  when 0), a duplicate fires at the next replica; first response wins
+  and the loser is CANCELLED (recorded as a cancelled child span in
+  the trace tree — the tail-latency debugging story).
+- **Health**: a background probe hits every replica's ``/healthz``
+  each ``probe_interval_s``; ``router_eject_after`` consecutive
+  failures eject it from rotation, the next healthy probe readmits
+  it. Stale-but-alive replicas stay usable at lowest preference, and
+  their answers keep the ``degraded`` tag they arrived with.
+- **Admission**: the same per-tenant query buckets + in-flight ladder
+  as the daemons (sans the rollup-only step, which is the replicas'
+  job) — the router sheds with 429/503 + Retry-After before its own
+  event loop drowns.
+
+Telnet connections are sniffed exactly like the TSD and ``put`` lines
+forward to ``Config.writer_url`` under ingest admission; everything
+else about writes stays the writer's business.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import urllib.parse
+
+from opentsdb_tpu.build_data import version_string
+from opentsdb_tpu.obs import trace as obs_trace
+from opentsdb_tpu.obs.registry import METRICS
+from opentsdb_tpu.obs.ring import TraceRing
+from opentsdb_tpu.serve.admission import (DEGRADE, SHED_LOAD,
+                                          SHED_QUOTA,
+                                          AdmissionController)
+from opentsdb_tpu.stats.collector import LatencyDigest, StatsCollector
+from opentsdb_tpu.storage.sstable import series_hash
+
+LOG = logging.getLogger(__name__)
+
+_M_FANOUTS = METRICS.counter("router.fanouts")
+_M_RETRIES = METRICS.counter("router.retries")
+_M_HEDGES = METRICS.counter("router.hedges")
+_M_HEDGE_WINS = METRICS.counter("router.hedge_wins")
+_M_EJECTED = METRICS.counter("router.ejections")
+_M_READMITTED = METRICS.counter("router.readmissions")
+_M_HOP = METRICS.timer("router.hop")
+_M_ERRORS = METRICS.counter("router.hop_errors")
+
+# Hedge-delay bounds when derived from the p95: never hedge absurdly
+# early (doubling every request's load) nor later than half the
+# remaining budget (a hedge that can't finish is noise).
+_HEDGE_FLOOR_MS = 10.0
+
+
+class Backend:
+    """One replica as the router sees it."""
+
+    def __init__(self, url: str) -> None:
+        self.url = url.rstrip("/")
+        parsed = urllib.parse.urlsplit(self.url)
+        if parsed.scheme != "http" or not parsed.hostname:
+            raise ValueError(f"backend must be http://host:port, "
+                             f"got {url!r}")
+        self.host = parsed.hostname
+        self.port = parsed.port or 80
+        self.healthy = True          # in rotation?
+        self.stale = False           # serving, but beyond its contract
+        self.consecutive_fails = 0
+        self.probes = 0
+        self.latency = LatencyDigest()
+        self.last_health: dict = {}
+
+    def snapshot(self) -> dict:
+        return {"url": self.url, "healthy": self.healthy,
+                "stale": self.stale,
+                "consecutive_fails": self.consecutive_fails,
+                "hop_p95_ms": round(self.latency.percentile(95), 3)
+                if self.latency.count else None,
+                "health": self.last_health}
+
+
+class HopError(Exception):
+    """One backend hop failed (connect/timeout/5xx); retryable."""
+
+
+async def _http_fetch(host: str, port: int, target: str,
+                      timeout_s: float) -> tuple[int, dict, bytes]:
+    """Minimal one-shot HTTP/1.0-style GET (Connection: close). The
+    router's hops are coarse (one per sub-query), so per-hop connection
+    setup is noise next to the query itself — and one-shot connections
+    make cancellation trivially safe: closing the socket IS the
+    cancel."""
+
+    async def _go():
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            writer.write((f"GET {target} HTTP/1.1\r\n"
+                          f"Host: {host}:{port}\r\n"
+                          f"Connection: close\r\n\r\n").encode())
+            await writer.drain()
+            raw = await reader.read()
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+        head, sep, body = raw.partition(b"\r\n\r\n")
+        if not sep:
+            raise HopError(f"short response from {host}:{port}")
+        lines = head.decode("latin-1").split("\r\n")
+        status = int(lines[0].split(" ", 2)[1])
+        headers = {}
+        for ln in lines[1:]:
+            k, _, v = ln.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        return status, headers, body
+
+    try:
+        return await asyncio.wait_for(_go(), timeout=timeout_s)
+    except asyncio.TimeoutError:
+        raise HopError(
+            f"hop to {host}:{port} exceeded {timeout_s * 1000:.0f}ms "
+            f"deadline") from None
+    except OSError as e:
+        raise HopError(f"hop to {host}:{port} failed: {e}") from None
+
+
+class RouterServer:
+    def __init__(self, config) -> None:
+        self.config = config
+        backends = list(getattr(config, "router_backends", ()) or ())
+        if not backends:
+            raise ValueError("router role needs --backends "
+                             "(comma-separated replica URLs)")
+        self.backends = [Backend(u) for u in backends]
+        self.writer_url = getattr(config, "writer_url", None)
+        self._writer = Backend(self.writer_url) if self.writer_url \
+            else None
+        self.admission = AdmissionController(config)
+        self.trace_ring = TraceRing(getattr(config, "trace_ring", 256))
+        self._server: asyncio.AbstractServer | None = None
+        self._shutdown = asyncio.Event()
+        self._probe_task: asyncio.Task | None = None
+        self.start_time = int(time.time())
+        self.http_rpcs = 0
+        self.telnet_lines_forwarded = 0
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.config.bind, self.config.port)
+        self._probe_task = asyncio.create_task(self._probe_loop())
+        LOG.info("Router ready on %s:%d over %d backends",
+                 self.config.bind, self.port, len(self.backends))
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        await self._shutdown.wait()
+        await self.stop()
+
+    async def stop(self) -> None:
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._probe_task = None
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def request_shutdown(self) -> None:
+        self._shutdown.set()
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    # ------------------------------------------------------------------
+    # Health probing: ejection + readmission
+    # ------------------------------------------------------------------
+
+    async def _probe_loop(self) -> None:
+        interval = float(getattr(self.config, "probe_interval_s", 1.0))
+        while True:
+            await asyncio.gather(
+                *(self._probe_one(b) for b in self.backends),
+                return_exceptions=True)
+            await asyncio.sleep(interval)
+
+    async def _probe_one(self, b: Backend) -> None:
+        b.probes += 1
+        try:
+            status, _, body = await _http_fetch(
+                b.host, b.port, "/healthz", timeout_s=2.0)
+            health = json.loads(body)
+        except (HopError, ValueError):
+            self._note_failure(b)
+            return
+        b.last_health = health
+        b.consecutive_fails = 0
+        # 503 + stale is a REPLICA KEEPING ITS CONTRACT, not a dead
+        # box: keep it at lowest preference (its answers carry the
+        # degraded tag) instead of pretending it's gone.
+        b.stale = bool(health.get("stale"))
+        if not b.healthy:
+            b.healthy = True
+            _M_READMITTED.inc()
+            LOG.info("backend %s readmitted", b.url)
+
+    def _note_failure(self, b: Backend) -> None:
+        b.consecutive_fails += 1
+        eject_after = int(getattr(self.config, "router_eject_after",
+                                  3) or 3)
+        if b.healthy and b.consecutive_fails >= eject_after:
+            b.healthy = False
+            _M_EJECTED.inc()
+            LOG.warning("backend %s ejected after %d failures",
+                        b.url, b.consecutive_fails)
+
+    def _candidates(self, owner: int) -> list[Backend]:
+        """Attempt order for a sub-query owned by backend index
+        ``owner``: the owner first, then the ring — healthy-and-fresh
+        before healthy-but-stale before ejected (a fully dark fleet
+        still gets ONE desperate attempt rather than an instant 502)."""
+        ring = [self.backends[(owner + i) % len(self.backends)]
+                for i in range(len(self.backends))]
+        fresh = [b for b in ring if b.healthy and not b.stale]
+        stale = [b for b in ring if b.healthy and b.stale]
+        dark = [b for b in ring if not b.healthy]
+        return fresh + stale + dark
+
+    # ------------------------------------------------------------------
+    # Connection handling (the TSD's first-byte sniff)
+    # ------------------------------------------------------------------
+
+    async def _handle_conn(self, reader, writer) -> None:
+        try:
+            first = await reader.read(1)
+            if not first:
+                return
+            if b"A" <= first <= b"Z":
+                await self._handle_http(first, reader, writer)
+            else:
+                await self._handle_telnet(first, reader, writer)
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            LOG.exception("router connection error")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # Telnet: forward puts to the writer under ingest admission
+    # ------------------------------------------------------------------
+
+    async def _handle_telnet(self, first: bytes, reader, writer) -> None:
+        upstream = None
+        try:
+            buf = first
+            while True:
+                nl = buf.find(b"\n")
+                if nl < 0:
+                    chunk = await reader.read(1 << 16)
+                    if not chunk:
+                        return
+                    buf += chunk
+                    continue
+                line, buf = buf[:nl], buf[nl + 1:]
+                text = line.decode("utf-8", "replace").rstrip("\r")
+                if text == "version":
+                    writer.write(
+                        f"router {version_string()}".encode())
+                    await writer.drain()
+                    continue
+                if text == "exit":
+                    return
+                if not text.startswith("put "):
+                    writer.write(b"unknown command: "
+                                 + text.split(" ", 1)[0].encode()
+                                 + b"\n")
+                    await writer.drain()
+                    continue
+                if self._writer is None:
+                    writer.write(b"put: no writer configured on this "
+                                 b"router\n")
+                    await writer.drain()
+                    continue
+                wait = self.admission.admit_ingest(1)
+                if wait > 0:
+                    writer.write(
+                        f"put: Please throttle writes: over ingest "
+                        f"quota, retry after {max(wait, 0.1):.1f}s\n"
+                        .encode())
+                    await writer.drain()
+                    continue
+                try:
+                    if upstream is None:
+                        upstream = await asyncio.open_connection(
+                            self._writer.host, self._writer.port)
+                    upstream[1].write(line + b"\n")
+                    await upstream[1].drain()
+                    self.telnet_lines_forwarded += 1
+                finally:
+                    self.admission.ingest_done(1)
+        finally:
+            if upstream is not None:
+                # Drain the writer's error lines (if any) back to the
+                # client before closing — they're the put's only ack.
+                up_reader, up_writer = upstream
+                try:
+                    up_writer.write_eof()
+                    back = await asyncio.wait_for(up_reader.read(),
+                                                  timeout=5.0)
+                    if back:
+                        writer.write(back)
+                        await writer.drain()
+                except Exception:
+                    pass
+                up_writer.close()
+
+    # ------------------------------------------------------------------
+    # HTTP
+    # ------------------------------------------------------------------
+
+    async def _handle_http(self, first: bytes, reader, writer) -> None:
+        data = first
+        while True:
+            while b"\r\n\r\n" not in data:
+                chunk = await reader.read(4096)
+                if not chunk:
+                    return
+                data += chunk
+                if len(data) > 65536:
+                    await self._respond(writer, 431, "text/plain",
+                                        b"headers too large\n", {},
+                                        False)
+                    return
+            head, _, data = data.partition(b"\r\n\r\n")
+            lines = head.decode("latin-1").split("\r\n")
+            try:
+                method, target, version = lines[0].split(" ", 2)
+            except ValueError:
+                return
+            headers = {}
+            for ln in lines[1:]:
+                k, _, v = ln.partition(":")
+                headers[k.strip().lower()] = v.strip()
+            keep = (version.strip().upper() == "HTTP/1.1"
+                    and headers.get("connection", "").lower()
+                    != "close")
+            self.http_rpcs += 1
+            try:
+                status, ctype, body, extra = await self._route(target)
+            except Exception as e:
+                LOG.exception("router error on %s", target)
+                status, ctype, body, extra = (
+                    500, "text/plain",
+                    f"router error: {e}\n".encode(), {})
+            await self._respond(writer, status, ctype, body, extra,
+                                keep)
+            if not keep:
+                return
+
+    async def _respond(self, writer, status, ctype, body, extra,
+                       keep) -> None:
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  429: "Too Many Requests", 500: "Internal Server Error",
+                  502: "Bad Gateway",
+                  503: "Service Unavailable"}.get(status, "OK")
+        hdrs = [f"HTTP/1.1 {status} {reason}",
+                f"Content-Type: {ctype}",
+                f"Content-Length: {len(body)}",
+                f"Connection: {'keep-alive' if keep else 'close'}"]
+        for k, v in extra.items():
+            hdrs.append(f"{k}: {v}")
+        writer.write(("\r\n".join(hdrs) + "\r\n\r\n").encode() + body)
+        await writer.drain()
+
+    async def _route(self, target: str):
+        parsed = urllib.parse.urlsplit(target)
+        path = parsed.path.rstrip("/") or "/"
+        params = urllib.parse.parse_qs(parsed.query,
+                                       keep_blank_values=True)
+        q = {k: v[-1] for k, v in params.items()}
+        if path == "/q":
+            return await self._query(parsed.query, q, params)
+        if path == "/healthz":
+            return self._healthz()
+        if path == "/stats":
+            return self._stats(q)
+        if path == "/metrics":
+            body = METRICS.prometheus_text(
+                extra_lines=self._collect_stats())
+            return (200, "text/plain; version=0.0.4; charset=utf-8",
+                    body.encode(), {})
+        if path == "/api/traces":
+            records = self.trace_ring.snapshot()
+            return (200, "application/json",
+                    json.dumps(records).encode(), {})
+        if path in ("/aggregators", "/version", "/suggest"):
+            # Storage-free passthroughs any healthy replica answers.
+            return await self._proxy_any(target)
+        return 404, "text/plain", b"Page Not Found\n", {}
+
+    def _healthz(self) -> tuple:
+        ok = any(b.healthy for b in self.backends)
+        body = {
+            "role": "router",
+            "ok": ok,
+            "backends": [b.snapshot() for b in self.backends],
+            "uptime_s": int(time.time()) - self.start_time,
+            "inflight_queries": self.admission.inflight_queries,
+        }
+        return (200 if ok else 503, "application/json",
+                json.dumps(body).encode(), {})
+
+    def _collect_stats(self) -> list[str]:
+        c = StatsCollector("tsd")
+        c.record("router.backends", len(self.backends))
+        c.record("router.backends_healthy",
+                 sum(1 for b in self.backends if b.healthy))
+        c.record("router.http_rpcs", self.http_rpcs)
+        c.record("router.put_lines_forwarded",
+                 self.telnet_lines_forwarded)
+        c.record("uptime_s", int(time.time()) - self.start_time)
+        self.admission.collect_stats(c)
+        METRICS.collect(c)
+        return c.lines
+
+    def _stats(self, q) -> tuple:
+        lines = self._collect_stats()
+        if "json" in q:
+            return (200, "application/json",
+                    json.dumps(lines).encode(), {})
+        return (200, "text/plain",
+                ("\n".join(lines) + "\n").encode(), {})
+
+    async def _proxy_any(self, target: str) -> tuple:
+        for b in self._candidates(0):
+            try:
+                status, headers, body = await _http_fetch(
+                    b.host, b.port, target, timeout_s=5.0)
+            except HopError:
+                self._note_failure(b)
+                continue
+            return (status,
+                    headers.get("content-type", "text/plain"), body,
+                    {})
+        return 502, "text/plain", b"no healthy backend\n", {}
+
+    # ------------------------------------------------------------------
+    # /q: ownership fan-out + deadlines + retries + hedging
+    # ------------------------------------------------------------------
+
+    async def _query(self, query_string: str, q, params) -> tuple:
+        ms = params.get("m", [])
+        if not ms or "start" not in q:
+            return (400, "text/plain",
+                    b"Missing parameter: start and m\n", {})
+        verdict, retry = self.admission.admit_query(
+            q.get("tenant", "default"))
+        if verdict == SHED_QUOTA:
+            return (429, "text/plain", b"query quota exceeded\n",
+                    {"Retry-After": str(max(1, round(retry + 0.5)))})
+        if verdict == SHED_LOAD:
+            return (503, "text/plain",
+                    b"router shedding load\n",
+                    {"Retry-After": str(max(1, round(retry + 0.5)))})
+        try:
+            return await self._query_admitted(
+                query_string, q, params, ms,
+                degrade=(verdict == DEGRADE))
+        finally:
+            self.admission.query_done()
+
+    async def _query_admitted(self, query_string: str, q, params, ms,
+                              degrade: bool) -> tuple:
+        _M_FANOUTS.inc()
+        want_trace = q.get("trace", "0") not in ("", "0")
+        trace_id = obs_trace.new_trace_id()
+        deadline = time.monotonic() + float(
+            getattr(self.config, "router_deadline_ms", 10_000)) / 1000.0
+        want_json = "json" in q or want_trace
+        png = not ("json" in q or "ascii" in q)
+
+        base = {k: v for k, v in
+                urllib.parse.parse_qsl(query_string,
+                                       keep_blank_values=True)
+                if k != "m"}
+        # Hops always speak JSON (the only mergeable body); the
+        # client-facing format is rebuilt from the merged results.
+        base.pop("ascii", None)
+        base.pop("png", None)
+        base.pop("trace", None)
+        base.pop("trace_parent", None)
+        if want_trace:
+            base["trace"] = "1"
+            base["trace_parent"] = trace_id
+        if degrade:
+            # The router's degraded ladder step IS the daemon's: strip
+            # trace work and tell the replicas to serve rollup-only
+            # (no raw stitching; raw-only queries come back 503 +
+            # Retry-After, which is the declared contract — "reject
+            # raw-stitch work first").
+            base.pop("trace", None)
+            base.pop("trace_parent", None)
+            base["degrade"] = "rollup-only"
+            want_trace = False
+
+        if png:
+            # PNG rendering can't be merged across hops: proxy the
+            # whole query to one owner replica (retries still apply).
+            # Built from the REWRITTEN base, not the raw query string:
+            # the degradation ladder must bite the default output
+            # format too, or browser dashboards dodge load shedding.
+            owner = series_hash(ms[0].encode()) % len(self.backends)
+            target = "/q?" + urllib.parse.urlencode(
+                list(base.items()) + [("m", m) for m in ms])
+            status, ctype, body, extra, _spans = await self._hop(
+                target, owner, deadline, sub=ms[0])
+            return status, ctype, body, extra
+
+        # One hop per m= sub-query, all concurrent; each hop retries
+        # and hedges independently. Ownership hashes the SUB-QUERY
+        # spec (not just the metric): distinct aggregations of one
+        # metric spread while repeats of the same panel stay hot on
+        # one replica.
+        t0 = time.monotonic()
+        hops = [self._hop(
+            "/q?" + urllib.parse.urlencode(
+                dict(base, m=m, json="")),
+            series_hash(m.encode()) % len(self.backends),
+            deadline, sub=m)
+            for m in ms]
+        outs = await asyncio.gather(*hops, return_exceptions=True)
+
+        results: list[dict] = []
+        degraded_tags: set[str] = set()
+        hop_spans: list[dict] = []
+        for m, out in zip(ms, outs):
+            if isinstance(out, BaseException):
+                return (502, "text/plain",
+                        f"all replicas failed for {m}: {out}\n"
+                        .encode(), {})
+            status, ctype, body, extra, spans = out
+            hop_spans.extend(spans)
+            if status != 200:
+                return (status, ctype, body, extra)
+            tag = extra.get("X-Tsd-Degraded")
+            if tag:
+                degraded_tags.update(tag.split(","))
+            try:
+                results.extend(json.loads(body))
+            except ValueError:
+                return (502, "text/plain",
+                        f"bad replica body for {m}\n".encode(), {})
+        if degrade:
+            degraded_tags.add("rollup-only")
+
+        extra = {}
+        if degraded_tags:
+            tag = ",".join(sorted(degraded_tags))
+            extra["X-Tsd-Degraded"] = tag
+            for ent in results:
+                ent["degraded"] = ",".join(sorted(
+                    set(ent.get("degraded", "").split(","))
+                    - {""} | degraded_tags))
+        wall_ms = (time.monotonic() - t0) * 1000.0
+
+        if want_trace:
+            record = {
+                "ts": int(time.time()),
+                "trace_id": trace_id,
+                "q": query_string,
+                "wall_ms": round(wall_ms, 3),
+                "plan": "router",
+                "slow": False,
+                "router": True,
+                "trace": {"name": "router.query",
+                          "ms": round(wall_ms, 3),
+                          "tags": {"q": query_string,
+                                   "m": len(ms)},
+                          "spans": hop_spans},
+            }
+            self.trace_ring.add(record)
+
+        if "ascii" in q:
+            out_lines = []
+            for ent in results:
+                tag_str = " ".join(f"{k}={v}" for k, v in
+                                   sorted(ent["tags"].items()))
+                for ts_s, v in sorted(ent["dps"].items(),
+                                      key=lambda kv: int(kv[0])):
+                    vs = (str(int(v)) if float(v).is_integer()
+                          else repr(float(v)))
+                    line = f"{ent['metric']} {ts_s} {vs}"
+                    out_lines.append(
+                        line + (" " + tag_str if tag_str else ""))
+            body = ("\n".join(out_lines)
+                    + ("\n" if out_lines else "")).encode()
+            return 200, "text/plain", body, extra
+        if want_trace:
+            for ent in results:
+                ent.setdefault("trace_id", trace_id)
+        return (200, "application/json",
+                json.dumps(results).encode(), extra)
+
+    async def _hop(self, target: str, owner: int, deadline: float,
+                   sub: str):
+        """One sub-query against the fleet: owner-first candidate
+        order, per-attempt share of the remaining deadline, capped
+        exponential backoff between retries, and a hedged duplicate
+        when the leader is slower than the hedge delay. Returns
+        (status, ctype, body, extra_headers, hop_spans)."""
+        retries = int(getattr(self.config, "router_retries", 2) or 0)
+        backoff = float(getattr(self.config, "router_backoff_ms",
+                                50.0)) / 1000.0
+        cands = self._candidates(owner)
+        spans: list[dict] = []
+        last_err: Exception | None = None
+        for attempt in range(retries + 1):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            # Per-attempt share of what's left: a wedged replica must
+            # not eat the whole budget and starve the retries (the
+            # last attempt gets everything that remains).
+            share = remaining / max(retries + 1 - attempt, 1)
+            b = cands[attempt % len(cands)]
+            hedge_b = (cands[(attempt + 1) % len(cands)]
+                       if len(cands) > 1 else None)
+            try:
+                out = await self._hop_once(
+                    b, hedge_b, target, share, attempt, spans, sub)
+            except HopError as e:
+                last_err = e
+                _M_ERRORS.inc()
+                self._note_failure(b)
+                if attempt < retries:
+                    _M_RETRIES.inc()
+                    await asyncio.sleep(
+                        min(backoff * (2 ** attempt), 1.0,
+                            max(deadline - time.monotonic(), 0)))
+                continue
+            return out
+        raise HopError(f"{sub}: no replica answered within the "
+                       f"deadline ({last_err})")
+
+    def _hedge_delay_s(self, b: Backend, remaining: float) -> float | None:
+        """None disables hedging for this hop."""
+        cfg_ms = float(getattr(self.config, "router_hedge_ms", 0.0))
+        if cfg_ms < 0 or len(self.backends) < 2:
+            return None
+        # Hedging is a TAIL-LATENCY tool, not an overload tool: a
+        # hedge doubles a hop's cost exactly when the fleet is
+        # saturated (inflated hop latency trips the p95 trigger on
+        # every request), which is how hedged routers melt down under
+        # load. At or beyond the admission ladder's first step, every
+        # hop flies solo.
+        n = int(getattr(self.config, "query_max_inflight", 0) or 0)
+        if n and self.admission.inflight_queries >= n:
+            return None
+        if cfg_ms > 0:
+            delay = cfg_ms / 1000.0
+        elif b.latency.count >= 8:
+            delay = max(b.latency.percentile(95) / 1000.0,
+                        _HEDGE_FLOOR_MS / 1000.0)
+        else:
+            # Too few observations for a p95: hedge only as a deadline
+            # backstop at half the remaining budget.
+            delay = remaining / 2
+        return min(delay, remaining / 2)
+
+    async def _hop_once(self, b: Backend, hedge_b, target: str,
+                        remaining: float, attempt: int,
+                        spans: list, sub: str):
+        """One attempt, possibly hedged: the primary fires now, the
+        hedge after the delay; first success wins and the loser is
+        cancelled + recorded as a cancelled span."""
+        t0 = time.monotonic()
+
+        async def fetch(backend: Backend):
+            budget = remaining - (time.monotonic() - t0)
+            with _M_HOP.time():
+                status, headers, body = await _http_fetch(
+                    backend.host, backend.port, target,
+                    timeout_s=max(budget, 0.001))
+            if status >= 500 and status != 503:
+                raise HopError(f"{backend.url} answered {status}")
+            return backend, status, headers, body
+
+        primary = asyncio.create_task(fetch(b))
+        tasks = [primary]
+        hedge_delay = (self._hedge_delay_s(b, remaining)
+                       if hedge_b is not None else None)
+        hedged = False
+        if hedge_delay is not None:
+            done, _ = await asyncio.wait({primary},
+                                         timeout=hedge_delay)
+            if not done:
+                hedged = True
+                _M_HEDGES.inc()
+                tasks.append(asyncio.create_task(fetch(hedge_b)))
+
+        winner = None
+        err: Exception | None = None
+        pending = set(tasks)
+        while pending and winner is None:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED,
+                timeout=max(remaining - (time.monotonic() - t0),
+                            0.001))
+            if not done:
+                break  # deadline: everything still pending loses
+            for t in done:
+                if t.exception() is None:
+                    winner = t
+                    break
+                err = t.exception()
+        for t in tasks:
+            if t is not winner and not t.done():
+                t.cancel()
+                # The cancelled-loser span: the PR-6 follow-on's
+                # debugging story — /api/traces shows WHICH replica
+                # was slow and that its request was abandoned.
+                loser = hedge_b if t is not primary else b
+                spans.append({
+                    "name": "hop",
+                    "ms": round((time.monotonic() - t0) * 1000.0, 3),
+                    "tags": {"m": sub, "backend": loser.url,
+                             "attempt": attempt,
+                             "cancelled": True},
+                })
+        if winner is None:
+            raise err if isinstance(err, HopError) else HopError(
+                f"{sub}: hop timed out")
+        backend, status, headers, body = winner.result()
+        ms_taken = (time.monotonic() - t0) * 1000.0
+        backend.latency.add(ms_taken)
+        backend.consecutive_fails = 0
+        if hedged and backend is not b:
+            _M_HEDGE_WINS.inc()
+        span = {
+            "name": "hop",
+            "ms": round(ms_taken, 3),
+            "tags": {"m": sub, "backend": backend.url,
+                     "attempt": attempt, "status": status,
+                     "hedged": hedged},
+        }
+        # Replica span trees ride the JSON results; graft them under
+        # the hop so the router's tree is the WHOLE request.
+        try:
+            parsed = json.loads(body)
+            subtrees = [ent["trace"] for ent in parsed
+                        if isinstance(ent, dict) and "trace" in ent]
+            if subtrees:
+                span["spans"] = subtrees
+        except ValueError:
+            pass
+        spans.append(span)
+        extra = {}
+        if "x-tsd-degraded" in headers:
+            extra["X-Tsd-Degraded"] = headers["x-tsd-degraded"]
+        if "retry-after" in headers:
+            extra["Retry-After"] = headers["retry-after"]
+        return (status, headers.get("content-type", "text/plain"),
+                body, extra, spans)

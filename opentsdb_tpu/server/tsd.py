@@ -32,6 +32,7 @@ from opentsdb_tpu.core import tags as tags_mod
 from opentsdb_tpu.core.errors import (
     BadRequestError,
     NoSuchUniqueName,
+    OverloadedError,
     PleaseThrottleError,
     ReadOnlyStoreError,
 )
@@ -64,6 +65,27 @@ MAX_LINE = 1024       # per-line telnet framing limit (reference
 MAX_BUFFER = 1 << 22  # pipelined-burst buffer bound for the bulk path
                       # (4 MiB: bigger bursts = bigger native-decode
                       # batches and fewer pipeline turns per point)
+
+# Protocol-level error counters (the wire.py error-path contract):
+# every >= 400 HTTP response and every telnet line the server answered
+# with an error bumps these — a collector watching them sees malformed
+# clients, oversized bodies, and shed load without parsing log text.
+_M_HTTP_ERRORS = METRICS.counter("http.errors")
+_M_TELNET_ERRORS = METRICS.counter("telnet.errors")
+
+# Test-only sabotage hook (scripts/servematrix.py --bug): names a
+# deliberate serve-tier bug the staleness-oracle gate must catch.
+# "stale-serve" suppresses the degraded/stale tagging while the
+# replica keeps serving — the exact contract violation the matrix
+# exists to flag.
+_SERVE_BUG = os.environ.get("TSDB_SERVE_BUG", "")
+
+
+def _retry_after(seconds: float) -> dict:
+    """Retry-After is integral delta-seconds on the wire; never 0 (a
+    0 invites an instant retry storm from well-behaved clients)."""
+    import math
+    return {"Retry-After": str(max(1, math.ceil(seconds)))}
 
 
 def _put_prefix_len(buf: bytes) -> int:
@@ -167,7 +189,19 @@ class TSDServer:
         self.selfmon = SelfMonitor(
             tsdb, self._collect_stats,
             getattr(self.config, "selfmon_interval_s", 0.0))
+        # Serve tier (opentsdb_tpu/serve/): admission control runs on
+        # every daemon (all knobs default off); the WAL tailer is
+        # attached by the CLI for --role replica daemons and owns the
+        # staleness contract surfaced at /healthz and in /q tags.
+        from opentsdb_tpu.serve.admission import AdmissionController
+        self.admission = AdmissionController(self.config)
+        self.tailer = None
         self._register_default_commands()
+
+    def attach_tailer(self, tailer) -> None:
+        """Wire a serve.tailer.WalTailer into /healthz, /stats, and
+        the /q staleness tagging (replica-role daemons)."""
+        self.tailer = tailer
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -192,6 +226,8 @@ class TSDServer:
             await self._server.wait_closed()
             self._server = None
         self.selfmon.stop()
+        if self.tailer is not None:
+            self.tailer.stop()
         self._pool.shutdown(wait=False)
         self.tsdb.shutdown()
         LOG.info("Server shut down")
@@ -314,14 +350,35 @@ class TSDServer:
             self._pool, wire.decode_puts, chunk)
         if prev is not None:
             await prev
-        n, series_errors = await loop.run_in_executor(
-            self._pool, wire.ingest_batch, self.tsdb, batch)
+        # Ingest admission (serve/admission.py): shed the whole batch
+        # with a throttle line + retry hint BEFORE it allocates store
+        # work — collectors already understand "Please throttle".
+        npts = len(batch.sid)
+        wait = self.admission.admit_ingest(npts) if npts else 0.0
+        if wait > 0:
+            self.telnet_rpcs += npts + len(batch.errors)
+            self.requests_put += npts + len(batch.errors)
+            self.hbase_errors_put += 1
+            _M_TELNET_ERRORS.inc()
+            writer.write(
+                f"put: Please throttle writes: over ingest quota, "
+                f"retry after {max(wait, 0.1):.1f}s\n".encode())
+            await writer.drain()
+            return
+        try:
+            n, series_errors = await loop.run_in_executor(
+                self._pool, wire.ingest_batch, self.tsdb, batch)
+        finally:
+            if npts:
+                self.admission.ingest_done(npts)
         self.telnet_rpcs += n + len(batch.errors)
         self.requests_put += n + len(batch.errors)
         for err in batch.errors:
             self.illegal_arguments_put += 1
+            _M_TELNET_ERRORS.inc()
             writer.write(f"put: illegal argument: {err}\n".encode())
         for err in series_errors:
+            _M_TELNET_ERRORS.inc()
             if "No such name" in err:
                 self.unknown_metrics_put += 1
                 writer.write(f"put: unknown metric: {err}\n".encode())
@@ -383,6 +440,7 @@ class TSDServer:
             "/sketch": lambda req: self._sketch(req.q),
             "/forecast": lambda req: self._forecast(req.q, req.params),
             "/fault": self._http_fault,
+            "/healthz": self._http_healthz,
             "/metrics": self._http_metrics,
             "/api/traces": self._http_traces,
             "/dropcaches": self._http_dropcaches,
@@ -404,6 +462,7 @@ class TSDServer:
         handler = self.telnet_commands.get(words[0])
         if handler is None:
             self.rpcs_unknown += 1
+            _M_TELNET_ERRORS.inc()
             writer.write(f"unknown command: {words[0]}\n".encode())
             await writer.drain()
             return True
@@ -424,6 +483,15 @@ class TSDServer:
         t0 = time.time()
         self.requests_put += 1
         try:
+            wait = self.admission.admit_ingest(1)
+            if wait > 0:
+                # Shed: admit_ingest took NO slot, so nothing to
+                # release (pairing ingest_done here would free
+                # capacity someone else's batch is really using).
+                raise PleaseThrottleError(
+                    f"over ingest quota, retry after "
+                    f"{max(wait, 0.1):.1f}s")
+            self.admission.ingest_done(1)
             if len(words) < 5:
                 raise ValueError("not enough arguments"
                                  f" (need least 5, got {len(words)})")
@@ -444,17 +512,21 @@ class TSDServer:
             self.put_latency.add((time.time() - t0) * 1000)
         except NoSuchUniqueName as e:
             self.unknown_metrics_put += 1
+            _M_TELNET_ERRORS.inc()
             writer.write(f"put: unknown metric: {e}\n".encode())
         except (ValueError, ArithmeticError) as e:
             self.illegal_arguments_put += 1
+            _M_TELNET_ERRORS.inc()
             writer.write(f"put: illegal argument: {e}\n".encode())
         except PleaseThrottleError as e:
             self.hbase_errors_put += 1
+            _M_TELNET_ERRORS.inc()
             writer.write(f"put: Please throttle writes: {e}\n".encode())
         except ReadOnlyStoreError as e:
             # A replica daemon (--read-only) serves reads only; tell
             # the collector to write to the writer frontend instead.
             self.hbase_errors_put += 1
+            _M_TELNET_ERRORS.inc()
             writer.write(f"put: read-only replica: {e}\n".encode())
 
     # ------------------------------------------------------------------
@@ -526,6 +598,12 @@ class TSDServer:
             except NoSuchUniqueName as e:
                 status, extra = 400, {}
                 ctype, body = self._error_body(target, str(e))
+            except OverloadedError as e:
+                # Admission shed: an explicit retry signal, not a
+                # failure — 429 (tenant quota) / 503 (load) with an
+                # honest Retry-After.
+                status, extra = e.status, _retry_after(e.retry_after)
+                ctype, body = "text/plain", f"{e}\n".encode()
             except Exception as e:
                 self.exceptions_caught += 1
                 LOG.exception("HTTP error on %s", target)
@@ -557,8 +635,12 @@ class TSDServer:
         reason = {200: "OK", 304: "Not Modified", 400: "Bad Request",
                   404: "Not Found", 405: "Method Not Allowed",
                   413: "Payload Too Large",
+                  429: "Too Many Requests",
                   431: "Request Header Fields Too Large",
-                  500: "Internal Server Error"}.get(status, "OK")
+                  500: "Internal Server Error",
+                  503: "Service Unavailable"}.get(status, "OK")
+        if status >= 400:
+            _M_HTTP_ERRORS.inc()
         hdrs = [f"HTTP/1.1 {status} {reason}",
                 f"Content-Type: {ctype}",
                 f"Content-Length: {len(body)}",
@@ -663,6 +745,42 @@ class TSDServer:
         return (200, "application/json",
                 json.dumps(fp.status()).encode(), {})
 
+    def _http_healthz(self, req) -> tuple:
+        """Liveness + the replica staleness contract. The router's
+        probes key on both the status code and the body: 200/ok keeps
+        (or readmits) a replica in rotation, 503/stale ejects it from
+        preference while the body still carries the measured lag."""
+        if self.tailer is not None:
+            body = self.tailer.health()
+        else:
+            body = {
+                "role": getattr(self.config, "role", "writer"),
+                "ok": True,
+                "read_only": bool(getattr(self.tsdb.store, "read_only",
+                                          False)),
+            }
+        body["uptime_s"] = int(time.time()) - self.start_time
+        body["inflight_queries"] = self.admission.inflight_queries
+        status = 200 if body.get("ok") else 503
+        return (status, "application/json",
+                json.dumps(body).encode(), {})
+
+    def _degraded_reason(self, load_degraded: bool) -> str | None:
+        """The /q result tag: "stale" when the replica staleness
+        contract is violated, "rollup-only" under load shedding's
+        degraded step, both comma-joined when both hold. None = full
+        service. The stale half is what the bounded-staleness oracle
+        checks — and what TSDB_SERVE_BUG=stale-serve sabotages so the
+        serve matrix's gate can prove the oracle catches a lying
+        replica."""
+        reasons = []
+        if (self.tailer is not None and self.tailer.stale()
+                and _SERVE_BUG != "stale-serve"):
+            reasons.append("stale")
+        if load_degraded:
+            reasons.append("rollup-only")
+        return ",".join(reasons) if reasons else None
+
     def _http_metrics(self, req) -> tuple:
         """Prometheus text exposition: the metrics registry (typed —
         counters, gauges, timer summaries) merged with the classic
@@ -725,19 +843,61 @@ class TSDServer:
         if not ms:
             raise BadRequestError("Missing parameter: m")
 
+        # Admission (serve/admission.py): a dry per-tenant bucket is
+        # 429, the ladder's top is 503 — both via OverloadedError so
+        # the Retry-After reaches the wire. DEGRADE takes a slot like
+        # OK (the work still runs, just cheaper), released in the
+        # finally below. Only VALID requests consume slots: the
+        # parameter checks above stay outside.
+        from opentsdb_tpu.serve import admission as _adm
+        verdict, retry = self.admission.admit_query(
+            q.get("tenant", "default"))
+        if verdict == _adm.SHED_QUOTA:
+            raise OverloadedError(
+                f"query quota exceeded for tenant "
+                f"{q.get('tenant', 'default')!r}", retry, status=429)
+        if verdict == _adm.SHED_LOAD:
+            raise OverloadedError(
+                "shedding load: too many queries in flight", retry,
+                status=503)
+        # ?degrade=rollup-only: an overloaded ROUTER asking for the
+        # cheap path on this hop — honor it exactly like the local
+        # ladder's degraded step (trace stripped, rollup-only, tagged).
+        degrade = (verdict == _adm.DEGRADE
+                   or q.get("degrade") == "rollup-only")
+        try:
+            return await self._query_admitted(q, query_string, params,
+                                              ms, start, end, degrade)
+        finally:
+            self.admission.query_done()
+
+    async def _query_admitted(self, q, query_string: str, params, ms,
+                              start: int, end: int,
+                              degrade: bool) -> tuple:
         # Tracing: requested explicitly (?trace=1) or implied for
         # every query when a slow-query threshold is configured (the
         # span tree is what makes the slow-query record debuggable).
         # The per-hook cost is one global-int check when off and a
         # perf_counter pair per STAGE when on — never per point.
-        want_trace = q.get("trace", "0") not in ("", "0")
+        # The degraded ladder step sheds trace work FIRST: span
+        # bookkeeping is pure overhead when the goal is staying up.
+        want_trace = (q.get("trace", "0") not in ("", "0")
+                      and not degrade)
         slow_ms = float(getattr(self.config, "slow_query_ms", 0) or 0)
-        do_trace = want_trace or slow_ms > 0
+        do_trace = want_trace or (slow_ms > 0 and not degrade)
+        # The result tag for anything less than full service ("stale",
+        # "rollup-only", or both): evaluated once per request, echoed
+        # per-result in JSON and as X-Tsd-Degraded so the router can
+        # propagate it without parsing bodies. Degraded answers bypass
+        # the disk cache both ways — caching one would serve it after
+        # recovery, and a cached full answer carries no tag.
+        degraded = self._degraded_reason(degrade)
         # An explicitly traced request bypasses the /q disk cache both
         # ways: a cached body carries no trace, and a trace of a disk
         # read would claim the query cost nothing.
-        cache_path = (None if want_trace
+        cache_path = (None if want_trace or degraded
                       else self._cache_path(query_string, q))
+        now = int(time.time())
         if cache_path and self._cache_fresh(cache_path, q, end, now):
             with open(cache_path, "rb") as f:
                 body = f.read()
@@ -791,11 +951,18 @@ class TSDServer:
             # Returned with the results: reading it back off the shared
             # executor after the pool hop could pick up a CONCURRENT
             # request's label.
-            trace = obs_trace.Trace(m) if do_trace else None
+            # trace_parent: the router's fan-out id — hop traces on
+            # this replica carry the SAME trace_id as the router's
+            # assembled tree, so /api/traces correlates across
+            # processes.
+            trace = (obs_trace.Trace(
+                m, trace_id=q.get("trace_parent") or None)
+                if do_trace else None)
             rs, plan, cached = await loop.run_in_executor(
                 self._pool,
                 functools.partial(self.executor.run_with_plan,
-                                  spec, start, end, trace))
+                                  spec, start, end, trace,
+                                  rollup_only=degrade))
             tdict = None
             if trace is not None:
                 rec = make_record(
@@ -818,6 +985,8 @@ class TSDServer:
             result_traces.extend([tdict] * len(rs))
 
         extra: dict = {}
+        if degraded:
+            extra["X-Tsd-Degraded"] = degraded
         if "ascii" in q:
             body = self._ascii_output(results).encode()
             ctype = "text/plain"
@@ -825,7 +994,8 @@ class TSDServer:
             body = json.dumps(
                 self._json_output(
                     results, result_plans, result_cached,
-                    result_traces if want_trace else None)).encode()
+                    result_traces if want_trace else None,
+                    degraded=degraded)).encode()
             ctype = "application/json"
         else:
             t0 = time.time()
@@ -867,6 +1037,14 @@ class TSDServer:
             max_age = 60
         else:
             max_age = 300
+        if (self.tailer is not None
+                and getattr(self.config, "max_staleness_ms", 0) > 0):
+            # Staleness-contract replicas: a disk-cache hit adds its
+            # age to the answer's staleness, so cap it at the contract
+            # bound — the cache can never make a fresh replica serve
+            # an answer older than it promises.
+            max_age = min(max_age,
+                          self.config.max_staleness_ms / 1000.0)
         return (now - mtime) < max_age
 
     @staticmethod
@@ -886,7 +1064,7 @@ class TSDServer:
         return "\n".join(out) + ("\n" if out else "")
 
     def _json_output(self, results, plans=None, cached=None,
-                     traces=None):
+                     traces=None, degraded=None):
         out = [{
             "metric": r.metric,
             "tags": r.tags,
@@ -899,6 +1077,12 @@ class TSDServer:
             "dps": {str(int(t)): float(v)
                     for t, v in zip(r.timestamps, r.values)},
         } for i, r in enumerate(results)]
+        if degraded:
+            # Anything less than full service is DECLARED per result:
+            # "stale" (replica lag beyond the contract) and/or
+            # "rollup-only" (load shedding omitted raw stitching).
+            for ent in out:
+                ent["degraded"] = degraded
         if traces is not None:
             # ?trace=1 only: the per-sub-query span tree, inline.
             for i, ent in enumerate(out):
@@ -1318,6 +1502,13 @@ class TSDServer:
             c.record("process.rss_bytes", rss)
         c.record("traces.recorded", self.trace_ring.recorded)
         c.record("traces.slow", self.trace_ring.slow)
+        # Serve tier: the staleness contract (replica role) and the
+        # admission/shedding counters — self-monitoring ingests these
+        # as tsd.replica.* / tsd.admission.* series, which is what
+        # `tsdb check -m tsd.replica.lag_ms ...` alerts on.
+        if self.tailer is not None:
+            self.tailer.collect_stats(c)
+        self.admission.collect_stats(c)
         c.record("selfmon.cycles", self.selfmon.cycles)
         c.record("selfmon.points", self.selfmon.points)
         c.record("selfmon.errors", self.selfmon.errors)

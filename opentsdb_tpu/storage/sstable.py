@@ -169,6 +169,13 @@ def _write_bloom_and_trailer(
                 f.write(bits.tobytes())
         f.write(_TRAILER_V3.pack(ntables, footer_start, bloom_start))
     f.flush()
+    # Footer + bloom + trailer written, not yet durable: torn mode
+    # cuts INSIDE this section specifically (rec_bytes spans exactly
+    # the bytes since footer_start), leaving a body-complete file
+    # whose index is garbage — the reader/recovery must treat it as a
+    # stray .tmp, never parse a half footer.
+    _fault("sst.write.footer", getattr(f, "name", None),
+           max(f.tell() - footer_start, 1))
     os.fsync(f.fileno())
 
 

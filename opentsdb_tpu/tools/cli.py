@@ -150,14 +150,29 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         cfg.flush_interval = args.flush_interval
         cfg.checkpoint_interval = getattr(args, "checkpoint_interval", 0.0)
         if getattr(args, "read_only", False) \
-                and not cfg.checkpoint_interval:
-            # A replica that never polls would serve a permanently
-            # frozen snapshot; the timer drives store.refresh() for
-            # read-only daemons (core/compaction.py).
+                and not cfg.checkpoint_interval \
+                and getattr(args, "role", "writer") != "replica":
+            # A legacy --read-only daemon that never polls would serve
+            # a permanently frozen snapshot; the timer drives
+            # refresh_replica() (core/compaction.py). Serve-tier
+            # replicas (--role replica) are excluded: the WalTailer is
+            # their ONLY refresh driver — a second concurrent driver
+            # would race the rollup tier's refresh and do catch-up
+            # work the tailer's lag clock never sees.
             cfg.checkpoint_interval = 5.0
         cfg.mesh_devices = getattr(args, "mesh_devices", 0)
         cfg.slow_query_ms = getattr(args, "slow_query_ms", 0.0)
         cfg.selfmon_interval_s = getattr(args, "selfmon_interval", 0.0)
+        # Serve tier (opentsdb_tpu/serve/): staleness contract +
+        # admission knobs ride the daemon config.
+        cfg.role = getattr(args, "role", "writer")
+        cfg.max_staleness_ms = getattr(args, "max_staleness_ms", 0.0)
+        cfg.tail_interval_s = getattr(args, "tail_interval", 0.25)
+        cfg.query_max_inflight = getattr(args, "query_max_inflight", 0)
+        cfg.query_rate = getattr(args, "query_rate", 0.0)
+        cfg.ingest_rate = getattr(args, "ingest_rate", 0.0)
+        cfg.ingest_queue_points = getattr(args, "ingest_queue_points",
+                                          0)
     read_only = getattr(args, "read_only", False)
     shards = getattr(args, "shards", 0) or 0
     from opentsdb_tpu.storage.sharded import manifest_path
@@ -198,6 +213,17 @@ def cmd_tsd(args) -> int:
 
     from opentsdb_tpu.server.tsd import TSDServer
 
+    role = getattr(args, "role", "writer")
+    if role == "router":
+        return _cmd_router(args)
+    if role == "replica":
+        # A serve-tier replica IS a read-only daemon, plus the WAL
+        # tailer and the staleness contract.
+        args.read_only = True
+        if not getattr(args, "max_staleness_ms", 0.0):
+            # The contract defaults ON for the replica role: a serve
+            # tier without a staleness bound is just the old poller.
+            args.max_staleness_ms = 5000.0
     tsdb = make_tsdb(args, start_thread=True)
     # Replayed WAL/sstable state is in place: freeze it out of cycle
     # collection (utils/gctune.py has the measured motivation — gen2
@@ -206,6 +232,12 @@ def cmd_tsd(args) -> int:
     from opentsdb_tpu.utils.gctune import tune_for_ingest
     tune_for_ingest()
     server = TSDServer(tsdb)
+    if role == "replica":
+        from opentsdb_tpu.serve.tailer import WalTailer
+
+        tailer = WalTailer(tsdb)
+        server.attach_tailer(tailer)
+        tailer.start()
 
     async def main():
         await server.start()
@@ -228,6 +260,54 @@ def cmd_tsd(args) -> int:
         asyncio.run(main())
     except KeyboardInterrupt:
         tsdb.shutdown()
+    return 0
+
+
+def _cmd_router(args) -> int:
+    """``tsd --role router``: the storage-free front door
+    (serve/router.py). Imports neither jax nor the storage engine —
+    a router restart is sub-second by construction."""
+    import asyncio
+
+    from opentsdb_tpu.serve.router import RouterServer
+
+    backends = tuple(u.strip() for u in
+                     (getattr(args, "backends", "") or "").split(",")
+                     if u.strip())
+    cfg = Config(
+        port=args.port, bind=args.bind, role="router",
+        router_backends=backends,
+        writer_url=getattr(args, "writer_url", None) or None,
+        router_deadline_ms=getattr(args, "router_deadline_ms",
+                                   10_000.0),
+        router_retries=getattr(args, "router_retries", 2),
+        router_hedge_ms=getattr(args, "router_hedge_ms", 0.0),
+        probe_interval_s=getattr(args, "probe_interval", 1.0),
+        router_eject_after=getattr(args, "router_eject_after", 3),
+        query_max_inflight=getattr(args, "query_max_inflight", 0),
+        query_rate=getattr(args, "query_rate", 0.0),
+        ingest_rate=getattr(args, "ingest_rate", 0.0),
+        ingest_queue_points=getattr(args, "ingest_queue_points", 0))
+    server = RouterServer(cfg)
+
+    async def main():
+        await server.start()
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, server.request_shutdown)
+            except (NotImplementedError, RuntimeError):
+                pass
+        print(f"Ready to serve on {cfg.bind}:{server.port}",
+              flush=True)
+        await server.serve_forever()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -633,6 +713,48 @@ def main(argv: list[str] | None = None) -> int:
                    help="seconds between self-monitoring cycles that "
                         "ingest /stats into the store itself as tsd.* "
                         "series (0 disables)")
+    # Distributed serve tier (opentsdb_tpu/serve/).
+    p.add_argument("--role", default="writer",
+                   choices=["writer", "replica", "router"],
+                   help="writer: the single ingesting daemon "
+                        "(default). replica: read-only daemon that "
+                        "TAILS the writer's WAL continuously with a "
+                        "bounded staleness contract (/healthz reports "
+                        "lag vs --max-staleness-ms). router: "
+                        "storage-free front door fanning /q across "
+                        "--backends with deadlines, retries, hedging "
+                        "and health-probe ejection")
+    p.add_argument("--max-staleness-ms", type=float, default=0.0,
+                   help="replica staleness contract: beyond this lag "
+                        "every answer is tagged degraded/stale and "
+                        "/healthz turns unhealthy (replica role "
+                        "defaults to 5000; 0 elsewhere disables)")
+    p.add_argument("--tail-interval", type=float, default=0.25,
+                   help="seconds between WAL tail cycles (replica)")
+    p.add_argument("--backends", default="",
+                   help="router: comma-separated replica base URLs "
+                        "(http://host:port)")
+    p.add_argument("--writer-url", default=None,
+                   help="router: forward telnet put lines here")
+    p.add_argument("--router-deadline-ms", type=float, default=10000.0)
+    p.add_argument("--router-retries", type=int, default=2)
+    p.add_argument("--router-hedge-ms", type=float, default=0.0,
+                   help="hedge a slow hop after this many ms (0 = "
+                        "derive from the observed p95; negative "
+                        "disables)")
+    p.add_argument("--probe-interval", type=float, default=1.0)
+    p.add_argument("--router-eject-after", type=int, default=3)
+    # Admission control (any role; all off by default).
+    p.add_argument("--query-max-inflight", type=int, default=0,
+                   help="load-shedding ladder threshold N: N..2N in "
+                        "flight degrades (rollup-only), 2N sheds 503")
+    p.add_argument("--query-rate", type=float, default=0.0,
+                   help="per-tenant queries/s quota (429 when dry)")
+    p.add_argument("--ingest-rate", type=float, default=0.0,
+                   help="per-tenant ingest points/s quota")
+    p.add_argument("--ingest-queue-points", type=int, default=0,
+                   help="global in-flight decoded-point cap; over it "
+                        "puts shed with a throttle line")
     p.set_defaults(fn=cmd_tsd)
 
     p = sub.add_parser("import", help="bulk import text files")

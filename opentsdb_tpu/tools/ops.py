@@ -39,7 +39,9 @@ OK, WARNING, CRITICAL = 0, 1, 2
 def add_check_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("-H", "--host", default="localhost")
     p.add_argument("-p", "--port", type=int, default=4242)
-    p.add_argument("-m", "--metric", required=True)
+    p.add_argument("-m", "--metric", default=None,
+                   help="metric to probe via /q (required unless "
+                        "--stats-metric)")
     p.add_argument("-t", "--tag", action="append", default=[],
                    help="tag=value filter (repeatable)")
     p.add_argument("-d", "--duration", type=int, default=600,
@@ -58,6 +60,29 @@ def add_check_args(p: argparse.ArgumentParser) -> None:
                    help="ignore data points newer than this many seconds")
     p.add_argument("-T", "--timeout", type=int, default=10)
     p.add_argument("-v", "--verbose", action="store_true")
+    # Ratio checks (the self-monitoring alerting follow-on): divide
+    # the probed metric by a second one, timestamp-aligned, and
+    # threshold the RATIO — e.g. a fragment-cache hit ratio from the
+    # tsd.qcache.hit / tsd.qcache.miss series the selfmon loop
+    # ingests:
+    #   tsdb check -m tsd.qcache.hit -R tsd.qcache.miss --ratio-total \
+    #        -x lt -c 0.5
+    p.add_argument("-R", "--divide-by", default=None,
+                   help="second metric; checked value becomes "
+                        "a/b per aligned timestamp (b's query reuses "
+                        "the same tags/downsample/rate)")
+    p.add_argument("--ratio-total", action="store_true",
+                   help="with --divide-by: use a/(a+b) instead of "
+                        "a/b (hit-ratio shape; denominator-0 points "
+                        "are skipped either way)")
+    p.add_argument("--stats-metric", default=None,
+                   help="threshold a live /stats line instead of a "
+                        "/q series (read-only replicas can't "
+                        "self-ingest tsd.* series, but their /stats "
+                        "carries the same values — e.g. "
+                        "--stats-metric tsd.replica.lag_ms -x gt "
+                        "-c 5000 alerts on the staleness contract). "
+                        "-m is ignored in this mode")
 
 
 def check_query_path(args) -> str:
@@ -124,15 +149,43 @@ def evaluate_check(args, lines: list[str], now: int) -> tuple[int, str]:
                 f" worst: {badval!r} @ {when}")
 
 
-def cmd_check(args) -> int:
-    if args.warning is None and args.critical is None:
-        print("ERROR: need at least one of --warning/--critical",
-              file=sys.stderr)
-        return CRITICAL
-    url = check_query_path(args)
+def _sum_by_ts(lines: list[str]) -> dict[int, float]:
+    """Collapse ascii /q lines to {ts: summed value} (the probe's
+    aggregator already merged groups; summing here makes multi-line
+    answers — distinct tag sets — behave like one series)."""
+    out: dict[int, float] = {}
+    for line in lines:
+        parts = line.split()
+        if len(parts) < 3:
+            continue
+        try:
+            ts, val = int(parts[1]), float(parts[2])
+        except ValueError:
+            continue
+        out[ts] = out.get(ts, 0.0) + val
+    return out
+
+
+def ratio_lines(num_lines: list[str], den_lines: list[str],
+                metric: str, total: bool) -> list[str]:
+    """Timestamp-aligned a/b (or a/(a+b)) as synthetic ascii lines, so
+    the threshold logic runs unchanged on ratios. Zero denominators
+    are skipped — no data beats a division blowup in an alert."""
+    num = _sum_by_ts(num_lines)
+    den = _sum_by_ts(den_lines)
+    out = []
+    for ts in sorted(set(num) & set(den)):
+        d = num[ts] + den[ts] if total else den[ts]
+        if d == 0:
+            continue
+        out.append(f"{metric} {ts} {num[ts] / d!r}")
+    return out
+
+
+def _fetch_ascii(args, url: str):
+    """GET an ascii /q; returns (lines, None) or (None, exit code)."""
     conn = http.client.HTTPConnection(args.host, args.port,
                                       timeout=args.timeout)
-    now = int(time.time())
     try:
         conn.request("GET", url)
         res = conn.getresponse()
@@ -141,16 +194,86 @@ def cmd_check(args) -> int:
     except (OSError, http.client.HTTPException) as e:
         print(f"ERROR: couldn't GET {url} from "
               f"{args.host}:{args.port}: {e}")
-        return CRITICAL
+        return None, CRITICAL
     if res.status not in (200, 202):
         print(f"CRITICAL: status = {res.status} when talking to "
               f"{args.host}:{args.port}")
         if args.verbose:
             print(body)
-        return CRITICAL
+        return None, CRITICAL
     if args.verbose:
         print(body)
-    rv, msg = evaluate_check(args, body.splitlines(), now)
+    return body.splitlines(), None
+
+
+def check_stats_metric(args) -> int:
+    """Threshold the CURRENT value of one /stats line (gauge shape):
+    the replica-lag / shed-counter alerting path, no selfmon loop or
+    writable store required."""
+    lines, err = _fetch_ascii(args, "/stats")
+    if err is not None:
+        return err
+    name = args.stats_metric
+    cmp_ = COMPARATORS[args.comparator]
+    warning = args.warning if args.warning is not None else args.critical
+    critical = args.critical if args.critical is not None else args.warning
+    worst = None
+    for line in lines:
+        parts = line.split()
+        if len(parts) < 3 or parts[0] != name:
+            continue
+        val = float(parts[2])
+        if worst is None or cmp_(val, worst):
+            worst = val
+    if worst is None:
+        if args.no_result_ok:
+            print(f"OK: no {name} line in /stats")
+            return OK
+        print(f"CRITICAL: no {name} line in /stats")
+        return CRITICAL
+    if cmp_(worst, critical):
+        print(f"CRITICAL: {name} {args.comparator} {critical}: "
+              f"value={worst!r}")
+        return CRITICAL
+    if cmp_(worst, warning):
+        print(f"WARNING: {name} {args.comparator} {warning}: "
+              f"value={worst!r}")
+        return WARNING
+    print(f"OK: {name}: value={worst!r}")
+    return OK
+
+
+def cmd_check(args) -> int:
+    if args.warning is None and args.critical is None:
+        print("ERROR: need at least one of --warning/--critical",
+              file=sys.stderr)
+        return CRITICAL
+    if getattr(args, "stats_metric", None):
+        return check_stats_metric(args)
+    if not args.metric:
+        print("ERROR: need -m/--metric (or --stats-metric)",
+              file=sys.stderr)
+        return CRITICAL
+    now = int(time.time())
+    lines, err = _fetch_ascii(args, check_query_path(args))
+    if err is not None:
+        return err
+    divisor = getattr(args, "divide_by", None)
+    if divisor:
+        import copy
+        args2 = copy.copy(args)
+        args2.metric = divisor
+        den_lines, err = _fetch_ascii(args2, check_query_path(args2))
+        if err is not None:
+            return err
+        label = (f"{args.metric}/({args.metric}+{divisor})"
+                 if getattr(args, "ratio_total", False)
+                 else f"{args.metric}/{divisor}")
+        lines = ratio_lines(lines, den_lines, label,
+                            getattr(args, "ratio_total", False))
+        args = copy.copy(args)
+        args.metric = label
+    rv, msg = evaluate_check(args, lines, now)
     print(msg)
     return rv
 

@@ -115,6 +115,66 @@ class Config:
     selfmon_interval_s: float = 0.0
     trace_ring: int = 256
 
+    # Distributed serve tier (opentsdb_tpu/serve/):
+    # - role: "writer" (the single ingesting daemon), "replica" (a
+    #   read-only daemon that TAILS the writer's WAL continuously —
+    #   bounded staleness instead of checkpoint-interval refresh), or
+    #   "router" (the stateless front door fanning /q across replicas).
+    # - max_staleness_ms: the replica staleness CONTRACT. A replica
+    #   whose last successful WAL catch-up is older than this serves
+    #   every /q answer with a "degraded": "stale" tag (and reports
+    #   unhealthy at /healthz) — answers may lag the writer, but never
+    #   silently. 0 disables the contract (refresh-interval semantics).
+    # - tail_interval_s: the tailer's poll period between WAL suffix
+    #   replays; steady-state lag is ~one interval.
+    role: str = "writer"
+    max_staleness_ms: float = 0.0
+    tail_interval_s: float = 0.25
+
+    # Admission control / backpressure (serve/admission.py). All off
+    # by default (0); per-tenant buckets key on the ?tenant= query
+    # param (HTTP) or the connection's tenant (telnet; "default").
+    # - ingest_rate/_burst_s: per-tenant token bucket in points/s;
+    #   over-quota puts shed with "Please throttle" + Retry-After
+    #   instead of queueing.
+    # - ingest_queue_points: global cap on decoded-but-not-yet-applied
+    #   points across connections — sheds before memory does.
+    # - query_rate/_burst: per-tenant queries/s bucket (429 when dry).
+    # - query_max_inflight N: the load-shedding ladder. Below N
+    #   queries in flight: full service. N..2N: degraded — traces are
+    #   stripped and /q serves ROLLUP-ONLY (no raw stitching; results
+    #   tagged "degraded": "rollup-only"; queries the tier cannot
+    #   serve get 503 + Retry-After). At 2N: 503 + Retry-After.
+    ingest_rate: float = 0.0
+    ingest_burst_s: float = 2.0
+    ingest_queue_points: int = 0
+    query_rate: float = 0.0
+    query_burst: float = 8.0
+    query_max_inflight: int = 0
+
+    # Query router (serve/router.py; role="router" only).
+    # - router_backends: replica base URLs ("http://host:port").
+    # - writer_url: where forwarded telnet puts go (None = reject).
+    # - router_deadline_ms: total per-request budget; each hop gets
+    #   the remainder.
+    # - router_retries: max additional attempts on OTHER replicas
+    #   after a failed/expired hop (capped exponential backoff).
+    # - router_hedge_ms: send a hedged duplicate to the next replica
+    #   when the first hop is slower than this; first response wins,
+    #   the loser is cancelled. 0 = derive from the observed p95 hop
+    #   latency; negative disables hedging.
+    # - probe_interval_s / router_eject_after: /healthz probe cadence
+    #   and the consecutive-failure count that ejects a replica from
+    #   rotation (readmitted on the next healthy probe).
+    router_backends: tuple = ()
+    writer_url: str | None = None
+    router_deadline_ms: float = 10_000.0
+    router_retries: int = 2
+    router_backoff_ms: float = 50.0
+    router_hedge_ms: float = 0.0
+    probe_interval_s: float = 1.0
+    router_eject_after: int = 3
+
     # compute backend: 'tpu' = jitted JAX kernels; 'cpu' = numpy oracle
     backend: str = "tpu"
     # device mesh for distributed query execution: 0 = single-device;

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Serve-tier overload bench: shedding keeps accepted-query latency.
+
+Boots the live topology (scripts/servematrix.py Deployment: writer +
+2 WAL-tailing replicas + router) with admission configured on the
+router, ingests a seeded corpus, then measures two legs:
+
+  unloaded   one client, sequential dashboard queries -> p50/p99
+  overload   2x the sustainable concurrency (sustainable = the
+             router's full-service in-flight budget N) hammering the
+             same mix -> accepted-query p50/p99, shed counts, and
+             whether every shed carried Retry-After
+
+The acceptance gate (ISSUE 7): under 2x load the router sheds with
+429/503 + Retry-After while ACCEPTED-query p99 stays within 2x the
+unloaded p99 — load shedding exists precisely so the queries you do
+accept stay fast. Client-measured latencies drive the gate; the
+router's obs-registry snapshot (tsd.router.hop percentiles,
+admission.shed counters) is recorded alongside.
+
+    python scripts/bench_serve.py [--points 200000] [--json BENCH_SERVE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scripts.servematrix import (BT, Deployment, http_get,  # noqa: E402
+                                 owner_metric, telnet_acked)
+
+INFLIGHT_N = 2          # router full-service budget (sustainable)
+QUERY_METRICS = 4       # distinct sub-queries spread over both owners
+
+
+def pct(vals, p):
+    return float(np.percentile(np.asarray(vals), p)) if vals else None
+
+
+def q_target(m: str, end_n: int) -> str:
+    return (f"/q?start={BT - 60}&end={BT + end_n * 60}&m={m}"
+            f"&json&nocache")
+
+
+def wait_rollup_ready(port: int, timeout: float = 120.0) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            _, _, body = http_get(port, "/stats", timeout=10)
+            for ln in body.decode().splitlines():
+                parts = ln.split()
+                if parts and parts[0] == "tsd.rollup.ready" \
+                        and parts[2] == "1":
+                    return True
+        except Exception:
+            pass
+        time.sleep(0.5)
+    return False
+
+
+def run_queries(port, targets, duration_s, out, tenant=None):
+    """One client loop: latencies for 200s, shed records otherwise."""
+    i = 0
+    t_end = time.time() + duration_s
+    while time.time() < t_end:
+        tgt = targets[i % len(targets)]
+        if tenant:
+            tgt += f"&tenant={tenant}"
+        t0 = time.perf_counter()
+        try:
+            status, headers, _ = http_get(port, tgt, timeout=60)
+        except Exception as e:
+            out.setdefault("errors", []).append(repr(e))
+            i += 1
+            continue
+        ms = (time.perf_counter() - t0) * 1000.0
+        if status == 200:
+            out.setdefault("lat_ms", []).append(ms)
+        elif status in (429, 503):
+            out.setdefault("shed", []).append(
+                (status, "Retry-After" in headers))
+        else:
+            out.setdefault("errors", []).append(f"status {status}")
+        i += 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=int, default=200_000)
+    ap.add_argument("--json", default="BENCH_SERVE.json")
+    ap.add_argument("--duration", type=float, default=12.0,
+                    help="seconds per leg")
+    ap.add_argument("--work-dir", default=None)
+    args = ap.parse_args()
+
+    work = args.work_dir or tempfile.mkdtemp(prefix="benchserve-")
+    os.makedirs(work, exist_ok=True)
+    dep = Deployment(work, seed=42, rollups=True, router_args=[
+        "--query-max-inflight", str(INFLIGHT_N)])
+    print("booting deployment (rollups on) ...", file=sys.stderr,
+          flush=True)
+    dep.start()
+    try:
+        # Seeded corpus: points split over metrics owned by both
+        # replicas so the fan-out exercises real ownership. The query
+        # mix is dashboard-shaped (1h downsamples), so the degraded
+        # ladder step has a real rollup tier to serve from.
+        metrics = []
+        per = args.points // QUERY_METRICS
+        for k in range(QUERY_METRICS):
+            m = owner_metric(k % 2, salt=3 + k // 2)
+            metric = m.split(":", 1)[1]
+            metrics.append((f"sum:1h-avg:{metric}", per))
+            print(f"ingesting {per} points into {metric} ...",
+                  file=sys.stderr, flush=True)
+            for off in range(0, per, 20_000):
+                n = min(20_000, per - off)
+                lines = [f"put {metric} {BT + (off + i) * 6} "
+                         f"{(off + i) % 97} host=h" for i in range(n)]
+                telnet_acked(dep.ports["writer"], lines, timeout=300)
+        print("waiting for the rollup tier (writer + replicas) ...",
+              file=sys.stderr, flush=True)
+        assert wait_rollup_ready(dep.ports["writer"]), \
+            "writer tier never became ready"
+        time.sleep(1.0)  # a tail cycle beyond the last fold
+        targets = [q_target(m, per * 6 // 60 + 60)
+                   for m, per in metrics]
+
+        # Warm both replicas' fragment caches out of the measurement.
+        for tgt in targets:
+            http_get(dep.ports["router"], tgt, timeout=120)
+
+        print("unloaded leg ...", file=sys.stderr, flush=True)
+        unloaded: dict = {}
+        run_queries(dep.ports["router"], targets, args.duration,
+                    unloaded)
+        p99_unloaded = pct(unloaded.get("lat_ms"), 99)
+
+        print("overload leg (2x sustainable) ...", file=sys.stderr,
+              flush=True)
+        workers = 2 * 2 * INFLIGHT_N  # 2x the hard-shed boundary 2N
+        outs = [dict() for _ in range(workers)]
+        threads = [threading.Thread(
+            target=run_queries,
+            args=(dep.ports["router"], targets, args.duration,
+                  outs[w], f"w{w}"))
+            for w in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        accepted = [ms for o in outs for ms in o.get("lat_ms", [])]
+        shed = [s for o in outs for s in o.get("shed", [])]
+        errors = [e for o in outs for e in o.get("errors", [])]
+        p99_loaded = pct(accepted, 99)
+
+        _, _, stats = http_get(dep.ports["router"], "/stats",
+                               timeout=30)
+        registry = [ln for ln in stats.decode().splitlines()
+                    if any(k in ln for k in
+                           ("router.hop", "admission.shed",
+                            "router.fanouts"))]
+
+        shed_429 = sum(1 for s, _ in shed if s == 429)
+        shed_503 = sum(1 for s, _ in shed if s == 503)
+        retry_after_ok = all(ra for _, ra in shed) if shed else False
+        gate = {
+            "sheds_under_overload": len(shed) > 0,
+            "retry_after_on_every_shed": retry_after_ok,
+            "accepted_p99_within_2x_unloaded":
+                (p99_loaded is not None and p99_unloaded is not None
+                 and p99_loaded <= 2 * p99_unloaded),
+        }
+        out = {
+            "points": args.points,
+            "metrics": [m for m, _ in metrics],
+            "router_query_max_inflight": INFLIGHT_N,
+            "unloaded": {
+                "clients": 1,
+                "queries": len(unloaded.get("lat_ms", [])),
+                "p50_ms": round(pct(unloaded.get("lat_ms"), 50), 3),
+                "p99_ms": round(p99_unloaded, 3),
+            },
+            "overload": {
+                "clients": workers,
+                "accepted": len(accepted),
+                "shed_429": shed_429,
+                "shed_503": shed_503,
+                "errors": len(errors),
+                "p50_ms": round(pct(accepted, 50), 3)
+                if accepted else None,
+                "p99_ms": round(p99_loaded, 3) if accepted else None,
+            },
+            "gate": gate,
+            "pass": all(gate.values()),
+            "registry_snapshot": registry,
+            "note": ("client-measured latencies gate the run; the "
+                     "registry snapshot is cumulative across both "
+                     "legs"),
+            "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({k: out[k] for k in
+                          ("unloaded", "overload", "gate", "pass")},
+                         indent=1))
+        return 0 if out["pass"] else 1
+    finally:
+        dep.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

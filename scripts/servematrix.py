@@ -1,0 +1,595 @@
+#!/usr/bin/env python
+"""Serve-tier fault matrix: failover proven against a LIVE deployment.
+
+Boots the real topology as separate OS processes — one writer daemon,
+two streaming replicas (``tsd --role replica``, WAL-tailing with a
+bounded staleness contract), one router (``tsd --role router``) — runs
+a seeded ingest workload over real sockets, then injures the fleet and
+verifies the contracts:
+
+  replica-kill        SIGKILL the owner replica while its query is in
+                      flight (a delay faultpoint armed over HTTP via
+                      /fault holds the query open — the PR-4 arm-over-
+                      HTTP integration); the router must retry onto
+                      the surviving replica and answer BIT-IDENTICALLY
+                      to the writer, then readmit the replica once
+                      restarted.
+  router-partition    SIGSTOP one replica (a partition as the router
+                      sees it: connects hang, probes time out); the
+                      router must eject it, serve its queries from the
+                      other replica within the deadline, and readmit
+                      after SIGCONT.
+  writer-crash        SIGKILL the writer mid-ingest-stream; replicas
+                      keep serving every ACKNOWLEDGED point (golden vs
+                      the ack log), fsck over the crashed store is
+                      clean (--expect-clean), and a restarted writer
+                      reconverges with the fleet.
+  staleness-contract  Wedge both replicas' refresh (ioerror faultpoint
+                      armed over /fault), keep ingesting acknowledged
+                      points, outwait the bound: every router answer
+                      must now carry the "stale" tag — the BOUNDED-
+                      STALENESS ORACLE. ``--bug stale-serve`` starts
+                      the replicas with the tagging sabotaged
+                      (TSDB_SERVE_BUG) and the oracle must CATCH the
+                      untagged stale answer — the matrix's gate.
+
+Scenario outcomes are seed-deterministic: the workload derives from
+--seed, answers are hashed into per-scenario fingerprints, and two
+runs with the same seed produce the same fingerprints.
+
+    python scripts/servematrix.py --json SERVE_MATRIX.json   # full
+    python scripts/servematrix.py --fast                     # tier-1
+    python scripts/servematrix.py --only staleness --bug stale-serve
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BT = 1356998400
+FAST = ("replica-kill", "router-partition")
+ALL = ("replica-kill", "router-partition", "writer-crash",
+       "staleness-contract")
+BUGS = ("stale-serve",)
+MAX_STALENESS_MS = 1200.0
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def series_hash(b: bytes) -> int:
+    return zlib.crc32(b)
+
+
+def owner_metric(owner: int, salt: int = 0,
+                 n_backends: int = 2) -> str:
+    """The ``salt``-th m-spec owned by backend ``owner``. Scenarios
+    share one live deployment, so each uses its OWN metric — reusing
+    one with different seeded values would plant conflicting
+    duplicates."""
+    found = 0
+    for i in range(1000):
+        m = f"sum:serve.m{i}"
+        if series_hash(m.encode()) % n_backends == owner:
+            if found == salt:
+                return m
+            found += 1
+    raise AssertionError
+
+
+def http_get(port: int, target: str, timeout: float = 30.0):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{target}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def telnet_acked(port: int, lines: list[str],
+                 timeout: float = 60.0) -> None:
+    """Send put lines and BLOCK until the daemon acknowledged them
+    (the version round-trip drains the per-connection pipeline —
+    everything sent before it has been applied or error-reported)."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    s.settimeout(timeout)
+    try:
+        payload = "".join(ln + "\n" for ln in lines).encode()
+        s.sendall(payload)
+        s.sendall(b"version\n")
+        buf = b""
+        while b"net.opentsdb" not in buf and b"opentsdb" not in buf:
+            chunk = s.recv(4096)
+            if not chunk:
+                raise RuntimeError(f"daemon closed during ack; "
+                                   f"got {buf[-400:]!r}")
+            buf += chunk
+        if b"put:" in buf:
+            raise RuntimeError(f"puts rejected: {buf[-400:]!r}")
+    finally:
+        s.close()
+
+
+def wait_ready(proc, logpath: str, name: str, timeout: float = 180.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with open(logpath) as f:
+                for ln in f:
+                    if ln.startswith("Ready to serve on ") \
+                            and ln.endswith("\n"):
+                        try:
+                            return int(ln.strip().rsplit(":", 1)[1])
+                        except ValueError:
+                            pass
+        except OSError:
+            pass
+        if proc.poll() is not None:
+            tail = ""
+            try:
+                tail = open(logpath).read()[-2000:]
+            except OSError:
+                pass
+            raise RuntimeError(f"{name} died during startup: {tail}")
+        time.sleep(0.2)
+    raise RuntimeError(f"{name} never came up")
+
+
+def answer_hash(body: bytes) -> str:
+    """Stable hash of a /q json answer (dps only, ordered)."""
+    res = json.loads(body)
+    canon = [(r["metric"], sorted(r.get("tags", {}).items()),
+              sorted((int(k), v) for k, v in r["dps"].items()))
+             for r in res]
+    canon.sort()
+    return hashlib.sha1(json.dumps(canon).encode()).hexdigest()
+
+
+class Deployment:
+    """writer + 2 replicas + router, each its own OS process."""
+
+    def __init__(self, workdir: str, seed: int,
+                 bug: str | None = None,
+                 router_args: list[str] | None = None,
+                 rollups: bool = False) -> None:
+        self.workdir = workdir
+        self.seed = seed
+        self.bug = bug
+        self.router_args = list(router_args or [])
+        # rollups=True: writer folds the tier on a short checkpoint
+        # timer and replicas serve it read-only (the bench topology;
+        # the failover scenarios run raw to keep boot deterministic).
+        self.rollups = rollups
+        self.store = os.path.join(workdir, "store")
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.ports: dict[str, int] = {}
+        self.env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO + os.pathsep
+            + os.environ.get("PYTHONPATH", ""))
+        self.env.pop("TSDB_FAULTPOINTS", None)
+
+    def _spawn(self, name: str, args: list[str],
+               extra_env: dict | None = None) -> int:
+        logpath = os.path.join(self.workdir, f"{name}.log")
+        env = dict(self.env, **(extra_env or {}))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "opentsdb_tpu.tools.cli", "tsd",
+             "--bind", "127.0.0.1", "--backend", "cpu"] + args,
+            env=env, stdout=open(logpath, "w"),
+            stderr=subprocess.STDOUT, cwd=REPO)
+        self.procs[name] = proc
+        port = wait_ready(proc, logpath, name)
+        self.ports[name] = port
+        return port
+
+    def start(self) -> None:
+        os.makedirs(self.store, exist_ok=True)
+        writer_args = ["--port", "0", "--wal",
+                       os.path.join(self.store, "wal"),
+                       "--auto-metric"]
+        rollup_args = (["--rollups", "--checkpoint-interval", "2"]
+                       if self.rollups else [])
+        self._spawn("writer", writer_args + rollup_args)
+        rep_env = ({"TSDB_SERVE_BUG": self.bug} if self.bug else None)
+        for name in ("replica-a", "replica-b"):
+            self._spawn(name, [
+                "--port", "0", "--wal",
+                os.path.join(self.store, "wal"),
+                "--role", "replica",
+                "--max-staleness-ms", str(MAX_STALENESS_MS),
+                "--tail-interval", "0.1"]
+                + (["--rollups"] if self.rollups else []),
+                extra_env=rep_env)
+        self._spawn("router", [
+            "--port", "0", "--role", "router",
+            "--backends",
+            f"http://127.0.0.1:{self.ports['replica-a']},"
+            f"http://127.0.0.1:{self.ports['replica-b']}",
+            "--writer-url",
+            f"http://127.0.0.1:{self.ports['writer']}",
+            "--probe-interval", "0.2",
+            "--router-eject-after", "2",
+            "--router-retries", "2",
+            "--router-deadline-ms", "8000"] + self.router_args)
+
+    def restart(self, name: str, extra: list[str] | None = None) -> int:
+        """Restart a daemon on its OLD port (the router's backend list
+        is positional-by-URL)."""
+        role = {"writer": [], "replica-a": ["--role", "replica"],
+                "replica-b": ["--role", "replica"]}[name]
+        port = self.ports[name]
+        args = ["--port", str(port), "--wal",
+                os.path.join(self.store, "wal")] + role
+        if name == "writer":
+            args.append("--auto-metric")
+        else:
+            args += ["--max-staleness-ms", str(MAX_STALENESS_MS),
+                     "--tail-interval", "0.1"]
+        rep_env = ({"TSDB_SERVE_BUG": self.bug}
+                   if self.bug and name != "writer" else None)
+        return self._spawn(name, args + (extra or []),
+                           extra_env=rep_env)
+
+    def kill(self, name: str) -> None:
+        self.procs[name].send_signal(signal.SIGKILL)
+        self.procs[name].wait(timeout=30)
+
+    def stop(self) -> None:
+        for name, p in self.procs.items():
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGCONT)
+                except OSError:
+                    pass
+                p.terminate()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # -- workload ------------------------------------------------------
+
+    def ingest_acked(self, metric: str, n: int, t0: int,
+                     vbase: int) -> None:
+        """Seeded, acknowledged points (value = (vbase + i) % 97)."""
+        lines = [f"put {metric} {t0 + i * 60} {(vbase + i) % 97} "
+                 f"host=h" for i in range(n)]
+        telnet_acked(self.ports["writer"], lines)
+
+    def wait_backend_state(self, idx: int, healthy: bool,
+                           timeout: float = 30.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                _, _, body = http_get(self.ports["router"], "/healthz",
+                                      timeout=5)
+                b = json.loads(body)["backends"][idx]
+                if b["healthy"] == healthy:
+                    return True
+            except Exception:
+                pass
+            time.sleep(0.1)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Scenarios
+# ---------------------------------------------------------------------------
+
+def _golden(dep: Deployment, m: str, end_n: int) -> str:
+    """The writer's own answer hash for the scenario query."""
+    q = (f"/q?start={BT - 60}&end={BT + end_n * 60}&m={m}"
+         f"&json&nocache")
+    status, _, body = http_get(dep.ports["writer"], q)
+    assert status == 200, (status, body[:300])
+    return answer_hash(body)
+
+
+def _router_q(dep: Deployment, m: str, end_n: int,
+              timeout: float = 30.0):
+    q = (f"/q?start={BT - 60}&end={BT + end_n * 60}&m={m}"
+         f"&json&nocache")
+    return http_get(dep.ports["router"], q, timeout=timeout)
+
+
+def scenario_replica_kill(dep: Deployment, seed: int) -> dict:
+    problems: list[str] = []
+    m0 = owner_metric(0)
+    n = 400
+    dep.ingest_acked(m0.split(":", 1)[1], n, BT, seed % 97)
+    time.sleep(0.5)  # a tail cycle
+    golden = _golden(dep, m0, n)
+
+    # Arm a delay over HTTP on the OWNER replica so its in-flight
+    # query is still running when the SIGKILL lands (the /fault
+    # integration against a live multi-process deployment).
+    status, _, body = http_get(
+        dep.ports["replica-a"],
+        "/fault?arm=query.scan%3Ddelay%3Adelay%3D5.0%3Acount%3D10")
+    if status != 200 or b"query.scan" not in body:
+        problems.append(f"arm-over-HTTP failed: {status} {body[:200]}")
+
+    import threading
+    out: dict = {}
+
+    def query():
+        try:
+            out["res"] = _router_q(dep, m0, n, timeout=60)
+        except Exception as e:
+            out["err"] = repr(e)
+
+    t = threading.Thread(target=query)
+    t.start()
+    time.sleep(0.8)      # hop reached the wedged replica
+    dep.kill("replica-a")
+    t.join(timeout=60)
+    if "err" in out:
+        problems.append(f"router query died with {out['err']}")
+    else:
+        status, headers, body = out["res"]
+        if status != 200:
+            problems.append(
+                f"router answered {status} after replica kill: "
+                f"{body[:200]}")
+        elif answer_hash(body) != golden:
+            problems.append("failover answer != writer answer")
+    # Restart on the old port; the router must readmit.
+    dep.restart("replica-a")
+    if not dep.wait_backend_state(0, healthy=True):
+        problems.append("killed replica never readmitted after "
+                        "restart")
+    return {"problems": problems,
+            "fingerprint_parts": [golden]}
+
+
+def scenario_router_partition(dep: Deployment, seed: int) -> dict:
+    problems: list[str] = []
+    m1 = owner_metric(1)
+    n = 400
+    dep.ingest_acked(m1.split(":", 1)[1], n, BT, seed % 89)
+    time.sleep(0.5)
+    golden = _golden(dep, m1, n)
+
+    # Partition: the replica hangs (SIGSTOP) — connects succeed but
+    # nothing answers, which is what a network partition looks like
+    # from the router's side.
+    dep.procs["replica-b"].send_signal(signal.SIGSTOP)
+    try:
+        if not dep.wait_backend_state(1, healthy=False):
+            problems.append("partitioned replica never ejected")
+        t0 = time.time()
+        status, _, body = _router_q(dep, m1, n, timeout=60)
+        wall = time.time() - t0
+        if status != 200:
+            problems.append(
+                f"router answered {status} during partition")
+        elif answer_hash(body) != golden:
+            problems.append("partition failover answer != writer")
+        if wall > 10.0:
+            problems.append(
+                f"partition failover took {wall:.1f}s (> deadline "
+                f"budget)")
+    finally:
+        dep.procs["replica-b"].send_signal(signal.SIGCONT)
+    if not dep.wait_backend_state(1, healthy=True):
+        problems.append("healed replica never readmitted")
+    return {"problems": problems, "fingerprint_parts": [golden]}
+
+
+def scenario_writer_crash(dep: Deployment, seed: int) -> dict:
+    problems: list[str] = []
+    m0 = owner_metric(0, salt=1)
+    metric = m0.split(":", 1)[1]
+    # Acked prefix, then the crash. Every acked point must survive.
+    n_acked = 300
+    dep.ingest_acked(metric, n_acked, BT, seed % 83)
+    dep.kill("writer")
+    # Replicas keep serving the acked history (tail catches up to the
+    # durable WAL end; a dead writer is NOT staleness).
+    time.sleep(1.0)
+    status, headers, body = _router_q(dep, m0, n_acked)
+    if status != 200:
+        problems.append(f"router {status} with writer dead")
+    else:
+        res = json.loads(body)
+        got = sum(len(r["dps"]) for r in res)
+        if got != n_acked:
+            problems.append(
+                f"replica serves {got}/{n_acked} acked points with "
+                f"writer dead (tag: "
+                f"{headers.get('X-Tsd-Degraded')!r})")
+    # The crashed store recovers clean: the operator tool, verbatim.
+    fsck = subprocess.run(
+        [sys.executable, "-m", "opentsdb_tpu.tools.cli", "fsck",
+         "--wal", os.path.join(dep.store, "wal"), "--backend", "cpu",
+         "--expect-clean"],
+        env=dep.env, capture_output=True, cwd=REPO, timeout=120)
+    if fsck.returncode != 0:
+        problems.append(
+            f"fsck --expect-clean exit {fsck.returncode}: "
+            f"{fsck.stdout.decode()[-300:]}")
+    # Restarted writer reconverges with the fleet.
+    dep.restart("writer")
+    dep.ingest_acked(metric, 50, BT + n_acked * 60, 7)
+    time.sleep(0.8)
+    golden = _golden(dep, m0, n_acked + 50)
+    status, _, body = _router_q(dep, m0, n_acked + 50)
+    if status != 200 or answer_hash(body) != golden:
+        problems.append("post-restart router answer != writer")
+    return {"problems": problems, "fingerprint_parts": [golden]}
+
+
+def scenario_staleness_contract(dep: Deployment, seed: int) -> dict:
+    """THE bounded-staleness oracle. Wedge every replica's refresh,
+    ingest acknowledged points, outwait the bound: an untagged answer
+    that is missing acked-and-older-than-the-bound records is a
+    CONTRACT VIOLATION (exactly what --bug stale-serve fabricates)."""
+    problems: list[str] = []
+    m0 = owner_metric(0, salt=2)
+    metric = m0.split(":", 1)[1]
+    n0 = 200
+    dep.ingest_acked(metric, n0, BT, seed % 79)
+    time.sleep(0.5)
+    for rep in ("replica-a", "replica-b"):
+        status, _, body = http_get(
+            dep.ports[rep],
+            "/fault?arm=replica.refresh%3Dioerror%3Acount%3D100000")
+        if status != 200:
+            problems.append(f"/fault arm on {rep} failed: {status}")
+    try:
+        # New ACKED points the wedged replicas can never see.
+        n1 = 100
+        dep.ingest_acked(metric, n1, BT + n0 * 60, 13)
+        t_ack = time.time()
+        # Outwait the contract bound (plus a tail interval of slack).
+        while (time.time() - t_ack) * 1000 <= MAX_STALENESS_MS + 400:
+            time.sleep(0.1)
+        status, headers, body = _router_q(dep, m0, n0 + n1)
+        if status != 200:
+            problems.append(f"router {status} during staleness test")
+        else:
+            res = json.loads(body)
+            got = sum(len(r["dps"]) for r in res)
+            tagged = "stale" in (headers.get("X-Tsd-Degraded") or "")
+            missing = got < n0 + n1
+            if missing and not tagged:
+                problems.append(
+                    f"STALENESS CONTRACT VIOLATION: answer reflects "
+                    f"{got}/{n0 + n1} acknowledged points, every "
+                    f"missing one acked "
+                    f">{MAX_STALENESS_MS:.0f}ms ago, and carries NO "
+                    f"stale tag")
+            if not missing:
+                problems.append(
+                    "vacuous staleness run: the wedged replicas "
+                    "somehow saw the new points")
+    finally:
+        for rep in ("replica-a", "replica-b"):
+            try:
+                http_get(dep.ports[rep], "/fault?clear=1", timeout=5)
+            except Exception:
+                pass
+    return {"problems": problems, "fingerprint_parts": []}
+
+
+SCENARIOS = {
+    "replica-kill": scenario_replica_kill,
+    "router-partition": scenario_router_partition,
+    "writer-crash": scenario_writer_crash,
+    "staleness-contract": scenario_staleness_contract,
+}
+
+
+def run(labels, workdir: str, seed: int, bug: str | None) -> list[dict]:
+    os.makedirs(workdir, exist_ok=True)
+    dep = Deployment(workdir, seed, bug=bug)
+    results = []
+    log("booting writer + 2 replicas + router ...")
+    dep.start()
+    try:
+        for label in labels:
+            t0 = time.time()
+            try:
+                out = SCENARIOS[label](dep, seed)
+            except Exception as e:
+                import traceback
+                out = {"problems": [f"scenario crashed: {e!r}",
+                                    traceback.format_exc(limit=5)],
+                       "fingerprint_parts": []}
+            status = "ok" if not out["problems"] else \
+                "invariant-failed"
+            fp = hashlib.sha1(
+                ("|".join([label, status] + out["problems"]
+                          + out["fingerprint_parts"])).encode()
+            ).hexdigest()
+            results.append({
+                "label": label, "status": status,
+                "problems": out["problems"],
+                "seed": seed, "bug": bug,
+                "wall_s": round(time.time() - t0, 2),
+                "fingerprint": fp,
+                "repro": (f"python scripts/servematrix.py --only "
+                          f"{label} --seed {seed}"
+                          + (f" --bug {bug}" if bug else "")),
+            })
+            log(f"{status:17s} {label} "
+                f"({results[-1]['wall_s']:.1f}s)")
+    finally:
+        dep.stop()
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--json", default="SERVE_MATRIX.json")
+    p.add_argument("--fast", action="store_true",
+                   help="tier-1 subset: replica-kill + "
+                        "router-partition")
+    p.add_argument("--only", action="append", default=[])
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--bug", default=None, choices=BUGS,
+                   help="sabotage the replicas (TSDB_SERVE_BUG) so "
+                        "the oracle must catch the violation — the "
+                        "matrix's own gate; expect failures")
+    p.add_argument("--work-dir", default=None)
+    p.add_argument("--list", action="store_true")
+    args = p.parse_args(argv)
+
+    labels = list(FAST if args.fast else ALL)
+    if args.only:
+        labels = [lb for lb in labels + [x for x in ALL
+                                         if x not in labels]
+                  if any(o in lb for o in args.only)]
+    if args.list:
+        for lb in labels:
+            print(lb)
+        return 0
+    if not labels:
+        print("no scenarios match", file=sys.stderr)
+        return 2
+
+    import tempfile
+    work = args.work_dir or tempfile.mkdtemp(prefix="servematrix-")
+    t0 = time.time()
+    results = run(labels, work, args.seed, args.bug)
+    dt = time.time() - t0
+    passed = sum(1 for r in results if r["status"] == "ok")
+    artifact = {
+        "scenarios": len(results), "passed": passed,
+        "failed": len(results) - passed,
+        "wall_seconds": round(dt, 2),
+        "fast": bool(args.fast), "seed": args.seed,
+        "bug": args.bug,
+        "max_staleness_ms": MAX_STALENESS_MS,
+        "results": results,
+    }
+    with open(args.json, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"\n{passed}/{len(results)} serve scenarios passed in "
+          f"{dt:.1f}s -> {args.json}")
+    for r in results:
+        if r["status"] != "ok":
+            print(f"  FAIL {r['label']}: {r['problems'][:2]}")
+            print(f"       repro: {r['repro']}")
+    return 0 if passed == len(results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

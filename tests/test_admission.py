@@ -1,0 +1,276 @@
+"""Admission control tests: token buckets, ingest shedding with
+Retry-After, and the query load-shedding ladder (serve/admission.py +
+the server integration in server/tsd.py)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.errors import OverloadedError
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.serve import admission as adm
+from opentsdb_tpu.serve.admission import (AdmissionController,
+                                          TokenBucket)
+from opentsdb_tpu.server.tsd import TSDServer
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        b = TokenBucket(rate=10.0, burst=5.0)
+        t = 1000.0
+        assert b.take(5, now=t) == 0.0
+        wait = b.take(1, now=t)
+        assert wait == pytest.approx(0.1)
+        # Half a second later: 5 tokens back (capped at burst).
+        assert b.take(5, now=t + 0.5) == 0.0
+
+    def test_burst_cap(self):
+        b = TokenBucket(rate=1.0, burst=2.0)
+        t = 0.0
+        b.take(2, now=t)
+        # An hour idle still caps at burst.
+        assert b.take(2, now=t + 3600) == 0.0
+        assert b.take(1, now=t + 3600) == pytest.approx(1.0)
+
+    def test_oversized_request_does_not_go_negative(self):
+        b = TokenBucket(rate=10.0, burst=5.0)
+        t = 0.0
+        assert b.take(50, now=t) == pytest.approx(4.5)
+        # The refused take spent nothing.
+        assert b.take(5, now=t) == 0.0
+
+
+class TestController:
+    def test_ingest_quota_per_tenant(self):
+        c = AdmissionController(Config(ingest_rate=100.0,
+                                       ingest_burst_s=1.0))
+        assert c.admit_ingest(100, "a") == 0.0
+        assert c.admit_ingest(100, "a") > 0.0   # tenant a dry
+        assert c.admit_ingest(100, "b") == 0.0  # tenant b unaffected
+        assert c.ingest_shed_quota == 1
+
+    def test_ingest_queue_cap(self):
+        c = AdmissionController(Config(ingest_queue_points=100))
+        assert c.admit_ingest(80) == 0.0
+        assert c.admit_ingest(80) > 0.0
+        c.ingest_done(80)
+        assert c.admit_ingest(80) == 0.0
+        assert c.ingest_shed_queue == 1
+
+    def test_query_ladder(self):
+        c = AdmissionController(Config(query_max_inflight=2))
+        verdicts = [c.admit_query()[0] for _ in range(5)]
+        assert verdicts == [adm.OK, adm.OK, adm.DEGRADE, adm.DEGRADE,
+                            adm.SHED_LOAD]
+        assert c.inflight_queries == 4  # shed takes no slot
+        for _ in range(4):
+            c.query_done()
+        assert c.admit_query()[0] == adm.OK
+
+    def test_query_quota_429_before_ladder(self):
+        c = AdmissionController(Config(query_rate=1.0, query_burst=1.0,
+                                       query_max_inflight=100))
+        assert c.admit_query("t1")[0] == adm.OK
+        verdict, retry = c.admit_query("t1")
+        assert verdict == adm.SHED_QUOTA and retry > 0
+
+    def test_disabled_is_always_ok(self):
+        c = AdmissionController(Config())
+        assert all(c.admit_query()[0] == adm.OK for _ in range(100))
+        assert c.admit_ingest(1 << 30) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Server integration
+# ---------------------------------------------------------------------------
+
+async def http_get(port, target):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n"
+                 "Connection: close\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    headers = {}
+    for ln in head.split(b"\r\n")[1:]:
+        k, _, v = ln.partition(b":")
+        headers[k.strip().lower().decode()] = v.strip().decode()
+    return status, headers, body
+
+
+async def telnet(port, lines, wait=0.1):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    for line in lines:
+        writer.write(line.encode() + b"\n")
+    await writer.drain()
+    await asyncio.sleep(wait)
+    writer.write(b"exit\n")
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    return data
+
+
+def run_with_server(server, coro_fn):
+    async def main():
+        await server.start()
+        try:
+            return await coro_fn(server.port)
+        finally:
+            server._pool.shutdown(wait=False)
+            server._server.close()
+            await server._server.wait_closed()
+    return asyncio.run(main())
+
+
+def make_server(tmp_path=None, rollups=False, **cfg_kw):
+    kw = dict(auto_create_metrics=True, port=0, bind="127.0.0.1",
+              backend="cpu", enable_sketches=False,
+              device_window=False)
+    store = MemKVStore()
+    if tmp_path is not None:
+        wal = str(tmp_path / "wal")
+        kw.update(wal_path=wal, enable_rollups=rollups,
+                  rollup_catchup="sync")
+        store = MemKVStore(wal_path=wal)
+    cfg = Config(**kw, **cfg_kw)
+    tsdb = TSDB(store, cfg, start_compaction_thread=False)
+    return TSDServer(tsdb), tsdb
+
+
+class TestServerSheds:
+    def test_query_quota_429_with_retry_after(self):
+        server, tsdb = make_server(query_rate=1.0, query_burst=1.0)
+        tsdb.add_point("m.a", BT + 1, 1, {"h": "x"})
+
+        async def drive(port):
+            outs = []
+            for _ in range(3):
+                outs.append(await http_get(
+                    port, f"/q?start={BT}&m=sum:m.a&json&nocache"))
+            return outs
+
+        outs = run_with_server(server, drive)
+        tsdb.shutdown()
+        assert outs[0][0] == 200
+        shed = [o for o in outs[1:] if o[0] == 429]
+        assert shed, "second+ query within the burst must 429"
+        status, headers, body = shed[0]
+        assert int(headers["retry-after"]) >= 1
+        assert b"quota" in body
+
+    def test_load_shed_503(self):
+        server, tsdb = make_server(query_max_inflight=1)
+        tsdb.add_point("m.a", BT + 1, 1, {"h": "x"})
+        # Pin the ladder's top deterministically.
+        server.admission.inflight_queries = 2
+
+        async def drive(port):
+            return await http_get(
+                port, f"/q?start={BT}&m=sum:m.a&json&nocache")
+
+        status, headers, body = run_with_server(server, drive)
+        tsdb.shutdown()
+        assert status == 503
+        assert int(headers["retry-after"]) >= 1
+        assert b"shedding" in body
+
+    def test_degraded_step_serves_rollup_only(self, tmp_path):
+        server, tsdb = make_server(tmp_path, rollups=True,
+                                   query_max_inflight=1)
+        ts = np.arange(5000, dtype=np.int64) * 60 + BT
+        tsdb.add_batch("m.a", ts, (ts % 7).astype(np.float64),
+                       {"h": "x"})
+        tsdb.checkpoint()
+        server.admission.inflight_queries = 1  # ladder step: DEGRADE
+
+        async def drive(port):
+            ds = await http_get(
+                port, f"/q?start={BT}&end={BT + 5000 * 60}"
+                      f"&m=sum:1h-sum:m.a&json&nocache")
+            raw = await http_get(
+                port, f"/q?start={BT}&end={BT + 5000 * 60}"
+                      f"&m=sum:m.a&json&nocache")
+            return ds, raw
+
+        (ds_status, ds_hdrs, ds_body), (raw_status, raw_hdrs, raw_body) \
+            = run_with_server(server, drive)
+        tsdb.shutdown()
+        # Rollup-eligible: served from the tier, tagged.
+        assert ds_status == 200
+        res = json.loads(ds_body)
+        assert res[0]["rollup"] == "1h"
+        assert res[0]["degraded"] == "rollup-only"
+        assert ds_hdrs.get("x-tsd-degraded") == "rollup-only"
+        assert len(res[0]["dps"]) > 0
+        # Raw-only query under the degraded step: explicit 503.
+        assert raw_status == 503
+        assert "retry-after" in raw_hdrs
+
+    def test_degraded_strips_trace(self, tmp_path):
+        server, tsdb = make_server(tmp_path, rollups=True,
+                                   query_max_inflight=1)
+        ts = np.arange(3000, dtype=np.int64) * 60 + BT
+        tsdb.add_batch("m.a", ts, np.ones(3000), {"h": "x"})
+        tsdb.checkpoint()
+        server.admission.inflight_queries = 1
+
+        async def drive(port):
+            return await http_get(
+                port, f"/q?start={BT}&end={BT + 3000 * 60}"
+                      f"&m=sum:1h-sum:m.a&json&nocache&trace=1")
+
+        status, _, body = run_with_server(server, drive)
+        tsdb.shutdown()
+        assert status == 200
+        res = json.loads(body)
+        assert "trace" not in res[0], \
+            "degraded step must shed trace work first"
+
+    def test_ingest_quota_throttle_line(self):
+        server, tsdb = make_server(ingest_rate=100.0,
+                                   ingest_burst_s=1.0)
+
+        async def drive(port):
+            lines = [f"put m.bulk {BT + i} {i} host=h" for i in
+                     range(300)]
+            return await telnet(port, lines, wait=0.3)
+
+        out = run_with_server(server, drive)
+        tsdb.shutdown()
+        assert b"Please throttle writes" in out
+        assert b"retry after" in out
+        # The shed batch was counted.
+        assert server.admission.ingest_shed_quota >= 1
+
+    def test_shed_counters_in_stats(self):
+        server, tsdb = make_server(query_rate=1.0, query_burst=1.0)
+        tsdb.add_point("m.a", BT + 1, 1, {"h": "x"})
+
+        async def drive(port):
+            for _ in range(3):
+                await http_get(
+                    port, f"/q?start={BT}&m=sum:m.a&json&nocache")
+            return await http_get(port, "/stats")
+
+        _, _, body = run_with_server(server, drive)
+        tsdb.shutdown()
+        lines = [ln for ln in body.decode().splitlines()
+                 if "admission.shed" in ln and "path=query" in ln
+                 and "reason=quota" in ln]
+        assert lines and int(lines[0].split()[2]) >= 1
+
+
+class TestOverloadedError:
+    def test_carries_retry_after_and_status(self):
+        e = OverloadedError("nope", retry_after=2.5, status=429)
+        assert e.retry_after == 2.5 and e.status == 429
+        assert OverloadedError("x", -1).retry_after == 0.0

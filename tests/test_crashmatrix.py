@@ -29,12 +29,13 @@ class TestMatrixShape:
         for want in ("kv.wal.append", "kv.checkpoint.freeze",
                      "kv.checkpoint.commit", "sst.write.body",
                      "sharded.spill.shard", "rollup.fold.start",
-                     "rollup.bracket.flip", "replica.refresh"):
+                     "rollup.bracket.flip", "replica.refresh",
+                     "sst.write.footer"):
             assert want in sites, f"matrix lost coverage of {want}"
 
     def test_fast_subset_resolves(self):
         fast = harness.fast_matrix()
-        assert len(fast) == len(harness.FAST_LABELS) == 8
+        assert len(fast) == len(harness.FAST_LABELS) == 9
 
 
 class TestFastSubset:

@@ -198,3 +198,160 @@ class TestCleanCache:
 
     def test_missing_dir(self, tmp_path):
         assert ops.clean_cache(str(tmp_path / "nope")) == 0
+
+
+class TestRatioCheck:
+    """--divide-by ratio checks + --stats-metric (the self-monitoring
+    alerting follow-on: thresholds against tsd.* series and live
+    /stats gauges like tsd.replica.lag_ms)."""
+
+    def test_ratio_lines_alignment_and_zero_divisor(self):
+        num = ["a 100 8", "a 200 0", "a 300 5"]
+        den = ["b 100 2", "b 200 0", "b 400 7"]
+        out = ops.ratio_lines(num, den, "r", total=False)
+        # ts 200: denominator 0 skipped; ts 300/400: unaligned.
+        assert out == ["r 100 4.0"]
+        out = ops.ratio_lines(num, den, "r", total=True)
+        assert out == ["r 100 0.8"]
+
+    def test_ratio_sums_multi_line_groups(self):
+        num = ["a 100 3 host=x", "a 100 5 host=y"]
+        den = ["b 100 2 host=x", "b 100 6 host=y"]
+        assert ops.ratio_lines(num, den, "r", total=False) == \
+            ["r 100 1.0"]
+
+    @staticmethod
+    def _live_server(tsdb):
+        server = TSDServer(tsdb)
+        started = threading.Event()
+        holder = {}
+
+        def run_server():
+            async def main():
+                await server.start()
+                holder["loop"] = asyncio.get_running_loop()
+                holder["stop"] = asyncio.Event()
+                started.set()
+                await holder["stop"].wait()
+            asyncio.run(main())
+
+        t = threading.Thread(target=run_server, daemon=True)
+        t.start()
+        assert started.wait(5)
+        return server, holder, t
+
+    def test_hit_ratio_end_to_end(self, capsys):
+        cfg = Config(auto_create_metrics=True, port=0,
+                     bind="127.0.0.1", backend="cpu",
+                     enable_sketches=False, device_window=False)
+        tsdb = TSDB(MemKVStore(), cfg, start_compaction_thread=False)
+        now = int(time.time())
+        for i in range(5):
+            tsdb.add_point("q.hit", now - 60 + i * 10, 9, {"host": "a"})
+            tsdb.add_point("q.miss", now - 60 + i * 10, 1, {"host": "a"})
+        server, holder, t = self._live_server(tsdb)
+        try:
+            # hit/(hit+miss) = 0.9 per point: lt 0.5 critical is OK...
+            args = make_check_args(
+                port=server.port, metric="q.hit", comparator="lt",
+                critical=0.5, duration=300)
+            args.divide_by = "q.miss"
+            args.ratio_total = True
+            assert ops.cmd_check(args) == ops.OK
+            # ...and a 0.95 floor trips it.
+            args = make_check_args(
+                port=server.port, metric="q.hit", comparator="lt",
+                critical=0.95, duration=300)
+            args.divide_by = "q.miss"
+            args.ratio_total = True
+            rv = ops.cmd_check(args)
+            out = capsys.readouterr().out
+            assert rv == ops.CRITICAL
+            assert "q.hit/(q.hit+q.miss)" in out
+        finally:
+            holder["loop"].call_soon_threadsafe(holder["stop"].set)
+            t.join(5)
+            tsdb.shutdown()
+
+    def test_selfmon_series_checkable(self, tmp_path, capsys):
+        """The PR-6 follow-on proper: selfmon ingests /stats as tsd.*
+        series, and `tsdb check -m tsd....` thresholds them via /q."""
+        wal = str(tmp_path / "wal")
+        cfg = Config(auto_create_metrics=True, port=0,
+                     bind="127.0.0.1", backend="cpu", wal_path=wal,
+                     enable_sketches=False, device_window=False)
+        tsdb = TSDB(MemKVStore(wal_path=wal), cfg,
+                    start_compaction_thread=False)
+        server, holder, t = self._live_server(tsdb)
+        try:
+            assert server.selfmon.run_once() > 0
+            # ignore_recent=-1: the cycle stamped ts=now (delta 0),
+            # which the default window treats as "too recent".
+            args = make_check_args(
+                port=server.port, metric="tsd.uptime_s",
+                comparator="lt", critical=0.0, duration=300,
+                aggregator="max", ignore_recent=-1)
+            assert ops.cmd_check(args) == ops.OK
+        finally:
+            holder["loop"].call_soon_threadsafe(holder["stop"].set)
+            t.join(5)
+            tsdb.shutdown()
+
+    def test_stats_metric_replica_lag(self, tmp_path, capsys):
+        """Replicas can't self-ingest (read-only store): the lag
+        alert reads the live /stats gauge instead."""
+        from opentsdb_tpu.serve.tailer import WalTailer
+        wal = str(tmp_path / "wal")
+        wcfg = Config(wal_path=wal, backend="cpu",
+                      auto_create_metrics=True, enable_sketches=False,
+                      device_window=False)
+        w = TSDB(MemKVStore(wal_path=wal), wcfg,
+                 start_compaction_thread=False)
+        rcfg = Config(wal_path=wal, backend="cpu", port=0,
+                      bind="127.0.0.1", enable_sketches=False,
+                      device_window=False, max_staleness_ms=60000.0)
+        r = TSDB(MemKVStore(wal_path=wal, read_only=True), rcfg,
+                 start_compaction_thread=False)
+        server = TSDServer(r)
+        tailer = WalTailer(r, interval_s=3600.0)
+        server.attach_tailer(tailer)
+        tailer.run_once()
+        started = threading.Event()
+        holder = {}
+
+        def run_server():
+            async def main():
+                await server.start()
+                holder["loop"] = asyncio.get_running_loop()
+                holder["stop"] = asyncio.Event()
+                started.set()
+                await holder["stop"].wait()
+            asyncio.run(main())
+
+        t = threading.Thread(target=run_server, daemon=True)
+        t.start()
+        assert started.wait(5)
+        try:
+            args = make_check_args(port=server.port, comparator="gt",
+                                   critical=1e9)
+            args.stats_metric = "tsd.replica.lag_ms"
+            assert ops.cmd_check(args) == ops.OK
+            args = make_check_args(port=server.port, comparator="gt",
+                                   critical=0.0)
+            args.stats_metric = "tsd.replica.lag_ms"
+            rv = ops.cmd_check(args)
+            out = capsys.readouterr().out
+            assert rv == ops.CRITICAL
+            assert "tsd.replica.lag_ms" in out
+            # A missing gauge is loud unless told otherwise.
+            args = make_check_args(port=server.port, comparator="gt",
+                                   critical=1.0)
+            args.stats_metric = "tsd.no.such.gauge"
+            assert ops.cmd_check(args) == ops.CRITICAL
+            args.no_result_ok = True
+            assert ops.cmd_check(args) == ops.OK
+        finally:
+            holder["loop"].call_soon_threadsafe(holder["stop"].set)
+            t.join(5)
+            r.shutdown()
+            w.shutdown()

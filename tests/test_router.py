@@ -1,0 +1,424 @@
+"""Query-router tests (serve/router.py): ownership fan-out, per-hop
+deadlines + retries on another replica, hedging with cancelled-loser
+spans, health-probe ejection/readmission, trace-id propagation, and
+put forwarding — all against an in-process writer + two replica
+TSDServers + RouterServer in one event loop."""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.serve.router import RouterServer
+from opentsdb_tpu.serve.tailer import WalTailer
+from opentsdb_tpu.server.tsd import TSDServer
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.storage.sstable import series_hash
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+N_POINTS = 3000
+
+
+def owner_metric(owner: int, n_backends: int = 2) -> str:
+    """A '<agg>:<metric>' m-spec whose series hash routes to
+    ``owner`` (the router hashes the whole sub-query spec)."""
+    for i in range(1000):
+        m = f"sum:route.m{i}"
+        if series_hash(m.encode()) % n_backends == owner:
+            return m
+    raise AssertionError("no metric found")
+
+
+def make_writer(tmp_path):
+    wal = str(tmp_path / "wal")
+    cfg = Config(wal_path=wal, backend="cpu", auto_create_metrics=True,
+                 enable_sketches=False, device_window=False)
+    w = TSDB(MemKVStore(wal_path=wal), cfg,
+             start_compaction_thread=False)
+    for owner in (0, 1):
+        metric = owner_metric(owner).split(":", 1)[1]
+        ts = np.arange(N_POINTS, dtype=np.int64) * 60 + BT
+        w.add_batch(metric, ts,
+                    ((ts % 11) + owner).astype(np.float64),
+                    {"host": "a"})
+    return w
+
+
+def make_replica_server(tmp_path, **cfg_kw):
+    wal = str(tmp_path / "wal")
+    kw = dict(wal_path=wal, backend="cpu", enable_sketches=False,
+              device_window=False, port=0, bind="127.0.0.1",
+              role="replica", max_staleness_ms=60_000.0)
+    kw.update(cfg_kw)
+    cfg = Config(**kw)
+    r = TSDB(MemKVStore(wal_path=wal, read_only=True), cfg,
+             start_compaction_thread=False)
+    server = TSDServer(r)
+    tailer = WalTailer(r, interval_s=3600.0)  # tests drive run_once
+    server.attach_tailer(tailer)
+    return server, r, tailer
+
+
+async def http_get(port, target):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n"
+                 "Connection: close\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    headers = {}
+    for ln in head.split(b"\r\n")[1:]:
+        k, _, v = ln.partition(b":")
+        headers[k.strip().lower().decode()] = v.strip().decode()
+    return status, headers, body
+
+
+class Deployment:
+    """writer TSDB + two replica TSDServers + RouterServer, one loop."""
+
+    def __init__(self, tmp_path, **router_cfg):
+        self.writer = make_writer(tmp_path)
+        self.ra, self.tsdb_a, self.tail_a = make_replica_server(tmp_path)
+        self.rb, self.tsdb_b, self.tail_b = make_replica_server(tmp_path)
+        self.router_cfg = router_cfg
+        self.router: RouterServer | None = None
+
+    async def start(self):
+        await self.ra.start()
+        await self.rb.start()
+        cfg = Config(
+            port=0, bind="127.0.0.1", role="router",
+            router_backends=(f"http://127.0.0.1:{self.ra.port}",
+                             f"http://127.0.0.1:{self.rb.port}"),
+            **self.router_cfg)
+        self.router = RouterServer(cfg)
+        await self.router.start()
+
+    async def stop(self):
+        if self.router is not None:
+            await self.router.stop()
+        for s in (self.ra, self.rb):
+            s._pool.shutdown(wait=False)
+            if s._server is not None:
+                s._server.close()
+                await s._server.wait_closed()
+
+    def shutdown(self):
+        self.tsdb_a.shutdown()
+        self.tsdb_b.shutdown()
+        self.writer.shutdown()
+
+
+def run_deployment(dep, coro_fn):
+    async def main():
+        await dep.start()
+        try:
+            return await coro_fn(dep)
+        finally:
+            await dep.stop()
+    try:
+        return asyncio.run(main())
+    finally:
+        dep.shutdown()
+
+
+def writer_answer(writer, m_spec, end_n=N_POINTS):
+    agg, metric = m_spec.split(":", 1)
+    ex = QueryExecutor(writer, backend="cpu")
+    got = ex.run(QuerySpec(metric, {}, aggregator=agg),
+                 BT - 60, BT + end_n * 60)
+    return {str(int(t)): float(v) for t, v in
+            zip(got[0].timestamps, got[0].values)}
+
+
+class TestFanout:
+    def test_multi_m_fanout_parity_and_ownership(self, tmp_path):
+        dep = Deployment(tmp_path, probe_interval_s=3600.0)
+        m0, m1 = owner_metric(0), owner_metric(1)
+
+        async def drive(dep):
+            q = (f"/q?start={BT - 60}&end={BT + N_POINTS * 60}"
+                 f"&m={m0}&m={m1}&json&nocache")
+            status, _, body = await http_get(dep.router.port, q)
+            return status, json.loads(body)
+
+        status, res = run_deployment(dep, drive)
+        assert status == 200
+        assert len(res) == 2
+        by_metric = {r["metric"]: r["dps"] for r in res}
+        for m in (m0, m1):
+            metric = m.split(":", 1)[1]
+            assert by_metric[metric] == writer_answer(dep.writer, m)
+        # Ownership: each sub-query landed on its owner (one query
+        # per replica, warm-cache affinity).
+        assert dep.ra.http_rpcs >= 1 and dep.rb.http_rpcs >= 1
+
+    def test_ascii_output(self, tmp_path):
+        dep = Deployment(tmp_path, probe_interval_s=3600.0)
+        m0 = owner_metric(0)
+
+        async def drive(dep):
+            q = (f"/q?start={BT - 60}&end={BT + N_POINTS * 60}"
+                 f"&m={m0}&ascii&nocache")
+            return await http_get(dep.router.port, q)
+
+        status, _, body = run_deployment(dep, drive)
+        assert status == 200
+        lines = body.decode().strip().split("\n")
+        assert len(lines) == N_POINTS
+        assert lines[0].split()[0] == m0.split(":", 1)[1]
+
+
+class TestRetry:
+    def test_retry_on_dead_replica(self, tmp_path):
+        dep = Deployment(tmp_path, probe_interval_s=3600.0,
+                         router_retries=2, router_backoff_ms=5.0,
+                         router_hedge_ms=-1.0)
+        m0 = owner_metric(0)
+
+        async def drive(dep):
+            # Kill the OWNER replica's listener: the router's hop
+            # fails to connect and must retry on the other replica.
+            dep.ra._server.close()
+            await dep.ra._server.wait_closed()
+            q = (f"/q?start={BT - 60}&end={BT + N_POINTS * 60}"
+                 f"&m={m0}&json&nocache")
+            status, _, body = await http_get(dep.router.port, q)
+            return status, json.loads(body)
+
+        status, res = run_deployment(dep, drive)
+        assert status == 200
+        assert res[0]["dps"] == writer_answer(dep.writer, m0)
+        from opentsdb_tpu.obs.registry import METRICS
+        assert METRICS.counter("router.retries").value >= 1
+
+    def test_deadline_bounds_wedged_replica(self, tmp_path):
+        dep = Deployment(tmp_path, probe_interval_s=3600.0,
+                         router_retries=1, router_backoff_ms=5.0,
+                         router_hedge_ms=-1.0,
+                         router_deadline_ms=800.0)
+        m0 = owner_metric(0)
+        # Wedge replica A's executor: queries to it hang well past
+        # the deadline.
+        real = dep.ra.executor.run_with_plan
+
+        def slow(*a, **kw):
+            time.sleep(5.0)
+            return real(*a, **kw)
+
+        dep.ra.executor.run_with_plan = slow
+
+        async def drive(dep):
+            t0 = time.monotonic()
+            q = (f"/q?start={BT - 60}&end={BT + N_POINTS * 60}"
+                 f"&m={m0}&json&nocache")
+            status, _, body = await http_get(dep.router.port, q)
+            return status, json.loads(body), time.monotonic() - t0
+
+        status, res, wall = run_deployment(dep, drive)
+        assert status == 200, "retry on B must still answer"
+        assert res[0]["dps"] == writer_answer(dep.writer, m0)
+        assert wall < 4.0, (
+            f"deadline must bound the wedged hop, took {wall:.1f}s")
+
+
+class TestHedging:
+    def test_hedge_wins_and_records_cancelled_span(self, tmp_path):
+        dep = Deployment(tmp_path, probe_interval_s=3600.0,
+                         router_retries=0, router_hedge_ms=50.0,
+                         router_deadline_ms=10_000.0)
+        m0 = owner_metric(0)
+        real = dep.ra.executor.run_with_plan
+
+        def slow(*a, **kw):
+            time.sleep(1.5)
+            return real(*a, **kw)
+
+        dep.ra.executor.run_with_plan = slow
+
+        async def drive(dep):
+            q = (f"/q?start={BT - 60}&end={BT + N_POINTS * 60}"
+                 f"&m={m0}&json&nocache&trace=1")
+            t0 = time.monotonic()
+            status, _, body = await http_get(dep.router.port, q)
+            wall = time.monotonic() - t0
+            _, _, traces = await http_get(dep.router.port,
+                                          "/api/traces")
+            return status, json.loads(body), wall, json.loads(traces)
+
+        status, res, wall, traces = run_deployment(dep, drive)
+        assert status == 200
+        assert res[0]["dps"] == writer_answer(dep.writer, m0)
+        assert wall < 1.4, "the hedge must win long before the " \
+                           "wedged primary"
+        from opentsdb_tpu.obs.registry import METRICS
+        assert METRICS.counter("router.hedges").value >= 1
+        assert METRICS.counter("router.hedge_wins").value >= 1
+        # The loser shows up as a cancelled child span in the tree.
+        rec = traces[-1]
+        spans = rec["trace"]["spans"]
+        cancelled = [s for s in spans
+                     if s["tags"].get("cancelled")]
+        won = [s for s in spans if s["tags"].get("hedged")
+               and not s["tags"].get("cancelled")]
+        assert cancelled and won
+        assert cancelled[0]["tags"]["backend"] != \
+            won[0]["tags"]["backend"]
+
+
+class TestHealthProbes:
+    def test_eject_and_readmit(self, tmp_path):
+        dep = Deployment(tmp_path, probe_interval_s=0.05,
+                         router_eject_after=2, router_retries=2,
+                         router_backoff_ms=5.0, router_hedge_ms=-1.0)
+        m0 = owner_metric(0)
+
+        async def drive(dep):
+            port_a = dep.ra.port
+            # Down A; probes must eject it.
+            dep.ra._server.close()
+            await dep.ra._server.wait_closed()
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if not dep.router.backends[0].healthy:
+                    break
+            assert not dep.router.backends[0].healthy, "never ejected"
+            # Queries owned by A keep answering (via B), and skip the
+            # dead backend entirely (candidate order puts it last).
+            q = (f"/q?start={BT - 60}&end={BT + N_POINTS * 60}"
+                 f"&m={m0}&json&nocache")
+            status, _, body = await http_get(dep.router.port, q)
+            assert status == 200
+            # Bring A back ON ITS OLD PORT; probes must readmit.
+            dep.ra._server = await asyncio.start_server(
+                dep.ra._handle_conn, "127.0.0.1", port_a)
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if dep.router.backends[0].healthy:
+                    break
+            assert dep.router.backends[0].healthy, "never readmitted"
+            _, _, hz = await http_get(dep.router.port, "/healthz")
+            return json.loads(hz), json.loads(body)
+
+        hz, res = run_deployment(dep, drive)
+        assert hz["ok"] is True
+        assert all(b["healthy"] for b in hz["backends"])
+        assert res[0]["dps"] == writer_answer(dep.writer, m0)
+        from opentsdb_tpu.obs.registry import METRICS
+        assert METRICS.counter("router.ejections").value >= 1
+        assert METRICS.counter("router.readmissions").value >= 1
+
+    def test_stale_replica_tag_propagates(self, tmp_path):
+        dep = Deployment(tmp_path, probe_interval_s=3600.0,
+                         router_retries=0, router_hedge_ms=-1.0)
+        m0 = owner_metric(0)
+        # Force the owner replica stale: contract bound of ~0.
+        dep.tail_a.max_staleness_ms = 0.001
+        dep.tail_b.max_staleness_ms = 0.001
+
+        async def drive(dep):
+            await asyncio.sleep(0.01)
+            q = (f"/q?start={BT - 60}&end={BT + N_POINTS * 60}"
+                 f"&m={m0}&json&nocache")
+            return await http_get(dep.router.port, q)
+
+        status, headers, body = run_deployment(dep, drive)
+        assert status == 200
+        assert "stale" in headers.get("x-tsd-degraded", "")
+        assert "stale" in json.loads(body)[0]["degraded"]
+
+
+class TestTracePropagation:
+    def test_one_trace_id_spans_router_and_replica(self, tmp_path):
+        dep = Deployment(tmp_path, probe_interval_s=3600.0,
+                         router_hedge_ms=-1.0)
+        m0, m1 = owner_metric(0), owner_metric(1)
+
+        async def drive(dep):
+            q = (f"/q?start={BT - 60}&end={BT + N_POINTS * 60}"
+                 f"&m={m0}&m={m1}&json&nocache&trace=1")
+            status, _, body = await http_get(dep.router.port, q)
+            _, _, rt = await http_get(dep.router.port, "/api/traces")
+            _, _, ra = await http_get(dep.ra.port, "/api/traces")
+            _, _, rb = await http_get(dep.rb.port, "/api/traces")
+            return (status, json.loads(body), json.loads(rt),
+                    json.loads(ra), json.loads(rb))
+
+        status, res, rt, ra, rb = run_deployment(dep, drive)
+        assert status == 200
+        router_rec = rt[-1]
+        tid = router_rec["trace_id"]
+        assert tid
+        # The SAME id landed in both replicas' rings.
+        assert any(r.get("trace_id") == tid for r in ra)
+        assert any(r.get("trace_id") == tid for r in rb)
+        # The router's tree contains one hop per sub-query, each
+        # carrying the replica's grafted span subtree.
+        hops = [s for s in router_rec["trace"]["spans"]
+                if s["name"] == "hop"]
+        assert len(hops) == 2
+        for h in hops:
+            assert h["tags"]["status"] == 200
+            sub = h.get("spans")
+            assert sub and sub[0]["name"] == "query", \
+                "replica span tree must graft under the hop"
+        # Results carry the id too (client-side correlation).
+        assert all(r.get("trace_id") == tid for r in res)
+
+
+class TestPutForwarding:
+    def test_put_forwards_to_writer_and_sheds_over_quota(self, tmp_path):
+        # The router's writer is a THIRD daemon over a separate store
+        # (the writer TSDB in Deployment holds its flock).
+        wdir = tmp_path / "w2"
+        wdir.mkdir()
+        cfg = Config(wal_path=str(wdir / "wal"), backend="cpu",
+                     auto_create_metrics=True, enable_sketches=False,
+                     device_window=False, port=0, bind="127.0.0.1")
+        wtsdb = TSDB(MemKVStore(wal_path=str(wdir / "wal")), cfg,
+                     start_compaction_thread=False)
+        wserver = TSDServer(wtsdb)
+        dep = Deployment(tmp_path, probe_interval_s=3600.0,
+                         ingest_rate=2.0, ingest_burst_s=1.0)
+
+        async def drive(dep):
+            await wserver.start()
+            try:
+                dep.router.writer_url = \
+                    f"http://127.0.0.1:{wserver.port}"
+                from opentsdb_tpu.serve.router import Backend
+                dep.router._writer = Backend(dep.router.writer_url)
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", dep.router.port)
+                for i in range(6):
+                    writer.write(
+                        f"put fwd.m {BT + i} {i} host=h\n".encode())
+                await writer.drain()
+                await asyncio.sleep(0.3)
+                writer.close()
+                out = await reader.read()
+                await asyncio.sleep(0.2)
+                return out
+            finally:
+                wserver._pool.shutdown(wait=False)
+                wserver._server.close()
+                await wserver._server.wait_closed()
+
+        out = run_deployment(dep, drive)
+        # Quota: 2/s burst 2 -> the tail of the burst shed loudly.
+        assert b"Please throttle writes" in out
+        assert dep.router.telnet_lines_forwarded >= 1
+        # The admitted lines LANDED in the writer.
+        ex = QueryExecutor(wtsdb, backend="cpu")
+        got = ex.run(QuerySpec("fwd.m", {}, aggregator="count"),
+                     BT - 60, BT + 60)
+        wtsdb.shutdown()
+        assert float(got[0].values.sum()) == \
+            dep.router.telnet_lines_forwarded

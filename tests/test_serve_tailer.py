@@ -1,0 +1,386 @@
+"""Serve-tier replica tests: WAL tailing, the bounded-staleness
+contract, /healthz, and replica-side rollup reads (opentsdb_tpu/serve/
+tailer.py + rollup/tier.py ReadOnlyRollupTier)."""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.fault import faultpoints
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.serve.tailer import WalTailer
+from opentsdb_tpu.server.tsd import TSDServer
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+
+
+def make_writer(tmp_path, rollups=False, **kw):
+    wal = str(tmp_path / "wal")
+    cfg = Config(wal_path=wal, backend="cpu", auto_create_metrics=True,
+                 enable_sketches=False, device_window=False,
+                 enable_rollups=rollups, rollup_catchup="sync", **kw)
+    return TSDB(MemKVStore(wal_path=wal), cfg,
+                start_compaction_thread=False)
+
+
+def make_replica(tmp_path, rollups=False, max_staleness_ms=0.0, **kw):
+    wal = str(tmp_path / "wal")
+    cfg = Config(wal_path=wal, backend="cpu", enable_sketches=False,
+                 device_window=False, enable_rollups=rollups,
+                 max_staleness_ms=max_staleness_ms, role="replica",
+                 **kw)
+    return TSDB(MemKVStore(wal_path=wal, read_only=True), cfg,
+                start_compaction_thread=False)
+
+
+def ingest(tsdb, n=600, t0=BT, step=60, metric="serve.m",
+           tags=None, base_val=0):
+    ts = np.arange(n, dtype=np.int64) * step + t0
+    vals = ((np.arange(n) % 97) + base_val).astype(np.float64)
+    tsdb.add_batch(metric, ts, vals, tags or {"host": "a"})
+    return ts
+
+
+class TestTailer:
+    def test_suffix_tail_converges_without_checkpoint(self, tmp_path):
+        w = make_writer(tmp_path)
+        try:
+            ingest(w, 500)
+            r = make_replica(tmp_path)
+            try:
+                t = WalTailer(r, interval_s=0.01)
+                assert t.run_once()
+                ingest(w, 100, t0=BT + 500 * 60)  # WAL suffix only
+                assert t.run_once()
+                ex_w = QueryExecutor(w, backend="cpu")
+                ex_r = QueryExecutor(r, backend="cpu")
+                spec = QuerySpec("serve.m", {}, aggregator="sum")
+                a = ex_w.run(spec, BT, BT + 700 * 60)
+                b = ex_r.run(spec, BT, BT + 700 * 60)
+                assert np.array_equal(a[0].values, b[0].values)
+                assert t.refreshes == 2 and t.errors == 0
+            finally:
+                r.shutdown()
+        finally:
+            w.shutdown()
+
+    def test_tail_across_writer_checkpoint(self, tmp_path):
+        w = make_writer(tmp_path)
+        try:
+            ingest(w, 400)
+            r = make_replica(tmp_path)
+            try:
+                t = WalTailer(r, interval_s=0.01)
+                assert t.run_once()
+                w.checkpoint()  # rotation: rebuild path
+                ingest(w, 50, t0=BT + 400 * 60)
+                assert t.run_once()
+                ex_r = QueryExecutor(r, backend="cpu")
+                got = ex_r.run(QuerySpec("serve.m", {},
+                                         aggregator="count"),
+                               BT, BT + 500 * 60)
+                assert float(got[0].values.sum()) == 450
+            finally:
+                r.shutdown()
+        finally:
+            w.shutdown()
+
+    def test_lag_grows_on_refresh_failure_and_recovers(self, tmp_path):
+        w = make_writer(tmp_path)
+        r = make_replica(tmp_path, max_staleness_ms=40.0)
+        try:
+            t = WalTailer(r, interval_s=0.01)
+            assert t.run_once() and not t.stale()
+            faultpoints.arm("replica.refresh", "ioerror", count=1000)
+            try:
+                assert not t.run_once()
+                assert t.errors == 1
+                time.sleep(0.06)
+                assert not t.run_once()
+                assert t.stale(), (
+                    "lag beyond max_staleness_ms must trip the "
+                    "contract while refreshes keep failing")
+                h = t.health()
+                assert h["ok"] is False and h["stale"] is True
+                assert h["lag_ms"] > 40.0
+            finally:
+                faultpoints.disarm("replica.refresh")
+            assert t.run_once()
+            assert not t.stale(), "a clean catch-up resets the clock"
+        finally:
+            r.shutdown()
+            w.shutdown()
+
+    def test_dead_writer_leaves_replica_fresh(self, tmp_path):
+        # A writer that STOPS is not staleness: the replica holds
+        # everything durable, and refresh keeps succeeding (no-op).
+        w = make_writer(tmp_path)
+        ingest(w, 100)
+        w.shutdown()
+        r = make_replica(tmp_path, max_staleness_ms=30.0)
+        try:
+            t = WalTailer(r, interval_s=0.01)
+            assert t.run_once()
+            time.sleep(0.05)
+            assert t.run_once()
+            assert not t.stale()
+        finally:
+            r.shutdown()
+
+
+class TestReplicaRollups:
+    def test_rollup_served_parity(self, tmp_path):
+        w = make_writer(tmp_path, rollups=True)
+        try:
+            ingest(w, 5000)
+            w.checkpoint()
+            r = make_replica(tmp_path, rollups=True)
+            try:
+                from opentsdb_tpu.rollup.tier import ReadOnlyRollupTier
+                assert isinstance(r.rollups, ReadOnlyRollupTier)
+                assert r.rollups.ready
+                ex_w = QueryExecutor(w, backend="cpu")
+                ex_r = QueryExecutor(r, backend="cpu")
+                spec = QuerySpec("serve.m", {}, aggregator="sum",
+                                 downsample=(3600, "sum"))
+                aw, pw, _ = ex_w.run_with_plan(spec, BT, BT + 5000 * 60)
+                ar, pr, _ = ex_r.run_with_plan(spec, BT, BT + 5000 * 60)
+                assert pw == pr == "1h", (pw, pr)
+                assert np.array_equal(aw[0].values, ar[0].values)
+            finally:
+                r.shutdown()
+        finally:
+            w.shutdown()
+
+    def test_pending_state_degrades_to_raw(self, tmp_path):
+        w = make_writer(tmp_path, rollups=True)
+        try:
+            ingest(w, 3000)
+            w.checkpoint()
+            r = make_replica(tmp_path, rollups=True)
+            try:
+                assert r.rollups.ready
+                # Simulate the writer opening its spill bracket: the
+                # replica must park the tier not-ready (raw answers)
+                # instead of trusting mid-fold records.
+                w.rollups._write_state(pending=True)
+                assert r.refresh_replica() is not None
+                assert not r.rollups.ready
+                ex_r = QueryExecutor(r, backend="cpu")
+                spec = QuerySpec("serve.m", {}, aggregator="sum",
+                                 downsample=(3600, "sum"))
+                _, plan, _ = ex_r.run_with_plan(spec, BT,
+                                                BT + 3000 * 60)
+                assert plan == "raw"
+                w.rollups._write_state(pending=False)
+                r.refresh_replica()
+                assert r.rollups.ready
+            finally:
+                r.shutdown()
+        finally:
+            w.shutdown()
+
+    def test_tail_after_new_fold_stays_bit_identical(self, tmp_path):
+        # Live writer keeps checkpointing (new folds) while the
+        # replica tails: replica rollup answers must track the writer
+        # exactly at every step.
+        w = make_writer(tmp_path, rollups=True)
+        try:
+            ingest(w, 2000)
+            w.checkpoint()
+            r = make_replica(tmp_path, rollups=True)
+            try:
+                t = WalTailer(r, interval_s=0.01)
+                ex_w = QueryExecutor(w, backend="cpu")
+                ex_r = QueryExecutor(r, backend="cpu")
+                spec = QuerySpec("serve.m", {}, aggregator="sum",
+                                 downsample=(3600, "sum"))
+                for round_i in range(3):
+                    ingest(w, 500, t0=BT + (2000 + round_i * 500) * 60,
+                           base_val=round_i)
+                    w.checkpoint()
+                    t.run_once()
+                    end = BT + (2500 + round_i * 500) * 60
+                    aw = ex_w.run(spec, BT, end)
+                    ar = ex_r.run(spec, BT, end)
+                    assert np.array_equal(aw[0].values, ar[0].values), \
+                        f"round {round_i} diverged"
+            finally:
+                r.shutdown()
+        finally:
+            w.shutdown()
+
+
+async def http_get(port, target):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n"
+                 "Connection: close\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    headers = {}
+    for ln in head.split(b"\r\n")[1:]:
+        k, _, v = ln.partition(b":")
+        headers[k.strip().lower().decode()] = v.strip().decode()
+    return status, headers, body
+
+
+def run_with_server(server, coro_fn):
+    async def main():
+        await server.start()
+        try:
+            return await coro_fn(server.port)
+        finally:
+            server._pool.shutdown(wait=False)
+            server._server.close()
+            await server._server.wait_closed()
+    return asyncio.run(main())
+
+
+class TestHealthzAndStaleTag:
+    def test_healthz_writer(self, tmp_path):
+        w = make_writer(tmp_path)
+        server = TSDServer(w)
+
+        async def drive(port):
+            return await http_get(port, "/healthz")
+
+        status, _, body = run_with_server(server, drive)
+        w.shutdown()
+        assert status == 200
+        h = json.loads(body)
+        assert h["ok"] is True and h["role"] == "writer"
+
+    def test_healthz_and_stale_tag_replica(self, tmp_path):
+        w = make_writer(tmp_path)
+        ingest(w, 300)
+        r = make_replica(tmp_path, max_staleness_ms=30.0)
+        server = TSDServer(r)
+        tailer = WalTailer(r, interval_s=0.01)
+        server.attach_tailer(tailer)
+        tailer.run_once()
+
+        async def drive(port):
+            s1, h1, b1 = await http_get(port, "/healthz")
+            # Freeze the tailer and outwait the contract: the replica
+            # must declare itself stale everywhere.
+            await asyncio.sleep(0.05)
+            s2, h2, b2 = await http_get(port, "/healthz")
+            q = ("/q?start=" + str(BT - 60) + "&end="
+                 + str(BT + 400 * 60) + "&m=sum:serve.m&json&nocache")
+            s3, h3, b3 = await http_get(port, q)
+            return (s1, json.loads(b1)), (s2, json.loads(b2)), \
+                (s3, h3, json.loads(b3))
+
+        (s1, h1), (s2, h2), (s3, hdr3, res3) = run_with_server(
+            server, drive)
+        r.shutdown()
+        w.shutdown()
+        assert s1 == 200 and h1["ok"] is True
+        assert h1["lag_ms"] < 30.0
+        assert s2 == 503 and h2["stale"] is True
+        assert s3 == 200
+        assert hdr3.get("x-tsd-degraded") == "stale"
+        assert all(ent["degraded"] == "stale" for ent in res3)
+
+
+class TestBoundedStalenessGolden:
+    def test_contract_under_live_ingest(self, tmp_path):
+        """The acceptance-criteria oracle, in process: during live
+        ingest a replica answer either reflects every WAL record older
+        than max_staleness_ms, or carries the stale tag — golden
+        against the writer's answer."""
+        stale_ms = 200.0
+        w = make_writer(tmp_path)
+        r = make_replica(tmp_path, max_staleness_ms=stale_ms)
+        server = TSDServer(r)
+        tailer = WalTailer(r, interval_s=0.02)
+        server.attach_tailer(tailer)
+        ex_w = QueryExecutor(w, backend="cpu")
+
+        def writer_answer(end_n):
+            got = ex_w.run(QuerySpec("serve.m", {}, aggregator="sum"),
+                           BT - 60, BT + end_n * 60)
+            return {int(t): float(v) for t, v in
+                    zip(got[0].timestamps, got[0].values)}
+
+        async def drive(port):
+            outcomes = []
+            n = 0
+            for batch in range(6):
+                ingest(w, 50, t0=BT + n * 60)
+                n += 50
+                t_ack = time.monotonic()
+                tailer.run_once()
+                # Outwait the bound: every acked record is now "older
+                # than max_staleness_ms".
+                while (time.monotonic() - t_ack) * 1000 <= stale_ms:
+                    await asyncio.sleep(0.02)
+                    tailer.run_once()
+                q = (f"/q?start={BT - 60}&end={BT + n * 60}"
+                     f"&m=sum:serve.m&json&nocache")
+                status, hdrs, body = await http_get(port, q)
+                assert status == 200
+                res = json.loads(body)
+                tagged = "stale" in hdrs.get("x-tsd-degraded", "")
+                got = {int(t): float(v)
+                       for t, v in res[0]["dps"].items()}
+                outcomes.append((tagged, got, writer_answer(n)))
+            return outcomes
+
+        outcomes = run_with_server(server, drive)
+        r.shutdown()
+        w.shutdown()
+        fresh = 0
+        for tagged, got, want in outcomes:
+            if tagged:
+                continue  # contract satisfied by declaration
+            assert got == want, ("untagged replica answer missing "
+                                 "records older than the bound")
+            fresh += 1
+        assert fresh >= 1, "tailer never caught up — vacuous test"
+
+    def test_violation_is_visible_when_tailer_wedged(self, tmp_path):
+        """With refresh failing, new acked records stay invisible —
+        the contract demands the stale tag (this is the exact
+        violation the servematrix gate re-introduces via
+        TSDB_SERVE_BUG=stale-serve)."""
+        w = make_writer(tmp_path)
+        ingest(w, 100)
+        r = make_replica(tmp_path, max_staleness_ms=30.0)
+        server = TSDServer(r)
+        tailer = WalTailer(r, interval_s=0.01)
+        server.attach_tailer(tailer)
+        tailer.run_once()
+
+        async def drive(port):
+            faultpoints.arm("replica.refresh", "ioerror", count=10_000)
+            try:
+                ingest(w, 100, t0=BT + 100 * 60)  # never reaches r
+                await asyncio.sleep(0.05)
+                tailer.run_once()
+                q = (f"/q?start={BT - 60}&end={BT + 200 * 60}"
+                     f"&m=count:serve.m&json&nocache")
+                status, hdrs, body = await http_get(port, q)
+            finally:
+                faultpoints.disarm("replica.refresh")
+            return status, hdrs, json.loads(body)
+
+        status, hdrs, res = run_with_server(server, drive)
+        r.shutdown()
+        w.shutdown()
+        assert status == 200
+        # The answer IS stale (missing the second batch)...
+        total = sum(res[0]["dps"].values())
+        assert total == 100
+        # ...and says so.
+        assert "stale" in hdrs.get("x-tsd-degraded", "")

@@ -1,0 +1,77 @@
+"""Serve-tier fault matrix tests (scripts/servematrix.py): the tier-1
+fast subset (replica kill + router partition against a live
+multi-process deployment), the bounded-staleness-oracle GATE
+(--bug stale-serve must be caught), and the slow full sweep with a
+seed-determinism check."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "servematrix.py")
+
+
+def run_matrix(tmp_path, *args, timeout=420):
+    out_json = str(tmp_path / "serve.json")
+    r = subprocess.run(
+        [sys.executable, SCRIPT, "--json", out_json,
+         "--work-dir", str(tmp_path / "work")] + list(args),
+        capture_output=True, text=True, timeout=timeout, cwd=REPO)
+    art = None
+    if os.path.exists(out_json):
+        with open(out_json) as f:
+            art = json.load(f)
+    return r, art
+
+
+class TestFastSubset:
+    """The tier-1 leg: one live deployment, replica killed mid-query
+    + router partitioned from one replica, answers golden vs the
+    writer, ejection + readmission observed."""
+
+    def test_fast_scenarios_pass(self, tmp_path):
+        r, art = run_matrix(tmp_path, "--fast")
+        assert art is not None, r.stderr[-2000:]
+        assert r.returncode == 0, (
+            [x["problems"] for x in art["results"]], r.stderr[-2000:])
+        assert art["passed"] == art["scenarios"] == 2
+        labels = {x["label"] for x in art["results"]}
+        assert labels == {"replica-kill", "router-partition"}
+
+
+class TestStalenessGate:
+    """The matrix must CATCH a replica that serves beyond
+    max_staleness_ms without the degraded tag (TSDB_SERVE_BUG=
+    stale-serve re-introduces exactly that lie)."""
+
+    def test_bug_is_caught(self, tmp_path):
+        r, art = run_matrix(tmp_path, "--only", "staleness",
+                            "--bug", "stale-serve")
+        assert art is not None, r.stderr[-2000:]
+        assert r.returncode != 0, \
+            "sabotaged replica passed the oracle — the gate is dead"
+        res = art["results"][0]
+        assert res["status"] == "invariant-failed"
+        assert any("STALENESS CONTRACT VIOLATION" in p
+                   for p in res["problems"]), res["problems"]
+        # The repro line is self-contained (bug flag included).
+        assert "--bug stale-serve" in res["repro"]
+
+
+@pytest.mark.slow
+class TestFullSweep:
+    def test_all_scenarios_and_determinism(self, tmp_path):
+        r1, a1 = run_matrix(tmp_path / "r1", timeout=600)
+        assert r1.returncode == 0, (
+            a1 and [x["problems"] for x in a1["results"]],
+            r1.stderr[-2000:])
+        assert a1["passed"] == a1["scenarios"] == 4
+        r2, a2 = run_matrix(tmp_path / "r2", timeout=600)
+        assert r2.returncode == 0
+        f1 = {x["label"]: x["fingerprint"] for x in a1["results"]}
+        f2 = {x["label"]: x["fingerprint"] for x in a2["results"]}
+        assert f1 == f2, "serve matrix is not seed-deterministic"

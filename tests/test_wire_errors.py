@@ -1,0 +1,231 @@
+"""Wire error-path coverage (the serve-tier hardening satellite):
+malformed telnet put lines, oversized HTTP bodies/headers, and
+mid-request client disconnects must produce clean errors, bump the
+http.errors/telnet.errors registry counters, and NEVER wedge a
+handler — the server keeps answering on a fresh connection after
+every abuse."""
+
+import asyncio
+import json
+
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.obs.registry import METRICS
+from opentsdb_tpu.server.tsd import TSDServer
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+
+
+@pytest.fixture
+def server_env():
+    cfg = Config(auto_create_metrics=True, port=0, bind="127.0.0.1",
+                 backend="cpu", enable_sketches=False,
+                 device_window=False)
+    tsdb = TSDB(MemKVStore(), cfg, start_compaction_thread=False)
+    server = TSDServer(tsdb)
+    yield server, tsdb
+    tsdb.shutdown()
+
+
+def run_async(server, coro_fn):
+    async def main():
+        await server.start()
+        try:
+            return await coro_fn(server.port)
+        finally:
+            server._pool.shutdown(wait=False)
+            server._server.close()
+            await server._server.wait_closed()
+    return asyncio.run(main())
+
+
+async def raw_http(port, payload: bytes, read=True):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(payload)
+    await writer.drain()
+    data = b""
+    if read:
+        data = await reader.read()
+    writer.close()
+    try:
+        await writer.wait_closed()
+    except Exception:
+        pass
+    return data
+
+
+async def liveness(port) -> bool:
+    """The post-abuse invariant: a FRESH connection still answers."""
+    data = await raw_http(
+        port, b"GET /version HTTP/1.1\r\nHost: x\r\n"
+              b"Connection: close\r\n\r\n")
+    return b"200" in data.split(b"\r\n", 1)[0]
+
+
+def errors():
+    return (METRICS.counter("http.errors").value,
+            METRICS.counter("telnet.errors").value)
+
+
+class TestTelnetErrorPaths:
+    def test_malformed_put_lines_bump_counter(self, server_env):
+        server, tsdb = server_env
+        h0, t0 = errors()
+
+        async def drive(port):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            # A burst of distinct malformations: short line, bad
+            # timestamp, bad value, no tags, non-put command.
+            writer.write(b"put onlymetric\n"
+                         b"put m.x notatime 1 host=a\n"
+                         b"put m.x 1356998400 notanum host=a\n"
+                         b"put m.x 1356998400 1\n"
+                         b"bogus command here\n")
+            await writer.drain()
+            await asyncio.sleep(0.2)
+            writer.write(b"exit\n")
+            await writer.drain()
+            out = await reader.read()
+            writer.close()
+            return out, await liveness(port)
+
+        out, alive = run_async(server, drive)
+        assert alive, "handler wedged after malformed puts"
+        assert out.count(b"put:") >= 4, out
+        assert b"unknown command" in out
+        _, t1 = errors()
+        assert t1 - t0 >= 5, (
+            f"telnet.errors moved {t1 - t0}, want >= 5")
+        # No point landed.
+        assert tsdb.datapoints_added == 0
+
+    def test_oversized_telnet_line_closes_cleanly(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            # One 2 KiB command line (> MAX_LINE): framing protection
+            # must close THIS connection without taking the server.
+            writer.write(b"x" * 2048 + b"\n")
+            await writer.drain()
+            closed = await reader.read()
+            writer.close()
+            return closed, await liveness(port)
+
+        closed, alive = run_async(server, drive)
+        assert alive, "server died with the abusive connection"
+        assert closed == b""  # closed, nothing leaked
+
+
+class TestHttpErrorPaths:
+    def test_oversized_body_413(self, server_env):
+        server, _ = server_env
+        h0, _ = errors()
+
+        async def drive(port):
+            body = b"z" * 100
+            payload = (b"POST /q HTTP/1.1\r\nHost: x\r\n"
+                       b"Content-Length: 9999999999\r\n\r\n" + body)
+            data = await raw_http(port, payload)
+            return data, await liveness(port)
+
+        data, alive = run_async(server, drive)
+        assert alive
+        assert b"413" in data.split(b"\r\n", 1)[0]
+        h1, _ = errors()
+        assert h1 > h0
+
+    def test_oversized_headers_431(self, server_env):
+        server, _ = server_env
+
+        async def drive(port):
+            payload = (b"GET /q HTTP/1.1\r\n"
+                       + b"X-Junk: " + b"j" * 70000 + b"\r\n\r\n")
+            data = await raw_http(port, payload)
+            return data, await liveness(port)
+
+        data, alive = run_async(server, drive)
+        assert alive
+        assert b"431" in data.split(b"\r\n", 1)[0]
+
+    def test_bad_request_and_404_bump_counter(self, server_env):
+        server, _ = server_env
+        h0, _ = errors()
+
+        async def drive(port):
+            a = await raw_http(port,
+                               b"GET /q HTTP/1.1\r\nHost: x\r\n"
+                               b"Connection: close\r\n\r\n")
+            b = await raw_http(port,
+                               b"GET /nosuch HTTP/1.1\r\nHost: x\r\n"
+                               b"Connection: close\r\n\r\n")
+            return a, b
+
+        a, b = run_async(server, drive)
+        assert b"400" in a.split(b"\r\n", 1)[0]  # missing start param
+        assert b"404" in b.split(b"\r\n", 1)[0]
+        h1, _ = errors()
+        assert h1 - h0 >= 2
+
+    def test_mid_request_disconnects_never_wedge(self, server_env):
+        """Clients vanishing at every framing stage: mid-headers,
+        mid-body, and mid-telnet-burst. Each handler must unwind; the
+        server answers normally afterwards and counts no uncaught
+        exceptions."""
+        server, _ = server_env
+
+        async def drive(port):
+            # Disconnect mid-headers.
+            await raw_http(port, b"GET /q HTTP/1.1\r\nHost", read=False)
+            # Disconnect mid-body (Content-Length promises more).
+            await raw_http(port,
+                           b"POST /q HTTP/1.1\r\nHost: x\r\n"
+                           b"Content-Length: 5000\r\n\r\nonly-this",
+                           read=False)
+            # Disconnect mid-telnet-burst (no trailing newline).
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            writer.write(b"put m.x 1356998400 1 host=a\nput m.y 135")
+            await writer.drain()
+            writer.close()
+            await asyncio.sleep(0.2)
+            return await liveness(port)
+
+        alive = run_async(server, drive)
+        assert alive, "a mid-request disconnect wedged the server"
+        assert server.exceptions_caught == 0, (
+            "disconnects must unwind cleanly, not as caught "
+            "exceptions")
+
+
+class TestShedResponsesCount:
+    def test_429_counts_as_http_error(self):
+        cfg = Config(auto_create_metrics=True, port=0,
+                     bind="127.0.0.1", backend="cpu",
+                     enable_sketches=False, device_window=False,
+                     query_rate=1.0, query_burst=1.0)
+        tsdb = TSDB(MemKVStore(), cfg, start_compaction_thread=False)
+        tsdb.add_point("m.a", BT + 1, 1, {"h": "x"})
+        server = TSDServer(tsdb)
+        h0, _ = errors()
+
+        async def drive(port):
+            outs = []
+            for _ in range(3):
+                outs.append(await raw_http(
+                    port,
+                    f"GET /q?start={BT}&m=sum:m.a&json&nocache "
+                    f"HTTP/1.1\r\nHost: x\r\n"
+                    f"Connection: close\r\n\r\n".encode()))
+            return outs
+
+        outs = run_async(server, drive)
+        tsdb.shutdown()
+        assert any(b"429" in o.split(b"\r\n", 1)[0] for o in outs)
+        h1, _ = errors()
+        assert h1 > h0
