@@ -1,0 +1,42 @@
+"""Cluster write tier: epoch-fenced writer failover + sharded ingest.
+
+The reference never solves distribution itself — it delegates it to
+HBase region servers (PAPER.md §storage), and this engine's analog of
+a region server is the writer process. PR 7's serve tier made *reads*
+resilient (WAL-tailing replicas, hedged router, admission); this
+package makes the *write path* survive and scale:
+
+- ``epoch``: the ownership protocol. A monotonically increasing
+  writer epoch persisted next to the WAL (``EPOCH.json``, atomic
+  write + rename — the ``SHARDS.json`` discipline) and stamped into
+  WAL segment headers, so a deposed zombie writer's appends are
+  refused on replay and every mutation on a superseded writer raises
+  ``FencedWriterError`` (core/errors.py).
+- ``promote``: the router-side failover driver. When the writer's
+  ``/healthz`` stays dead past a configured grace, the router asks a
+  healthy replica to ``/promote``: the replica bumps the epoch,
+  reopens the WAL tail read-write under a guaranteed-fresh inode
+  (the PR-1 inode + cursor machinery is the foundation), and ingest
+  forwarding flips to it. A returned old writer is ``/demote``-d back
+  to tailing.
+- ``ownership``: the multi-writer shard map. ``SHARDS.json``
+  generalized to ``CLUSTER.json`` — series-hash slots → writer,
+  versioned by an epoch the router consults for both ingest fan-out
+  and read fan-out; shard handoff is a drain-then-transfer epoch
+  bump, with per-slot owner history keeping reads exact across the
+  split.
+
+Every durability-relevant step carries faultpoint sites
+(``cluster.promote.*``, ``cluster.handoff.*``, ``cluster.epoch.*``)
+with crash-matrix and serve-matrix rows; ``scripts/servematrix.py
+--bug split-brain`` proves the matrix catches a deliberately unfenced
+zombie writer.
+"""
+
+from opentsdb_tpu.cluster.epoch import (EpochGuard, bump_epoch,
+                                        epoch_path_for_wal, read_epoch,
+                                        write_epoch)
+from opentsdb_tpu.cluster.ownership import OwnershipMap
+
+__all__ = ["EpochGuard", "OwnershipMap", "bump_epoch",
+           "epoch_path_for_wal", "read_epoch", "write_epoch"]

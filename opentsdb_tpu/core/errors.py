@@ -56,6 +56,28 @@ class OverloadedError(Exception):
         self.status = status
 
 
+class FencedWriterError(Exception):
+    """This writer's epoch has been superseded (cluster/epoch.py).
+
+    A monotonically increasing writer epoch is persisted next to the
+    WAL (EPOCH.json); a replica promotion bumps it. A deposed writer
+    that keeps running — wedged through its health grace, then woken —
+    sees the bump on its next fence check and every mutation refuses
+    with this error instead of silently split-braining the store.
+
+    Subclasses Exception (the ReadOnlyStoreError precedent), NOT
+    OSError: broad ``except OSError`` handlers around storage I/O must
+    never swallow a fence refusal as a disk hiccup — the writer is no
+    longer the writer, and the caller has to hear it.
+    """
+
+    def __init__(self, message: str, own_epoch: int = 0,
+                 current_epoch: int = 0):
+        super().__init__(message)
+        self.own_epoch = own_epoch
+        self.current_epoch = current_epoch
+
+
 class NoSuchUniqueName(Exception):
     """Name -> UID lookup failed (reference src/uid/NoSuchUniqueName.java)."""
 

@@ -63,6 +63,13 @@ class TSDB:
         # folded, so stale summaries get served (and a crash in the gap
         # skips the rebuild).
         self._checkpoint_lock = threading.Lock()
+        # Cluster write tier (cluster/): the epoch file this daemon's
+        # store is governed by (None = not a cluster member). Set by
+        # the CLI when --cluster is on; collect_stats exports the
+        # current epoch as tsd.cluster.epoch so the self-monitoring
+        # loop makes epoch SKEW between daemons alertable
+        # (`tsdb check --skew`).
+        self.cluster_epoch_path: str | None = None
         # Optional deregistration hook: the CLI's open-TSDB sweep list
         # (tools/cli._OPEN_TSDBS) sets this so shutdown() removes the
         # entry — embedders calling make_tsdb() outside main() would
@@ -219,6 +226,114 @@ class TSDB:
         if tier is not None and getattr(tier, "read_only", False):
             tier.refresh()
         return changed
+
+    def promote(self, writer_epoch: int, epoch_guard=None) -> None:
+        """Replica → writer takeover (the cluster failover's storage
+        half; cluster/promote.py and the ``/promote`` endpoint drive
+        it). The caller has already bumped the persisted epoch.
+
+        Order matters: the store takes ownership first (fresh-inode
+        WAL + epoch header, storage/kv.promote_writable), then the
+        sketch state re-initializes in WRITER mode (snapshot load +
+        memtable re-fold — the boot path), then the read-only rollup
+        view swaps for the owning tier (adopting ROLLUP.json; a tier
+        the dead writer left mid-fold rebuilds through the standard
+        pending-marker catch-up). The store + sketch swap runs under
+        the checkpoint lock; the rollup tier swap runs OUTSIDE it
+        (lock discipline below). The device window stays off — a
+        replica never had one, and a promoted writer serves through
+        the scan path until its next restart."""
+        with self._checkpoint_lock:
+            self.store.promote_writable(writer_epoch,
+                                        epoch_guard=epoch_guard)
+            try:
+                if self.config.enable_sketches:
+                    self._init_sketches()
+                old = self.rollups
+                self.rollups = None
+            except BaseException:
+                # The store already committed its takeover; a failure
+                # in the post-store steps (torn sketch snapshot, EIO)
+                # must not leave a HALF-promoted daemon — writable
+                # store + bumped epoch but role still replica, which
+                # would make a retried /promote short-circuit on
+                # "already writer" over broken serving state. Demote
+                # the store back so the caller's recovery (re-attach a
+                # tailer, let the router try the next candidate) acts
+                # on a genuine replica.
+                try:
+                    self.store.demote_readonly()
+                except Exception:
+                    LOG.exception("rollback demote after failed "
+                                  "promotion")
+                raise
+        # Rollup tier swap OUTSIDE the checkpoint lock — the same
+        # discipline shutdown() documents: close() joins the tier's
+        # catch-up thread, and the rebuild-completion commit takes
+        # THIS lock (sync catch-up takes it in the constructor), so
+        # doing either under it deadlocks. The window is safe in the
+        # daemon flow: a promoting replica's compaction timer has
+        # checkpoint_interval 0 until _do_promote restores it after
+        # this returns, so no spill can race the tier-less gap.
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                LOG.exception("closing replica rollup view during "
+                              "promotion")
+        if (self.config.enable_rollups
+                and getattr(self.store, "_wal_path", None)):
+            from opentsdb_tpu.rollup.tier import RollupTier
+            try:
+                self.rollups = RollupTier(self, self.config)
+            except Exception:
+                # The promoted writer must SERVE even when the old
+                # writer's tier is torn; raw answers stay exact and
+                # the operator sees rollup.ready=0.
+                LOG.exception("promoted writer rollup tier "
+                              "unavailable; serving raw")
+
+    def demote(self) -> None:
+        """Writer → tailing replica, in place (a deposed writer that
+        came back and was told so). The owning rollup tier closes
+        BEFORE the store flips — its catch-up thread reads the raw
+        store — then the store drops WAL + flock and rebuilds through
+        the replica recovery path, sketches reload from the (new)
+        writer's snapshot, and the read-only rollup view is adopted
+        exactly as a replica boot would."""
+        # The owning tier closes FIRST and OUTSIDE the checkpoint lock
+        # (the shutdown() discipline): close() joins the catch-up
+        # thread, which acquires this very lock for its completion
+        # commit — joining it while holding the lock deadlocks the
+        # daemon inside /demote. Detach the tier before closing so no
+        # concurrent checkpoint brackets a spill against a
+        # half-closed tier.
+        with self._checkpoint_lock:
+            old = self.rollups
+            self.rollups = None
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                LOG.exception("closing rollup tier during demotion")
+        with self._checkpoint_lock:
+            # Queued row compactions are writer work: a demoted daemon
+            # would only log ReadOnlyStoreError noise trying to write
+            # them back. They're reconstructible soft state — the new
+            # writer re-queues and compacts as it reads.
+            with self.compactionq._lock:
+                self.compactionq._queue.clear()
+            self.store.demote_readonly()
+            self.reload_sketches()
+        if (self.config.enable_rollups
+                and getattr(self.store, "_wal_path", None)):
+            from opentsdb_tpu.rollup.tier import ReadOnlyRollupTier
+            try:
+                self.rollups = ReadOnlyRollupTier(self, self.config)
+            except Exception:
+                # refresh_replica retries adoption every cycle.
+                LOG.exception("demoted daemon rollup view "
+                              "unavailable; serving raw")
 
     def reload_sketches(self) -> None:
         """Replica catch-up: re-load the writer's sketch snapshot and
@@ -796,6 +911,27 @@ class TSDB:
         if dirty is not None:
             collector.record("dirty_set.size",
                              int(len(dirty(self.table))))
+        if self.cluster_epoch_path:
+            # Writers export the epoch they OWN; replicas (and a
+            # fenced ex-writer) export the persisted file's view —
+            # divergence between daemons is exactly the skew signal
+            # the check tool alerts on.
+            epoch = getattr(self.store, "writer_epoch", None)
+            if epoch is None:
+                from opentsdb_tpu.cluster.epoch import read_epoch
+                try:
+                    epoch, _ = read_epoch(self.cluster_epoch_path)
+                except (OSError, ValueError, KeyError):
+                    epoch = None
+            if epoch is not None:
+                collector.record("cluster.epoch", int(epoch))
+            guard = getattr(self.store, "epoch_guard", None)
+            if guard is not None:
+                collector.record("cluster.fenced", int(guard.fenced))
+            refused = getattr(self.store, "fenced_bytes_refused", 0)
+            if refused:
+                collector.record("cluster.fenced_bytes_refused",
+                                 refused)
         cq = self.compactionq
         collector.record("compaction.count", cq.written_cells)
         collector.record("compaction.deleted_cells", cq.deleted_cells)

@@ -721,11 +721,106 @@ def _run_replica_scenario(sc: Scenario, workdir: str) -> dict:
             "repro": f"python scripts/crashmatrix.py --only {sc.label}"}
 
 
+def _run_promote_scenario(sc: Scenario, workdir: str) -> dict:
+    """In-process fault scenarios for the replica-promotion path
+    (cluster/): an injected failure mid-promotion must leave the
+    candidate a coherent, still-read-only replica; a RETRY must take
+    over fully; and the deposed writer must come out fenced — exactly
+    the states the live serve-matrix promote-crash scenario checks at
+    process granularity."""
+    from opentsdb_tpu.cluster import epoch as cepoch
+    from opentsdb_tpu.core.errors import FencedWriterError
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir, exist_ok=True)
+    store_dir = os.path.join(workdir, "store")
+    problems: list[str] = []
+    epoch_path = (os.path.join(store_dir, "EPOCH.json")
+                  if sc.shards > 1
+                  else os.path.join(store_dir, "wal") + ".epoch.json")
+    tsdb = open_tsdb(store_dir, sc.shards, rollups=False)
+    try:
+        cepoch.write_epoch(epoch_path, 1, "writer")
+        # The writer runs UNGUARDED until the fence matters — the ops
+        # below predate the promotion, so they must apply normally.
+        for op in gen_ops(sc.seed, 10):
+            apply_op(tsdb, op)
+        tsdb.checkpoint()
+        apply_op(tsdb, ("ingest", 1, _EXTRA_HOUR, 1, 300, 0, 5))
+        tsdb.store.flush()
+        replica = open_store(store_dir, sc.shards, read_only=True)
+        try:
+            before = _dump_store(replica)
+            new_epoch = cepoch.bump_epoch(epoch_path, "replica",
+                                          expect=1)
+            faultpoints.arm(sc.site, sc.mode, skip=sc.skip,
+                            count=sc.count, seed=sc.seed)
+            try:
+                replica.promote_writable(
+                    new_epoch,
+                    epoch_guard=cepoch.EpochGuard(epoch_path,
+                                                  new_epoch, 0.0))
+                problems.append(f"injected {sc.mode} at {sc.site} was "
+                                f"swallowed by promote_writable()")
+            except (faultpoints.FaultInjected, OSError):
+                pass
+            finally:
+                faultpoints.disarm(sc.site)
+            if not replica.read_only:
+                problems.append("failed promotion left the store "
+                                "writable (half-promoted)")
+            if _dump_store(replica) != before:
+                problems.append("replica view changed across a FAILED "
+                                "promotion (torn takeover served)")
+            # The retry must fully take over...
+            replica.promote_writable(
+                new_epoch,
+                epoch_guard=cepoch.EpochGuard(epoch_path, new_epoch,
+                                              0.0))
+            if _dump_store(replica) != _dump_store(tsdb.store):
+                problems.append("promoted store != writer store "
+                                "(takeover lost records)")
+            # ...and the deposed writer must be fenced: arm ITS guard
+            # (production writers carry one from boot; the harness
+            # writer ran unguarded so the pre-promotion ops above
+            # stayed clean) and watch a mutation refuse.
+            tsdb.store.epoch_guard = cepoch.EpochGuard(epoch_path, 1,
+                                                       0.0)
+            shards = getattr(tsdb.store, "shards", None)
+            for s in (shards or [tsdb.store]):
+                s.epoch_guard = tsdb.store.epoch_guard
+            try:
+                apply_op(tsdb, ("ingest", 0, _EXTRA_HOUR + 3600, 1,
+                                300, 0, 7))
+                problems.append("deposed writer's post-promotion "
+                                "ingest was NOT fenced")
+            except FencedWriterError:
+                pass
+        finally:
+            replica.close()
+    except Exception as e:
+        problems.append(f"promote scenario crashed: {e!r}")
+    finally:
+        faultpoints.disarm(sc.site)
+        tsdb.shutdown()
+    status = "ok" if not problems else "invariant-failed"
+    return {"label": sc.label, "site": sc.site, "mode": sc.mode,
+            "skip": sc.skip, "shards": sc.shards, "rollups": False,
+            "seed": sc.seed, "n_ops": 10, "bug": None,
+            "child_exit": None, "ops_done": 10, "status": status,
+            "problems": problems,
+            "fingerprint": hashlib.sha1(
+                f"{status}|{';'.join(problems)}".encode()).hexdigest(),
+            "repro": f"python scripts/crashmatrix.py --only {sc.label}"}
+
+
 def run_scenario(sc: Scenario, work_root: str,
                  shrink: bool = True) -> dict:
     workdir = os.path.join(work_root, sc.label)
     if sc.kind == "replica":
         return _run_replica_scenario(sc, workdir)
+    if sc.kind == "promote":
+        return _run_promote_scenario(sc, workdir)
     if sc.mode not in ("crash", "torn"):
         # Child scenarios are verified BY the crash: a raise/ioerror/
         # delay child either errors out mid-workload or finishes
@@ -827,6 +922,18 @@ def build_matrix() -> list[Scenario]:
         shards=1, kind="replica", seed=3102)
     add("replica-rebuild-raise-s4", "replica.rebuild", "raise",
         shards=4, kind="replica", seed=3103)
+    # Replica promotion faults (cluster/, in-process): a failed
+    # takeover must leave a coherent replica, the retry must win, and
+    # the deposed writer must be fenced. The live process-kill variant
+    # is scripts/servematrix.py promote-crash.
+    add("promote-take-raise", "cluster.promote.take", "raise",
+        shards=1, kind="promote", seed=3201)
+    add("promote-rotate-raise", "cluster.promote.rotate", "raise",
+        shards=1, kind="promote", seed=3202)
+    add("promote-rotate-raise-s4", "cluster.promote.rotate", "raise",
+        shards=4, kind="promote", seed=3203)
+    add("promote-rotate-ioerror", "cluster.promote.rotate", "ioerror",
+        shards=1, kind="promote", seed=3204)
     return scens
 
 

@@ -43,10 +43,13 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 import urllib.parse
 
 from opentsdb_tpu.build_data import version_string
+from opentsdb_tpu.cluster.ownership import OwnershipMap
+from opentsdb_tpu.cluster.promote import PromotionManager
 from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.obs.registry import METRICS
 from opentsdb_tpu.obs.ring import TraceRing
@@ -55,6 +58,7 @@ from opentsdb_tpu.serve.admission import (DEGRADE, SHED_LOAD,
                                           AdmissionController)
 from opentsdb_tpu.stats.collector import LatencyDigest, StatsCollector
 from opentsdb_tpu.storage.sstable import series_hash
+from opentsdb_tpu.utils.lru import LRUCache
 
 LOG = logging.getLogger(__name__)
 
@@ -66,6 +70,9 @@ _M_EJECTED = METRICS.counter("router.ejections")
 _M_READMITTED = METRICS.counter("router.readmissions")
 _M_HOP = METRICS.timer("router.hop")
 _M_ERRORS = METRICS.counter("router.hop_errors")
+_M_RCACHE_HIT = METRICS.counter("router.rcache.hit")
+_M_RCACHE_MISS = METRICS.counter("router.rcache.miss")
+_M_HANDOFFS = METRICS.counter("cluster.handoffs")
 
 # Hedge-delay bounds when derived from the p95: never hedge absurdly
 # early (doubling every request's load) nor later than half the
@@ -150,16 +157,72 @@ async def _http_fetch(host: str, port: int, target: str,
 class RouterServer:
     def __init__(self, config) -> None:
         self.config = config
+        # Multi-writer mode (cluster/ownership.py): with N writers,
+        # the ownership map drives BOTH ingest fan-out (each put line
+        # routes to the writer owning its metric's hash slot) and read
+        # fan-out (each sub-query hops to every writer in its slot's
+        # owner history and the answers merge). The map is loaded from
+        # Config.cluster_map when the file exists, else built as an
+        # equal split and persisted there.
+        writers = list(getattr(config, "router_writers", ()) or ())
+        self.cluster_map_path = getattr(config, "cluster_map", None)
+        self.ownership: OwnershipMap | None = None
+        if self.cluster_map_path and \
+                os.path.exists(self.cluster_map_path):
+            self.ownership = OwnershipMap.load(self.cluster_map_path)
+            if writers and list(self.ownership.writers) != \
+                    [w.rstrip("/") for w in writers]:
+                raise ValueError(
+                    f"--writers disagrees with the cluster map at "
+                    f"{self.cluster_map_path!r} "
+                    f"({self.ownership.writers}); edit the map, not "
+                    f"the flag (slot history would dangle)")
+        elif len(writers) > 1:
+            self.ownership = OwnershipMap(
+                writers,
+                slots=int(getattr(config, "cluster_slots", 64) or 64))
+            if self.cluster_map_path:
+                self.ownership.save(self.cluster_map_path)
+        self.writer_backends = [Backend(u) for u in
+                                (self.ownership.writers
+                                 if self.ownership else writers)]
         backends = list(getattr(config, "router_backends", ()) or ())
         if not backends:
-            raise ValueError("router role needs --backends "
-                             "(comma-separated replica URLs)")
+            if self.writer_backends:
+                # Writer-serves-reads topology: the writers ARE the
+                # read backends (the bench_serve --writers shape).
+                backends = [b.url for b in self.writer_backends]
+            else:
+                raise ValueError("router role needs --backends "
+                                 "(comma-separated replica URLs) or "
+                                 "--writers")
         self.backends = [Backend(u) for u in backends]
         self.writer_url = getattr(config, "writer_url", None)
+        if not self.writer_url and len(writers) == 1:
+            # A lone --writers entry is just the writer (ingest
+            # forwards there; no ownership map needed).
+            self.writer_url = writers[0]
         self._writer = Backend(self.writer_url) if self.writer_url \
+            else None
+        # Failover driver (cluster/promote.py): probes the writer,
+        # promotes a replica past the grace, demotes the deposed one
+        # on return. Constructed whenever there IS a writer; inert
+        # unless Config.writer_grace_ms > 0 (or a fenced writer shows
+        # up in a probe).
+        self.promotion = PromotionManager(self) if self._writer \
             else None
         self.admission = AdmissionController(config)
         self.trace_ring = TraceRing(getattr(config, "trace_ring", 256))
+        # Bounded result cache (the fragment-cache stamp discipline at
+        # the router): full-service JSON answers keyed by (normalized
+        # query, ownership-map epoch, staleness bound). Repeat
+        # dashboard fan-ins stop re-hitting replicas every poll; an
+        # ownership handoff bumps the map epoch and orphans every
+        # entry computed under the old layout.
+        n_rcache = int(getattr(config, "router_rcache", 0) or 0)
+        self.rcache = LRUCache(n_rcache) if n_rcache > 0 else None
+        self.rcache_ms = float(getattr(config, "router_rcache_ms",
+                                       1000.0) or 1000.0)
         self._server: asyncio.AbstractServer | None = None
         self._shutdown = asyncio.Event()
         self._probe_task: asyncio.Task | None = None
@@ -211,9 +274,13 @@ class RouterServer:
     async def _probe_loop(self) -> None:
         interval = float(getattr(self.config, "probe_interval_s", 1.0))
         while True:
-            await asyncio.gather(
-                *(self._probe_one(b) for b in self.backends),
-                return_exceptions=True)
+            probes = [self._probe_one(b) for b in self.backends]
+            if self.promotion is not None:
+                # The failover driver rides the same cadence: writer
+                # health, the promotion grace, and the demote-on-
+                # return handshake (cluster/promote.py).
+                probes.append(self.promotion.probe_writer())
+            await asyncio.gather(*probes, return_exceptions=True)
             await asyncio.sleep(interval)
 
     async def _probe_one(self, b: Backend) -> None:
@@ -286,8 +353,25 @@ class RouterServer:
     # Telnet: forward puts to the writer under ingest admission
     # ------------------------------------------------------------------
 
+    def _ingest_target(self, text: str) -> Backend | None:
+        """Which writer a ``put`` line belongs to. Single-writer:
+        the (possibly failed-over) forwarding target. Multi-writer:
+        the ownership map routes by the metric's series hash — the
+        same crc32 chain the storage sharder and the TSST3 blooms
+        use, one level up."""
+        if self.ownership is None:
+            return self._writer
+        parts = text.split(" ", 2)
+        if len(parts) < 2 or not parts[1]:
+            return self.writer_backends[0]  # malformed; let a writer
+            #                                 produce the error line
+        return self.writer_backends[
+            self.ownership.owner(parts[1].encode())]
+
     async def _handle_telnet(self, first: bytes, reader, writer) -> None:
-        upstream = None
+        # One lazily-opened upstream per writer URL: a multi-writer
+        # cluster fans one client connection across N owner writers.
+        upstreams: dict[str, tuple] = {}
         try:
             buf = first
             while True:
@@ -313,7 +397,8 @@ class RouterServer:
                                  + b"\n")
                     await writer.drain()
                     continue
-                if self._writer is None:
+                target = self._ingest_target(text)
+                if target is None:
                     writer.write(b"put: no writer configured on this "
                                  b"router\n")
                     await writer.drain()
@@ -327,19 +412,20 @@ class RouterServer:
                     await writer.drain()
                     continue
                 try:
+                    upstream = upstreams.get(target.url)
                     if upstream is None:
                         upstream = await asyncio.open_connection(
-                            self._writer.host, self._writer.port)
+                            target.host, target.port)
+                        upstreams[target.url] = upstream
                     upstream[1].write(line + b"\n")
                     await upstream[1].drain()
                     self.telnet_lines_forwarded += 1
                 finally:
                     self.admission.ingest_done(1)
         finally:
-            if upstream is not None:
-                # Drain the writer's error lines (if any) back to the
+            for up_reader, up_writer in upstreams.values():
+                # Drain each writer's error lines (if any) back to the
                 # client before closing — they're the put's only ack.
-                up_reader, up_writer = upstream
                 try:
                     up_writer.write_eof()
                     back = await asyncio.wait_for(up_reader.read(),
@@ -430,6 +516,10 @@ class RouterServer:
             records = self.trace_ring.snapshot()
             return (200, "application/json",
                     json.dumps(records).encode(), {})
+        if path == "/api/topology":
+            return self._topology()
+        if path == "/api/cluster/handoff":
+            return await self._handoff(q)
         if path in ("/aggregators", "/version", "/suggest"):
             # Storage-free passthroughs any healthy replica answers.
             return await self._proxy_any(target)
@@ -447,6 +537,125 @@ class RouterServer:
         return (200 if ok else 503, "application/json",
                 json.dumps(body).encode(), {})
 
+    def _topology(self) -> tuple:
+        """The cluster-state dashboard feed: writers (+ epoch,
+        failover history), every read backend with its measured lag /
+        ejection state / hop latency, hedge + retry counters, and the
+        ownership map — everything a topology view needs without
+        scraping and correlating /stats text."""
+        # Health by URL: in multi-writer mode the probed Backend
+        # objects live in self.backends (writers serve reads), not in
+        # the writer_backends copies — resolve through both so the
+        # writers array carries real probe data.
+        by_url = {b.url: b.last_health for b in self.backends}
+        if self._writer is not None:
+            by_url.setdefault(self._writer.url,
+                              self._writer.last_health)
+        writers = []
+        if self._writer is not None:
+            writers.append({"url": self._writer.url,
+                            "health": by_url.get(
+                                self._writer.url,
+                                self._writer.last_health)})
+        for b in self.writer_backends:
+            if self._writer is None or b.url != self._writer.url:
+                writers.append({"url": b.url,
+                                "health": by_url.get(b.url,
+                                                     b.last_health)})
+        replicas = []
+        for b in self.backends:
+            h = b.last_health or {}
+            replicas.append({
+                "url": b.url,
+                "healthy": b.healthy,
+                "ejected": not b.healthy,
+                "stale": b.stale,
+                "consecutive_fails": b.consecutive_fails,
+                "lag_ms": h.get("lag_ms"),
+                "writer_epoch": h.get("writer_epoch"),
+                "hop_p95_ms": round(b.latency.percentile(95), 3)
+                if b.latency.count else None,
+            })
+        body = {
+            "role": "router",
+            "writers": writers,
+            "replicas": replicas,
+            "promotion": self.promotion.snapshot()
+            if self.promotion else None,
+            "ownership": self.ownership.snapshot()
+            if self.ownership else None,
+            "counters": {
+                "hedges": METRICS.counter("router.hedges").value,
+                "hedge_wins": METRICS.counter("router.hedge_wins").value,
+                "retries": METRICS.counter("router.retries").value,
+                "ejections": METRICS.counter("router.ejections").value,
+                "readmissions":
+                    METRICS.counter("router.readmissions").value,
+                "rcache_hit": _M_RCACHE_HIT.value,
+                "rcache_miss": _M_RCACHE_MISS.value,
+            },
+            "uptime_s": int(time.time()) - self.start_time,
+        }
+        return (200, "application/json", json.dumps(body).encode(),
+                {})
+
+    async def _handoff(self, q) -> tuple:
+        """Shard handoff: drain-then-transfer one ownership slot (or a
+        metric's slot) to another writer, committed as an ownership-
+        map epoch bump. The router is the single ingest door, so the
+        drain is local: flush nothing-left-in-flight semantics come
+        from the per-connection forwarding being synchronous (a line
+        is drained to the old owner before the next is read); the
+        map flip below happens atomically on this event loop, so no
+        two writers ever receive the same slot concurrently."""
+        if self.ownership is None:
+            return (400, "text/plain",
+                    b"not a multi-writer cluster (no ownership map)\n",
+                    {})
+        if "metric" in q:
+            from opentsdb_tpu.cluster.ownership import slot_of
+            slot = slot_of(q["metric"].encode(), self.ownership.slots)
+        elif "slot" in q:
+            try:
+                slot = int(q["slot"])
+            except ValueError:
+                return (400, "text/plain", b"slot must be an integer\n",
+                        {})
+        else:
+            return (400, "text/plain",
+                    b"need slot= or metric= and to=\n", {})
+        try:
+            to = int(q.get("to", ""))
+        except ValueError:
+            return (400, "text/plain", b"need to=<writer index>\n", {})
+        snap = self.ownership.snapshot()
+        try:
+            old = self.ownership.assign[slot]
+            self.ownership.transfer(slot, to)
+        except (ValueError, IndexError) as e:
+            return (400, "text/plain", f"{e}\n".encode(), {})
+        if self.cluster_map_path:
+            try:
+                self.ownership.save(self.cluster_map_path)
+            except Exception:
+                # Commit failed: the flip must not outlive the crash-
+                # durable map — restore the WHOLE pre-transfer view
+                # (assign, epoch, AND the history entry transfer
+                # appended; a leaked history entry would fan every
+                # later read of this slot to a writer that never
+                # owned it).
+                self.ownership.assign = list(snap["assign"])
+                self.ownership.history = [list(h) for h in
+                                          snap["history"]]
+                self.ownership.epoch = snap["epoch"]
+                raise
+        _M_HANDOFFS.inc()
+        LOG.warning("handoff: slot %d writer %d -> %d (map epoch %d)",
+                    slot, old, to, self.ownership.epoch)
+        return (200, "application/json", json.dumps({
+            "slot": slot, "from": old, "to": to,
+            "epoch": self.ownership.epoch}).encode(), {})
+
     def _collect_stats(self) -> list[str]:
         c = StatsCollector("tsd")
         c.record("router.backends", len(self.backends))
@@ -456,6 +665,13 @@ class RouterServer:
         c.record("router.put_lines_forwarded",
                  self.telnet_lines_forwarded)
         c.record("uptime_s", int(time.time()) - self.start_time)
+        if self.ownership is not None:
+            c.record("cluster.map_epoch", self.ownership.epoch)
+            c.record("cluster.writers", len(self.ownership.writers))
+        if self.promotion is not None:
+            c.record("cluster.epoch", self.promotion.epoch)
+        if self.rcache is not None:
+            c.record("router.rcache.entries", len(self.rcache))
         self.admission.collect_stats(c)
         METRICS.collect(c)
         return c.lines
@@ -500,9 +716,41 @@ class RouterServer:
                     b"router shedding load\n",
                     {"Retry-After": str(max(1, round(retry + 0.5)))})
         try:
-            return await self._query_admitted(
+            # Router-side result cache: the fragment-cache stamp
+            # discipline one level up. The key carries the ownership-
+            # map epoch (a handoff orphans every entry computed under
+            # the old layout) and the staleness bound; entries expire
+            # at router_rcache_ms — the bound IS the declared promise,
+            # not a TTL guess. Admission runs first so quotas and the
+            # ladder still bite; degraded/traced answers never cache.
+            cache_key = None
+            if (self.rcache is not None and "nocache" not in q
+                    and q.get("trace", "0") in ("", "0")
+                    and verdict != DEGRADE):
+                epoch = (self.ownership.epoch if self.ownership
+                         else self.promotion.epoch if self.promotion
+                         else 0)
+                norm = tuple(sorted(
+                    (k, v) for k, v in
+                    urllib.parse.parse_qsl(query_string,
+                                           keep_blank_values=True)))
+                cache_key = (norm, epoch, int(self.rcache_ms))
+                hit = self.rcache.get(cache_key)
+                if hit is not None and time.monotonic() < hit[0]:
+                    _M_RCACHE_HIT.inc()
+                    return hit[1], hit[2], hit[3], hit[4]
+                _M_RCACHE_MISS.inc()
+            out = await self._query_admitted(
                 query_string, q, params, ms,
                 degrade=(verdict == DEGRADE))
+            if cache_key is not None:
+                status, ctype, body, extra = out
+                if status == 200 and "X-Tsd-Degraded" not in extra:
+                    self.rcache.put(
+                        cache_key,
+                        (time.monotonic() + self.rcache_ms / 1000.0,
+                         status, ctype, body, extra))
+            return out
         finally:
             self.admission.query_done()
 
@@ -546,25 +794,48 @@ class RouterServer:
             # Built from the REWRITTEN base, not the raw query string:
             # the degradation ladder must bite the default output
             # format too, or browser dashboards dodge load shedding.
-            owner = series_hash(ms[0].encode()) % len(self.backends)
             target = "/q?" + urllib.parse.urlencode(
                 list(base.items()) + [("m", m) for m in ms])
-            status, ctype, body, extra, _spans = await self._hop(
-                target, owner, deadline, sub=ms[0])
+            if self.ownership is not None:
+                # PNG can only proxy whole; that is correct ONLY when
+                # every sub-query's full owner history is one writer.
+                # Anything else would render with other owners' series
+                # silently absent — refuse loudly instead (the JSON
+                # path merges fine).
+                idxs = {i for m in ms for i in self.ownership.readers(
+                    self._m_metric(m).encode())}
+                if len(idxs) > 1:
+                    return (400, "text/plain",
+                            b"PNG output cannot merge across writer "
+                            b"ownership; add &json or &ascii\n", {})
+                b = self.writer_backends[idxs.pop()]
+                status, ctype, body, extra, _spans = \
+                    await self._hop_writer(b, target, deadline,
+                                           sub=ms[0])
+            else:
+                owner = series_hash(ms[0].encode()) % len(self.backends)
+                status, ctype, body, extra, _spans = await self._hop(
+                    target, owner, deadline, sub=ms[0])
             return status, ctype, body, extra
 
         # One hop per m= sub-query, all concurrent; each hop retries
         # and hedges independently. Ownership hashes the SUB-QUERY
         # spec (not just the metric): distinct aggregations of one
         # metric spread while repeats of the same panel stay hot on
-        # one replica.
+        # one replica. Multi-writer mode instead consults the
+        # ownership map: a sub-query hops to every writer in its
+        # slot's owner HISTORY (one, absent handoffs) and the answers
+        # merge.
         t0 = time.monotonic()
-        hops = [self._hop(
-            "/q?" + urllib.parse.urlencode(
-                dict(base, m=m, json="")),
-            series_hash(m.encode()) % len(self.backends),
-            deadline, sub=m)
-            for m in ms]
+        if self.ownership is not None:
+            hops = [self._hop_cluster(m, base, deadline) for m in ms]
+        else:
+            hops = [self._hop(
+                "/q?" + urllib.parse.urlencode(
+                    dict(base, m=m, json="")),
+                series_hash(m.encode()) % len(self.backends),
+                deadline, sub=m)
+                for m in ms]
         outs = await asyncio.gather(*hops, return_exceptions=True)
 
         results: list[dict] = []
@@ -637,6 +908,148 @@ class RouterServer:
                 ent.setdefault("trace_id", trace_id)
         return (200, "application/json",
                 json.dumps(results).encode(), extra)
+
+    # ------------------------------------------------------------------
+    # Multi-writer read fan-out (cluster/ownership.py)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _m_metric(m: str) -> str:
+        """The metric name inside an m-spec: the last colon segment
+        before the optional tag filter — 'sum:1h-avg:rate:cpu{h=a}'
+        → 'cpu'. The router routes on the METRIC (all aggregations of
+        one metric live with its owner), unlike single-writer mode's
+        whole-spec hash which only had cache affinity to optimize."""
+        return m.split("{", 1)[0].split(":")[-1]
+
+    async def _hop_cluster(self, m: str, base: dict, deadline: float):
+        """One sub-query in multi-writer mode: concurrent hops to
+        every writer in the metric's slot-owner history, answers
+        merged agg-aware. Returns the standard hop 5-tuple with the
+        MERGED body."""
+        metric = self._m_metric(m)
+        target = "/q?" + urllib.parse.urlencode(
+            dict(base, m=m, json=""))
+        idxs = self.ownership.readers(metric.encode())
+        outs = await asyncio.gather(
+            *(self._hop_writer(self.writer_backends[i], target,
+                               deadline, sub=m) for i in idxs),
+            return_exceptions=True)
+        parts: list[list[dict]] = []
+        spans: list[dict] = []
+        extra: dict = {}
+        for i, out in zip(idxs, outs):
+            if isinstance(out, BaseException):
+                # Any owner-history writer missing = a wrong (partial)
+                # answer; fail the sub-query loudly rather than serve
+                # a silent hole.
+                raise out if isinstance(out, HopError) else HopError(
+                    f"{m}: writer {self.writer_backends[i].url} "
+                    f"failed: {out}")
+            status, ctype, body, hop_extra, hop_spans = out
+            spans.extend(hop_spans)
+            if status != 200:
+                return status, ctype, body, hop_extra, spans
+            for k, v in hop_extra.items():
+                extra[k] = (v if k not in extra
+                            else ",".join(sorted(set(extra[k].split(","))
+                                                 | set(v.split(",")))))
+            try:
+                parts.append(json.loads(body))
+            except ValueError:
+                raise HopError(f"bad writer body for {m}") from None
+        merged = self._merge_results(m, parts)
+        return (200, "application/json", json.dumps(merged).encode(),
+                extra, spans)
+
+    @staticmethod
+    def _merge_results(m: str, parts: list[list[dict]]) -> list[dict]:
+        """Union per-(metric, tags) dps across the owner history
+        (current owner's part FIRST). Ownership is per-METRIC (slot =
+        hash of the metric name), so a metric's series NEVER split
+        across owners by series — a slot only spans writers after a
+        handoff, partitioned by TIME. A timestamp present on both
+        sides is therefore the SAME logical cell(s): the old owner's
+        stale copy vs a post-handoff rewrite (backfill/correction)
+        that landed on the current owner. Single-store semantics for
+        a re-put is last-write-wins, so the CURRENT owner's value
+        stands for every aggregator — arithmetic combination (summing
+        the superseded copy into the rewrite, or two partial
+        downsample buckets into each other) would fabricate values no
+        single-store deployment could ever return."""
+        merged: dict[tuple, dict] = {}
+        for part in parts:
+            for ent in part:
+                key = (ent.get("metric"),
+                       tuple(sorted((ent.get("tags") or {}).items())))
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = ent
+                    continue
+                dps = cur["dps"]
+                for ts, v in ent.get("dps", {}).items():
+                    if ts not in dps:
+                        dps[ts] = v
+                    # else: the current owner's value stands
+                if ent.get("degraded"):
+                    cur["degraded"] = ",".join(sorted(
+                        set((cur.get("degraded") or "").split(","))
+                        - {""} | set(ent["degraded"].split(","))))
+        return list(merged.values())
+
+    async def _hop_writer(self, b: Backend, target: str,
+                          deadline: float, sub: str):
+        """One writer-directed hop: same deadline shares, backoff and
+        5xx handling as the replica hop, but NO alternate candidates
+        and no hedging — writers are not interchangeable (each owns
+        its slice), so retries go to the same writer."""
+        retries = int(getattr(self.config, "router_retries", 2) or 0)
+        backoff = float(getattr(self.config, "router_backoff_ms",
+                                50.0)) / 1000.0
+        spans: list[dict] = []
+        last_err: Exception | None = None
+        for attempt in range(retries + 1):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            share = remaining / max(retries + 1 - attempt, 1)
+            t0 = time.monotonic()
+            try:
+                with _M_HOP.time():
+                    status, headers, body = await _http_fetch(
+                        b.host, b.port, target,
+                        timeout_s=max(share, 0.001))
+                if status >= 500 and status != 503:
+                    raise HopError(f"{b.url} answered {status}")
+            except HopError as e:
+                last_err = e
+                _M_ERRORS.inc()
+                if attempt < retries:
+                    _M_RETRIES.inc()
+                    await asyncio.sleep(
+                        min(backoff * (2 ** attempt), 1.0,
+                            max(deadline - time.monotonic(), 0)))
+                continue
+            ms_taken = (time.monotonic() - t0) * 1000.0
+            b.latency.add(ms_taken)
+            b.consecutive_fails = 0
+            spans.append({
+                "name": "hop",
+                "ms": round(ms_taken, 3),
+                "tags": {"m": sub, "backend": b.url,
+                         "attempt": attempt, "status": status,
+                         "writer": True},
+            })
+            extra = {}
+            if "x-tsd-degraded" in headers:
+                extra["X-Tsd-Degraded"] = headers["x-tsd-degraded"]
+            if "retry-after" in headers:
+                extra["Retry-After"] = headers["retry-after"]
+            return (status,
+                    headers.get("content-type", "text/plain"), body,
+                    extra, spans)
+        raise HopError(f"{sub}: writer {b.url} did not answer within "
+                       f"the deadline ({last_err})")
 
     async def _hop(self, target: str, owner: int, deadline: float,
                    sub: str):

@@ -31,6 +31,7 @@ from opentsdb_tpu.build_data import build_data, version_string
 from opentsdb_tpu.core import tags as tags_mod
 from opentsdb_tpu.core.errors import (
     BadRequestError,
+    FencedWriterError,
     NoSuchUniqueName,
     OverloadedError,
     PleaseThrottleError,
@@ -185,6 +186,8 @@ class TSDServer:
         # anyway so tests can run_once() deterministically).
         self.trace_ring = TraceRing(
             getattr(self.config, "trace_ring", 256))
+        # 1-in-N ambient trace sampling counter (Config.trace_sample_n).
+        self._trace_sample_seq = 0
         from opentsdb_tpu.obs.selfmon import SelfMonitor
         self.selfmon = SelfMonitor(
             tsdb, self._collect_stats,
@@ -196,6 +199,12 @@ class TSDServer:
         from opentsdb_tpu.serve.admission import AdmissionController
         self.admission = AdmissionController(self.config)
         self.tailer = None
+        # Serializes cluster role transitions (/promote, /demote):
+        # they run in the worker pool, so two retried requests can
+        # both pass the event-loop idempotency check — the second
+        # bump would fence the writer the first one just made.
+        import threading
+        self._role_lock = threading.Lock()
         self._register_default_commands()
 
     def attach_tailer(self, tailer) -> None:
@@ -390,6 +399,13 @@ class TSDServer:
                 self.hbase_errors_put += 1
                 writer.write(
                     f"put: read-only replica: {err}\n".encode())
+            elif "[fenced]" in err:
+                # FencedWriterError, tagged by wire.ingest_batch with
+                # a stable marker (message wording may drift): this
+                # daemon has been deposed — refuse loudly, the router
+                # forwards to the current writer.
+                self.hbase_errors_put += 1
+                writer.write(f"put: fenced writer: {err}\n".encode())
             else:
                 self.illegal_arguments_put += 1
                 writer.write(f"put: illegal argument: {err}\n".encode())
@@ -440,6 +456,8 @@ class TSDServer:
             "/sketch": lambda req: self._sketch(req.q),
             "/forecast": lambda req: self._forecast(req.q, req.params),
             "/fault": self._http_fault,
+            "/promote": self._http_promote,
+            "/demote": self._http_demote,
             "/healthz": self._http_healthz,
             "/metrics": self._http_metrics,
             "/api/traces": self._http_traces,
@@ -528,6 +546,16 @@ class TSDServer:
             self.hbase_errors_put += 1
             _M_TELNET_ERRORS.inc()
             writer.write(f"put: read-only replica: {e}\n".encode())
+        except FencedWriterError as e:
+            # Deposed writer (cluster/epoch.py): a promotion bumped
+            # the epoch past ours while this daemon was wedged. The
+            # put is REFUSED — never acked, never applied to a
+            # replayable file — and the collector should re-send to
+            # the router, which forwards to the current writer.
+            self.hbase_errors_put += 1
+            _M_TELNET_ERRORS.inc()
+            writer.write(f"put: fenced writer (superseded by epoch "
+                         f"{e.current_epoch}): {e}\n".encode())
 
     # ------------------------------------------------------------------
     # HTTP protocol
@@ -749,7 +777,10 @@ class TSDServer:
         """Liveness + the replica staleness contract. The router's
         probes key on both the status code and the body: 200/ok keeps
         (or readmits) a replica in rotation, 503/stale ejects it from
-        preference while the body still carries the measured lag."""
+        preference while the body still carries the measured lag. In
+        cluster mode the body also carries the writer epoch this
+        daemon owns (or is fenced behind) — the router's promotion
+        manager keys demote-on-return off exactly this."""
         if self.tailer is not None:
             body = self.tailer.health()
         else:
@@ -759,11 +790,158 @@ class TSDServer:
                 "read_only": bool(getattr(self.tsdb.store, "read_only",
                                           False)),
             }
+        store = self.tsdb.store
+        epoch = getattr(store, "writer_epoch", None)
+        if epoch is not None:
+            body["writer_epoch"] = int(epoch)
+        guard = getattr(store, "epoch_guard", None)
+        if guard is not None and guard.fenced:
+            # Deposed but alive: reads still serve (coherent, just no
+            # longer advancing), every write refuses. The router sees
+            # this and issues /demote.
+            body["fenced"] = True
+            body["fenced_by_epoch"] = guard.fenced_epoch
         body["uptime_s"] = int(time.time()) - self.start_time
         body["inflight_queries"] = self.admission.inflight_queries
         status = 200 if body.get("ok") else 503
         return (status, "application/json",
                 json.dumps(body).encode(), {})
+
+    # ------------------------------------------------------------------
+    # Cluster failover (opentsdb_tpu/cluster/): promote / demote
+    # ------------------------------------------------------------------
+
+    async def _http_promote(self, req) -> tuple:
+        """Replica → writer takeover. The router's promotion manager
+        (cluster/promote.py) calls this when the writer's /healthz has
+        been dead past the grace; operators can call it by hand.
+        Bumps the persisted epoch (EPOCH.json CAS), reopens the WAL
+        tail read-write under a fresh inode, swaps sketches + rollups
+        into writer mode, and stops the tailer. Idempotent: asking an
+        already-promoted daemon again returns its epoch without
+        another bump (a retry after a lost response must not
+        re-depose anyone)."""
+        path = getattr(self.tsdb, "cluster_epoch_path", None)
+        if not path:
+            raise BadRequestError(
+                "not a cluster member (start the daemon with "
+                "--cluster)")
+        store = self.tsdb.store
+        if not getattr(store, "read_only", False):
+            return (200, "application/json", json.dumps({
+                "role": "writer", "already_writer": True,
+                "epoch": int(getattr(store, "writer_epoch", 0) or 0),
+            }).encode(), {})
+        expect = None
+        if req.q.get("expect"):
+            try:
+                expect = int(req.q["expect"])
+            except ValueError:
+                raise BadRequestError("expect must be an integer") \
+                    from None
+        loop = asyncio.get_running_loop()
+        epoch = await loop.run_in_executor(
+            self._pool, functools.partial(self._do_promote, path,
+                                          expect))
+        return (200, "application/json", json.dumps(
+            {"role": "writer", "epoch": epoch}).encode(), {})
+
+    def _do_promote(self, path: str, expect: int | None) -> int:
+        from opentsdb_tpu.cluster import epoch as _ep
+        from opentsdb_tpu.fault.faultpoints import fire as _fault
+        # One role transition at a time: the event-loop idempotency
+        # check races its own executor dispatch (two retried /promote
+        # requests can both pass it), and a second bump after the
+        # first promotion landed would instantly fence the freshly
+        # promoted writer. Re-check under the lock.
+        with self._role_lock:
+            if not getattr(self.tsdb.store, "read_only", False):
+                return int(getattr(self.tsdb.store, "writer_epoch", 0)
+                           or 0)
+            # Bump BEFORE touching the tailer: a failed bump (CAS
+            # conflict, disk error) must leave the replica exactly as
+            # it was — still tailing. The bump is durable; crash
+            # after it leaves an epoch with no acting writer, and the
+            # next promotion attempt bumps past it.
+            owner = (getattr(self.config, "cluster_owner", None)
+                     or f"{self.config.bind}:{self.config.port}")
+            new = _ep.bump_epoch(path, owner=owner, expect=expect)
+            _fault("cluster.promote.bumped", path)
+            guard = _ep.EpochGuard(
+                path, new,
+                interval_s=getattr(self.config,
+                                   "epoch_check_interval_s", 0.05))
+            tailer, self.tailer = self.tailer, None
+            if tailer is not None:
+                # The tailer is the replica's only refresh driver; it
+                # must stop BEFORE the store flips writable
+                # (refresh_replica on a writable store raises —
+                # correctly).
+                tailer.stop()
+            try:
+                self.tsdb.promote(new, epoch_guard=guard)
+            except BaseException:
+                # The store restored itself to a coherent replica; go
+                # back to tailing so this daemon keeps its place in
+                # rotation while the router tries the next candidate.
+                from opentsdb_tpu.serve.tailer import WalTailer
+                self.tailer = WalTailer(self.tsdb)
+                self.tailer.start()
+                raise
+            self.config.role = "writer"
+            # A promoted replica inherits the spill cadence it was
+            # configured with (0 = manual/shutdown checkpoints only,
+            # the plain-writer default).
+            self.tsdb.compactionq.checkpoint_interval = \
+                getattr(self.config, "checkpoint_interval", 0.0) or 0.0
+            LOG.warning("promoted to writer at epoch %d", new)
+            return new
+
+    async def _http_demote(self, req) -> tuple:
+        """Writer → tailing replica (the deposed writer's way back
+        into the fleet). The router calls this when a fenced or
+        stale-epoch writer reappears; idempotent on replicas."""
+        path = getattr(self.tsdb, "cluster_epoch_path", None)
+        if not path:
+            raise BadRequestError(
+                "not a cluster member (start the daemon with "
+                "--cluster)")
+        if getattr(self.tsdb.store, "read_only", False):
+            return (200, "application/json", json.dumps(
+                {"role": "replica", "already_replica": True}).encode(),
+                {})
+        if os.environ.get("TSDB_CLUSTER_BUG") == "split-brain":
+            # The servematrix cluster gate: an unfenced zombie ignores
+            # the protocol entirely — it neither fences its writes nor
+            # complies with demotion. The matrix must catch what such
+            # a writer does to the cluster.
+            return (500, "text/plain",
+                    b"demote sabotaged by TSDB_CLUSTER_BUG\n", {})
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(self._pool, self._do_demote)
+        return (200, "application/json", json.dumps(
+            {"role": "replica"}).encode(), {})
+
+    def _do_demote(self) -> None:
+        with self._role_lock:
+            if getattr(self.tsdb.store, "read_only", False):
+                return  # a concurrent demote won the race; idempotent
+            self.tsdb.demote()
+            self.config.role = "replica"
+            if not getattr(self.config, "max_staleness_ms", 0.0):
+                # The staleness contract defaults ON for replicas (the
+                # cmd_tsd replica-role default) — a demoted daemon
+                # serves under the same promise as a born replica.
+                self.config.max_staleness_ms = 5000.0
+            # The tailer becomes the ONLY refresh driver: the
+            # compaction timer must stop double-driving
+            # refresh_replica (the make_tsdb role=replica exclusion,
+            # applied at runtime).
+            self.tsdb.compactionq.checkpoint_interval = 0.0
+            from opentsdb_tpu.serve.tailer import WalTailer
+            self.tailer = WalTailer(self.tsdb)
+            self.tailer.start()
+            LOG.warning("demoted to tailing replica")
 
     def _degraded_reason(self, load_degraded: bool) -> str | None:
         """The /q result tag: "stale" when the replica staleness
@@ -884,7 +1062,19 @@ class TSDServer:
         want_trace = (q.get("trace", "0") not in ("", "0")
                       and not degrade)
         slow_ms = float(getattr(self.config, "slow_query_ms", 0) or 0)
-        do_trace = want_trace or (slow_ms > 0 and not degrade)
+        # Ambient 1-in-N trace sampling (Config.trace_sample_n): every
+        # Nth query is traced into the ring even when nobody asked and
+        # nothing is slow, so the traces BETWEEN incidents exist when
+        # a slow-query record needs a baseline to compare against.
+        # Sampled traces keep normal caching (a disk-cache hit simply
+        # isn't traced — the baseline is of executed queries).
+        sample_n = int(getattr(self.config, "trace_sample_n", 0) or 0)
+        sampled = False
+        if sample_n > 0 and not degrade and not want_trace:
+            self._trace_sample_seq += 1
+            sampled = self._trace_sample_seq % sample_n == 0
+        do_trace = want_trace or sampled or (slow_ms > 0
+                                             and not degrade)
         # The result tag for anything less than full service ("stale",
         # "rollup-only", or both): evaluated once per request, echoed
         # per-result in JSON and as X-Tsd-Degraded so the router can
@@ -971,10 +1161,14 @@ class TSDServer:
                     bool(getattr(self.tsdb.store, "read_only", False)))
                 tdict = rec["trace"]
                 # The ring holds what an operator would want to SEE at
-                # /api/traces: every explicit trace, every slow query.
-                # Threshold-only tracing of fast queries stays out —
-                # it would flush the ring with noise between incidents.
-                if want_trace or rec["slow"]:
+                # /api/traces: every explicit trace, every slow query,
+                # and the 1-in-N ambient samples (flagged, so ?slow=1
+                # still filters to incidents). Threshold-only tracing
+                # of fast queries stays out — it would flush the ring
+                # with noise between incidents.
+                if sampled:
+                    rec["sampled"] = True
+                if want_trace or sampled or rec["slow"]:
                     self.trace_ring.add(rec)
                 if rec["slow"]:
                     log_slow(rec)

@@ -279,5 +279,12 @@ def ingest_batch(tsdb, batch: DecodedBatch,
                 tag_map, durable=durable, is_float=batch.is_float[run],
                 int_values=batch.ivalues[run])
         except Exception as e:
-            errors.append(f"{metric}: {e}")
+            # Stable machine-readable tag for the fence refusal
+            # (cluster/epoch.py): the server's error classifier keys
+            # on "[fenced]", not on exception message wording that
+            # could drift.
+            from opentsdb_tpu.core.errors import FencedWriterError
+            tag = "[fenced] " if isinstance(e, FencedWriterError) \
+                else ""
+            errors.append(f"{metric}: {tag}{e}")
     return n, errors

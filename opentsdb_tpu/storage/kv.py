@@ -362,6 +362,12 @@ _OP_PUT = 1
 _OP_DELETE = 2
 _OP_DELETE_ROW = 3
 _OP_PUT_BATCH = 4   # one record for a whole put_many batch
+# WAL segment epoch header (cluster/epoch.py): a cluster-mode writer
+# begins every WAL segment it opens with its epoch, and replay refuses
+# any segment whose header epoch is LOWER than one already seen — the
+# on-disk artifact of a split brain (a deposed writer's records landing
+# after a newer writer's) is cut at the fence line, never applied.
+_OP_EPOCH = 5
 
 
 class MemKVStore(KVStore):
@@ -374,11 +380,22 @@ class MemKVStore(KVStore):
     def __init__(self, wal_path: str | None = None,
                  throttle_rows: int | None = None,
                  fsync: bool = False, read_only: bool = False,
-                 max_generations: int | None = None) -> None:
+                 max_generations: int | None = None,
+                 writer_epoch: int | None = None,
+                 epoch_guard=None) -> None:
         """``max_generations`` overrides the sstable generation cap
         (default ``_MAX_GENERATIONS``); the sharded store staggers it
         per shard so size-tiered collapses don't fire on the same
         checkpoint across shards.
+
+        ``writer_epoch`` (cluster mode, cluster/epoch.py) stamps this
+        writer's ownership epoch into every WAL segment it opens and
+        arms the replay-side fence; ``epoch_guard`` (an
+        ``EpochGuard``) is checked from every mutation entry point and
+        from ``checkpoint()`` so a deposed writer raises
+        ``FencedWriterError`` instead of split-braining the store.
+        Both default off — a non-cluster store's WAL bytes and hot
+        path are unchanged.
 
         ``read_only=True`` opens another daemon's store WITHOUT the
         single-writer lock: a replica that serves reads over the same
@@ -400,6 +417,15 @@ class MemKVStore(KVStore):
         self._fsync = fsync
         self._wal_path = wal_path
         self.read_only = read_only
+        # Cluster write tier (cluster/): the epoch this writer owns
+        # (None = non-cluster store, no headers, no fence), the
+        # mutation-path guard, the highest segment-header epoch the
+        # replay stream has produced so far, and the bytes replay
+        # refused past a fence line (zombie segments).
+        self.writer_epoch = writer_epoch
+        self.epoch_guard = epoch_guard
+        self._replay_epoch = 0
+        self.fenced_bytes_refused = 0
         # Count of replica full rebuilds (each corresponds to a writer
         # checkpoint/rotation); TSDB's refresh timer keys sketch
         # snapshot reloads off it.
@@ -544,6 +570,7 @@ class MemKVStore(KVStore):
         """Load sstable generations, replay the WAL(s), open for append
         (the recovery tail of __init__; caller owns lock-fd cleanup on
         failure)."""
+        self._replay_epoch = 0
         if self._sst_path:
             for path in self._generation_paths():
                 sst = SSTable(path)
@@ -582,6 +609,7 @@ class MemKVStore(KVStore):
                                   "old": self._stat_old()}
             else:
                 self._wal = open(wal_path, "ab")
+                self._stamp_epoch_header()
 
     def _stat_old(self) -> "tuple[int, int] | None":
         try:
@@ -779,6 +807,12 @@ class MemKVStore(KVStore):
         if self.read_only:
             raise ReadOnlyStoreError(
                 f"store on {self._wal_path!r} is a read-only replica")
+        if self.epoch_guard is not None:
+            # The zombie fence (cluster/epoch.py): raises
+            # FencedWriterError once a promotion has bumped the
+            # persisted epoch past ours. Stat-cached — nothing
+            # measurable on the batched ingest path.
+            self.epoch_guard.check()
 
     def memtable_keys(self, table: str) -> list[bytes]:
         """Row keys in the live memtable only (excludes spilled tiers).
@@ -1061,6 +1095,29 @@ class MemKVStore(KVStore):
                 with _M_WAL_FSYNC.time():
                     os.fsync(self._wal.fileno())
 
+    def _stamp_epoch_header(self, force: bool = False) -> None:
+        """Begin (or continue) this writer's ownership span in the WAL
+        with an ``_OP_EPOCH`` record. ``force`` stamps unconditionally
+        — a freshly rotated segment always needs a header; otherwise
+        the stamp is skipped when the replayed stream already ended
+        inside this writer's epoch (a clean same-epoch restart keeps
+        appending without a redundant header). Opening with a replayed
+        epoch ABOVE our own means this process was deposed while down:
+        refuse to take the WAL at all."""
+        if self._wal is None or self.writer_epoch is None:
+            return
+        if self._replay_epoch > self.writer_epoch:
+            from opentsdb_tpu.core.errors import FencedWriterError
+            raise FencedWriterError(
+                f"WAL at {self._wal_path!r} already carries epoch "
+                f"{self._replay_epoch}, this writer owns "
+                f"{self.writer_epoch}: superseded while down",
+                self.writer_epoch, self._replay_epoch)
+        if force or self._replay_epoch < self.writer_epoch:
+            self._wal_append(_OP_EPOCH,
+                             struct.pack(">Q", self.writer_epoch))
+            self._replay_epoch = self.writer_epoch
+
     # _REC frames the payload with a u32 length, capping one record at
     # 4 GiB. Batches whose blobs approach that are split into multiple
     # _OP_PUT_BATCH records (replay applies them in order, so the split
@@ -1203,6 +1260,27 @@ class MemKVStore(KVStore):
             payload = f.read(plen)
             if len(payload) < plen:
                 break
+            if op == _OP_EPOCH:
+                (e,) = struct.unpack(
+                    ">Q", self._split_payload(payload)[0])
+                if e < self._replay_epoch:
+                    # A segment from a DEPOSED writer landed after a
+                    # newer writer's records — the split-brain
+                    # artifact the epoch fence exists for. Refuse
+                    # everything from the stale header on: for a
+                    # writer the torn-tail truncation cuts it off
+                    # (those appends were never legitimately acked —
+                    # their author had already been superseded); a
+                    # replica simply stops its cursor here.
+                    try:
+                        end = os.fstat(f.fileno()).st_size
+                    except OSError:
+                        end = valid
+                    self.fenced_bytes_refused += max(end - valid, 0)
+                    break
+                self._replay_epoch = e
+                valid += _REC.size + plen
+                continue
             valid += _REC.size + plen
             if op == _OP_PUT_BATCH:
                 n, tl, fl = struct.unpack_from(">IHH", payload, 0)
@@ -1301,6 +1379,196 @@ class MemKVStore(KVStore):
                 os.close(self._lockfd)
                 self._lockfd = None
 
+    # -- cluster promotion / demotion (cluster/) --------------------------
+
+    def _try_take_lock(self) -> bool:
+        """Non-blocking attempt at the single-writer flock (the
+        promoted-over-a-zombie recovery path). Returns True when
+        held after the call."""
+        if self._lockfd is not None:
+            return True
+        lockfd = os.open(self._wal_path + ".lock",
+                         os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(lockfd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(lockfd)
+            return False
+        self._lockfd = lockfd
+        logging.getLogger(__name__).info(
+            "re-acquired single-writer lock at %s.lock",
+            self._wal_path)
+        return True
+
+    def promote_writable(self, writer_epoch: int,
+                         epoch_guard=None) -> None:
+        """Take write ownership of this replica's store (replica
+        promotion, cluster/promote.py). The caller has already bumped
+        the persisted epoch (``bump_epoch``); this is the storage
+        half:
+
+        1. Try the advisory single-writer flock — but do NOT let a
+           wedged-but-alive zombie (which still holds it) block the
+           takeover: in cluster mode the EPOCH is the authority, the
+           flock is best-effort courtesy. A deposed-but-locked zombie
+           is fenced by its guard on the next mutation, and its
+           appends land on an unlinked inode (step 3).
+        2. Re-run the WRITER recovery path over the store (torn tails
+           truncated, .old + WAL replayed — the exact crash-recovery
+           code, correct in any in-flight writer state).
+        3. Reopen the WAL tail under a GUARANTEED-FRESH inode (the
+           PR-1 rotation discipline: pre-promotion records move to
+           ``<wal>.old``, tmp + ``os.replace`` mints the new file) and
+           stamp the new epoch header — the zombie's still-open fd now
+           points at an unlinked inode, so even its pre-fence appends
+           can never reach a file anyone replays.
+        """
+        with self._lock:
+            if not self.read_only:
+                raise ValueError("promote_writable() is for read-only "
+                                 "replica stores")
+            if not self._wal_path:
+                raise ValueError("an in-memory store cannot be "
+                                 "promoted")
+            if writer_epoch < 1:
+                raise ValueError(f"writer epoch must be >= 1, got "
+                                 f"{writer_epoch}")
+            lockfd = os.open(self._wal_path + ".lock",
+                             os.O_CREAT | os.O_RDWR, 0o644)
+            try:
+                fcntl.flock(lockfd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                # The deposed owner is alive and still holds it. The
+                # epoch fence makes proceeding safe; refusing here
+                # would make a WEDGED writer (the promotion trigger!)
+                # un-deposable.
+                os.close(lockfd)
+                lockfd = None
+                logging.getLogger(__name__).warning(
+                    "promoting over a held writer lock at %s.lock — "
+                    "epoch fence (epoch %d) deposes the holder",
+                    self._wal_path, writer_epoch)
+            _fault("cluster.promote.take", self._wal_path)
+            old_ssts, old_tables = self._ssts, self._tables
+            old_state = self._ro_state
+            self._ssts = []
+            self._tables = {}
+            self._ro_state = None
+            self.read_only = False
+            self.writer_epoch = int(writer_epoch)
+            try:
+                # Writer-path recovery (NOT the replica's): truncates
+                # torn tails, replays .old + WAL, opens for append,
+                # stamps the epoch into the current segment.
+                self._open_tiers(self._wal_path)
+                self._promote_rotate_locked()
+            except BaseException:
+                # Stay a coherent REPLICA on any failure (fault
+                # injected mid-rotation, disk full): close whatever
+                # half-opened, restore the pre-promotion view, release
+                # the lock — the caller retries or picks another
+                # target.
+                for sst in self._ssts:
+                    sst.close()
+                if self._wal is not None:
+                    self._wal.close()
+                    self._wal = None
+                self._ssts, self._tables = old_ssts, old_tables
+                self._ro_state = old_state
+                self.read_only = True
+                self.writer_epoch = None
+                if lockfd is not None:
+                    os.close(lockfd)
+                raise
+            self._lockfd = lockfd
+            self.epoch_guard = epoch_guard
+            for sst in old_ssts:
+                sst.close()
+            # The generation set was replaced wholesale (a rebuild, as
+            # far as cache consumers can tell): bump the rebuild
+            # counter (sketch reload key) and jump the fragment-cache
+            # stamp floor.
+            self.rebuilds += 1
+            self.mutation_seq += 1
+            self._stamp_floor = self.mutation_seq
+            self._base_stamps = {}
+            self._stamps_snap = {}
+            self._dirty_snap = {}
+
+    def _promote_rotate_locked(self) -> None:
+        """The fresh-inode WAL rotation of a promotion (checkpoint's
+        rotation discipline, minus the spill): pre-promotion records
+        move to ``<wal>.old`` — appended when a crash remnant already
+        exists, renamed otherwise — and the fresh segment opens with
+        this writer's epoch header. Recovery replays .old then the
+        WAL, so a crash anywhere in here loses nothing."""
+        _fault("cluster.promote.rotate", self._wal_path)
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+        old_path = self._wal_path + ".old"
+        if os.path.exists(self._wal_path):
+            # COPY into .old, never rename: a rename keeps the old
+            # inode LINKED (at .old — a file recovery replays), so a
+            # zombie's still-open fd would keep appending into the
+            # replay stream. Copying leaves the zombie's inode with no
+            # name the moment the replace below lands; records it
+            # appends after our read vanish with it. A crash between
+            # copy and replace duplicates the WAL into .old — replay
+            # is an upsert, so the double-apply is idempotent (the
+            # same property checkpoint's crash-recovered .old append
+            # relies on).
+            with open(old_path, "ab") as dst, \
+                    open(self._wal_path, "rb") as src:
+                # Streamed, not one read(): a plain writer defaults to
+                # manual checkpoints, so the WAL at failover time can
+                # be the whole ingest history — materializing it as
+                # one bytes object could OOM the promotion candidate
+                # under exactly the load that killed the writer.
+                import shutil as _shutil
+                _shutil.copyfileobj(src, dst, 1 << 20)
+                dst.flush()
+                os.fsync(dst.fileno())
+            # tmp-then-replace, not unlink-then-create: the tmp's
+            # inode is allocated while the old WAL is still linked,
+            # so the filesystem cannot recycle the number (the PR-1
+            # replica-cursor lesson).
+            tmp = self._wal_path + ".rotate"
+            self._wal = open(tmp, "wb")
+            os.replace(tmp, self._wal_path)
+        else:
+            self._wal = open(self._wal_path, "ab")
+        self._stamp_epoch_header(force=True)
+        self._wal_flush()
+
+    def demote_readonly(self) -> None:
+        """Deposed writer → tailing replica, in place: drop the WAL
+        fd and the flock, flip read-only, and rebuild the view through
+        the replica recovery path (which never truncates — the new
+        writer owns the files now). The caller (TSDB.demote) holds
+        the checkpoint lock so no spill is in flight."""
+        with self._lock:
+            if self.read_only:
+                return
+            if self._wal is not None:
+                try:
+                    self._wal.flush()
+                except OSError:
+                    pass  # likely an unlinked inode already; fine
+                self._wal.close()
+                self._wal = None
+            if self._lockfd is not None:
+                os.close(self._lockfd)
+                self._lockfd = None
+            # A frozen middle tier (fence tripped mid-checkpoint) is
+            # fully covered by <wal>.old — the rotation preceded the
+            # freeze — so the rebuild below reproduces it from disk.
+            self._frozen = None
+            self.read_only = True
+            self.writer_epoch = None
+            self.epoch_guard = None
+            self._rebuild_locked()
+
     # -- checkpoint / spill ----------------------------------------------
 
     def checkpoint(self) -> int:
@@ -1341,6 +1609,20 @@ class MemKVStore(KVStore):
         """
         if self._sst_path is None or self.read_only:
             return 0
+        if self.epoch_guard is not None:
+            # Fence BEFORE the rotation: a deposed writer's checkpoint
+            # renames WAL files BY PATH and rewrites the manifest —
+            # the single most destructive thing a zombie can do to the
+            # store its successor now owns. force=True: a checkpoint
+            # is rare enough to afford a fresh read of the epoch file.
+            self.epoch_guard.check(force=True)
+        if self._lockfd is None and self.writer_epoch is not None:
+            # A promotion over a still-held zombie flock came out
+            # lockless (epoch fence was the authority). Re-acquire
+            # opportunistically once the zombie exits, so a later
+            # NON-cluster writer — to which no epoch fence applies —
+            # is refused by the lock like on any other store.
+            self._try_take_lock()
         old_path = self._wal_path + ".old"
         t_p1 = _perf()
         with self._lock:
@@ -1380,6 +1662,9 @@ class MemKVStore(KVStore):
                 else:
                     os.replace(self._wal_path, old_path)
                     self._wal = open(self._wal_path, "ab")
+                # A cluster-mode writer begins the fresh segment with
+                # its epoch header (replay-side fence anchor).
+                self._stamp_epoch_header(force=True)
             frozen = self._frozen
             spill_keys = None
             if self.record_spill_keys:
@@ -1477,6 +1762,15 @@ class MemKVStore(KVStore):
             new_sst = None
             unlink_new = True
             try:
+                if self.epoch_guard is not None:
+                    # Re-fence at the COMMIT: a promotion that landed
+                    # while phase 2 streamed must stop this checkpoint
+                    # before it rewrites the manifest and unlinks
+                    # <wal>.old out from under the new owner. The
+                    # exception path below already knows how to back a
+                    # failed commit out (unlink the new generation,
+                    # thaw the frozen tier).
+                    self.epoch_guard.check(force=True)
                 new_sst = SSTable(out_path)
                 # The new generation is durable but the manifest does
                 # not name it yet: crash leaves it a stray the next

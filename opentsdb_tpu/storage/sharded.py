@@ -94,10 +94,18 @@ class ShardedKVStore(KVStore):
                  data_table: str = "tsdb",
                  throttle_rows: int | None = None, fsync: bool = False,
                  read_only: bool = False,
-                 spill_workers: int | None = None) -> None:
+                 spill_workers: int | None = None,
+                 writer_epoch: int | None = None,
+                 epoch_guard=None) -> None:
         self._dir = dir_path
         self.read_only = read_only
         self.data_table = data_table
+        # Cluster write tier: ONE epoch (EPOCH.json at the store root,
+        # next to SHARDS.json) covers all shards — they live and die
+        # with the writer process as a unit — and one guard is shared
+        # across every shard's mutation path.
+        self.writer_epoch = writer_epoch
+        self.epoch_guard = epoch_guard
         # Whole shards dropped from a fan-out by the series-hint
         # routing prefilter (scan_raw).
         self.bloom_shards_skipped = 0
@@ -177,7 +185,9 @@ class ShardedKVStore(KVStore):
                     wal_path=wal, throttle_rows=per_throttle,
                     fsync=fsync, read_only=read_only,
                     max_generations=(MemKVStore._MAX_GENERATIONS
-                                     + i % min(n, 8))))
+                                     + i % min(n, 8)),
+                    writer_epoch=writer_epoch,
+                    epoch_guard=epoch_guard))
         except BaseException:
             for s in self.shards:
                 try:
@@ -592,3 +602,39 @@ class ShardedKVStore(KVStore):
         MemKVStore._simulate_crash)."""
         for s in self.shards:
             s._simulate_crash()
+
+    # -- cluster promotion / demotion (cluster/) --------------------------
+
+    def promote_writable(self, writer_epoch: int,
+                         epoch_guard=None) -> None:
+        """Replica promotion across every shard (each shard runs the
+        MemKVStore fresh-inode takeover). A shard that fails to
+        promote demotes the already-promoted prefix back — the store
+        comes out all-writer or all-replica, never mixed."""
+        done: list[MemKVStore] = []
+        try:
+            for s in self.shards:
+                s.promote_writable(writer_epoch,
+                                   epoch_guard=epoch_guard)
+                done.append(s)
+        except BaseException:
+            for s in done:
+                try:
+                    s.demote_readonly()
+                except Exception:
+                    pass
+            raise
+        self.read_only = False
+        self.writer_epoch = int(writer_epoch)
+        self.epoch_guard = epoch_guard
+
+    def demote_readonly(self) -> None:
+        for s in self.shards:
+            s.demote_readonly()
+        self.read_only = True
+        self.writer_epoch = None
+        self.epoch_guard = None
+
+    @property
+    def fenced_bytes_refused(self) -> int:
+        return sum(s.fenced_bytes_refused for s in self.shards)

@@ -163,6 +163,7 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         cfg.mesh_devices = getattr(args, "mesh_devices", 0)
         cfg.slow_query_ms = getattr(args, "slow_query_ms", 0.0)
         cfg.selfmon_interval_s = getattr(args, "selfmon_interval", 0.0)
+        cfg.trace_sample_n = getattr(args, "trace_sample_n", 0)
         # Serve tier (opentsdb_tpu/serve/): staleness contract +
         # admission knobs ride the daemon config.
         cfg.role = getattr(args, "role", "writer")
@@ -178,7 +179,48 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
     from opentsdb_tpu.storage.sharded import manifest_path
 
     manifest = manifest_path(args.wal) if args.wal else None
-    if shards > 1 or (manifest and os.path.exists(manifest)):
+    dir_store = bool(shards > 1
+                     or (manifest and os.path.exists(manifest)))
+    # Cluster write tier (opentsdb_tpu/cluster/): --cluster adopts (or
+    # creates, at epoch 1) the EPOCH.json next to the WAL. Writers
+    # stamp their epoch into WAL segments and fence every mutation
+    # against promotion bumps; replicas just remember the path so
+    # /promote can take over.
+    epoch_path = None
+    writer_epoch = None
+    epoch_guard = None
+    if getattr(args, "cluster", False) and args.wal:
+        from opentsdb_tpu.cluster import epoch as _ep
+
+        cfg.cluster = True
+        cfg.cluster_owner = (getattr(args, "cluster_owner", None)
+                             or f"{os.uname().nodename}:{os.getpid()}")
+        epoch_path = _ep.epoch_path_for_wal(args.wal, is_dir=dir_store)
+        if not read_only:
+            cur, _owner = _ep.read_epoch(epoch_path)
+            if cur == 0:
+                _ep.write_epoch(epoch_path, 1, cfg.cluster_owner)
+                cur = 1
+            else:
+                # A writer BOOT claims ownership with a fresh bump,
+                # never by adopting the persisted epoch: a restarted
+                # deposed writer adopting epoch N while the promoted
+                # replica (also at N) still serves would put two
+                # unfenced writers at the SAME epoch — no guard,
+                # header, or replay fence could tell them apart.
+                # Bumping makes every boot a new ownership
+                # generation: if another writer is live, exactly one
+                # of the two survives the fence (the booter), loudly,
+                # instead of both surviving silently. Restart the old
+                # daemon with --role replica if the promoted writer
+                # should keep the store.
+                cur = _ep.bump_epoch(epoch_path, cfg.cluster_owner,
+                                     expect=cur)
+            writer_epoch = cur
+            epoch_guard = _ep.EpochGuard(
+                epoch_path, cur,
+                interval_s=cfg.epoch_check_interval_s)
+    if dir_store:
         from opentsdb_tpu.storage.sharded import ShardedKVStore
 
         # An explicit --shards (1 included) is passed through so a
@@ -187,11 +229,16 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         store = ShardedKVStore(args.wal,
                                shards=shards if shards >= 1 else None,
                                data_table=args.table,
-                               read_only=read_only)
+                               read_only=read_only,
+                               writer_epoch=writer_epoch,
+                               epoch_guard=epoch_guard)
         cfg.shards = store.shard_count
     else:
-        store = MemKVStore(wal_path=args.wal, read_only=read_only)
+        store = MemKVStore(wal_path=args.wal, read_only=read_only,
+                           writer_epoch=writer_epoch,
+                           epoch_guard=epoch_guard)
     tsdb = TSDB(store, cfg, start_compaction_thread=start_thread)
+    tsdb.cluster_epoch_path = epoch_path
     lst = _open_list()
     lst.append(tsdb)
     # Shutdown (idempotent, always reached via the main() sweep or the
@@ -274,6 +321,9 @@ def _cmd_router(args) -> int:
     backends = tuple(u.strip() for u in
                      (getattr(args, "backends", "") or "").split(",")
                      if u.strip())
+    writers = tuple(u.strip() for u in
+                    (getattr(args, "writers", "") or "").split(",")
+                    if u.strip())
     cfg = Config(
         port=args.port, bind=args.bind, role="router",
         router_backends=backends,
@@ -287,7 +337,15 @@ def _cmd_router(args) -> int:
         query_max_inflight=getattr(args, "query_max_inflight", 0),
         query_rate=getattr(args, "query_rate", 0.0),
         ingest_rate=getattr(args, "ingest_rate", 0.0),
-        ingest_queue_points=getattr(args, "ingest_queue_points", 0))
+        ingest_queue_points=getattr(args, "ingest_queue_points", 0),
+        # Cluster write tier: automatic failover grace, multi-writer
+        # ownership, and the router-side result cache.
+        writer_grace_ms=getattr(args, "writer_grace_ms", 0.0),
+        router_writers=writers,
+        cluster_map=getattr(args, "cluster_map", None) or None,
+        cluster_slots=getattr(args, "cluster_slots", 64),
+        router_rcache=getattr(args, "router_rcache", 0),
+        router_rcache_ms=getattr(args, "router_rcache_ms", 1000.0))
     server = RouterServer(cfg)
 
     async def main():
@@ -744,6 +802,41 @@ def main(argv: list[str] | None = None) -> int:
                         "disables)")
     p.add_argument("--probe-interval", type=float, default=1.0)
     p.add_argument("--router-eject-after", type=int, default=3)
+    # Cluster write tier (opentsdb_tpu/cluster/).
+    p.add_argument("--cluster", action="store_true",
+                   help="join the cluster write tier: adopt/create "
+                        "EPOCH.json next to the WAL, stamp writer "
+                        "epochs into WAL segments, fence mutations "
+                        "once deposed (writers); accept /promote "
+                        "(replicas)")
+    p.add_argument("--cluster-owner", default=None,
+                   help="this daemon's label in EPOCH.json bumps "
+                        "(default host:pid)")
+    p.add_argument("--writer-grace-ms", type=float, default=0.0,
+                   help="router: promote a replica once the writer's "
+                        "/healthz has been dead this long (0 = "
+                        "operator-driven failover only)")
+    p.add_argument("--writers", default="",
+                   help="router: comma-separated writer base URLs; "
+                        ">1 enables multi-writer series-hash "
+                        "sharding via the ownership map")
+    p.add_argument("--cluster-map", default=None,
+                   help="router: CLUSTER.json ownership-map path "
+                        "(created as an equal split over --writers "
+                        "when missing)")
+    p.add_argument("--cluster-slots", type=int, default=64,
+                   help="hash-space slots for a newly created "
+                        "ownership map")
+    p.add_argument("--router-rcache", type=int, default=0,
+                   help="router: bounded result-cache entries keyed "
+                        "by (query, ownership epoch, staleness "
+                        "bound); 0 disables")
+    p.add_argument("--router-rcache-ms", type=float, default=1000.0,
+                   help="router result-cache staleness bound")
+    p.add_argument("--trace-sample-n", type=int, default=0,
+                   help="trace 1 in N queries into /api/traces even "
+                        "when fast — ambient baselines between "
+                        "incidents (0 disables)")
     # Admission control (any role; all off by default).
     p.add_argument("--query-max-inflight", type=int, default=0,
                    help="load-shedding ladder threshold N: N..2N in "

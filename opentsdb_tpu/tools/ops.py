@@ -75,6 +75,16 @@ def add_check_args(p: argparse.ArgumentParser) -> None:
                    help="with --divide-by: use a/(a+b) instead of "
                         "a/b (hit-ratio shape; denominator-0 points "
                         "are skipped either way)")
+    p.add_argument("--skew", action="store_true",
+                   help="threshold the per-timestamp SPREAD (max - "
+                        "min) across the answer's series instead of "
+                        "the raw values — the cluster epoch-skew "
+                        "alert over self-monitored series: daemons "
+                        "disagreeing about the writer epoch is a "
+                        "failover wedged halfway. Query with a "
+                        "group-by so daemons stay distinct lines: "
+                        "tsdb check -m tsd.cluster.epoch -t host=* "
+                        "--skew -x gt -c 0")
     p.add_argument("--stats-metric", default=None,
                    help="threshold a live /stats line instead of a "
                         "/q series (read-only replicas can't "
@@ -182,6 +192,28 @@ def ratio_lines(num_lines: list[str], den_lines: list[str],
     return out
 
 
+def skew_lines(lines: list[str], metric: str) -> list[str]:
+    """Per-timestamp max-min across an answer's lines, as synthetic
+    ascii lines the threshold logic runs on unchanged. Unlike
+    ``_sum_by_ts`` this keeps every line DISTINCT per timestamp (each
+    tag set — each daemon, for selfmon-ingested tsd.* series — is one
+    observation; the spread between them is the alert signal).
+    Timestamps with a single observation still emit (spread 0): a
+    one-daemon window is agreement, not no-data."""
+    by_ts: dict[int, list[float]] = {}
+    for line in lines:
+        parts = line.split()
+        if len(parts) < 3:
+            continue
+        try:
+            ts, val = int(parts[1]), float(parts[2])
+        except ValueError:
+            continue
+        by_ts.setdefault(ts, []).append(val)
+    return [f"{metric} {ts} {max(vs) - min(vs)!r}"
+            for ts, vs in sorted(by_ts.items())]
+
+
 def _fetch_ascii(args, url: str):
     """GET an ascii /q; returns (lines, None) or (None, exit code)."""
     conn = http.client.HTTPConnection(args.host, args.port,
@@ -258,6 +290,14 @@ def cmd_check(args) -> int:
     lines, err = _fetch_ascii(args, check_query_path(args))
     if err is not None:
         return err
+    if getattr(args, "skew", False):
+        # Spread-across-series mode (epoch skew): query with a
+        # group-by (-t host=*) so each daemon stays a distinct line.
+        import copy
+        label = f"skew({args.metric})"
+        lines = skew_lines(lines, label)
+        args = copy.copy(args)
+        args.metric = label
     divisor = getattr(args, "divide_by", None)
     if divisor:
         import copy

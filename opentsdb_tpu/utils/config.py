@@ -131,6 +131,49 @@ class Config:
     max_staleness_ms: float = 0.0
     tail_interval_s: float = 0.25
 
+    # Cluster write tier (opentsdb_tpu/cluster/):
+    # - cluster: membership switch. A writer adopts (or creates) the
+    #   EPOCH.json next to its WAL, stamps its epoch into every WAL
+    #   segment it opens, and fences every mutation once a promotion
+    #   bumps the persisted epoch past its own (FencedWriterError).
+    #   Replicas in cluster mode accept /promote.
+    # - cluster_owner: this daemon's label in EPOCH.json bumps
+    #   (defaults to host:port at daemon start).
+    # - epoch_check_interval_s: the zombie guard's stat cadence —
+    #   mutations re-read the epoch file at most this often (rotation
+    #   and manifest commits always re-read).
+    # - writer_grace_ms (router role): how long the writer's /healthz
+    #   must stay dead before the router promotes a replica. 0
+    #   disables automatic failover (promotion stays operator-driven
+    #   via /promote).
+    # - trace_sample_n: 1-in-N always-on query trace sampling feeding
+    #   the trace ring, so slow queries between incidents have ambient
+    #   baselines. 0 disables.
+    cluster: bool = False
+    cluster_owner: str | None = None
+    epoch_check_interval_s: float = 0.05
+    writer_grace_ms: float = 0.0
+    trace_sample_n: int = 0
+
+    # Multi-writer sharding (cluster/ownership.py; router role only):
+    # - router_writers: writer base URLs. With >1, the router fans
+    #   telnet/HTTP ingest by the series-hash ownership map and fans
+    #   reads over each slot's owner history (answers merge).
+    # - cluster_map: CLUSTER.json path. Missing file: an equal-split
+    #   map over router_writers is created there. The map's epoch
+    #   versions every handoff.
+    # - cluster_slots: hash-space granularity for a newly created map.
+    router_writers: tuple = ()
+    cluster_map: str | None = None
+    cluster_slots: int = 64
+
+    # Router-side bounded result cache (the fragment-cache stamp
+    # discipline one level up): full-service /q JSON answers cached
+    # keyed by (normalized query, ownership-map epoch, staleness
+    # bound); entries expire at router_rcache_ms. 0 entries = off.
+    router_rcache: int = 0
+    router_rcache_ms: float = 1000.0
+
     # Admission control / backpressure (serve/admission.py). All off
     # by default (0); per-tenant buckets key on the ?tenant= query
     # param (HTTP) or the connection's tenant (telnet; "default").

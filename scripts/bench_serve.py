@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import threading
@@ -37,7 +38,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from scripts.servematrix import (BT, Deployment, http_get,  # noqa: E402
-                                 owner_metric, telnet_acked)
+                                 owner_metric, telnet_acked,
+                                 wait_ready)
 
 INFLIGHT_N = 2          # router full-service budget (sustainable)
 QUERY_METRICS = 4       # distinct sub-queries spread over both owners
@@ -94,14 +96,230 @@ def run_queries(port, targets, duration_s, out, tenant=None):
         i += 1
 
 
+# ---------------------------------------------------------------------------
+# Multi-writer leg (--writers N): cluster ingest throughput + parity
+# ---------------------------------------------------------------------------
+
+class ClusterDeployment:
+    """N writer daemons (each its OWN store, --shards 4) behind one
+    router fanning ingest and reads by the ownership map
+    (cluster/ownership.py) — the multi-writer topology, vs. the
+    single-writer control (N=1, same router code path)."""
+
+    def __init__(self, workdir: str, n_writers: int,
+                 shards: int = 4) -> None:
+        self.workdir = workdir
+        self.n = n_writers
+        self.shards = shards
+        self.map_path = os.path.join(workdir, "CLUSTER.json")
+        self.procs: dict[str, object] = {}
+        self.ports: dict[str, int] = {}
+        self.env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO + os.pathsep
+            + os.environ.get("PYTHONPATH", ""))
+        self.env.pop("TSDB_FAULTPOINTS", None)
+
+    def _spawn(self, name: str, extra: list[str]) -> int:
+        logpath = os.path.join(self.workdir, f"{name}.log")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "opentsdb_tpu.tools.cli", "tsd",
+             "--bind", "127.0.0.1", "--backend", "cpu"] + extra,
+            env=self.env, stdout=open(logpath, "w"),
+            stderr=subprocess.STDOUT, cwd=REPO)
+        self.procs[name] = proc
+        self.ports[name] = wait_ready(proc, logpath, name)
+        return self.ports[name]
+
+    def start(self) -> None:
+        os.makedirs(self.workdir, exist_ok=True)
+        urls = []
+        for i in range(self.n):
+            store = os.path.join(self.workdir, f"store-w{i}")
+            port = self._spawn(f"writer-{i}", [
+                "--port", "0", "--wal", store, "--auto-metric",
+                "--shards", str(self.shards)])
+            urls.append(f"http://127.0.0.1:{port}")
+        self._spawn("router", [
+            "--port", "0", "--role", "router",
+            "--writers", ",".join(urls),
+            "--cluster-map", self.map_path,
+            "--probe-interval", "0.5"])
+
+    def stop(self) -> None:
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=20)
+            except Exception:
+                p.kill()
+
+    def owner(self, metric: str) -> int:
+        """Client-side sharding by the PUBLISHED map — collectors fan
+        directly to owner writers; the router forwards strays."""
+        if self.n == 1:
+            return 0
+        from opentsdb_tpu.cluster.ownership import OwnershipMap
+        m = OwnershipMap.load(self.map_path)
+        return m.owner(metric.encode())
+
+
+def cluster_metrics(n_writers: int, map_path: str,
+                    count: int = QUERY_METRICS) -> list[str]:
+    """``count`` metric names split evenly across the writers by the
+    ownership map (the corpus recipe's owner_metric, one level up)."""
+    if n_writers == 1:
+        return [f"serve.c{k}" for k in range(count)]
+    from opentsdb_tpu.cluster.ownership import OwnershipMap
+    m = OwnershipMap.load(map_path)
+    per_writer = {i: 0 for i in range(n_writers)}
+    out: list[str] = []
+    i = 0
+    while len(out) < count:
+        name = f"serve.c{i}"
+        o = m.owner(name.encode())
+        if per_writer[o] < (count + n_writers - 1) // n_writers:
+            out.append(name)
+            per_writer[o] += 1
+        i += 1
+    return out
+
+
+def ingest_cluster(groups: list[tuple[int, list[str]]],
+                   per: int) -> float:
+    """Ingest the corpus: one client thread per (port, metrics)
+    group. The CALLER builds identical groupings for both legs (same
+    thread count, same metric partition) — only the target ports
+    differ, so the measured delta is server-side parallelism, not
+    client structure. Returns wall seconds."""
+    errs: list[str] = []
+
+    def feed(port: int, ms: list[str]) -> None:
+        try:
+            for metric in ms:
+                for off in range(0, per, 20_000):
+                    n = min(20_000, per - off)
+                    lines = [f"put {metric} {BT + (off + i) * 6} "
+                             f"{(off + i) % 97} host=h"
+                             for i in range(n)]
+                    telnet_acked(port, lines, timeout=300)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=feed, args=(port, ms))
+               for port, ms in groups]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errs:
+        raise RuntimeError(f"cluster ingest failed: {errs[:3]}")
+    return wall
+
+
+def run_cluster_bench(args) -> int:
+    """The --writers N leg: sustained ingest throughput vs the
+    single-writer control (same host, same corpus recipe, same client
+    parallelism) + the ownership-split parity gate (router answers
+    byte-identical between topologies)."""
+    out: dict = {"writers": args.writers, "points": args.points,
+                 "shards": 4}
+    bodies: dict[str, dict[str, bytes]] = {}
+    per = args.points // QUERY_METRICS
+    metrics: list[str] | None = None
+    groups_by_owner: list[list[str]] | None = None
+    root = args.work_dir or tempfile.mkdtemp(prefix="benchclu-")
+    for leg, n_writers in (("multi", args.writers), ("single", 1)):
+        work = os.path.join(root, leg)
+        dep = ClusterDeployment(work, n_writers)
+        print(f"[{leg}] booting {n_writers} writer(s) + router ...",
+              file=sys.stderr, flush=True)
+        dep.start()
+        try:
+            if metrics is None:
+                # The multi leg runs first and pins the corpus: the
+                # metric set, its ownership split, and the client
+                # thread grouping both legs reuse verbatim.
+                metrics = cluster_metrics(args.writers, dep.map_path)
+                split = {m: dep.owner(m) for m in metrics}
+                if len(set(split.values())) < 2:
+                    raise RuntimeError(
+                        f"corpus does not split across writers: "
+                        f"{split}")
+                out["ownership_split"] = split
+                groups_by_owner = [
+                    [m for m in metrics if split[m] == w]
+                    for w in sorted(set(split.values()))]
+            # Same thread count + metric partition on both legs; only
+            # the target ports differ (owners vs the lone writer).
+            groups = [(dep.ports[f"writer-{dep.owner(ms[0])}"]
+                       if n_writers > 1 else dep.ports["writer-0"],
+                       ms)
+                      for ms in groups_by_owner]
+            wall = ingest_cluster(groups, per)
+            dps = args.points / wall
+            out[leg] = {"ingest_wall_s": round(wall, 3),
+                        "ingest_dps": round(dps, 1),
+                        "writers": n_writers}
+            print(f"[{leg}] {args.points} pts in {wall:.2f}s "
+                  f"({dps:,.0f} dps)", file=sys.stderr, flush=True)
+            # Parity battery through the router: raw + downsampled.
+            bodies[leg] = {}
+            for metric in metrics:
+                for spec in (f"sum:{metric}", f"sum:1h-avg:{metric}",
+                             f"max:{metric}"):
+                    tgt = q_target(spec, per * 6 // 60 + 60)
+                    status, _, body = http_get(dep.ports["router"],
+                                               tgt, timeout=120)
+                    assert status == 200, (leg, spec, status,
+                                           body[:200])
+                    bodies[leg][spec] = body
+        finally:
+            dep.stop()
+    mismatches = [spec for spec in bodies["multi"]
+                  if bodies["multi"][spec] != bodies["single"][spec]]
+    gate = {
+        "ingest_above_single_writer_control":
+            out["multi"]["ingest_dps"] > out["single"]["ingest_dps"],
+        "parity_byte_identical": not mismatches,
+    }
+    out["parity"] = {"queries": len(bodies["multi"]),
+                     "mismatches": mismatches}
+    out["speedup"] = round(out["multi"]["ingest_dps"]
+                           / out["single"]["ingest_dps"], 3)
+    out["gate"] = gate
+    out["pass"] = all(gate.values())
+    out["iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    path = args.json or "BENCH_CLUSTER.json"
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: out[k] for k in
+                      ("multi", "single", "speedup", "gate", "pass")},
+                     indent=1))
+    return 0 if out["pass"] else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--points", type=int, default=200_000)
-    ap.add_argument("--json", default="BENCH_SERVE.json")
+    ap.add_argument("--json", default=None)
     ap.add_argument("--duration", type=float, default=12.0,
                     help="seconds per leg")
     ap.add_argument("--work-dir", default=None)
+    ap.add_argument("--writers", type=int, default=1,
+                    help=">1: run the multi-writer cluster bench "
+                         "(ownership-map sharded ingest vs a single-"
+                         "writer control + byte-parity gate) instead "
+                         "of the overload bench")
     args = ap.parse_args()
+    if args.writers > 1:
+        return run_cluster_bench(args)
+    if args.json is None:
+        args.json = "BENCH_SERVE.json"
 
     work = args.work_dir or tempfile.mkdtemp(prefix="benchserve-")
     os.makedirs(work, exist_ok=True)

@@ -33,6 +33,31 @@ verifies the contracts:
                       (TSDB_SERVE_BUG) and the oracle must CATCH the
                       untagged stale answer — the matrix's gate.
 
+Cluster failover scenarios (opentsdb_tpu/cluster/; each boots a FRESH
+--cluster deployment, since a promotion permanently changes who the
+writer is):
+
+  writer-promote      SIGKILL the writer mid-stream; the router must
+                      promote a replica within the grace, flip ingest
+                      forwarding to it (proven by ingesting THROUGH
+                      the router afterwards), every acked point stays
+                      queryable (durability oracle), and the old
+                      writer restarted as a replica reconverges.
+  zombie-fence        SIGSTOP the writer (wedged, alive, flock held);
+                      the router promotes past the grace; SIGCONT
+                      wakes the zombie, whose direct put must be
+                      REFUSED (epoch fence) and which must end up
+                      demoted to a tailing replica. ``--bug
+                      split-brain`` disables the fence + demote
+                      compliance (TSDB_CLUSTER_BUG) and the matrix
+                      must CATCH the deposed writer acking a write
+                      the cluster cannot serve — the cluster gate.
+  promote-crash       Arm cluster.promote.rotate=crash on the first
+                      promotion candidate over /fault; the candidate
+                      dies MID-PROMOTION and the router must walk to
+                      the next replica, which takes over at a higher
+                      epoch with every acked point intact.
+
 Scenario outcomes are seed-deterministic: the workload derives from
 --seed, answers are hashed into per-scenario fingerprints, and two
 runs with the same seed produce the same fingerprints.
@@ -40,6 +65,7 @@ runs with the same seed produce the same fingerprints.
     python scripts/servematrix.py --json SERVE_MATRIX.json   # full
     python scripts/servematrix.py --fast                     # tier-1
     python scripts/servematrix.py --only staleness --bug stale-serve
+    python scripts/servematrix.py --only zombie --bug split-brain
 """
 
 from __future__ import annotations
@@ -61,11 +87,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 BT = 1356998400
-FAST = ("replica-kill", "router-partition")
+# Cluster scenarios each boot a FRESH deployment (a promotion changes
+# who the writer is for good); the legacy four share one.
+CLUSTER = ("writer-promote", "zombie-fence", "promote-crash")
+FAST = ("replica-kill", "router-partition", "writer-promote",
+        "zombie-fence")
 ALL = ("replica-kill", "router-partition", "writer-crash",
-       "staleness-contract")
-BUGS = ("stale-serve",)
+       "staleness-contract") + CLUSTER
+BUGS = ("stale-serve", "split-brain")
 MAX_STALENESS_MS = 1200.0
+WRITER_GRACE_MS = 1000.0
 
 
 def log(msg: str) -> None:
@@ -166,7 +197,8 @@ class Deployment:
     def __init__(self, workdir: str, seed: int,
                  bug: str | None = None,
                  router_args: list[str] | None = None,
-                 rollups: bool = False) -> None:
+                 rollups: bool = False,
+                 cluster: bool = False) -> None:
         self.workdir = workdir
         self.seed = seed
         self.bug = bug
@@ -175,6 +207,10 @@ class Deployment:
         # timer and replicas serve it read-only (the bench topology;
         # the failover scenarios run raw to keep boot deterministic).
         self.rollups = rollups
+        # cluster=True: every daemon joins the epoch-fenced write tier
+        # (--cluster) and the router drives automatic failover
+        # (--writer-grace-ms).
+        self.cluster = cluster
         self.store = os.path.join(workdir, "store")
         self.procs: dict[str, subprocess.Popen] = {}
         self.ports: dict[str, int] = {}
@@ -200,20 +236,27 @@ class Deployment:
 
     def start(self) -> None:
         os.makedirs(self.store, exist_ok=True)
+        cluster_args = ["--cluster"] if self.cluster else []
         writer_args = ["--port", "0", "--wal",
                        os.path.join(self.store, "wal"),
-                       "--auto-metric"]
+                       "--auto-metric"] + cluster_args
         rollup_args = (["--rollups", "--checkpoint-interval", "2"]
                        if self.rollups else [])
-        self._spawn("writer", writer_args + rollup_args)
-        rep_env = ({"TSDB_SERVE_BUG": self.bug} if self.bug else None)
+        # The cluster gate sabotages the WRITER's fence (an unfenced
+        # zombie); the serve gate sabotages the replicas' stale tag.
+        writer_env = ({"TSDB_CLUSTER_BUG": self.bug}
+                      if self.bug == "split-brain" else None)
+        rep_env = ({"TSDB_SERVE_BUG": self.bug}
+                   if self.bug and self.bug != "split-brain" else None)
+        self._spawn("writer", writer_args + rollup_args,
+                    extra_env=writer_env)
         for name in ("replica-a", "replica-b"):
             self._spawn(name, [
                 "--port", "0", "--wal",
                 os.path.join(self.store, "wal"),
                 "--role", "replica",
                 "--max-staleness-ms", str(MAX_STALENESS_MS),
-                "--tail-interval", "0.1"]
+                "--tail-interval", "0.1"] + cluster_args
                 + (["--rollups"] if self.rollups else []),
                 extra_env=rep_env)
         self._spawn("router", [
@@ -226,23 +269,32 @@ class Deployment:
             "--probe-interval", "0.2",
             "--router-eject-after", "2",
             "--router-retries", "2",
-            "--router-deadline-ms", "8000"] + self.router_args)
+            "--router-deadline-ms", "8000"]
+            + (["--writer-grace-ms", str(WRITER_GRACE_MS)]
+               if self.cluster else [])
+            + self.router_args)
 
-    def restart(self, name: str, extra: list[str] | None = None) -> int:
+    def restart(self, name: str, extra: list[str] | None = None,
+                role: str | None = None) -> int:
         """Restart a daemon on its OLD port (the router's backend list
-        is positional-by-URL)."""
-        role = {"writer": [], "replica-a": ["--role", "replica"],
-                "replica-b": ["--role", "replica"]}[name]
+        is positional-by-URL). ``role`` overrides the daemon's role —
+        a deposed writer comes back as ``--role replica``."""
+        if role is None:
+            role = "writer" if name == "writer" else "replica"
         port = self.ports[name]
         args = ["--port", str(port), "--wal",
-                os.path.join(self.store, "wal")] + role
-        if name == "writer":
-            args.append("--auto-metric")
-        else:
-            args += ["--max-staleness-ms", str(MAX_STALENESS_MS),
+                os.path.join(self.store, "wal")]
+        if role == "replica":
+            args += ["--role", "replica",
+                     "--max-staleness-ms", str(MAX_STALENESS_MS),
                      "--tail-interval", "0.1"]
+        else:
+            args.append("--auto-metric")
+        if self.cluster:
+            args.append("--cluster")
         rep_env = ({"TSDB_SERVE_BUG": self.bug}
-                   if self.bug and name != "writer" else None)
+                   if self.bug and self.bug != "split-brain"
+                   and role == "replica" else None)
         return self._spawn(name, args + (extra or []),
                            extra_env=rep_env)
 
@@ -489,50 +541,309 @@ def scenario_staleness_contract(dep: Deployment, seed: int) -> dict:
     return {"problems": problems, "fingerprint_parts": []}
 
 
+# ---------------------------------------------------------------------------
+# Cluster failover scenarios (fresh --cluster deployment each)
+# ---------------------------------------------------------------------------
+
+def wait_promotion(dep: Deployment, timeout: float = 30.0):
+    """Poll /api/topology until the router reports a promotion;
+    returns (promoted_url, epoch) or (None, 0)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            _, _, body = http_get(dep.ports["router"], "/api/topology",
+                                  timeout=5)
+            promo = json.loads(body).get("promotion") or {}
+            for ev in promo.get("events", []):
+                if ev.get("event") == "promoted":
+                    return ev["url"], promo.get("epoch", 0)
+        except Exception:
+            pass
+        time.sleep(0.1)
+    return None, 0
+
+
+def wait_point_count(port: int, m: str, end_n: int, want: int,
+                     timeout: float = 30.0) -> int:
+    """Poll a daemon's /q until it serves ``want`` points (the ack
+    boundary for ingest routed through the router, whose telnet
+    forwarding acks asynchronously)."""
+    deadline = time.time() + timeout
+    got = -1
+    q = (f"/q?start={BT - 60}&end={BT + end_n * 60}&m={m}"
+         f"&json&nocache")
+    while time.time() < deadline:
+        try:
+            status, _, body = http_get(port, q, timeout=10)
+            if status == 200:
+                got = sum(len(r["dps"]) for r in json.loads(body))
+                if got >= want:
+                    return got
+        except Exception:
+            pass
+        time.sleep(0.2)
+    return got
+
+
+def telnet_try_put(port: int, line: str, timeout: float = 15.0) -> bytes:
+    """Send one put + version; return whatever came back (the caller
+    decides whether a ``put:`` error line was the right answer)."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    s.settimeout(timeout)
+    try:
+        s.sendall((line + "\nversion\n").encode())
+        buf = b""
+        while b"opentsdb" not in buf:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+        return buf
+    finally:
+        s.close()
+
+
+def scenario_writer_promote(dep: Deployment, seed: int) -> dict:
+    """Writer SIGKILL → grace → replica promoted → ingest forwarding
+    flips → acked-point durability oracle → deposed writer rejoins as
+    a replica."""
+    problems: list[str] = []
+    m0 = owner_metric(0, salt=4)
+    metric = m0.split(":", 1)[1]
+    n0 = 300
+    dep.ingest_acked(metric, n0, BT, seed % 71)
+    time.sleep(0.5)  # a tail cycle: replicas hold everything durable
+    dep.kill("writer")
+    promoted, epoch = wait_promotion(dep)
+    if promoted is None:
+        return {"problems": ["router never promoted a replica after "
+                             "the writer died"],
+                "fingerprint_parts": []}
+    if epoch < 2:
+        problems.append(f"promotion did not bump the epoch "
+                        f"(topology says {epoch})")
+    promoted_name = next(
+        (n for n in ("replica-a", "replica-b")
+         if str(dep.ports[n]) in promoted), None)
+    if promoted_name is None:
+        problems.append(f"promoted url {promoted!r} is not a replica")
+        return {"problems": problems, "fingerprint_parts": []}
+    # Ingest THROUGH THE ROUTER: proves telnet forwarding flipped to
+    # the promoted writer (the old writer is a corpse).
+    n1 = 100
+    lines = [f"put {metric} {BT + (n0 + i) * 60} {(13 + i) % 97} "
+             f"host=h" for i in range(n1)]
+    telnet_acked(dep.ports["router"], lines)
+    got = wait_point_count(dep.ports[promoted_name], m0, n0 + n1,
+                           n0 + n1)
+    if got != n0 + n1:
+        problems.append(
+            f"DURABILITY: promoted writer serves {got}/{n0 + n1} "
+            f"acked points")
+    # The promoted writer is the authority now; the router must agree.
+    q = (f"/q?start={BT - 60}&end={BT + (n0 + n1) * 60}&m={m0}"
+         f"&json&nocache")
+    _, _, direct = http_get(dep.ports[promoted_name], q)
+    golden = answer_hash(direct)
+    status, _, via_router = _router_q(dep, m0, n0 + n1)
+    if status != 200 or answer_hash(via_router) != golden:
+        problems.append("router answer != promoted writer answer")
+    # The deposed writer's way back: restart on its old port as a
+    # replica; it must tail the promoted writer's WAL and converge.
+    dep.restart("writer", role="replica")
+    got = wait_point_count(dep.ports["writer"], m0, n0 + n1, n0 + n1)
+    if got != n0 + n1:
+        problems.append(
+            f"restarted old writer (as replica) serves {got}/"
+            f"{n0 + n1} points — never converged")
+    return {"problems": problems, "fingerprint_parts": [golden]}
+
+
+def scenario_zombie_fence(dep: Deployment, seed: int) -> dict:
+    """THE split-brain oracle. Wedge the writer (SIGSTOP — alive,
+    flock held, /healthz dark), let the router promote past the
+    grace, wake the zombie: its direct put must be REFUSED (the epoch
+    fence), and it must end up demoted to a tailing replica. --bug
+    split-brain disables the fence and demote compliance
+    (TSDB_CLUSTER_BUG) and this scenario must CATCH the zombie acking
+    a write the cluster cannot serve."""
+    problems: list[str] = []
+    m0 = owner_metric(1, salt=4)
+    metric = m0.split(":", 1)[1]
+    n0 = 250
+    dep.ingest_acked(metric, n0, BT, seed % 67)
+    time.sleep(0.5)
+    dep.procs["writer"].send_signal(signal.SIGSTOP)
+    try:
+        promoted, epoch = wait_promotion(dep)
+        if promoted is None:
+            return {"problems": ["router never promoted past a wedged "
+                                 "writer"],
+                    "fingerprint_parts": []}
+        promoted_name = next(
+            (n for n in ("replica-a", "replica-b")
+             if str(dep.ports[n]) in promoted), "replica-a")
+        # Acked points the NEW writer owns.
+        n1 = 50
+        lines = [f"put {metric} {BT + (n0 + i) * 60} {(7 + i) % 97} "
+                 f"host=h" for i in range(n1)]
+        telnet_acked(dep.ports[promoted_name], lines)
+    finally:
+        dep.procs["writer"].send_signal(signal.SIGCONT)
+    # The zombie wakes with a stale epoch. Its OWN ingest port must
+    # refuse the put — fenced (or already demoted; both mean no split
+    # brain). An ack here is THE violation.
+    zombie_line = (f"put {metric} {BT + (n0 + 500) * 60} 55 host=h")
+    back = telnet_try_put(dep.ports["writer"], zombie_line)
+    if b"put:" not in back:
+        # The zombie acked. Is the point actually servable?
+        time.sleep(1.0)
+        got = wait_point_count(dep.ports[promoted_name], m0,
+                               n0 + 501, n0 + n1 + 1, timeout=3.0)
+        problems.append(
+            f"SPLIT BRAIN: deposed writer ACKNOWLEDGED a write "
+            f"(cluster serves {got}/{n0 + n1 + 1} points incl. it "
+            f"— the acked point is "
+            f"{'lost' if got < n0 + n1 + 1 else 'duplicated'})")
+    # Demote-on-return: the router owes the zombie a /demote; it must
+    # end up a tailing replica (skip under the bug — sabotaged).
+    if dep.bug != "split-brain":
+        deadline = time.time() + 20
+        role = None
+        while time.time() < deadline:
+            try:
+                _, _, body = http_get(dep.ports["writer"], "/healthz",
+                                      timeout=5)
+                role = json.loads(body).get("role")
+                if role == "replica":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        if role != "replica":
+            problems.append(f"zombie writer never demoted to tailing "
+                            f"(healthz role: {role!r})")
+        else:
+            got = wait_point_count(dep.ports["writer"], m0, n0 + 51,
+                                   n0 + 50)
+            if got != n0 + 50:
+                problems.append(
+                    f"demoted writer serves {got}/{n0 + 50} points — "
+                    f"tailing never converged")
+    return {"problems": problems, "fingerprint_parts": []}
+
+
+def scenario_promote_crash(dep: Deployment, seed: int) -> dict:
+    """A promotion candidate dying MID-PROMOTION (cluster.promote.
+    rotate=crash armed over /fault) must not strand the cluster: the
+    router walks to the next replica, which takes over at a higher
+    epoch with every acked point intact."""
+    problems: list[str] = []
+    m0 = owner_metric(0, salt=5)
+    metric = m0.split(":", 1)[1]
+    n0 = 200
+    dep.ingest_acked(metric, n0, BT, seed % 61)
+    time.sleep(0.5)
+    # The router's candidate walk probes replica-a first: arm its
+    # rotate site to kill it at the worst moment (epoch already
+    # bumped, WAL mid-rotation).
+    status, _, body = http_get(
+        dep.ports["replica-a"],
+        "/fault?arm=cluster.promote.rotate%3Dcrash")
+    if status != 200 or b"cluster.promote.rotate" not in body:
+        problems.append(f"arm-over-HTTP failed: {status} {body[:200]}")
+    dep.kill("writer")
+    promoted, epoch = wait_promotion(dep, timeout=60.0)
+    if promoted is None:
+        return {"problems": ["router never promoted anyone (candidate "
+                             "crash stranded the failover)"],
+                "fingerprint_parts": []}
+    if str(dep.ports["replica-b"]) not in promoted:
+        problems.append(f"expected replica-b promoted after "
+                        f"replica-a's injected crash, got {promoted!r}")
+    if dep.procs["replica-a"].poll() is None:
+        problems.append("replica-a survived an armed crash "
+                        "faultpoint (site never fired)")
+    got = wait_point_count(dep.ports["replica-b"], m0, n0, n0)
+    if got != n0:
+        problems.append(f"DURABILITY: promoted replica-b serves "
+                        f"{got}/{n0} acked points")
+    # The crashed candidate recovers as a replica over the store the
+    # new writer now owns (crash recovery mid-rotation is the PR-1
+    # idempotent-replay contract).
+    dep.restart("replica-a")
+    got = wait_point_count(dep.ports["replica-a"], m0, n0, n0)
+    if got != n0:
+        problems.append(f"crashed candidate recovered serving "
+                        f"{got}/{n0} points")
+    return {"problems": problems, "fingerprint_parts": []}
+
+
 SCENARIOS = {
     "replica-kill": scenario_replica_kill,
     "router-partition": scenario_router_partition,
     "writer-crash": scenario_writer_crash,
     "staleness-contract": scenario_staleness_contract,
+    "writer-promote": scenario_writer_promote,
+    "zombie-fence": scenario_zombie_fence,
+    "promote-crash": scenario_promote_crash,
 }
+
+
+def _run_one(dep: Deployment, label: str, seed: int,
+             bug: str | None) -> dict:
+    t0 = time.time()
+    try:
+        out = SCENARIOS[label](dep, seed)
+    except Exception as e:
+        import traceback
+        out = {"problems": [f"scenario crashed: {e!r}",
+                            traceback.format_exc(limit=5)],
+               "fingerprint_parts": []}
+    status = "ok" if not out["problems"] else "invariant-failed"
+    fp = hashlib.sha1(
+        ("|".join([label, status] + out["problems"]
+                  + out["fingerprint_parts"])).encode()).hexdigest()
+    rec = {
+        "label": label, "status": status,
+        "problems": out["problems"],
+        "seed": seed, "bug": bug,
+        "wall_s": round(time.time() - t0, 2),
+        "fingerprint": fp,
+        "repro": (f"python scripts/servematrix.py --only "
+                  f"{label} --seed {seed}"
+                  + (f" --bug {bug}" if bug else "")),
+    }
+    log(f"{status:17s} {label} ({rec['wall_s']:.1f}s)")
+    return rec
 
 
 def run(labels, workdir: str, seed: int, bug: str | None) -> list[dict]:
     os.makedirs(workdir, exist_ok=True)
-    dep = Deployment(workdir, seed, bug=bug)
     results = []
-    log("booting writer + 2 replicas + router ...")
-    dep.start()
-    try:
-        for label in labels:
-            t0 = time.time()
-            try:
-                out = SCENARIOS[label](dep, seed)
-            except Exception as e:
-                import traceback
-                out = {"problems": [f"scenario crashed: {e!r}",
-                                    traceback.format_exc(limit=5)],
-                       "fingerprint_parts": []}
-            status = "ok" if not out["problems"] else \
-                "invariant-failed"
-            fp = hashlib.sha1(
-                ("|".join([label, status] + out["problems"]
-                          + out["fingerprint_parts"])).encode()
-            ).hexdigest()
-            results.append({
-                "label": label, "status": status,
-                "problems": out["problems"],
-                "seed": seed, "bug": bug,
-                "wall_s": round(time.time() - t0, 2),
-                "fingerprint": fp,
-                "repro": (f"python scripts/servematrix.py --only "
-                          f"{label} --seed {seed}"
-                          + (f" --bug {bug}" if bug else "")),
-            })
-            log(f"{status:17s} {label} "
-                f"({results[-1]['wall_s']:.1f}s)")
-    finally:
-        dep.stop()
+    legacy = [lb for lb in labels if lb not in CLUSTER]
+    if legacy:
+        dep = Deployment(os.path.join(workdir, "legacy"), seed,
+                         bug=bug)
+        log("booting writer + 2 replicas + router ...")
+        dep.start()
+        try:
+            for label in legacy:
+                results.append(_run_one(dep, label, seed, bug))
+        finally:
+            dep.stop()
+    for label in (lb for lb in labels if lb in CLUSTER):
+        dep = Deployment(os.path.join(workdir, label), seed, bug=bug,
+                         cluster=True)
+        log(f"booting CLUSTER deployment for {label} ...")
+        dep.start()
+        try:
+            results.append(_run_one(dep, label, seed, bug))
+        finally:
+            dep.stop()
+    # Preserve the requested label order in the artifact.
+    order = {lb: i for i, lb in enumerate(labels)}
+    results.sort(key=lambda r: order[r["label"]])
     return results
 
 
@@ -541,7 +852,12 @@ def main(argv=None) -> int:
     p.add_argument("--json", default="SERVE_MATRIX.json")
     p.add_argument("--fast", action="store_true",
                    help="tier-1 subset: replica-kill + "
-                        "router-partition")
+                        "router-partition + writer-promote + "
+                        "zombie-fence")
+    p.add_argument("--cluster", action="store_true",
+                   help="cluster failover scenarios only "
+                        "(writer-promote, zombie-fence, "
+                        "promote-crash)")
     p.add_argument("--only", action="append", default=[])
     p.add_argument("--seed", type=int, default=1234)
     p.add_argument("--bug", default=None, choices=BUGS,
@@ -552,7 +868,8 @@ def main(argv=None) -> int:
     p.add_argument("--list", action="store_true")
     args = p.parse_args(argv)
 
-    labels = list(FAST if args.fast else ALL)
+    labels = list(CLUSTER if args.cluster
+                  else FAST if args.fast else ALL)
     if args.only:
         labels = [lb for lb in labels + [x for x in ALL
                                          if x not in labels]

@@ -1,0 +1,884 @@
+"""Cluster write tier tests (opentsdb_tpu/cluster/): the epoch file +
+CAS, the zombie guard, WAL segment-header fencing on replay, replica
+promotion / writer demotion at the store and TSDB/server levels, the
+ownership map + handoff, the router's multi-writer merge, the
+result cache, /api/topology, ambient trace sampling, and the
+``tsdb check --skew`` epoch-skew alert."""
+
+import asyncio
+import json
+import os
+import struct
+
+import pytest
+
+from opentsdb_tpu.cluster import epoch as cepoch
+from opentsdb_tpu.cluster.ownership import OwnershipMap, slot_of
+from opentsdb_tpu.core.errors import (FencedWriterError,
+                                      ReadOnlyStoreError)
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.storage.kv import _OP_EPOCH, _REC, MemKVStore
+from opentsdb_tpu.storage.sharded import ShardedKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+
+
+def guard(path, epoch):
+    """A zero-interval guard: every check re-stats (test determinism)."""
+    return cepoch.EpochGuard(path, epoch, interval_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# EPOCH.json + EpochGuard
+# ---------------------------------------------------------------------------
+
+class TestEpochFile:
+    def test_roundtrip_and_bump(self, tmp_path):
+        p = str(tmp_path / "EPOCH.json")
+        assert cepoch.read_epoch(p) == (0, None)
+        cepoch.write_epoch(p, 1, owner="w0")
+        assert cepoch.read_epoch(p) == (1, "w0")
+        assert cepoch.bump_epoch(p, owner="r1", expect=1) == 2
+        assert cepoch.read_epoch(p) == (2, "r1")
+
+    def test_cas_conflict_is_loud(self, tmp_path):
+        p = str(tmp_path / "EPOCH.json")
+        cepoch.write_epoch(p, 3)
+        with pytest.raises(cepoch.EpochConflictError):
+            cepoch.bump_epoch(p, expect=2)
+
+    def test_bad_version_refused(self, tmp_path):
+        p = tmp_path / "EPOCH.json"
+        p.write_text(json.dumps({"version": 99, "epoch": 5}))
+        with pytest.raises(ValueError):
+            cepoch.read_epoch(str(p))
+
+    def test_epoch_zero_refused(self, tmp_path):
+        with pytest.raises(ValueError):
+            cepoch.write_epoch(str(tmp_path / "E.json"), 0)
+
+    def test_guard_fences_and_stays_fenced(self, tmp_path):
+        p = str(tmp_path / "EPOCH.json")
+        cepoch.write_epoch(p, 1)
+        g = guard(p, 1)
+        g.check()  # own epoch: fine
+        cepoch.write_epoch(p, 2)
+        with pytest.raises(FencedWriterError) as ei:
+            g.check()
+        assert ei.value.current_epoch == 2
+        # Tripped stays tripped, even if the file regresses somehow.
+        cepoch.write_epoch(p, 1)
+        with pytest.raises(FencedWriterError):
+            g.check()
+        g.reset(2)
+        g.check()
+
+    def test_guard_bug_env_disables_fence(self, tmp_path, monkeypatch):
+        p = str(tmp_path / "EPOCH.json")
+        cepoch.write_epoch(p, 2)
+        g = guard(p, 1)
+        monkeypatch.setenv("TSDB_CLUSTER_BUG", "split-brain")
+        g.check()  # sabotaged: no fence
+        monkeypatch.delenv("TSDB_CLUSTER_BUG")
+        with pytest.raises(FencedWriterError):
+            g.check()
+
+    def test_concurrent_bumps_serialize(self, tmp_path):
+        """Review fix: the CAS runs under a cross-process flock —
+        two concurrent no-expect bumps must mint DISTINCT epochs,
+        never the same one twice."""
+        import concurrent.futures
+        p = str(tmp_path / "EPOCH.json")
+        cepoch.write_epoch(p, 1)
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            got = sorted(ex.map(lambda _: cepoch.bump_epoch(p),
+                                range(8)))
+        assert got == list(range(2, 10))  # all distinct, gapless
+        assert cepoch.read_epoch(p)[0] == 9
+
+    def test_epoch_path_for_wal(self, tmp_path):
+        d = tmp_path / "store"
+        d.mkdir()
+        assert cepoch.epoch_path_for_wal(str(d)) == \
+            str(d / "EPOCH.json")
+        assert cepoch.epoch_path_for_wal(str(tmp_path / "wal")) == \
+            str(tmp_path / "wal") + ".epoch.json"
+        assert cepoch.epoch_path_for_wal("nowhere", is_dir=True) == \
+            os.path.join("nowhere", "EPOCH.json")
+
+
+# ---------------------------------------------------------------------------
+# WAL epoch headers + replay fencing (storage/kv.py)
+# ---------------------------------------------------------------------------
+
+def _frame_epoch(e):
+    p = struct.pack(">I", 8) + struct.pack(">Q", e)
+    return _REC.pack(_OP_EPOCH, len(p)) + p
+
+
+def _frame_put(key, val):
+    parts = [b"t", key, b"f", b"q", val]
+    p = b"".join(struct.pack(">I", len(x)) + x for x in parts)
+    return _REC.pack(1, len(p)) + p
+
+
+class TestWalEpochFence:
+    def test_noncluster_wal_bytes_unchanged(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        s = MemKVStore(wal_path=wal)
+        s.put("t", b"k", b"f", b"q", b"v")
+        s.close()
+        with open(wal, "rb") as f:
+            op = f.read(1)
+        assert op[0] != _OP_EPOCH  # no header for non-cluster stores
+
+    def test_cluster_wal_starts_with_epoch_header(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        s = MemKVStore(wal_path=wal, writer_epoch=3)
+        s.put("t", b"k", b"f", b"q", b"v")
+        s.close()
+        with open(wal, "rb") as f:
+            hdr = f.read(_REC.size)
+            op, plen = _REC.unpack(hdr)
+            payload = f.read(plen)
+        assert op == _OP_EPOCH
+        assert struct.unpack(">Q", payload[4:])[0] == 3
+
+    def test_same_epoch_reopen_does_not_restamp(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        s = MemKVStore(wal_path=wal, writer_epoch=2)
+        s.put("t", b"k", b"f", b"q", b"v")
+        s.close()
+        size1 = os.path.getsize(wal)
+        s = MemKVStore(wal_path=wal, writer_epoch=2)
+        s.close()
+        assert os.path.getsize(wal) == size1
+
+    def test_stale_epoch_open_refused(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        MemKVStore(wal_path=wal, writer_epoch=5).close()
+        with pytest.raises(FencedWriterError):
+            MemKVStore(wal_path=wal, writer_epoch=4)
+
+    def test_zombie_segment_refused_on_replay(self, tmp_path):
+        """The split-brain artifact: a stale-epoch segment appended
+        after a newer writer's records must be cut at the fence line,
+        not applied."""
+        wal = str(tmp_path / "wal")
+        s = MemKVStore(wal_path=wal, writer_epoch=1)
+        s.put("t", b"k1", b"f", b"q", b"v1")
+        s.close()
+        with open(wal, "ab") as f:
+            f.write(_frame_epoch(2) + _frame_put(b"k2", b"new"))
+            f.write(_frame_epoch(1) + _frame_put(b"k9", b"ZOMBIE"))
+        s2 = MemKVStore(wal_path=wal, writer_epoch=2)
+        try:
+            assert s2.get("t", b"k1") and s2.get("t", b"k2")
+            assert not s2.get("t", b"k9")
+            assert s2.fenced_bytes_refused > 0
+        finally:
+            s2.close()
+        # The writer truncated the zombie suffix: a plain reopen no
+        # longer even sees it.
+        s3 = MemKVStore(wal_path=wal, writer_epoch=2)
+        try:
+            assert s3.fenced_bytes_refused == 0
+            assert not s3.get("t", b"k9")
+        finally:
+            s3.close()
+
+
+# ---------------------------------------------------------------------------
+# Promotion / demotion at the store level
+# ---------------------------------------------------------------------------
+
+class TestStorePromotion:
+    def _boot(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        ep = cepoch.epoch_path_for_wal(wal)
+        cepoch.write_epoch(ep, 1, "w0")
+        w = MemKVStore(wal_path=wal, writer_epoch=1,
+                       epoch_guard=guard(ep, 1))
+        w.put("t", b"k1", b"f", b"q", b"v1")
+        w.flush()
+        r = MemKVStore(wal_path=wal, read_only=True)
+        return wal, ep, w, r
+
+    def test_promote_fences_zombie_and_keeps_data(self, tmp_path):
+        wal, ep, w, r = self._boot(tmp_path)
+        new = cepoch.bump_epoch(ep, "r0", expect=1)
+        r.promote_writable(new, epoch_guard=guard(ep, new))
+        assert not r.read_only
+        # The zombie (still holding its flock!) is fenced on its next
+        # mutation...
+        with pytest.raises(FencedWriterError):
+            w.put("t", b"k2", b"f", b"q", b"v2")
+        # ...and the promoted store serves old + accepts new.
+        assert r.get("t", b"k1")
+        r.put("t", b"k3", b"f", b"q", b"v3")
+        r.close()
+        w.close()
+        # Recovery: everything acked by a LEGITIMATE writer survives;
+        # nothing from the zombie exists.
+        chk = MemKVStore(wal_path=wal, writer_epoch=new)
+        try:
+            assert chk.get("t", b"k1") and chk.get("t", b"k3")
+            assert not chk.get("t", b"k2")
+        finally:
+            chk.close()
+
+    def test_unfenced_zombie_appends_are_orphaned(self, tmp_path,
+                                                  monkeypatch):
+        """Even with the in-process fence sabotaged (the --bug
+        split-brain gate), the fresh-inode rotation strands the
+        zombie's appends on an unlinked inode — they can never reach
+        a file replay reads."""
+        wal, ep, w, r = self._boot(tmp_path)
+        monkeypatch.setenv("TSDB_CLUSTER_BUG", "split-brain")
+        new = cepoch.bump_epoch(ep, "r0", expect=1)
+        r.promote_writable(new, epoch_guard=guard(ep, new))
+        w.put("t", b"zz", b"f", b"q", b"unfenced")  # acked by zombie!
+        w.flush()
+        r.put("t", b"k3", b"f", b"q", b"v3")
+        r.close()
+        w.close()
+        monkeypatch.delenv("TSDB_CLUSTER_BUG")
+        chk = MemKVStore(wal_path=wal, writer_epoch=new)
+        try:
+            assert chk.get("t", b"k1") and chk.get("t", b"k3")
+            assert not chk.get("t", b"zz")
+        finally:
+            chk.close()
+
+    def test_demote_back_to_tailing(self, tmp_path):
+        wal, ep, w, r = self._boot(tmp_path)
+        new = cepoch.bump_epoch(ep, "r0", expect=1)
+        r.promote_writable(new, epoch_guard=guard(ep, new))
+        w.demote_readonly()
+        assert w.read_only
+        with pytest.raises(ReadOnlyStoreError):
+            w.put("t", b"x", b"f", b"q", b"v")
+        # The demoted ex-writer tails the new writer's appends.
+        r.put("t", b"k3", b"f", b"q", b"v3")
+        r.flush()
+        w.refresh()
+        assert w.get("t", b"k3")
+        w.close()
+        r.close()
+
+    def test_promote_failure_leaves_coherent_replica(self, tmp_path):
+        from opentsdb_tpu.fault import faultpoints
+        wal, ep, w, r = self._boot(tmp_path)
+        new = cepoch.bump_epoch(ep, "r0", expect=1)
+        faultpoints.arm("cluster.promote.rotate", "raise")
+        try:
+            with pytest.raises(faultpoints.FaultInjected):
+                r.promote_writable(new, epoch_guard=guard(ep, new))
+        finally:
+            faultpoints.disarm("cluster.promote.rotate")
+        assert r.read_only
+        assert r.get("t", b"k1")
+        # Retry wins.
+        r.promote_writable(new, epoch_guard=guard(ep, new))
+        assert not r.read_only
+        r.close()
+        w.close()
+
+    def test_tsdb_promote_rolls_back_on_post_store_failure(
+            self, tmp_path, monkeypatch):
+        """Review fix: a failure AFTER the store committed its
+        takeover (torn sketch snapshot) must demote the store back —
+        a half-promoted daemon (writable store, role replica) would
+        answer a retried /promote with 'already writer' over broken
+        serving state."""
+        wal = str(tmp_path / "wal")
+        ep = cepoch.epoch_path_for_wal(wal)
+        cepoch.write_epoch(ep, 1)
+        w = MemKVStore(wal_path=wal, writer_epoch=1)
+        w.put("t", b"k1", b"f", b"q", b"v1")
+        w.flush()
+        w.close()
+        cfg = Config(wal_path=wal, backend="cpu",
+                     enable_sketches=True, device_window=False)
+        r = TSDB(MemKVStore(wal_path=wal, read_only=True), cfg,
+                 start_compaction_thread=False)
+        monkeypatch.setattr(
+            TSDB, "_init_sketches",
+            lambda self: (_ for _ in ()).throw(OSError("torn")))
+        new = cepoch.bump_epoch(ep, expect=1)
+        with pytest.raises(OSError):
+            r.promote(new, epoch_guard=guard(ep, new))
+        assert r.store.read_only  # a genuine replica again
+        r.store.refresh()         # ...that still refreshes
+        r.shutdown()
+
+    def test_sharded_promote(self, tmp_path):
+        d = str(tmp_path / "store")
+        ep = os.path.join(d, "EPOCH.json")
+        w = ShardedKVStore(d, shards=2, writer_epoch=1)
+        cepoch.write_epoch(ep, 1, "w0")
+        for i in range(8):
+            w.put("tsdb", f"k{i}".encode() * 4, b"f", b"q", b"v")
+        w.flush()
+        r = ShardedKVStore(d, read_only=True)
+        new = cepoch.bump_epoch(ep, "r0", expect=1)
+        r.promote_writable(new, epoch_guard=guard(ep, new))
+        assert not r.read_only
+        assert all(not s.read_only for s in r.shards)
+        assert r.get("tsdb", b"k3" * 4)
+        r.put("tsdb", b"new-key-xx", b"f", b"q", b"v")
+        r.close()
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# Ownership map (CLUSTER.json)
+# ---------------------------------------------------------------------------
+
+class TestOwnershipMap:
+    def test_equal_split_and_owner(self):
+        m = OwnershipMap(["http://a:1", "http://b:2"], slots=8)
+        assert m.assign == [0, 0, 0, 0, 1, 1, 1, 1]
+        assert m.epoch == 1
+        name = b"sys.cpu.user"
+        assert m.owner(name) == m.assign[slot_of(name, 8)]
+        assert m.readers(name) == [m.owner(name)]
+
+    def test_slot_hash_is_crc32_chain(self):
+        import zlib
+        assert slot_of(b"metric.x", 64) == zlib.crc32(b"metric.x") % 64
+
+    def test_transfer_bumps_epoch_and_keeps_history(self):
+        m = OwnershipMap(["http://a:1", "http://b:2"], slots=4)
+        m.transfer(0, 1)
+        assert m.epoch == 2
+        assert m.assign[0] == 1
+        # Reads fan to the NEW owner first, then the old one.
+        name = next(bytes([65 + i]) for i in range(200)
+                    if slot_of(bytes([65 + i]), 4) == 0)
+        assert m.readers(name) == [1, 0]
+
+    def test_save_load_roundtrip(self, tmp_path):
+        p = str(tmp_path / "CLUSTER.json")
+        m = OwnershipMap(["http://a:1", "http://b:2"], slots=16)
+        m.transfer(3, 1)
+        m.save(p)
+        m2 = OwnershipMap.load(p)
+        assert m2.snapshot() == m.snapshot()
+
+    def test_bad_args(self):
+        with pytest.raises(ValueError):
+            OwnershipMap([])
+        with pytest.raises(ValueError):
+            OwnershipMap(["http://a:1"], slots=0)
+        m = OwnershipMap(["http://a:1", "http://b:2"], slots=4)
+        with pytest.raises(ValueError):
+            m.transfer(9, 0)
+        with pytest.raises(ValueError):
+            m.transfer(0, 5)
+
+
+# ---------------------------------------------------------------------------
+# Router: merge, result cache, topology (unit level)
+# ---------------------------------------------------------------------------
+
+class TestMergeResults:
+    def test_disjoint_union(self):
+        from opentsdb_tpu.serve.router import RouterServer
+        a = [{"metric": "m", "tags": {"h": "a"},
+              "dps": {"10": 1.0, "20": 2.0}}]
+        b = [{"metric": "m", "tags": {"h": "a"}, "dps": {"30": 3.0}}]
+        out = RouterServer._merge_results("sum", [a, b])
+        assert len(out) == 1
+        assert out[0]["dps"] == {"10": 1.0, "20": 2.0, "30": 3.0}
+
+    def test_collision_current_owner_wins(self):
+        """Review fix: ownership is per-METRIC, so a timestamp on
+        both sides of a handoff is the SAME logical cell — the old
+        owner's superseded copy vs a rewrite that landed on the
+        current owner. Single-store re-put semantics is last-write-
+        wins; summing the stale copy into the rewrite would fabricate
+        a value no single-store deployment could return."""
+        from opentsdb_tpu.serve.router import RouterServer
+        for agg in ("sum", "max", "min", "avg", "count"):
+            a = [{"metric": "m", "tags": {}, "dps": {"10": 5.0}}]
+            b = [{"metric": "m", "tags": {}, "dps": {"10": 9.0}}]
+            out = RouterServer._merge_results(f"{agg}:m", [a, b])
+            assert out[0]["dps"]["10"] == 5.0, agg
+
+    def test_distinct_series_stay_distinct(self):
+        from opentsdb_tpu.serve.router import RouterServer
+        a = [{"metric": "m", "tags": {"h": "a"}, "dps": {"10": 1.0}}]
+        b = [{"metric": "m", "tags": {"h": "b"}, "dps": {"10": 2.0}}]
+        assert len(RouterServer._merge_results("sum", [a, b])) == 2
+
+    def test_m_metric_extraction(self):
+        from opentsdb_tpu.serve.router import RouterServer
+        assert RouterServer._m_metric("sum:cpu.user") == "cpu.user"
+        assert RouterServer._m_metric(
+            "sum:1h-avg:rate:cpu{h=a}") == "cpu"
+
+    def test_downsampled_collision_keeps_current_owner(self):
+        """Review fix: a downsampled sub-query's values are per-bucket
+        AGGREGATES — two partial-bucket averages (or sums of averages)
+        must never be combined arithmetically. The handoff-boundary
+        bucket keeps the current owner's value."""
+        from opentsdb_tpu.serve.router import RouterServer
+        a = [{"metric": "m", "tags": {}, "dps": {"0": 4.0,
+                                                 "3600": 6.0}}]
+        b = [{"metric": "m", "tags": {}, "dps": {"0": 8.0}}]
+        out = RouterServer._merge_results("sum:1h-avg:m", [a, b])
+        assert out[0]["dps"] == {"0": 4.0, "3600": 6.0}
+
+
+# ---------------------------------------------------------------------------
+# Server-level: /promote, /demote, trace sampling (in-process daemons)
+# ---------------------------------------------------------------------------
+
+async def _http(port, target):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n"
+                 "Connection: close\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    return int(head.split(b" ", 2)[1]), body
+
+
+def _server(tsdb):
+    from opentsdb_tpu.server.tsd import TSDServer
+    return TSDServer(tsdb)
+
+
+def _writer_tsdb(wal, ep, epoch=1):
+    cfg = Config(wal_path=wal, backend="cpu",
+                 auto_create_metrics=True, enable_sketches=False,
+                 device_window=False, port=0, bind="127.0.0.1",
+                 cluster=True)
+    t = TSDB(MemKVStore(wal_path=wal, writer_epoch=epoch,
+                        epoch_guard=guard(ep, epoch)),
+             cfg, start_compaction_thread=False)
+    t.cluster_epoch_path = ep
+    return t
+
+
+def _replica_tsdb(wal, ep):
+    from opentsdb_tpu.serve.tailer import WalTailer
+    cfg = Config(wal_path=wal, backend="cpu", enable_sketches=False,
+                 device_window=False, port=0, bind="127.0.0.1",
+                 role="replica", max_staleness_ms=60_000.0,
+                 cluster=True, epoch_check_interval_s=0.0)
+    t = TSDB(MemKVStore(wal_path=wal, read_only=True), cfg,
+             start_compaction_thread=False)
+    t.cluster_epoch_path = ep
+    server = _server(t)
+    tailer = WalTailer(t, interval_s=3600.0)
+    server.attach_tailer(tailer)
+    return t, server
+
+
+class TestPromoteDemoteEndpoints:
+    def test_full_failover_handshake(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        ep = cepoch.epoch_path_for_wal(wal)
+        cepoch.write_epoch(ep, 1, "w0")
+        w = _writer_tsdb(wal, ep)
+        for i in range(50):
+            w.add_point("m.c", BT + i * 60, i % 7, {"host": "a"})
+        w.store.flush()
+        r, rserver = _replica_tsdb(wal, ep)
+        wserver = _server(w)
+
+        async def drive():
+            await wserver.start()
+            await rserver.start()
+            try:
+                # Promote the replica over HTTP.
+                status, body = await _http(rserver.port, "/promote")
+                assert status == 200, body
+                rec = json.loads(body)
+                assert rec == {"role": "writer", "epoch": 2}
+                assert not r.store.read_only
+                assert rserver.tailer is None
+                # Idempotent re-ask: no second bump — through the
+                # event-loop check AND through the locked executor
+                # path (a racing retry must not fence the writer the
+                # first promotion just made).
+                status, body = await _http(rserver.port, "/promote")
+                assert json.loads(body)["epoch"] == 2
+                assert json.loads(body)["already_writer"] is True
+                assert rserver._do_promote(ep, None) == 2
+                assert cepoch.read_epoch(ep)[0] == 2
+                # The promoted daemon's healthz flips to writer shape.
+                status, body = await _http(rserver.port, "/healthz")
+                h = json.loads(body)
+                assert h["role"] == "writer"
+                assert h["writer_epoch"] == 2
+                # The deposed writer is fenced on its next ingest...
+                with pytest.raises(FencedWriterError):
+                    w.add_point("m.c", BT + 9999 * 60, 1,
+                                {"host": "a"})
+                # ...reports it at /healthz...
+                status, body = await _http(wserver.port, "/healthz")
+                h = json.loads(body)
+                assert h.get("fenced") is True
+                assert h["fenced_by_epoch"] == 2
+                # ...and /demote turns it into a tailing replica.
+                status, body = await _http(wserver.port, "/demote")
+                assert status == 200, body
+                assert w.store.read_only
+                assert wserver.tailer is not None
+                # New writer appends; the demoted one tails them
+                # (a fresh hour row, so presence == the tailed append).
+                r.add_point("m.c", BT + 7200, 3, {"host": "a"})
+                r.store.flush()
+                wserver.tailer.run_once()
+                assert w.store.get(w.table,
+                                   r.row_key_for("m.c", {"host": "a"},
+                                                 BT + 7200))
+            finally:
+                for s in (wserver, rserver):
+                    if s.tailer is not None:
+                        s.tailer.stop()
+                    s._pool.shutdown(wait=False)
+                    if s._server is not None:
+                        s._server.close()
+                        await s._server.wait_closed()
+
+        try:
+            asyncio.run(drive())
+        finally:
+            r.shutdown()
+            w.shutdown()
+
+    def test_promote_without_cluster_is_400(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        cfg = Config(wal_path=wal, backend="cpu",
+                     enable_sketches=False, device_window=False,
+                     port=0, bind="127.0.0.1")
+        t = TSDB(MemKVStore(wal_path=wal),
+                 cfg, start_compaction_thread=False)
+        server = _server(t)
+
+        async def drive():
+            await server.start()
+            try:
+                status, body = await _http(server.port, "/promote")
+                assert status == 400
+                assert b"cluster" in body
+            finally:
+                server._pool.shutdown(wait=False)
+                server._server.close()
+                await server._server.wait_closed()
+
+        try:
+            asyncio.run(drive())
+        finally:
+            t.shutdown()
+
+
+class TestTraceSampling:
+    def test_one_in_n_feeds_the_ring(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        cfg = Config(wal_path=wal, backend="cpu",
+                     auto_create_metrics=True, enable_sketches=False,
+                     device_window=False, port=0, bind="127.0.0.1",
+                     trace_sample_n=2)
+        t = TSDB(MemKVStore(wal_path=wal), cfg,
+                 start_compaction_thread=False)
+        for i in range(20):
+            t.add_point("m.s", BT + i * 60, i % 5, {"host": "a"})
+        server = _server(t)
+
+        async def drive():
+            await server.start()
+            try:
+                q = (f"/q?start={BT - 60}&end={BT + 3600}&m=sum:m.s"
+                     f"&json&nocache")
+                for _ in range(4):
+                    status, _ = await _http(server.port, q)
+                    assert status == 200
+            finally:
+                server._pool.shutdown(wait=False)
+                server._server.close()
+                await server._server.wait_closed()
+
+        try:
+            asyncio.run(drive())
+        finally:
+            t.shutdown()
+        recs = server.trace_ring.snapshot()
+        sampled = [r for r in recs if r.get("sampled")]
+        # 1-in-2 of four queries: exactly two ambient samples, each
+        # carrying a full span tree.
+        assert len(sampled) == 2
+        assert all(r["trace"]["spans"] for r in sampled)
+
+
+# ---------------------------------------------------------------------------
+# tsdb check --skew (epoch-skew alerting)
+# ---------------------------------------------------------------------------
+
+class TestCheckSkew:
+    def test_skew_lines(self):
+        from opentsdb_tpu.tools.ops import skew_lines
+        lines = ["tsd.cluster.epoch 100 2 host=a",
+                 "tsd.cluster.epoch 100 3 host=b",
+                 "tsd.cluster.epoch 160 3 host=a",
+                 "tsd.cluster.epoch 160 3 host=b"]
+        out = skew_lines(lines, "skew(tsd.cluster.epoch)")
+        assert out[0].split()[1:] == ["100", "1.0"]
+        assert out[1].split()[1:] == ["160", "0.0"]
+
+    def test_single_observation_is_zero_spread(self):
+        from opentsdb_tpu.tools.ops import skew_lines
+        out = skew_lines(["m 5 42 host=a"], "skew(m)")
+        assert out == ["skew(m) 5 0.0"]
+
+    def test_check_cmd_alerts_on_skew(self, tmp_path, capsys):
+        """End-to-end through evaluate_check: agreeing daemons OK,
+        diverging daemons CRITICAL."""
+        import argparse as ap
+        import time as _time
+
+        from opentsdb_tpu.tools import ops
+        now = int(_time.time())
+        args = ap.Namespace(
+            metric="tsd.cluster.epoch", tag=["host=*"], duration=600,
+            comparator="gt", warning=None, critical=0.0,
+            ignore_recent=0, no_result_ok=False)
+        good = ops.skew_lines(
+            [f"tsd.cluster.epoch {now - 30} 2 host=a",
+             f"tsd.cluster.epoch {now - 30} 2 host=b"], "skew")
+        rv, msg = ops.evaluate_check(args, good, now)
+        assert rv == ops.OK
+        bad = ops.skew_lines(
+            [f"tsd.cluster.epoch {now - 30} 1 host=a",
+             f"tsd.cluster.epoch {now - 30} 2 host=b"], "skew")
+        rv, msg = ops.evaluate_check(args, bad, now)
+        assert rv == ops.CRITICAL
+
+
+# ---------------------------------------------------------------------------
+# Router: multi-writer fan-out, handoff, result cache, /api/topology
+# ---------------------------------------------------------------------------
+
+class _Cluster:
+    """Two in-process writer TSDServers + a RouterServer fanning by
+    the ownership map (the multi-writer read/ingest topology)."""
+
+    def __init__(self, tmp_path, **router_cfg):
+        self.writers = []
+        self.servers = []
+        for i in range(2):
+            wal = str(tmp_path / f"store-w{i}" / "wal")
+            cfg = Config(wal_path=wal, backend="cpu",
+                         auto_create_metrics=True,
+                         enable_sketches=False, device_window=False,
+                         port=0, bind="127.0.0.1")
+            t = TSDB(MemKVStore(wal_path=wal), cfg,
+                     start_compaction_thread=False)
+            self.writers.append(t)
+            self.servers.append(_server(t))
+        self.map_path = str(tmp_path / "CLUSTER.json")
+        self.router_cfg = router_cfg
+        self.router = None
+
+    def owner(self, metric: str) -> int:
+        return OwnershipMap.load(self.map_path).owner(metric.encode())
+
+    async def start(self):
+        from opentsdb_tpu.serve.router import RouterServer
+        for s in self.servers:
+            await s.start()
+        cfg = Config(
+            port=0, bind="127.0.0.1", role="router",
+            router_writers=tuple(
+                f"http://127.0.0.1:{s.port}" for s in self.servers),
+            cluster_map=self.map_path,
+            probe_interval_s=3600.0, **self.router_cfg)
+        self.router = RouterServer(cfg)
+        await self.router.start()
+
+    async def stop(self):
+        if self.router is not None:
+            await self.router.stop()
+        for s in self.servers:
+            s._pool.shutdown(wait=False)
+            if s._server is not None:
+                s._server.close()
+                await s._server.wait_closed()
+
+    def shutdown(self):
+        for t in self.writers:
+            t.shutdown()
+
+
+def _cluster_metric(clu, owner_idx, salt=0):
+    m = OwnershipMap.load(clu.map_path)
+    found = 0
+    for i in range(2000):
+        name = f"clu.m{i}"
+        if m.owner(name.encode()) == owner_idx:
+            if found == salt:
+                return name
+            found += 1
+    raise AssertionError
+
+
+def _run_cluster(clu, coro_fn):
+    async def main():
+        await clu.start()
+        try:
+            return await coro_fn(clu)
+        finally:
+            await clu.stop()
+    try:
+        return asyncio.run(main())
+    finally:
+        clu.shutdown()
+
+
+class TestMultiWriterRouter:
+    def test_reads_route_by_ownership_and_merge(self, tmp_path):
+        clu = _Cluster(tmp_path)
+
+        async def drive(clu):
+            m0 = _cluster_metric(clu, 0)
+            m1 = _cluster_metric(clu, 1)
+            for mi, metric in ((0, m0), (1, m1)):
+                for i in range(30):
+                    clu.writers[mi].add_point(
+                        metric, BT + i * 60, i % 9 + mi, {"h": "a"})
+            q = (f"/q?start={BT - 60}&end={BT + 3600}&m=sum:{m0}"
+                 f"&m=sum:{m1}&json&nocache")
+            await asyncio.sleep(0.3)  # boot-time health probes land
+            base = [s.http_rpcs for s in clu.servers]
+            status, body = await _http(clu.router.port, q)
+            assert status == 200, body
+            res = {r["metric"]: r["dps"] for r in json.loads(body)}
+            assert len(res[m0]) == 30 and len(res[m1]) == 30
+            # Each sub-query landed ONLY on its owner (delta vs the
+            # boot-time health probes).
+            assert [s.http_rpcs - b for s, b in
+                    zip(clu.servers, base)] == [1, 1]
+            return True
+
+        assert _run_cluster(clu, drive)
+
+    def test_handoff_epoch_bump_and_merged_reads(self, tmp_path):
+        clu = _Cluster(tmp_path)
+
+        async def drive(clu):
+            m0 = _cluster_metric(clu, 0)
+            # History on writer 0 (the pre-handoff owner).
+            for i in range(20):
+                clu.writers[0].add_point(m0, BT + i * 60, 2, {"h": "a"})
+            slot = slot_of(m0.encode(), clu.router.ownership.slots)
+            epoch_before = clu.router.ownership.epoch
+            status, body = await _http(
+                clu.router.port,
+                f"/api/cluster/handoff?metric={m0}&to=1")
+            assert status == 200, body
+            rec = json.loads(body)
+            assert rec["slot"] == slot and rec["to"] == 1
+            assert rec["epoch"] == epoch_before + 1
+            # The commit is durable: the on-disk map carries the bump.
+            assert OwnershipMap.load(clu.map_path).epoch == \
+                epoch_before + 1
+            assert clu.owner(m0) == 1
+            # New points land on the NEW owner; reads span the split.
+            for i in range(20, 30):
+                clu.writers[1].add_point(m0, BT + i * 60, 2, {"h": "a"})
+            q = (f"/q?start={BT - 60}&end={BT + 3600}&m=sum:{m0}"
+                 f"&json&nocache")
+            status, body = await _http(clu.router.port, q)
+            assert status == 200, body
+            res = json.loads(body)
+            assert len(res) == 1
+            assert len(res[0]["dps"]) == 30  # both sides of the split
+            return True
+
+        assert _run_cluster(clu, drive)
+
+    def test_topology_endpoint(self, tmp_path):
+        clu = _Cluster(tmp_path)
+
+        async def drive(clu):
+            status, body = await _http(clu.router.port,
+                                       "/api/topology")
+            assert status == 200
+            top = json.loads(body)
+            assert len(top["writers"]) == 2
+            assert len(top["replicas"]) == 2
+            assert top["ownership"]["epoch"] >= 1
+            assert top["ownership"]["slots"] == 64
+            assert "hedges" in top["counters"]
+            assert "rcache_hit" in top["counters"]
+            for r in top["replicas"]:
+                assert {"url", "healthy", "ejected", "stale",
+                        "lag_ms", "hop_p95_ms"} <= set(r)
+            return True
+
+        assert _run_cluster(clu, drive)
+
+    def test_result_cache_hit_and_epoch_invalidation(self, tmp_path):
+        clu = _Cluster(tmp_path, router_rcache=32,
+                       router_rcache_ms=60_000.0)
+
+        async def drive(clu):
+            m0 = _cluster_metric(clu, 0)
+            for i in range(10):
+                clu.writers[0].add_point(m0, BT + i * 60, 1, {"h": "a"})
+            q = (f"/q?start={BT - 60}&end={BT + 3600}&m=sum:{m0}"
+                 f"&json")
+            status, body1 = await _http(clu.router.port, q)
+            assert status == 200
+            rpcs_after_miss = clu.servers[0].http_rpcs
+            status, body2 = await _http(clu.router.port, q)
+            assert status == 200 and body2 == body1
+            # The hit never touched the writer.
+            assert clu.servers[0].http_rpcs == rpcs_after_miss
+            assert len(clu.router.rcache) == 1
+            # nocache bypasses, as does an ownership-map epoch bump
+            # (handoff): the old entry is orphaned by its key.
+            await _http(clu.router.port,
+                        "/api/cluster/handoff?slot=0&to=1")
+            status, _ = await _http(clu.router.port, q)
+            assert status == 200
+            assert clu.servers[0].http_rpcs > rpcs_after_miss
+            return True
+
+        assert _run_cluster(clu, drive)
+
+
+class TestWriterBootBumpsEpoch:
+    """Review fix: a --cluster writer BOOT claims ownership with a
+    fresh epoch bump, never by adopting the persisted epoch — a
+    restarted deposed writer adopting epoch N while the promoted
+    replica (also at N) still serves would put two unfenced writers
+    at the same epoch, invisible to every fence."""
+
+    def test_each_writer_boot_is_a_new_epoch(self, tmp_path):
+        import argparse
+
+        from opentsdb_tpu.tools import cli
+        args = argparse.Namespace(
+            table="tsdb", uidtable="tsdb-uid",
+            wal=str(tmp_path / "wal"), backend="cpu",
+            auto_metric=True, cluster=True, cluster_owner="t",
+            shards=0, read_only=False)
+        t1 = cli.make_tsdb(args)
+        try:
+            assert t1.store.writer_epoch == 1
+        finally:
+            t1.shutdown()
+        t2 = cli.make_tsdb(args)
+        try:
+            assert t2.store.writer_epoch == 2
+            p = cepoch.epoch_path_for_wal(str(tmp_path / "wal"))
+            assert cepoch.read_epoch(p)[0] == 2
+        finally:
+            t2.shutdown()

@@ -29,18 +29,21 @@ def run_matrix(tmp_path, *args, timeout=420):
 
 
 class TestFastSubset:
-    """The tier-1 leg: one live deployment, replica killed mid-query
-    + router partitioned from one replica, answers golden vs the
-    writer, ejection + readmission observed."""
+    """The tier-1 leg: the legacy deployment (replica killed
+    mid-query + router partition) PLUS the cluster failover pair —
+    writer SIGKILL → promotion → acked-point durability, and the
+    zombie-fence oracle (wedged writer deposed, its post-demotion
+    appends rejected)."""
 
     def test_fast_scenarios_pass(self, tmp_path):
-        r, art = run_matrix(tmp_path, "--fast")
+        r, art = run_matrix(tmp_path, "--fast", timeout=600)
         assert art is not None, r.stderr[-2000:]
         assert r.returncode == 0, (
             [x["problems"] for x in art["results"]], r.stderr[-2000:])
-        assert art["passed"] == art["scenarios"] == 2
+        assert art["passed"] == art["scenarios"] == 4
         labels = {x["label"] for x in art["results"]}
-        assert labels == {"replica-kill", "router-partition"}
+        assert labels == {"replica-kill", "router-partition",
+                          "writer-promote", "zombie-fence"}
 
 
 class TestStalenessGate:
@@ -62,15 +65,36 @@ class TestStalenessGate:
         assert "--bug stale-serve" in res["repro"]
 
 
+class TestSplitBrainGate:
+    """The cluster gate: --bug split-brain sabotages the writer's
+    epoch fence AND its demote compliance (TSDB_CLUSTER_BUG). The
+    zombie-fence scenario must CATCH the deposed writer acking a
+    write the cluster cannot serve — proof the matrix detects an
+    unfenced zombie, not just that the happy path passes."""
+
+    def test_bug_is_caught(self, tmp_path):
+        r, art = run_matrix(tmp_path, "--only", "zombie-fence",
+                            "--bug", "split-brain", timeout=600)
+        assert art is not None, r.stderr[-2000:]
+        assert r.returncode != 0, \
+            "unfenced zombie writer passed the matrix — the gate " \
+            "is dead"
+        res = art["results"][0]
+        assert res["status"] == "invariant-failed"
+        assert any("SPLIT BRAIN" in p for p in res["problems"]), \
+            res["problems"]
+        assert "--bug split-brain" in res["repro"]
+
+
 @pytest.mark.slow
 class TestFullSweep:
     def test_all_scenarios_and_determinism(self, tmp_path):
-        r1, a1 = run_matrix(tmp_path / "r1", timeout=600)
+        r1, a1 = run_matrix(tmp_path / "r1", timeout=900)
         assert r1.returncode == 0, (
             a1 and [x["problems"] for x in a1["results"]],
             r1.stderr[-2000:])
-        assert a1["passed"] == a1["scenarios"] == 4
-        r2, a2 = run_matrix(tmp_path / "r2", timeout=600)
+        assert a1["passed"] == a1["scenarios"] == 7
+        r2, a2 = run_matrix(tmp_path / "r2", timeout=900)
         assert r2.returncode == 0
         f1 = {x["label"]: x["fingerprint"] for x in a1["results"]}
         f2 = {x["label"]: x["fingerprint"] for x in a2["results"]}

@@ -73,3 +73,78 @@ class TestLogsLevelParam:
         data = asyncio.run(main())
         assert b"400" in data.split(b"\r\n")[0]
         assert server.exceptions_caught == 0
+
+
+class TestPromotionRotationOrphansZombieFd:
+    """Regression (PR 11): the promotion rotation must COPY the WAL
+    into <wal>.old, never rename it there. A rename keeps the old
+    inode LINKED at a path recovery replays — found live: with the
+    in-process fence disabled, a zombie writer's post-promotion
+    appends rode its still-open fd into <wal>.old and were replayed
+    as legitimate records. After a copy-based rotation the zombie's
+    fd must point at an inode with zero links."""
+
+    def test_zombie_fd_unlinked_after_promote(self, tmp_path):
+        import os
+
+        from opentsdb_tpu.cluster import epoch as cepoch
+        from opentsdb_tpu.storage.kv import MemKVStore
+
+        wal = str(tmp_path / "wal")
+        ep = cepoch.epoch_path_for_wal(wal)
+        cepoch.write_epoch(ep, 1)
+        w = MemKVStore(wal_path=wal, writer_epoch=1)
+        w.put("t", b"k1", b"f", b"q", b"v1")
+        w.flush()
+        zombie_fd = w._wal.fileno()
+        r = MemKVStore(wal_path=wal, read_only=True)
+        new = cepoch.bump_epoch(ep, expect=1)
+        r.promote_writable(
+            new, epoch_guard=cepoch.EpochGuard(ep, new, 0.0))
+        # The zombie's WAL inode has no name anywhere in the store
+        # directory — any append it still makes can never reach a
+        # file replay reads. (Checked by path-inode scan, not
+        # st_nlink: overlayfs keeps a link count on open-but-deleted
+        # files.) In particular .old is a COPY, not a rename of it.
+        zombie_ino = os.fstat(zombie_fd).st_ino
+        linked = {f: os.stat(os.path.join(str(tmp_path), f)).st_ino
+                  for f in os.listdir(str(tmp_path))}
+        assert zombie_ino not in linked.values(), linked
+        r.close()
+        w.close()
+
+
+class TestPromotionDurabilityRegression:
+    """Regression (PR 11): every point acked by a legitimate writer
+    before a promotion must survive the takeover — including points
+    only in the WAL (never checkpointed) and points appended by the
+    PROMOTED writer before a crash-reopen."""
+
+    def test_acked_points_survive_promotion_and_reopen(self, tmp_path):
+        from opentsdb_tpu.cluster import epoch as cepoch
+        from opentsdb_tpu.storage.kv import MemKVStore
+
+        wal = str(tmp_path / "wal")
+        ep = cepoch.epoch_path_for_wal(wal)
+        cepoch.write_epoch(ep, 1)
+        w = MemKVStore(wal_path=wal, writer_epoch=1,
+                       epoch_guard=cepoch.EpochGuard(ep, 1, 0.0))
+        for i in range(200):
+            w.put("t", f"k{i:04d}".encode(), b"f", b"q", b"v")
+        w.flush()
+        r = MemKVStore(wal_path=wal, read_only=True)
+        new = cepoch.bump_epoch(ep, expect=1)
+        r.promote_writable(
+            new, epoch_guard=cepoch.EpochGuard(ep, new, 0.0))
+        for i in range(200, 250):
+            r.put("t", f"k{i:04d}".encode(), b"f", b"q", b"v")
+        r.flush()
+        r._simulate_crash()
+        w.close()
+        chk = MemKVStore(wal_path=wal, writer_epoch=new)
+        try:
+            missing = [i for i in range(250)
+                       if not chk.get("t", f"k{i:04d}".encode())]
+            assert not missing, f"acked keys lost: {missing[:5]}"
+        finally:
+            chk.close()
