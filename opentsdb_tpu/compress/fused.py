@@ -1,0 +1,220 @@
+"""Query-side source for the fused decode-aggregate path.
+
+``gather`` decides whether a [start, end] range of one metric can be
+served straight from TSST4 blocks — exact-or-decline, the devwindow
+contract: every generation holding range keys is v4 with disjoint key
+ranges (store.encoded_range), every covering block is a TSF32
+columnar block, and the caller has verified no memtable-resident data
+overlaps the range (executor chunk_state). On success it returns the
+concatenated per-point arrays compress/kernels.fused_block_stage
+consumes plus the block-discovered series directory (series keys ->
+sid) for tag filtering and group-by.
+
+Host cost discipline: everything per-BLOCK is prepped once and cached
+on the (immutable) SSTable object — nibble unpack, record/point maps,
+per-record base times and series keys. A repeat query pays only
+numpy concatenation + one device dispatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from opentsdb_tpu.compress import codecs
+from opentsdb_tpu.core.const import (MAX_TIMESPAN, TIMESTAMP_BYTES,
+                                     UID_WIDTH)
+
+_IDENT_LO = UID_WIDTH
+_IDENT_HI = UID_WIDTH + TIMESTAMP_BYTES
+
+
+class _BlockPrep:
+    """Host-side arrays of one TSF32 block, independent of any query."""
+
+    __slots__ = ("npts", "ts_nb", "ts_pay", "v_nb", "v_pay",
+                 "rec_of_pt", "first_pt", "base", "local_sid",
+                 "skeys", "metric", "P", "n", "dmin", "dmax")
+
+
+def _prep_block(sst, j: int, table: str) -> "_BlockPrep | None":
+    """Parse block ``j`` once; None when the block is not a TSF32
+    data block the kernel can consume (caller falls back to the
+    scan)."""
+    cache = sst.__dict__.setdefault("_fused_prep", {})
+    if j in cache:
+        return cache[j]
+    prep = None
+    try:
+        tag, raw_len, enc_len = sst.block_header(j)
+        if tag == codecs.TSF32:
+            b = codecs.parse_ts_block(tag, sst.block_enc(j))
+            ok = (b.table == table.encode()
+                  and b.n > 0
+                  and not (b.klen < _IDENT_HI).any()
+                  and int(b.ts_nb.max(initial=0)) <= 4
+                  and int(b.v_nb.max(initial=0)) <= 4)
+            if ok:
+                K = b.K
+                base = (K[:, _IDENT_LO].astype(np.int64) << 24) \
+                    | (K[:, _IDENT_LO + 1].astype(np.int64) << 16) \
+                    | (K[:, _IDENT_LO + 2].astype(np.int64) << 8) \
+                    | K[:, _IDENT_LO + 3]
+                skeys = []
+                for i in range(b.n):
+                    row = K[i]
+                    skeys.append(row[:_IDENT_LO].tobytes()
+                                 + row[_IDENT_HI:b.klen[i]].tobytes())
+                uniq: dict[bytes, int] = {}
+                local = np.empty(b.n, np.int32)
+                for i, sk in enumerate(skeys):
+                    sid = uniq.setdefault(sk, len(uniq))
+                    local[i] = sid
+                prep = _BlockPrep()
+                prep.n, prep.P = b.n, b.P
+                prep.npts = b.npts.astype(np.int64)
+                prep.ts_nb = b.ts_nb.astype(np.int32)
+                # COPIES, not views: parse_ts_block's streams view the
+                # sstable's mmap, and a cached view would pin the map
+                # open past close() (BufferError on shutdown).
+                prep.ts_pay = np.array(b.ts_pay, np.uint8, copy=True)
+                prep.v_nb = b.v_nb.astype(np.int32)
+                prep.v_pay = np.array(b.v_pay, np.uint8, copy=True)
+                prep.rec_of_pt = b.rec_of_pt.astype(np.int32)
+                prep.first_pt = b.first_pt.astype(np.int64)
+                prep.base = base
+                prep.metric = K[:, :_IDENT_LO].copy()
+                prep.local_sid = local
+                prep.skeys = list(uniq)
+                # Per-record qualifier-delta bounds: the overlay check
+                # for a row-hour split across generations by a mid-hour
+                # checkpoint (disjoint delta ranges => the overlay is
+                # a pure union the kernel computes naturally).
+                deltas = b.deltas()
+                prep.dmin = np.minimum.reduceat(deltas, b.first_pt)
+                prep.dmax = np.maximum.reduceat(deltas, b.first_pt)
+    except Exception:
+        prep = None
+    cache[j] = prep
+    return prep
+
+
+class FusedSource:
+    """Concatenated kernel inputs + the series directory for one
+    (metric, range) gather. ``spans`` is the encoded_range snapshot
+    the arrays were built FROM — the executor's stage cache keys on
+    (and pins) exactly these SSTable objects, so a checkpoint racing
+    the gather can never get a stale stage cached under the new
+    generation set."""
+
+    __slots__ = ("ts_nb", "ts_pay", "v_nb", "v_pay", "first_idx",
+                 "blk_first", "rel_base_pt", "sid_pt", "valid",
+                 "series_keys", "epoch", "npoints", "spans")
+
+
+def gather(store, table: str, metric_uid: bytes, b_lo: int,
+           b_hi: int) -> "FusedSource | None":
+    """Collect every block holding rows of ``metric_uid`` with base
+    time in [b_lo, b_hi] from the store's v4 generations. Exact or
+    None — any ineligible block, format, or overlay risk declines."""
+    start_key = metric_uid + b_lo.to_bytes(4, "big")
+    stop_key = metric_uid + min(b_hi + MAX_TIMESPAN,
+                                0xFFFFFFFF).to_bytes(4, "big")
+    spans = store.encoded_range(table, start_key, stop_key)
+    if spans is None:
+        return None
+    m = np.frombuffer(metric_uid, np.uint8)
+    seen: set[bytes] = set()
+    parts = []           # (prep, rec_mask)
+    total_pts = 0
+    for sst, lo, hi in spans:
+        keys, offs = sst._index[table]
+        blk_ids = np.unique(
+            np.searchsorted(sst._blk_raw,
+                            np.asarray(offs[lo:hi], np.int64),
+                            "right") - 1)
+        for j in blk_ids.tolist():
+            prep = _prep_block(sst, j, table)
+            if prep is None:
+                return None
+            in_range = ((prep.base >= b_lo) & (prep.base <= b_hi)
+                        & (prep.metric == m).all(axis=1))
+            if not in_range.any():
+                continue
+            for ls in np.unique(prep.local_sid[in_range]).tolist():
+                seen.add(prep.skeys[ls])
+            parts.append((prep, in_range))
+            total_pts += prep.P
+    if not parts:
+        src = FusedSource()
+        src.npoints = 0
+        src.series_keys = []
+        src.spans = spans
+        return src
+    # sid order = ascending series key: the scan path discovers series
+    # in global key order; matching it keeps the group stage's
+    # float32 row-sum order aligned with the scan's.
+    sdir = {sk: i for i, sk in enumerate(sorted(seen))}
+    luts = [np.fromiter((sdir.get(sk, 0) for sk in prep.skeys),
+                        np.int64, len(prep.skeys))
+            for prep, _ in parts]
+    # Duplicate rows ACROSS generations (a mid-hour checkpoint splits
+    # one row-hour over two spills): serveable only when the copies'
+    # qualifier-delta ranges are disjoint — then the union the kernel
+    # computes IS the overlay. Overlapping ranges could mean a
+    # rewrite (newest-wins overlay) => decline to the scan path.
+    rs = np.concatenate([lut[p.local_sid[m]]
+                         for (p, m), lut in zip(parts, luts)])
+    rb = np.concatenate([p.base[m] for p, m in parts])
+    rdn = np.concatenate([p.dmin[m] for p, m in parts])
+    rdx = np.concatenate([p.dmax[m] for p, m in parts])
+    rowkey = rs * np.int64(1 << 33) + rb
+    order = np.lexsort((rdn, rowkey))
+    rk = rowkey[order]
+    dup_adj = rk[1:] == rk[:-1]
+    if dup_adj.any():
+        if (rdx[order][:-1][dup_adj] >= rdn[order][1:][dup_adj]).any():
+            return None
+    epoch = min(int(p.base[mask].min()) for p, mask in parts)
+    if any(int(p.base[mask].max()) - epoch > 2**31 - MAX_TIMESPAN - 1
+           for p, mask in parts):
+        return None   # rel int32 would wrap; scan path handles it
+    ts_nb = []
+    v_nb = []
+    ts_pay = []
+    v_pay = []
+    first_idx = []
+    blk_first = []
+    rel_base_pt = []
+    sid_pt = []
+    valid = []
+    pt_off = 0
+    for (prep, rec_mask), lut in zip(parts, luts):
+        lut = lut.astype(np.int32)
+        ts_nb.append(prep.ts_nb)
+        v_nb.append(prep.v_nb)
+        ts_pay.append(prep.ts_pay)
+        v_pay.append(prep.v_pay)
+        first_idx.append(prep.first_pt[prep.rec_of_pt] + pt_off)
+        blk_first.append(np.full(prep.P, pt_off, np.int64))
+        rel_base_pt.append(
+            (prep.base - epoch)[prep.rec_of_pt].astype(np.int32))
+        sid_pt.append(lut[prep.local_sid][prep.rec_of_pt])
+        valid.append(rec_mask[prep.rec_of_pt])
+        pt_off += prep.P
+    src = FusedSource()
+    src.npoints = pt_off
+    src.ts_nb = np.concatenate(ts_nb)
+    src.v_nb = np.concatenate(v_nb)
+    src.ts_pay = np.concatenate(ts_pay) if ts_pay else \
+        np.empty(0, np.uint8)
+    src.v_pay = np.concatenate(v_pay) if v_pay else \
+        np.empty(0, np.uint8)
+    src.first_idx = np.concatenate(first_idx).astype(np.int32)
+    src.blk_first = np.concatenate(blk_first).astype(np.int32)
+    src.rel_base_pt = np.concatenate(rel_base_pt)
+    src.sid_pt = np.concatenate(sid_pt)
+    src.valid = np.concatenate(valid)
+    src.series_keys = list(sdir)
+    src.epoch = epoch
+    src.spans = spans
+    return src

@@ -1,0 +1,112 @@
+"""Batched JAX decode + fused decode-plus-aggregate over TSST4 blocks.
+
+The shape of the win (PAPERS.md "GPU Acceleration of SQL Analytics on
+Compressed Data", arxiv 2506.10092): keep the scan compressed and run
+the reduction ON the encoded form. ``fused_block_stage`` is one XLA
+program that takes the blocks' packed control/payload byte streams and
+produces the per-(series, bucket) downsample grids the query pipeline
+consumes (ops/kernels._window_series_stage — the SAME stage the
+device-resident window uses, so group aggregation, percentiles, rate
+and gap-fill semantics are shared, not re-implemented). The decoded
+timestamp/value columns exist only as intermediates inside the
+program: nothing N-sized is ever materialized to host memory.
+
+Decode steps, all vectorized:
+- variable-width payload gather: 4 static byte gathers assembled by
+  shift/or, masked by the per-point nibble byte count;
+- zigzag undo; two segmented cumsums rebuild qualifier deltas from
+  the delta-of-delta entries (global cumsum minus a gather at each
+  record's first entry — int32 wraparound keeps in-segment differences
+  exact even when the global running sum overflows);
+- XOR undo via an associative scan, re-based per block (the encoder
+  chains xors from 0 at each block start);
+- bitcast to float32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from opentsdb_tpu.ops.kernels import _window_series_stage
+
+
+def _varbytes_u32(pay: jnp.ndarray, nb: jnp.ndarray) -> jnp.ndarray:
+    """[P] uint32 values from a packed payload: ``nb`` significant
+    big-endian bytes per value, concatenated. nb == 0 -> 0."""
+    off = jnp.cumsum(nb) - nb   # exclusive prefix
+    out = jnp.zeros(nb.shape, jnp.uint32)
+    limit = pay.shape[0] - 1 if pay.shape[0] else 0
+    for j in range(4):
+        m = j < nb
+        idx = jnp.clip(off + j, 0, limit)
+        byte = pay[idx].astype(jnp.uint32)
+        shift = (jnp.where(m, nb - 1 - j, 0) * 8).astype(jnp.uint32)
+        out = out | jnp.where(m, byte << shift, jnp.uint32(0))
+    return out
+
+
+def _unzigzag32(z: jnp.ndarray) -> jnp.ndarray:
+    half = (z >> jnp.uint32(1)).astype(jnp.int32)
+    return half ^ -((z & jnp.uint32(1)).astype(jnp.int32))
+
+
+def _seg_cumsum(x: jnp.ndarray, first_idx: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive per-segment cumsum: c[i] - c[first-1]. int32
+    wraparound is deliberate (see module docstring)."""
+    c = jnp.cumsum(x)
+    cp = jnp.concatenate([jnp.zeros(1, x.dtype), c])
+    return c - cp[first_idx]
+
+
+def decode_points(ts_nb, ts_pay, v_nb, v_pay, first_idx, blk_first,
+                  rel_base):
+    """(rel_ts int32, values float32) for the concatenated point
+    stream — the batched decode kernel shared by the fused stage and
+    the standalone jitted decoder."""
+    ent = _unzigzag32(_varbytes_u32(ts_pay, ts_nb))
+    steps = _seg_cumsum(ent, first_idx)
+    deltas = _seg_cumsum(steps, first_idx)
+    rel_ts = rel_base + deltas
+    x = _varbytes_u32(v_pay, v_nb)
+    X = jax.lax.associative_scan(jnp.bitwise_xor, x)
+    Xp = jnp.concatenate([jnp.zeros(1, jnp.uint32), X])
+    bits = X ^ Xp[blk_first]
+    vals = jax.lax.bitcast_convert_type(bits, jnp.float32)
+    return rel_ts, vals
+
+
+decode_points_jit = jax.jit(decode_points)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_series", "num_buckets", "interval",
+                     "agg_down", "rate", "counter", "drop_resets"))
+def fused_block_stage(ts_nb, ts_pay, v_nb, v_pay, first_idx, blk_first,
+                      rel_base, sid, valid, lo, hi, shift, *,
+                      num_series, num_buckets, interval, agg_down,
+                      rate=False, counter_max=0.0, reset_value=0.0,
+                      counter=False, drop_resets=False):
+    """Decode + range-mask + per-series downsample in ONE program.
+
+    Inputs are per-point arrays (padded to a static size; padding has
+    valid=False and nb=0): nibble byte counts + payload byte streams
+    for timestamps and values, each point's record-first index and
+    block-first index, the record's base time relative to the query
+    epoch, and the series id. Returns the window-stage contract
+    (series_values, series_mask, filled, in_range, presence) that
+    ops.kernels.window_moment_apply / window_quantile_apply consume —
+    so every group aggregator, percentile and rate the resident-window
+    path serves, this path serves identically.
+    """
+    rel_ts, vals = decode_points(ts_nb, ts_pay, v_nb, v_pay,
+                                 first_idx, blk_first, rel_base)
+    return _window_series_stage(
+        rel_ts, vals, sid, valid, lo, hi, shift,
+        num_series=num_series, num_buckets=num_buckets,
+        interval=interval, agg_down=agg_down, rate=rate,
+        counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)

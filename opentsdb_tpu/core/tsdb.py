@@ -51,6 +51,19 @@ class TSDB:
         self.tagv = UniqueId(store, uidtable, "tagv", 3)
         self.compactionq = CompactionQueue(
             self, start_thread=start_compaction_thread)
+        # Write-side sstable codec (compress/): pushed onto the store
+        # so checkpoint spills and compaction merges re-encode into
+        # the configured format. Only a non-default config value
+        # overrides a store the embedder configured directly; replicas
+        # never spill, so the read side stays format-sniffed per file.
+        codec = getattr(self.config, "sstable_codec", "none") or "none"
+        if codec != "none":
+            if codec != "tsst4":
+                raise ValueError(
+                    f"unknown sstable_codec {codec!r} "
+                    f"(one of: none, tsst4)")
+            if hasattr(store, "sstable_codec"):
+                store.sstable_codec = codec
         self._lock = threading.Lock()
         # Serializes checkpoint() end to end so the rollup tier's spill
         # bracketing (begin_spill ... fold_after_spill) pairs 1:1 with
@@ -898,6 +911,21 @@ class TSDB:
             for i, n in enumerate(rows_fn(self.table)):
                 collector.record("storage.memtable.rows", n,
                                  f"shard={i}")
+        fmt_fn = getattr(self.store, "sstable_format_bytes", None)
+        if fmt_fn is not None:
+            for fmt, nbytes in sorted(fmt_fn().items()):
+                collector.record("sstable.bytes", nbytes,
+                                 f"format=v{fmt}")
+        comp_fn = getattr(self.store, "compress_stats", None)
+        if comp_fn is not None:
+            raw, enc = comp_fn()
+            if enc:
+                # Uncompressed-record bytes per stored byte across the
+                # v4 generations — `tsdb check --stats-metric
+                # tsd.compress.ratio -x lt 1.5` alerts on a corpus
+                # that stopped compressing.
+                collector.record("compress.ratio",
+                                 round(raw / enc, 4))
         bloom_files = getattr(self.store, "bloom_files_skipped", None)
         if bloom_files is not None:
             collector.record("bloom.files_skipped", bloom_files)

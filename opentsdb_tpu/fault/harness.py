@@ -94,6 +94,11 @@ class Scenario:
     delete_heavy: bool = False
     bug: str | None = None
     kind: str = "crash"   # "crash" (child process) | "replica" (in-proc)
+    # Write-side sstable codec for the workload ("none" | "tsst4"):
+    # the sst.write.block scenarios need compressed spills to reach
+    # their faultpoint; verification reopens with the same codec so
+    # post-crash checkpoints re-exercise the compressed writers.
+    codec: str = "none"
 
 
 # ---------------------------------------------------------------------------
@@ -218,7 +223,8 @@ def open_store(dirpath: str, shards: int, read_only: bool = False):
                       read_only=read_only)
 
 
-def open_tsdb(dirpath: str, shards: int, rollups: bool) -> TSDB:
+def open_tsdb(dirpath: str, shards: int, rollups: bool,
+              codec: str = "none") -> TSDB:
     """Writer TSDB with the harness profile: cpu backend, sketches and
     device window off (the child must stay jax-free), compactions off
     and no background threads (schedule determinism), rollup catch-up
@@ -229,6 +235,7 @@ def open_tsdb(dirpath: str, shards: int, rollups: bool) -> TSDB:
         auto_create_metrics=True, enable_compactions=False,
         enable_sketches=False, device_window=False,
         enable_rollups=rollups, rollup_catchup="sync",
+        sstable_codec=codec,
         # Sub-day sketch columns so the 1h resolution carries digests
         # too (more fold surface for the crash sites to land in).
         rollup_sketch_min_res=3600)
@@ -304,7 +311,8 @@ def _child_main(args) -> int:
     ops = gen_ops(args.seed, args.n_ops, args.delete_heavy)
     if args.bug:
         _apply_bug(args.bug)
-    tsdb = open_tsdb(args.dir, args.shards, args.rollups)
+    tsdb = open_tsdb(args.dir, args.shards, args.rollups,
+                     codec=args.codec)
     with open(args.progress, "a") as pf:
         for i, op in enumerate(ops):
             apply_op(tsdb, op)
@@ -511,7 +519,8 @@ def verify(dirpath: str, sc: Scenario, ops: list[tuple],
     from opentsdb_tpu.tools.fsck import run_fsck
     problems: list[str] = []
     try:
-        tsdb = open_tsdb(dirpath, sc.shards, sc.rollups)
+        tsdb = open_tsdb(dirpath, sc.shards, sc.rollups,
+                         codec=sc.codec)
     except Exception as e:
         return [f"reopen failed: {e!r}"], ""
     try:
@@ -592,10 +601,13 @@ def _run_once(sc: Scenario, workdir: str) -> dict:
         cmd.append("--delete-heavy")
     if sc.bug:
         cmd += ["--bug", sc.bug]
+    if sc.codec != "none":
+        cmd += ["--codec", sc.codec]
     result = {
         "label": sc.label, "site": sc.site, "mode": sc.mode,
         "skip": sc.skip, "shards": sc.shards, "rollups": sc.rollups,
         "seed": sc.seed, "n_ops": sc.n_ops, "bug": sc.bug,
+        "codec": sc.codec,
         "problems": [], "ops_done": 0,
     }
     try:
@@ -644,6 +656,8 @@ def repro_command(sc: Scenario) -> str:
         out += " --delete-heavy"
     if sc.bug:
         out += f" --bug {sc.bug}"
+    if sc.codec != "none":
+        out += f" --codec {sc.codec}"
     return out
 
 
@@ -849,6 +863,7 @@ FAST_LABELS = (
     "ckpt-commit-crash-s1",
     "sst-body-torn-s1",
     "sst-footer-torn-s1",
+    "sst-block-torn-s1",
     "rollup-foldstart-crash-s1",
     "rollup-flip-crash-s1",
     "rollup-folddel-crash-s1",
@@ -891,6 +906,19 @@ def build_matrix() -> list[Scenario]:
         # bloom + trailer, the bytes a half-durable file would parse
         # garbage from): the fault-injection follow-on from PR 4.
         add(f"sst-footer-torn-{t}", "sst.write.footer", "torn", **c)
+        # Torn/crash INSIDE a TSST4 compressed block body
+        # (sst.write.block fires per flushed block): the spill dies
+        # mid-compression, leaving a .tmp whose last block is cut —
+        # recovery must treat the whole file as a stray and replay
+        # <wal>.old. Workload spills compressed (codec=tsst4); the
+        # verify reopen + post-crash checkpoints re-exercise the v4
+        # writers and fsck's block audits.
+        add(f"sst-block-crash-{t}", "sst.write.block", "crash",
+            codec="tsst4", **c)
+        add(f"sst-block-torn-{t}", "sst.write.block", "torn",
+            codec="tsst4", **c)
+        add(f"sst-block-torn-late-{t}", "sst.write.block", "torn",
+            skip=2, codec="tsst4", **c)
         add(f"sst-rename-crash-{t}", "sst.rename", "crash", **c)
         add(f"rollup-begin-crash-{t}", "rollup.begin_spill", "crash",
             **c)
@@ -915,6 +943,12 @@ def build_matrix() -> list[Scenario]:
         shards=1, rollups=False, seed=3001)
     add("ckpt-commit-crash-norollup", "kv.checkpoint.commit", "crash",
         shards=1, rollups=False, seed=3002)
+    # Compressed-block torn writes on rollup-less stores too (the
+    # ISSUE-12 shards x rollups sweep for sst.write.block).
+    add("sst-block-torn-norollup", "sst.write.block", "torn",
+        shards=1, rollups=False, codec="tsst4", seed=3003)
+    add("sst-block-torn-norollup-s4", "sst.write.block", "torn",
+        shards=4, rollups=False, codec="tsst4", seed=3004)
     # Replica refresh faults (in-process, no child crash).
     add("replica-refresh-ioerror", "replica.refresh", "ioerror",
         shards=1, kind="replica", seed=3101)
@@ -970,6 +1004,8 @@ def main(argv=None) -> int:
     p.add_argument("--delete-heavy", action="store_true")
     p.add_argument("--progress", required=True)
     p.add_argument("--bug", default=None, choices=BUGS)
+    p.add_argument("--codec", default="none",
+                   choices=("none", "tsst4"))
     args = p.parse_args(argv)
     return _child_main(args)
 

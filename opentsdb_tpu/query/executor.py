@@ -41,10 +41,15 @@ from opentsdb_tpu.core.const import MAX_TIMESPAN, TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.core.errors import BadRequestError
 from opentsdb_tpu.fault.faultpoints import fire as _fault
 from opentsdb_tpu.obs import trace as obs_trace
+from opentsdb_tpu.obs.registry import METRICS as _metrics
 from opentsdb_tpu.ops import kernels, oracle, sketches
 from opentsdb_tpu.query.aggregators import Aggregators
 from opentsdb_tpu.storage.sstable import series_hash
 from opentsdb_tpu.utils.lru import LRUCache
+
+# Fused decode-plus-aggregate serving off TSST4 blocks (compress/):
+# wall time of the gather + kernel dispatch per served query.
+_M_FUSED = _metrics.timer("compress.fused_agg")
 
 
 # One fragment cache PER STORE, shared by every QueryExecutor over it
@@ -152,6 +157,12 @@ class QueryExecutor:
         self._dw_mask_cache = LRUCache(128)
         self._dw_plan_cache = LRUCache(128)
         self._dw_stage_cache = LRUCache(4)
+        # Fused-block stage cache (compress/): device grids keyed by
+        # the generation set + range + downsample plan. Entries pin
+        # their source SSTable objects so id() reuse can't alias a
+        # dropped generation; eligibility (dirty range, format mix) is
+        # re-checked per query — only the decode+stage compute caches.
+        self._fused_stage_cache = LRUCache(4)
         self.qcache_hits = 0
         self.qcache_misses = 0
         self.qcache_bypasses = 0
@@ -494,6 +505,7 @@ class QueryExecutor:
         with obs_trace.span("planner.pick") as sp:
             dev = self._run_devwindow(spec, start, end, agg)
             planned = None
+            fusedr = None
             if dev is None:
                 planned = self._plan_rollup(spec, start, end,
                                             rollup_only=rollup_only)
@@ -503,12 +515,20 @@ class QueryExecutor:
                     "shedding load: this query needs a raw scan "
                     "(no eligible rollup resolution); retry shortly",
                     retry_after=0.5, status=503)
+            if dev is None and planned is None:
+                # Fused decode-plus-aggregate off TSST4 blocks
+                # (compress/): tried after the materialized tiers
+                # (resident window, rollups beat re-deriving from
+                # storage) and before the raw scan. Exact or None.
+                fusedr = self._run_fused_blocks(spec, start, end, agg)
             if sp is not None:
                 if dev is not None:
                     sp.tags["plan"] = "resident"
                 elif planned is not None:
                     from opentsdb_tpu.rollup.tier import res_label
                     sp.tags["plan"] = res_label(planned[2])
+                elif fusedr is not None:
+                    sp.tags["plan"] = "fused"
                 else:
                     sp.tags["plan"] = "raw"
         if dev is not None:
@@ -519,6 +539,8 @@ class QueryExecutor:
             with obs_trace.span("aggregate"):
                 results = self._execute_groups(spec2, groups, start, end)
             return results, res_label(res), False
+        if fusedr is not None:
+            return fusedr, "fused", False
         import time as _time
         t0 = _time.time()
         info: dict = {}
@@ -790,12 +812,26 @@ class QueryExecutor:
         hit = cache.get(fkey)
         if hit is not None and hit[0] == cols.generation:
             return hit[1], hit[2]
+        groups, named = self._series_groups(cols.series_keys, exact,
+                                            group_bys)
+        cache.put(fkey, (cols.generation, groups, named))
+        return groups, named
+
+    # -- fused decode-aggregate path (TSST4 blocks) --------------------
+
+    def _series_groups(self, series_keys, exact, group_bys):
+        """Filter + group a series-key directory on host UIDs — the
+        ONE implementation behind both the resident-window and fused
+        plans (they must answer identically, so their filter/group-by
+        semantics live in one place). sid = position in
+        ``series_keys``. Returns ({group_key_tuple: [sid]},
+        {sid: named_tags})."""
         group_by_keys = sorted(k for k, _ in group_bys)
         want = dict(exact)
         gb = {k: (set(v) if v else None) for k, v in group_bys}
         groups: dict[tuple, list[int]] = {}
         named: dict[int, dict[str, str]] = {}
-        for sid, skey in enumerate(cols.series_keys):
+        for sid, skey in enumerate(series_keys):
             tag_uids = codec.series_tag_uids(skey)
             ok = all(tag_uids.get(k) == v for k, v in want.items())
             if ok:
@@ -813,8 +849,186 @@ class QueryExecutor:
             named[sid] = {
                 self.tsdb.tagk.get_name(k): self.tsdb.tagv.get_name(v)
                 for k, v in tag_uids.items()}
-        cache.put(fkey, (cols.generation, groups, named))
         return groups, named
+
+    def _run_fused_blocks(self, spec: QuerySpec, start: int, end: int,
+                          agg) -> list[QueryResult] | None:
+        """Serve a downsampled query straight from TSST4 compressed
+        blocks: one fused decode-plus-aggregate XLA program produces
+        the per-(series, bucket) stage grids (the decoded columns are
+        never materialized on host), then the SAME apply kernels the
+        device-resident window uses finish grouping/percentiles.
+        Exact or None (the fall-back contract): any memtable-resident
+        data in range, non-v4 generation, non-TSF32 block, overlay
+        risk, or int32 overflow declines to the scan path."""
+        tsdb = self.tsdb
+        cfg = tsdb.config
+        if (self.backend == "cpu" or self.mesh is not None
+                or not spec.downsample
+                or agg.kind not in ("moment", "percentile")
+                or not getattr(cfg, "sstable_fused_agg", True)):
+            return None
+        store = tsdb.store
+        if getattr(store, "encoded_range", None) is None \
+                or getattr(store, "chunk_state", None) is None:
+            return None
+        interval, dsagg = spec.downsample
+        imax = 2**31 - 1
+        if start < 0 or end > 0xFFFFFFFF \
+                or end - start > imax - 4 * MAX_TIMESPAN:
+            return None
+        qbase = start - start % interval
+        if end - qbase > imax:
+            return None
+        from opentsdb_tpu.core.errors import NoSuchUniqueName
+        try:
+            metric_uid = tsdb.metrics.get_id(spec.metric)
+            exact, group_bys = self._tag_filters(spec.tags)
+        except NoSuchUniqueName:
+            return None  # scan path raises the canonical error
+        b_lo = codec.base_time(start)
+        b_hi = min(codec.base_time(end), 0xFFFFFFFF)
+        # Memtable-resident (dirty) data in range: decline — a frozen
+        # answer must equal the scan bit-for-bit, and overlaying live
+        # rows is the scan path's job.
+        seqs, floors, stamps, dirty = store.chunk_state(
+            tsdb.table, b_lo, b_hi + MAX_TIMESPAN)
+        if dirty:
+            return None
+        with _M_FUSED.time():
+            return self._run_fused_inner(
+                spec, start, end, agg, metric_uid, exact, group_bys,
+                interval, dsagg, qbase, b_lo, b_hi)
+
+    def _run_fused_inner(self, spec, start, end, agg, metric_uid,
+                         exact, group_bys, interval, dsagg, qbase,
+                         b_lo, b_hi):
+        from opentsdb_tpu.compress import fused as _fused
+        from opentsdb_tpu.compress import kernels as _ckernels
+        tsdb = self.tsdb
+        rate_kw = self._rate_kw(spec)
+        skey_cache = (metric_uid, b_lo, b_hi, interval, dsagg, start,
+                      end, tuple(sorted(rate_kw.items())))
+        hit = self._fused_stage_cache.get(skey_cache)
+        if hit is not None:
+            gens_hit, src_keys, epoch, stage = hit
+            # Validate against the CURRENT generation set: gens_hit
+            # holds the SSTable objects the cached stage was computed
+            # from (object identity — the entry pins them, so id
+            # recycling cannot alias a dropped generation). Any
+            # checkpoint/compaction swap mismatches and rebuilds.
+            spans = tsdb.store.encoded_range(
+                tsdb.table, metric_uid + b_lo.to_bytes(4, "big"),
+                metric_uid + min(b_hi + MAX_TIMESPAN,
+                                 0xFFFFFFFF).to_bytes(4, "big"))
+            if spans is None or \
+                    len(spans) != len(gens_hit) or \
+                    any(g is not h for (g, _, _), h
+                        in zip(spans, gens_hit)):
+                hit = None
+                self._fused_stage_cache.pop(skey_cache)
+        if hit is None:
+            src = _fused.gather(tsdb.store, tsdb.table, metric_uid,
+                                b_lo, b_hi)
+            if src is None:
+                return None
+            if src.npoints == 0:
+                return []
+            epoch = src.epoch
+            src_keys = src.series_keys
+        else:
+            src = None
+        S_all = len(src_keys)
+        S_pad = _pad_size(S_all)
+        imin, imax = -(2**31), 2**31 - 1
+        if not imin <= qbase - epoch <= imax:
+            return None
+        num_buckets = _pad_size(int((end - qbase) // interval + 1))
+        if S_pad * num_buckets >= 2**31:
+            return None
+        groups, named = self._series_groups(src_keys, exact, group_bys)
+        if not groups:
+            return []
+        lo32 = np.int32(min(max(start - epoch, imin), imax))
+        hi32 = np.int32(min(max(end - epoch, imin), imax))
+        shift32 = np.int32(qbase - epoch)
+        if hit is None:
+            P_pad = _pad_size(src.npoints)
+            def pad(a, dtype, fill=0):
+                out = np.full(P_pad, fill, dtype)
+                out[:len(a)] = a
+                return out
+            def padbuf(a):
+                out = np.zeros(_pad_size(max(len(a), 1)), np.uint8)
+                out[:len(a)] = a
+                return out
+            stage = list(_ckernels.fused_block_stage(
+                pad(src.ts_nb, np.int32), padbuf(src.ts_pay),
+                pad(src.v_nb, np.int32), padbuf(src.v_pay),
+                pad(src.first_idx, np.int32),
+                pad(src.blk_first, np.int32),
+                pad(src.rel_base_pt, np.int32),
+                pad(np.minimum(src.sid_pt, S_pad - 1), np.int32),
+                pad(src.valid, bool, False),
+                lo32, hi32, shift32,
+                num_series=S_pad, num_buckets=num_buckets,
+                interval=interval, agg_down=dsagg, **rate_kw)) + [None]
+            # Key the entry on the SNAPSHOT the stage was actually
+            # computed from (src.spans — not a fresh encoded_range,
+            # which a checkpoint racing this query could have moved
+            # past the gathered data). The held objects both pin
+            # against id reuse and make hit-validation pure identity.
+            self._fused_stage_cache.put(
+                skey_cache,
+                (tuple(g for g, _, _ in src.spans),
+                 src_keys, epoch, stage))
+        sv, sm, filled, in_range, presence_dev = stage[:5]
+        gkeys = sorted(groups)
+        G = _pad_size(len(gkeys))
+        ngroups = 1 if len(gkeys) == 1 else G
+        include = np.zeros(S_pad, bool)
+        gmap = np.full(S_pad, G - 1, np.int32)
+        for gi, gkey in enumerate(gkeys):
+            for sid in groups[gkey]:
+                include[sid] = True
+                gmap[sid] = gi
+        b_live = int((end - qbase) // interval + 1)
+        g_out = min(ngroups, _pad64(len(gkeys)))
+        b_out = min(num_buckets, _pad64(b_live))
+        shrink = dict(g_out=g_out, b_out=b_out,
+                      wire_bf16=bool(getattr(tsdb.config, "wire_bf16",
+                                             False)))
+        if agg.kind == "percentile":
+            gv, gm = kernels.window_quantile_apply(
+                sm, filled, in_range, include, gmap,
+                np.array([agg.quantile], np.float32),
+                num_groups=ngroups, **shrink)
+        else:
+            gv, gm = kernels.window_moment_apply(
+                sv, sm, filled, in_range, include, gmap,
+                num_groups=ngroups, agg_group=spec.aggregator,
+                **shrink)
+        if stage[5] is None:
+            gv, gm, stage[5] = jax.device_get((gv, gm, presence_dev))
+        else:
+            gv, gm = jax.device_get((gv, gm))
+        has_points = stage[5]
+        gm = np.unpackbits(gm, axis=1, count=b_out).astype(bool)
+        results = []
+        for gi, gkey in enumerate(gkeys):
+            live = [sid for sid in groups[gkey] if has_points[sid]]
+            if not live:
+                continue
+            spans_ = [_Span(src_keys[sid], named[sid], None, None)
+                      for sid in live]
+            tags, aggregated = self._group_tags(spans_)
+            mask = gm[gi]
+            grid_ts = (np.flatnonzero(mask).astype(np.int64) * interval
+                       + qbase)
+            results.append(QueryResult(
+                spec.metric, tags, aggregated, grid_ts,
+                gv[gi][mask].astype(np.float64)))
+        return results
 
     # -- CPU oracle backend -------------------------------------------
 

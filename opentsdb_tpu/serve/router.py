@@ -518,6 +518,9 @@ class RouterServer:
                     json.dumps(records).encode(), {})
         if path == "/api/topology":
             return self._topology()
+        if path == "/topology":
+            return (200, "text/html; charset=UTF-8",
+                    _TOPOLOGY_HTML.encode(), {})
         if path == "/api/cluster/handoff":
             return await self._handoff(q)
         if path in ("/aggregators", "/version", "/suggest"):
@@ -1211,3 +1214,111 @@ class RouterServer:
             extra["Retry-After"] = headers["retry-after"]
         return (status, headers.get("content-type", "text/plain"),
                 body, extra, spans)
+
+
+# ---------------------------------------------------------------------------
+# /topology: the browser view over the /api/topology JSON feed — the
+# cluster-state dashboard (writers + epoch + promotion history, every
+# replica's lag / ejection / hop p95, hedge + retry + rcache counters,
+# the ownership map) rendered client-side and auto-refreshed. No
+# external assets: one self-contained page the router serves from
+# memory, so it works air-gapped and on a storage-free router.
+# ---------------------------------------------------------------------------
+
+_TOPOLOGY_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>tsd topology</title>
+<style>
+ body{font:13px/1.45 system-ui,sans-serif;margin:1.2em;background:#fafafa;
+      color:#222}
+ h1{font-size:1.2em;margin:0 0 .2em}
+ h2{font-size:1em;margin:1.2em 0 .3em}
+ table{border-collapse:collapse;background:#fff;min-width:40em}
+ th,td{border:1px solid #ddd;padding:.25em .6em;text-align:left;
+       font-variant-numeric:tabular-nums}
+ th{background:#f0f0f0;font-weight:600}
+ .ok{color:#0a7d32}.bad{color:#c0392b}.warn{color:#b8860b}
+ .muted{color:#888}
+ #meta{color:#666;font-size:.9em;margin-bottom:.8em}
+ .pill{display:inline-block;padding:0 .5em;border-radius:.8em;
+       background:#eee;margin-right:.4em}
+</style></head><body>
+<h1>Cluster topology</h1>
+<div id="meta">loading /api/topology&hellip;</div>
+<div id="writers"></div><div id="replicas"></div>
+<div id="promotion"></div><div id="ownership"></div>
+<div id="counters"></div>
+<script>
+function esc(v){return String(v).replace(/&/g,"&amp;")
+  .replace(/</g,"&lt;").replace(/>/g,"&gt;")
+  .replace(/"/g,"&quot;");}
+function cls(ok){return ok?"ok":"bad";}
+function fmt(v){return v===null||v===undefined?"&mdash;":esc(v);}
+function table(title, heads, rows){
+  var h="<h2>"+title+"</h2><table><tr>"+heads.map(
+    function(x){return "<th>"+x+"</th>";}).join("")+"</tr>";
+  h+=rows.map(function(r){return "<tr>"+r.map(
+    function(c){return "<td>"+c+"</td>";}).join("")+"</tr>";}).join("");
+  return h+"</table>";
+}
+function render(t){
+  document.getElementById("meta").innerHTML=
+    "router up "+t.uptime_s+"s &middot; refreshed "+
+    new Date().toLocaleTimeString();
+  var w=(t.writers||[]).map(function(x){
+    var h=x.health||{};
+    var alive=!!h.ok, fenced=!!h.fenced;
+    return [esc(x.url),
+      "<span class='"+cls(alive)+"'>"+(alive?"alive":"down")+"</span>",
+      fmt(h.writer_epoch),
+      fenced?"<span class='bad'>FENCED</span>":"&mdash;",
+      fmt(h.role)];});
+  document.getElementById("writers").innerHTML=
+    table("Writers", ["url","health","epoch","fence","role"], w);
+  var r=(t.replicas||[]).map(function(x){
+    var s=x.ejected?"<span class='bad'>ejected</span>"
+      :(x.stale?"<span class='warn'>stale</span>"
+        :"<span class='ok'>healthy</span>");
+    return [esc(x.url), s, fmt(x.lag_ms), fmt(x.hop_p95_ms),
+      fmt(x.consecutive_fails), fmt(x.writer_epoch)];});
+  document.getElementById("replicas").innerHTML=
+    table("Read backends",
+      ["url","state","lag ms","hop p95 ms","consec fails","epoch"], r);
+  var p=t.promotion;
+  document.getElementById("promotion").innerHTML = p ?
+    table("Promotion driver",
+      ["enabled","grace ms","epoch","writer dead for","deposed",
+       "recent events"],
+      [[p.enabled?"yes":"no", fmt(p.writer_grace_ms), fmt(p.epoch),
+        p.writer_dead_for_ms===null?"&mdash;":p.writer_dead_for_ms+" ms",
+        fmt(p.deposed_url),
+        (p.events||[]).slice(-5).map(function(e){
+          return esc(JSON.stringify(e));}).join("<br>")||"&mdash;"]])
+    : "";
+  var o=t.ownership;
+  if(o && o.writers){
+    var counts=o.writers.map(function(){return 0;});
+    (o.assign||[]).forEach(function(wi){
+      if(wi>=0&&wi<counts.length)counts[wi]++;});
+    var rows=o.writers.map(function(u,i){
+      return [esc(u), counts[i], fmt(o.slots)];});
+    document.getElementById("ownership").innerHTML=
+      table("Ownership map (epoch "+fmt(o.epoch)+")",
+        ["writer","slots owned","total slots"], rows);
+  } else {
+    document.getElementById("ownership").innerHTML="";
+  }
+  var c=t.counters||{};
+  document.getElementById("counters").innerHTML=
+    "<h2>Counters</h2>"+Object.keys(c).map(function(k){
+      return "<span class='pill'>"+esc(k)+": "+esc(c[k])+
+        "</span>";}).join("");
+}
+function tick(){
+  fetch("/api/topology").then(function(r){return r.json();})
+    .then(render)
+    .catch(function(e){document.getElementById("meta").innerHTML=
+      "<span class='bad'>fetch failed: "+esc(e)+"</span>";});
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>
+"""

@@ -448,6 +448,13 @@ class MemKVStore(KVStore):
         # count hits _MAX_GENERATIONS.
         self._ssts: list[SSTable] = []
         self._sst_path = wal_path + ".sst" if wal_path else None
+        # Write-side sstable codec (Config.sstable_codec): "none"
+        # spills the WRITE_FORMAT legacy layout; "tsst4" spills
+        # compressed columnar blocks. Read-side is self-describing per
+        # file, so mixed-format generation sets are first-class and
+        # flipping this only affects FUTURE spills (compaction
+        # re-encodes as generations merge).
+        self.sstable_codec = "none"
         # Flush failures SWALLOWED on put_many's exceptional exit (the
         # in-flight throttle error wins) — the one case where a flush
         # failure cannot propagate to the caller. Ordinary flush
@@ -827,6 +834,72 @@ class MemKVStore(KVStore):
         the /stats per-shard memtable gauge."""
         with self._lock:
             return [len(self._table(table).rows)]
+
+    def sstable_format_bytes(self) -> dict[int, int]:
+        """On-disk bytes of the live generation set, keyed by sstable
+        format version (1-4) — the /stats ``sstable.bytes{format=}``
+        gauge and fsck's format-mix report."""
+        out: dict[int, int] = {}
+        with self._lock:
+            gens = list(self._ssts)
+        for sst in gens:
+            try:
+                sz = os.path.getsize(sst.path)
+            except OSError:
+                continue
+            out[sst.format] = out.get(sst.format, 0) + sz
+        return out
+
+    def compress_stats(self) -> tuple[int, int]:
+        """(uncompressed_record_bytes, stored_record_bytes) summed over
+        the v4 generations — ``compress.ratio`` = raw / stored. (0, 0)
+        when no generation is compressed."""
+        raw = enc = 0
+        with self._lock:
+            gens = list(self._ssts)
+        for sst in gens:
+            cs = sst.codec_stats()
+            if cs is not None:
+                raw += cs[0]
+                enc += cs[1]
+        return raw, enc
+
+    def encoded_range(self, table: str, start: bytes,
+                      stop: bytes | None):
+        """The fused decode-aggregate path's source check: when every
+        generation holding keys in [start, stop) is format v4, returns
+        [(sstable, lo_idx, hi_idx)] ordered by first key. Returns None
+        whenever serving the range off raw blocks could diverge from a
+        scan: a frozen mid-checkpoint tier, live row tombstones, or a
+        non-v4 generation in range. Two residual overlay risks are the
+        CALLER's checks: memtable-resident rows (executor chunk_state:
+        any dirty base in range declines the fused plan) and duplicate
+        keys ACROSS generations (compress/fused.gather verifies the
+        copies' qualifier-delta ranges are disjoint — the mid-hour
+        checkpoint-boundary straddle, where the overlay is a pure
+        union — and declines otherwise)."""
+        with self._lock:
+            if self._frozen is not None:
+                return None
+            t = self._tables.get(table)
+            if t is not None and t.row_tombs:
+                return None
+            gens = list(self._ssts)
+        spans = []
+        for g in gens:
+            idx = g._index.get(table)
+            if not idx or not idx[0]:
+                continue
+            keys, _ = idx
+            lo = bisect_left(keys, start)
+            hi = bisect_left(keys, stop) if stop else len(keys)
+            if lo == hi:
+                continue
+            if g.format != 4:
+                return None
+            spans.append((g, lo, hi, keys[lo]))
+        spans.sort(key=lambda s: s[3])
+        return [(g, lo, hi) for g, lo, hi, _ in spans]
 
     def pending_keys(self, table: str) -> list[bytes]:
         """Row keys (and row tombstones) NOT yet covered by the rollup
@@ -1736,10 +1809,16 @@ class MemKVStore(KVStore):
             # spilled yet. Crash here must recover purely from
             # .old + WAL replay; raise exercises the thaw path below.
             _fault("kv.checkpoint.freeze", self._wal_path)
+            # kwarg only when compressing: the default spill call shape
+            # stays identical (tests stub these writers by signature).
+            kw = {"codec": self.sstable_codec} \
+                if self.sstable_codec not in (None, "none") else {}
             with _M_CKPT_PHASE["spill"].time():
-                n = (merge_sstables(out_path, merge_gens, frozen_payload)
+                n = (merge_sstables(out_path, merge_gens, frozen_payload,
+                                    **kw)
                      if use_merge
-                     else write_sstable_bulk(out_path, spill_tables()))
+                     else write_sstable_bulk(out_path, spill_tables(),
+                                             **kw))
         except Exception:
             # Disk full or similar mid-merge: thaw the frozen tier back
             # under the live memtable so the store isn't wedged (a stuck

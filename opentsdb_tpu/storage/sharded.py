@@ -431,6 +431,43 @@ class ShardedKVStore(KVStore):
         """Live-memtable row count per shard (the /stats gauge)."""
         return [s.memtable_row_counts(table)[0] for s in self.shards]
 
+    @property
+    def sstable_codec(self) -> str:
+        return self.shards[0].sstable_codec if self.shards else "none"
+
+    @sstable_codec.setter
+    def sstable_codec(self, codec: str) -> None:
+        for s in self.shards:
+            s.sstable_codec = codec
+
+    def sstable_format_bytes(self) -> dict[int, int]:
+        out: dict[int, int] = {}
+        for s in self.shards:
+            for fmt, n in s.sstable_format_bytes().items():
+                out[fmt] = out.get(fmt, 0) + n
+        return out
+
+    def compress_stats(self) -> tuple[int, int]:
+        raw = enc = 0
+        for s in self.shards:
+            r, e = s.compress_stats()
+            raw += r
+            enc += e
+        return raw, enc
+
+    def encoded_range(self, table: str, start: bytes,
+                      stop: bytes | None):
+        """Per-shard encoded_range fan-in (see MemKVStore): shards are
+        key-disjoint by the series routing, so the union of per-shard
+        disjoint spans is disjoint. None if any shard declines."""
+        out = []
+        for s in self.shards:
+            got = s.encoded_range(table, start, stop)
+            if got is None:
+                return None
+            out.extend(got)
+        return out
+
     def pending_keys(self, table: str) -> list[bytes]:
         out: list[bytes] = []
         for s in self.shards:

@@ -20,6 +20,28 @@ File layout v3 (all integers big-endian):
                    (k == 0, nbits == 0 => table has no bloom)
     trailer  :=  [u32 ntables][u64 footer_start][u64 bloom_start]
 
+Format v4 (magic TSST4, Config.sstable_codec="tsst4") compresses the
+record section as columnar BLOCKS (opentsdb_tpu/compress/codecs.py:
+delta-of-delta timestamps + XOR floats / zigzag int deltas, zlib and
+verbatim fallbacks — each block self-describing):
+    magic  b"TSST4"
+    block*   :=  [u8 codec_tag][u32 raw_len][u32 enc_len][enc bytes]
+                 where the raw bytes are a run of same-table v3-framed
+                 records
+    footer   :=  [u32 raw_len][u32 enc_len][zlib of the v3 footer]
+    blocks   :=  [u32 nblocks][raw_starts: u64 x n][file_starts: u64 x n]
+    bloom    :=  identical to v3
+    trailer  :=  [u32 ntables][u64 footer_start][u64 bloom_start]
+                 [u64 blocks_start][u64 raw_end]
+Footer offsets are RAW-space offsets — the offset each record would
+have in the equivalent v3 file — so the index, ``record_extents`` and
+the copy-merge all keep working in one coordinate system; the blocks
+index maps raw offsets to file offsets, and readers decode whole
+blocks lazily behind a small per-file cache. Mixed-format stores are
+first-class: compaction re-encodes into whatever codec the writer is
+configured for, and v1-v3 generations keep opening, serving and
+merging forever.
+
 The footer exists because opening a file by scanning every row record
 cost ~3 us/row in Python — 10+ s per 4.4M-row generation, paid on every
 checkpoint swap-in AND at every daemon start. It opens with two numpy
@@ -47,27 +69,44 @@ serves gets and scans without rehydrating the dataset.
 
 from __future__ import annotations
 
+import io
 import mmap
 import os
 import struct
 import zlib
-from bisect import bisect_left
+from bisect import bisect_left, bisect_right
 from typing import Iterable, Iterator
 
 import numpy as np
 
+from opentsdb_tpu.compress import codecs as _codecs
 from opentsdb_tpu.core.const import TIMESTAMP_BYTES, UID_WIDTH
 from opentsdb_tpu.fault.faultpoints import fire as _fault
+from opentsdb_tpu.obs.registry import METRICS as _metrics
 from opentsdb_tpu.utils.nativeext import ext as _EXT
 
 _MAGIC_V1 = b"TSST1"
 _MAGIC_V2 = b"TSST2"
 _MAGIC = b"TSST3"
+_MAGIC_V4 = b"TSST4"
 _U16 = struct.Struct(">H")
 _U32 = struct.Struct(">I")
 _TRAILER = struct.Struct(">IQ")     # v2: ntables, footer_start
 _TRAILER_V3 = struct.Struct(">IQQ")  # ntables, footer_start, bloom_start
+# v4: ntables, footer_start, bloom_start, blocks_start, raw_end
+_TRAILER_V4 = struct.Struct(">IQQQQ")
 _BLOOM_HDR = struct.Struct(">BQ")   # k, nbits
+_BLOCK_HDR = struct.Struct(">BII")  # codec tag, raw_len, enc_len
+
+# Target UNCOMPRESSED bytes per v4 block: big enough that the columnar
+# codecs amortize their per-block headers and numpy passes, small
+# enough that a point-get decodes a bounded unit. Runs longer than
+# this split at record boundaries.
+BLOCK_RAW_TARGET = 1 << 18
+
+# Whole-block decode on the read path (scan, point get, copy-merge,
+# fsck round-trip audits) — p50/p95/p99 + count via /stats + /metrics.
+_M_DECODE = _metrics.timer("compress.decode")
 
 # Series-identity byte ranges of a data row key (the base-time bytes
 # between them are excluded — the sharder's routing identity,
@@ -147,14 +186,139 @@ def _slice_varlen(blob: bytes, lens_be: bytes) -> list[bytes]:
     return [blob[a:b] for a, b in zip(starts.tolist(), ends.tolist())]
 
 
+class _BodyWriter:
+    """The record section of a new sstable, in either format: v2/v3
+    writes records straight through (byte-identical to the historical
+    layout), v4 ("tsst4" codec) accumulates same-table record runs and
+    flushes them as self-describing compressed blocks.
+
+    ``write_record``/``write_run`` return the RAW-space offset of the
+    written bytes — the file offset in v2/v3, the virtual uncompressed
+    offset in v4 — which is what the footer indexes and
+    ``record_extents`` reports, so every consumer stays in one
+    coordinate system regardless of format."""
+
+    def __init__(self, f, codec: str | None) -> None:
+        self.f = f
+        self.v4 = codec == "tsst4"
+        magic = _MAGIC_V4 if self.v4 \
+            else (_MAGIC if WRITE_FORMAT >= 3 else _MAGIC_V2)
+        f.write(magic)
+        self.raw_off = len(magic)
+        self._chunks: list[bytes] = []
+        self._offs: list[int] = []
+        self._pend = 0
+        self._table: str | None = None
+        self.blocks: list[tuple[int, int]] = []  # (raw_start, file_start)
+
+    def _append(self, table: str, buf: bytes, starts) -> int:
+        """Queue record bytes for the current block; returns the raw
+        offset of ``buf``'s first byte. A table switch flushes BEFORE
+        queueing (one table per block) and raw_off only advances here,
+        so a flush's raw_start accounting is exact either way."""
+        if self._table is not None and self._table != table:
+            self._flush_block()
+        self._table = table
+        base = self._pend
+        self._offs.extend(int(s) + base for s in starts)
+        self._chunks.append(buf)
+        self._pend += len(buf)
+        off = self.raw_off
+        self.raw_off += len(buf)
+        if self._pend >= BLOCK_RAW_TARGET:
+            self._flush_block()
+        return off
+
+    def write_record(self, table: str, rec: bytes) -> int:
+        if not self.v4:
+            off = self.raw_off
+            self.raw_off += len(rec)
+            self.f.write(rec)
+            return off
+        return self._append(table, rec, (0,))
+
+    def write_run(self, table: str, buf: bytes, starts) -> int:
+        """A run of verbatim record bytes with known record ``starts``
+        (relative to ``buf``, first at 0) — the copy-merge's unit. v4
+        splits long runs at record boundaries near BLOCK_RAW_TARGET."""
+        if not self.v4:
+            off = self.raw_off
+            self.raw_off += len(buf)
+            self.f.write(buf)
+            return off
+        s = np.asarray(starts, np.int64)
+        off0 = None
+        i = 0
+        while i < len(s):
+            j = int(np.searchsorted(s, s[i] + BLOCK_RAW_TARGET, "left"))
+            j = max(j, i + 1)
+            end = int(s[j]) if j < len(s) else len(buf)
+            lo = int(s[i])
+            o = self._append(table, bytes(buf[lo:end]),
+                             (s[i:j] - lo).tolist())
+            if off0 is None:
+                off0 = o - lo
+            i = j
+        return off0 if off0 is not None else self.raw_off
+
+    def _flush_block(self) -> None:
+        if not self._pend:
+            return
+        raw = self._chunks[0] if len(self._chunks) == 1 \
+            else b"".join(self._chunks)
+        tag, enc = _codecs.encode_block(raw, self._offs)
+        raw_start = self.raw_off - self._pend
+        self.blocks.append((raw_start, self.f.tell()))
+        self.f.write(_BLOCK_HDR.pack(tag, len(raw), len(enc)))
+        self.f.write(enc)
+        # Compressed block body written, not yet durable: torn mode
+        # cuts INSIDE this block specifically (header + payload), the
+        # state a mid-spill power cut leaves — recovery must treat the
+        # whole .tmp as a stray, never parse a half block. Flushed
+        # first so the cut has on-disk bytes to land in (a block spans
+        # many buffered-writer pages anyway).
+        self.f.flush()
+        _fault("sst.write.block", getattr(self.f, "name", None),
+               _BLOCK_HDR.size + len(enc))
+        self._chunks.clear()
+        self._offs.clear()
+        self._pend = 0
+        self._table = None
+
+    def finish(self) -> int:
+        """Flush pending blocks; returns the footer's file offset."""
+        if self.v4:
+            self._flush_block()
+        return self.f.tell()
+
+
 def _write_bloom_and_trailer(
         f, ntables: int, footer_start: int,
-        blooms: "dict[str, np.ndarray | None]") -> None:
-    """Write the bloom section (format 3) and the trailer, then make
+        blooms: "dict[str, np.ndarray | None]",
+        bw: "_BodyWriter | None" = None) -> None:
+    """Write the bloom section (format 3+) and the trailer, then make
     the file durable. ``blooms`` maps table -> packed bit array or
     None (no bloom); at WRITE_FORMAT 2 the section and the extended
-    trailer fields are omitted entirely (legacy layout)."""
-    if WRITE_FORMAT < 3:
+    trailer fields are omitted entirely (legacy layout). ``bw`` (a v4
+    body writer) adds the blocks index + the extended v4 trailer."""
+    if bw is not None and bw.v4:
+        blocks_start = f.tell()
+        f.write(_U32.pack(len(bw.blocks)))
+        f.write(np.asarray([b[0] for b in bw.blocks], ">u8").tobytes())
+        f.write(np.asarray([b[1] for b in bw.blocks], ">u8").tobytes())
+        bloom_start = f.tell()
+        for table in sorted(blooms):
+            tb = table.encode()
+            bits = blooms[table]
+            f.write(_U16.pack(len(tb)) + tb)
+            if bits is None:
+                f.write(_BLOOM_HDR.pack(0, 0))
+            else:
+                f.write(_BLOOM_HDR.pack(BLOOM_K, BLOOM_BITS))
+                f.write(bits.tobytes())
+        f.write(_TRAILER_V4.pack(ntables, footer_start, bloom_start,
+                                 blocks_start, bw.raw_off))
+    elif WRITE_FORMAT < 3:
         f.write(_TRAILER.pack(ntables, footer_start))
     else:
         bloom_start = f.tell()
@@ -179,21 +343,47 @@ def _write_bloom_and_trailer(
     os.fsync(f.fileno())
 
 
-def _finish_file(f, index: dict[str, tuple[list[bytes], list[int]]],
-                 footer_start: int,
-                 blooms: "dict[str, np.ndarray | None] | None" = None,
-                 ) -> None:
-    """Write the footer (+ bloom section + trailer) and make the file
-    durable. ``blooms`` overrides the per-table bloom bits (the
-    copy-merge passes OR-ed source blooms); by default each table's
-    bloom is built from its index keys."""
+def _footer_bytes(index: dict[str, tuple[list[bytes], list[int]]],
+                  ) -> bytes:
+    out = io.BytesIO()
     for table in sorted(index):
         keys, offs = index[table]
         tb = table.encode()
-        f.write(_U16.pack(len(tb)) + tb + _U32.pack(len(keys)))
-        f.write(np.fromiter(map(len, keys), ">u4", len(keys)).tobytes())
-        f.write(np.asarray(offs, ">u8").tobytes())
-        f.write(b"".join(keys))
+        out.write(_U16.pack(len(tb)) + tb + _U32.pack(len(keys)))
+        out.write(np.fromiter(map(len, keys), ">u4",
+                              len(keys)).tobytes())
+        out.write(np.asarray(offs, ">u8").tobytes())
+        out.write(b"".join(keys))
+    return out.getvalue()
+
+
+def _finish_file(f, index: dict[str, tuple[list[bytes], list[int]]],
+                 footer_start: int,
+                 blooms: "dict[str, np.ndarray | None] | None" = None,
+                 bw: "_BodyWriter | None" = None,
+                 ) -> None:
+    """Write the footer (+ blocks index + bloom section + trailer) and
+    make the file durable. ``blooms`` overrides the per-table bloom
+    bits (the copy-merge passes OR-ed source blooms); by default each
+    table's bloom is built from its index keys. A v4 ``bw`` stores the
+    footer zlib-compressed (the per-key index is ~25 B/row of highly
+    redundant keys/offsets — left raw it would cap the whole file's
+    compression ratio)."""
+    if bw is not None and bw.v4:
+        fb = _footer_bytes(index)
+        z = zlib.compress(fb, 1)
+        f.write(_U32.pack(len(fb)) + _U32.pack(len(z)) + z)
+    else:
+        # Streamed (not buffered): a 4M-row generation's footer is
+        # ~100 MB and the v3 path must not grow a peak-RSS bump.
+        for table in sorted(index):
+            keys, offs = index[table]
+            tb = table.encode()
+            f.write(_U16.pack(len(tb)) + tb + _U32.pack(len(keys)))
+            f.write(np.fromiter(map(len, keys), ">u4",
+                                len(keys)).tobytes())
+            f.write(np.asarray(offs, ">u8").tobytes())
+            f.write(b"".join(keys))
     if blooms is None:
         blooms = {}
         for table, (keys, _) in index.items():
@@ -204,7 +394,7 @@ def _finish_file(f, index: dict[str, tuple[list[bytes], list[int]]],
         # One bloom entry per indexed table, always (the reader parses
         # the section by the trailer's table count).
         blooms = {t: blooms.get(t) for t in index}
-    _write_bloom_and_trailer(f, len(index), footer_start, blooms)
+    _write_bloom_and_trailer(f, len(index), footer_start, blooms, bw)
 
 
 def _durable_rename(tmp: str, path: str) -> None:
@@ -230,15 +420,16 @@ def _durable_rename(tmp: str, path: str) -> None:
 
 def write_sstable_bulk(path: str,
                        tables: dict[str, tuple[list[bytes], object]],
-                       ) -> int:
+                       codec: str | None = None) -> int:
     """write_sstable for pre-materialized data: per table, a SORTED key
     list and either a parallel list of cell lists OR the memtable row
     dict itself (key -> {(fam, qual): value}, no tombstones). With the
     native extension the whole record section frames in one C pass per
     table (the per-row Python framing was ~5 us/row — the dominant cost
     of checkpoint spills at scale); without it, falls back to the
-    streaming writer."""
-    if _EXT is None:
+    streaming writer. A compressed ``codec`` always streams: blocks
+    need per-record boundaries the C framer doesn't report."""
+    if _EXT is None or codec == "tsst4":
         def rows():
             for table in sorted(tables):
                 keys, data = tables[table]
@@ -250,7 +441,7 @@ def write_sstable_bulk(path: str,
                 else:
                     for k, c in zip(keys, data):
                         yield table, k, c
-        return write_sstable(path, rows())
+        return write_sstable(path, rows(), codec=codec)
     tmp = path + ".tmp"
     n = 0
     with open(tmp, "wb") as f:
@@ -286,18 +477,20 @@ def write_sstable_bulk(path: str,
     return n
 
 
-def write_sstable(path: str, rows: Iterable[Row]) -> int:
+def write_sstable(path: str, rows: Iterable[Row],
+                  codec: str | None = None) -> int:
     """Write rows (pre-sorted by (table, key)) to a new sstable at `path`.
 
     Returns the number of rows written. Writes via a temp file + atomic
     rename so a crash mid-write never corrupts the previous generation.
+    ``codec`` "tsst4" writes format v4 (compressed blocks); None/"none"
+    writes the WRITE_FORMAT legacy layout byte-identically.
     """
     tmp = path + ".tmp"
     n = 0
     index: dict[str, tuple[list[bytes], list[int]]] = {}
     with open(tmp, "wb") as f:
-        f.write(_MAGIC if WRITE_FORMAT >= 3 else _MAGIC_V2)
-        off = len(_MAGIC)
+        bw = _BodyWriter(f, codec)
         for table, key, cells in rows:
             tb = table.encode()
             parts = [_U16.pack(len(tb)), tb, _U16.pack(len(key)), key,
@@ -305,14 +498,12 @@ def write_sstable(path: str, rows: Iterable[Row]) -> int:
             for fam, qual, value in cells:
                 parts += [_U16.pack(len(fam)), fam, _U16.pack(len(qual)),
                           qual, _U32.pack(len(value)), value]
-            rec = b"".join(parts)
-            f.write(rec)
+            off = bw.write_record(table, b"".join(parts))
             keys, offs = index.setdefault(table, ([], []))
             keys.append(key)
             offs.append(off)
-            off += len(rec)
             n += 1
-        _finish_file(f, index, off)
+        _finish_file(f, index, bw.finish(), bw=bw)
     _durable_rename(tmp, path)
     return n
 
@@ -331,7 +522,7 @@ def _frame_record(table_b: bytes, key: bytes,
 
 
 def merge_sstables(path: str, gens: "list[SSTable]",
-                   frozen: dict) -> int:
+                   frozen: dict, codec: str | None = None) -> int:
     """Collapse sstable generations (OLDEST FIRST) + a frozen memtable
     tier into one new sstable at ``path`` — the full-merge leg of
     checkpoint (storage/kv.py), rebuilt as a COPY-MERGE.
@@ -350,7 +541,11 @@ def merge_sstables(path: str, gens: "list[SSTable]",
     row: 20.7 us/row, 145 s for a 7M-row merge measured at the 1B
     400M-point mark; the copy path is two orders cheaper.
     Returns rows written. Same tmp + fsync + atomic-rename durability
-    contract as write_sstable.
+    contract as write_sstable. ``codec`` selects the OUTPUT format;
+    compaction re-encodes as it merges, so mixed-format generation
+    sets converge on the writer's configured codec (v4 sources feeding
+    a v4 output decode + re-compress block-wise; the unique-key record
+    bytes themselves still relocate verbatim, never re-frame).
     """
     names = set(frozen)
     for g in gens:
@@ -360,8 +555,7 @@ def merge_sstables(path: str, gens: "list[SSTable]",
     index: dict[str, tuple[list[bytes], list[int]]] = {}
     blooms: dict[str, "np.ndarray | None"] = {}
     with open(tmp, "wb") as f:
-        f.write(_MAGIC if WRITE_FORMAT >= 3 else _MAGIC_V2)
-        off = len(_MAGIC)
+        bw = _BodyWriter(f, codec)
         for name in sorted(names):
             rows_f, row_tombs, has_tombs = frozen.get(
                 name, ({}, set(), False))
@@ -390,7 +584,6 @@ def merge_sstables(path: str, gens: "list[SSTable]",
             # footer pairs.
             skip = dup | row_tombs
             for (keys, starts, ends), g in zip(extents, gens):
-                mm = g._mm
                 m = len(keys)
                 if m == 0:
                     continue
@@ -410,11 +603,11 @@ def merge_sstables(path: str, gens: "list[SSTable]",
                     if a in excl:
                         continue
                     lo, hi = int(starts[a]), int(ends[b - 1])
-                    f.write(mm[lo:hi])
+                    run_off = bw.write_run(name, g.raw_bytes(lo, hi),
+                                           starts[a:b] - lo)
                     pairs.extend(zip(
                         keys[a:b],
-                        (starts[a:b] + (off - lo)).tolist()))
-                    off += hi - lo
+                        (starts[a:b] + (run_off - lo)).tolist()))
             # 2) Multi-source keys: overlay oldest -> newest -> frozen.
             for k in dup:
                 merged: dict = {}
@@ -434,21 +627,18 @@ def merge_sstables(path: str, gens: "list[SSTable]",
                 if not merged:
                     continue
                 rec = _frame_record(tb, k, merged)
-                f.write(rec)
-                pairs.append((k, off))
-                off += len(rec)
+                pairs.append((k, bw.write_record(name, rec)))
             # 3) Frozen-only rows (C-framed when tombstone-free).
             fr_only = sorted(k for k in rows_f
                              if k not in dup and rows_f[k])
             if fr_only and _EXT is not None and not has_tombs:
+                base = bw.raw_off
                 recs, offs_be, _ = _EXT.frame_rows_dict(
-                    tb, fr_only, rows_f, off)
-                f.write(recs)
-                pairs.extend(zip(
-                    fr_only,
-                    np.frombuffer(offs_be, ">u8").astype(
-                        np.int64).tolist()))
-                off += len(recs)
+                    tb, fr_only, rows_f, base)
+                abs_offs = np.frombuffer(offs_be, ">u8").astype(
+                    np.int64)
+                bw.write_run(name, recs, abs_offs - base)
+                pairs.extend(zip(fr_only, abs_offs.tolist()))
             else:
                 for k in fr_only:
                     cells = {ck: v for ck, v in rows_f[k].items()
@@ -456,9 +646,7 @@ def merge_sstables(path: str, gens: "list[SSTable]",
                     if not cells:
                         continue
                     rec = _frame_record(tb, k, cells)
-                    f.write(rec)
-                    pairs.append((k, off))
-                    off += len(rec)
+                    pairs.append((k, bw.write_record(name, rec)))
             if not pairs:
                 continue
             # Timsort exploits the concatenated sorted runs.
@@ -491,7 +679,7 @@ def merge_sstables(path: str, gens: "list[SSTable]",
                     np.bitwise_or(bloom, _bloom_bits_from_hashes(hs),
                                   out=bloom)
             blooms[name] = bloom
-        _finish_file(f, index, off, blooms)
+        _finish_file(f, index, bw.finish(), blooms, bw=bw)
     _durable_rename(tmp, path)
     return n
 
@@ -509,40 +697,78 @@ class SSTable:
         # table -> packed BLOOM_BITS bit array (absent = no pruning)
         self._blooms: dict[str, np.ndarray] = {}
         self._all_starts = None  # record_extents' sorted-start cache
+        # v4 state: raw-space block starts (python list for bisect),
+        # parallel file offsets, and a tiny decoded-block FIFO (scans
+        # walk blocks sequentially, so a handful of slots turns the
+        # per-row decode into one vectorized pass per block).
+        self._blk_raw: list[int] | None = None
+        self._blk_file: list[int] | None = None
+        self._blk_cache: dict[int, bytes] = {}
+        self.format = 3
         head = self._mm[:len(_MAGIC)]
-        if head == _MAGIC:
+        if head == _MAGIC_V4:
+            self.format = 4
+            self._load_footer(v3=True, v4=True)
+        elif head == _MAGIC:
             self._load_footer(v3=True)
         elif head == _MAGIC_V2:
+            self.format = 2
             self._load_footer(v3=False)
         elif head == _MAGIC_V1:
+            self.format = 1
             self._build_index_v1()
         else:
             raise IOError(f"{path}: bad sstable magic")
 
-    def _load_footer(self, v3: bool) -> None:
+    def _load_footer(self, v3: bool, v4: bool = False) -> None:
         mm = self._mm
-        if v3:
+        if v4:
+            (ntables, footer_start, bloom_start, blocks_start,
+             raw_end) = _TRAILER_V4.unpack_from(
+                mm, len(mm) - _TRAILER_V4.size)
+            self._data_end = raw_end
+            self._footer_file_start = footer_start
+            # Blocks index: raw-space starts + file offsets.
+            (nblocks,) = _U32.unpack_from(mm, blocks_start)
+            off = blocks_start + 4
+            self._blk_raw = np.frombuffer(
+                mm, ">u8", nblocks, off).astype(np.int64).tolist()
+            off += 8 * nblocks
+            self._blk_file = np.frombuffer(
+                mm, ">u8", nblocks, off).astype(np.int64).tolist()
+            # Footer: one zlib unit of the v3 footer bytes.
+            fb_raw, fb_enc = _U32.unpack_from(mm, footer_start)[0], \
+                _U32.unpack_from(mm, footer_start + 4)[0]
+            fbuf = zlib.decompress(
+                mm[footer_start + 8:footer_start + 8 + fb_enc])
+            if len(fbuf) != fb_raw:
+                raise IOError(f"{self.path}: footer decompressed to "
+                              f"{len(fbuf)} bytes, expected {fb_raw}")
+            src, off = fbuf, 0
+        elif v3:
             ntables, footer_start, bloom_start = _TRAILER_V3.unpack_from(
                 mm, len(mm) - _TRAILER_V3.size)
+            self._data_end = footer_start
+            src, off = mm, footer_start
         else:
             ntables, footer_start = _TRAILER.unpack_from(
                 mm, len(mm) - _TRAILER.size)
             bloom_start = None
-        self._data_end = footer_start
-        off = footer_start
+            self._data_end = footer_start
+            src, off = mm, footer_start
         for _ in range(ntables):
-            (tlen,) = _U16.unpack_from(mm, off)
+            (tlen,) = _U16.unpack_from(src, off)
             off += 2
-            table = mm[off:off + tlen].decode()
+            table = src[off:off + tlen].decode()
             off += tlen
-            (nkeys,) = _U32.unpack_from(mm, off)
+            (nkeys,) = _U32.unpack_from(src, off)
             off += 4
-            lens_be = mm[off:off + 4 * nkeys]
+            lens_be = src[off:off + 4 * nkeys]
             off += 4 * nkeys
-            offs = np.frombuffer(mm, ">u8", nkeys, off).tolist()
+            offs = np.frombuffer(src, ">u8", nkeys, off).tolist()
             off += 8 * nkeys
             blob_len = int(np.frombuffer(lens_be, ">u4").sum())
-            keys = _slice_varlen(mm[off:off + blob_len], lens_be)
+            keys = _slice_varlen(src[off:off + blob_len], lens_be)
             off += blob_len
             self._index[table] = (keys, offs)
         if bloom_start is not None:
@@ -682,8 +908,115 @@ class SSTable:
         i = bisect_left(keys, key)
         return i < len(keys) and keys[i] == key
 
+    # -- v4 block access ------------------------------------------------
+
+    @property
+    def block_count(self) -> int:
+        return len(self._blk_raw) if self._blk_raw is not None else 0
+
+    def block_header(self, j: int) -> tuple[int, int, int]:
+        """(codec tag, raw_len, enc_len) of block ``j``."""
+        return _BLOCK_HDR.unpack_from(self._mm, self._blk_file[j])
+
+    def block_raw_span(self, j: int) -> tuple[int, int]:
+        """[raw_start, raw_end) of block ``j`` in raw space."""
+        lo = self._blk_raw[j]
+        hi = self._blk_raw[j + 1] if j + 1 < len(self._blk_raw) \
+            else self._data_end
+        return lo, hi
+
+    def block_enc(self, j: int) -> memoryview:
+        """The encoded payload bytes of block ``j`` (no copy)."""
+        tag, raw_len, enc_len = self.block_header(j)
+        start = self._blk_file[j] + _BLOCK_HDR.size
+        return memoryview(self._mm)[start:start + enc_len]
+
+    def _block_raw(self, j: int) -> bytes:
+        """Decoded raw record bytes of block ``j``, behind a small
+        FIFO cache (scans touch blocks in order; dict ops are
+        GIL-atomic, so concurrent scans at worst decode twice)."""
+        got = self._blk_cache.get(j)
+        if got is not None:
+            return got
+        tag, raw_len, enc_len = self.block_header(j)
+        with _M_DECODE.time():
+            raw = _codecs.decode_block(tag, self.block_enc(j), raw_len)
+        if len(self._blk_cache) >= 8:
+            try:
+                self._blk_cache.pop(next(iter(self._blk_cache)))
+            except (StopIteration, KeyError):
+                pass
+        self._blk_cache[j] = raw
+        return raw
+
+    def _record_buf(self, off: int):
+        """(buffer, position) holding the record at raw offset ``off``
+        — the mmap itself on raw formats, the decoded enclosing block
+        on v4."""
+        if self._blk_raw is None:
+            return self._mm, off
+        j = bisect_right(self._blk_raw, off) - 1
+        return self._block_raw(j), off - self._blk_raw[j]
+
+    def raw_bytes(self, lo: int, hi: int) -> bytes:
+        """Raw record bytes [lo, hi) in raw space — what the copy-merge
+        relocates. v4 concatenates decoded block slices."""
+        if self._blk_raw is None:
+            return self._mm[lo:hi]
+        if hi <= lo:
+            return b""
+        j = bisect_right(self._blk_raw, lo) - 1
+        parts = []
+        while lo < hi:
+            blo, bhi = self.block_raw_span(j)
+            raw = self._block_raw(j)
+            parts.append(raw[lo - blo:min(hi, bhi) - blo])
+            lo = bhi
+            j += 1
+        return parts[0] if len(parts) == 1 else b"".join(parts)
+
+    def codec_stats(self) -> "tuple[int, int] | None":
+        """(raw_bytes, stored_bytes) of the record section — the
+        compression ratio source. None on non-v4 files."""
+        if self._blk_raw is None:
+            return None
+        return (self._data_end - len(_MAGIC_V4),
+                self._footer_file_start - len(_MAGIC_V4))
+
+    def block_audit(self, log=None) -> int:
+        """fsck's block check: every block's codec tag must be known,
+        its payload must decode, and the decoded size must match the
+        header's uncompressed size. Returns the error count."""
+        errors = 0
+        say = log if log is not None else (lambda *_: None)
+        if self._blk_raw is None:
+            return 0
+        for j in range(self.block_count):
+            lo, hi = self.block_raw_span(j)
+            try:
+                tag, raw_len, enc_len = self.block_header(j)
+            except struct.error:
+                errors += 1
+                say(f"ERROR: {self.path}: block {j}: truncated header")
+                continue
+            if raw_len != hi - lo:
+                errors += 1
+                say(f"ERROR: {self.path}: block {j}: header raw_len "
+                    f"{raw_len} != index span {hi - lo}")
+                continue
+            try:
+                raw = _codecs.decode_block(tag, self.block_enc(j),
+                                           raw_len)
+            except _codecs.BlockCodecError as e:
+                errors += 1
+                say(f"ERROR: {self.path}: block {j} "
+                    f"(tag={tag}): {e}")
+                continue
+            del raw
+        return errors
+
     def _read_row(self, off: int) -> list[tuple[bytes, bytes, bytes]]:
-        mm = self._mm
+        mm, off = self._record_buf(off)
         (tlen,) = _U16.unpack_from(mm, off)
         off += 2 + tlen
         (klen,) = _U16.unpack_from(mm, off)

@@ -49,6 +49,14 @@ def common_args(p: argparse.ArgumentParser) -> None:
                         "explicit N that disagrees with the manifest is "
                         "a hard error")
     p.add_argument("--backend", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument("--sstable-codec", default="none",
+                   choices=["none", "tsst4"],
+                   help="write-side sstable format: 'tsst4' spills "
+                        "compressed columnar blocks (delta-of-delta "
+                        "timestamps + XOR floats; opentsdb_tpu/"
+                        "compress/). Read side sniffs per file, so "
+                        "existing v1-v3 generations keep serving and "
+                        "compaction re-encodes as they merge")
     p.add_argument("--rollups", action="store_true",
                    help="maintain the materialized rollup tier "
                         "(opentsdb_tpu/rollup/): per-series 1h/1d "
@@ -112,7 +120,8 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
                 LOG.warning("could not pin jax to CPU: %s", e)
     cfg = Config(
         table=args.table, uidtable=args.uidtable, wal_path=args.wal,
-        backend=args.backend, auto_create_metrics=args.auto_metric)
+        backend=args.backend, auto_create_metrics=args.auto_metric,
+        sstable_codec=getattr(args, "sstable_codec", "none"))
     if getattr(args, "rollups", False):
         cfg.enable_rollups = True
     if getattr(args, "rollup_resolutions", None):
@@ -582,6 +591,13 @@ def cmd_fsck(args) -> int:
     rep = run_fsck(tsdb, fix=args.fix, log=print)
     print(f"sstables: {rep.bloomed} with series blooms, {rep.plain} "
           f"bloomless/legacy, {rep.bloom_misses} bloom false negatives")
+    if rep.format_counts:
+        mix = " ".join(f"v{fmt}={n}" for fmt, n in
+                       sorted(rep.format_counts.items()))
+        print(f"sstable formats: {mix}")
+    if rep.blocks:
+        print(f"compressed blocks: {rep.blocks} audited, "
+              f"{rep.codec_errors} codec errors")
     dt = max(time.time() - t0, 1e-9)
     print(f"{rep.kvs} KVs (in {rep.rows} rows) analyzed in "
           f"{dt * 1000:.0f}ms (~{rep.kvs / dt:.0f} KV/s)")

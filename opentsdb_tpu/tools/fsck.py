@@ -42,6 +42,14 @@ class FsckReport:
     bloomed: int = 0        # sstables carrying at least one bloom
     plain: int = 0          # bloomless / legacy-format sstables
     bloom_misses: int = 0   # bloom false negatives (counted in errors)
+    # Format-mix report: generation count per sstable format version
+    # (1-4) — the operator's view of how far a codec migration has
+    # compacted through the store.
+    format_counts: dict = dataclasses.field(default_factory=dict)
+    blocks: int = 0         # TSST4 blocks audited
+    codec_errors: int = 0   # block-level failures (counted in errors):
+    #                         unknown codec tag, decode failure, or
+    #                         uncompressed-size mismatch
 
     @property
     def clean(self) -> bool:
@@ -55,10 +63,30 @@ def run_fsck(tsdb, fix: bool = False, log=None) -> FsckReport:
         return _run_fsck(tsdb, fix, log)
 
 
+def _scan_rows(tsdb, rep: FsckReport, say):
+    """Row scan that survives a corrupt compressed block: the storage
+    layer raises BlockCodecError mid-iteration (the generator dies),
+    so the failure is counted here and the per-generation block audit
+    below pinpoints the block — fsck reports instead of crashing."""
+    from opentsdb_tpu.compress.codecs import BlockCodecError
+    it = tsdb.store.scan(tsdb.table, b"", b"", family=FAMILY)
+    while True:
+        try:
+            cells = next(it)
+        except StopIteration:
+            return
+        except (BlockCodecError, IOError) as e:
+            rep.errors += 1
+            rep.codec_errors += 1
+            say(f"ERROR: data scan aborted by unreadable storage: {e}")
+            return
+        yield cells
+
+
 def _run_fsck(tsdb, fix: bool, log) -> FsckReport:
     say = log if log is not None else (lambda *_: None)
     rep = FsckReport()
-    for cells in tsdb.store.scan(tsdb.table, b"", b"", family=FAMILY):
+    for cells in _scan_rows(tsdb, rep, say):
         rep.rows += 1
         key = cells[0].key
         bad = False
@@ -108,12 +136,16 @@ def _run_fsck(tsdb, fix: bool, log) -> FsckReport:
                 say(f"ERROR: row {key.hex()}: {e}")
         if bad and fix:
             rep.fixed += _fix_row(tsdb, key, cells)
-    # SSTable format / series-bloom audit over every generation
-    # (mixed-format stores are first-class: TSST3 files carry blooms,
-    # v1/v2 files don't and simply never prune).
+    # SSTable format / series-bloom / compressed-block audit over
+    # every generation (mixed-format stores are first-class: TSST3+
+    # files carry blooms, v1/v2 files don't and simply never prune;
+    # TSST4 files additionally get every block's codec tag, decode,
+    # and uncompressed size verified).
     stores = getattr(tsdb.store, "shards", None) or [tsdb.store]
     for s in stores:
         for sst in getattr(s, "_ssts", []):
+            fmt = getattr(sst, "format", 3)
+            rep.format_counts[fmt] = rep.format_counts.get(fmt, 0) + 1
             any_bloom = False
             for name in sst.tables():
                 miss = sst.bloom_check(name)
@@ -127,6 +159,12 @@ def _run_fsck(tsdb, fix: bool, log) -> FsckReport:
                         f"'{name}' excludes {miss} of its own keys")
             rep.bloomed += 1 if any_bloom else 0
             rep.plain += 0 if any_bloom else 1
+            audit = getattr(sst, "block_audit", None)
+            if audit is not None and getattr(sst, "block_count", 0):
+                rep.blocks += sst.block_count
+                bad = audit(say)
+                rep.codec_errors += bad
+                rep.errors += bad
     return rep
 
 

@@ -28,6 +28,22 @@ class Config:
     # MemKVStore. With persistence, wal_path is the store DIRECTORY
     # and the count is pinned by its SHARDS.json manifest.
     shards: int = 1
+    # Write-side sstable format (opentsdb_tpu/compress/):
+    # - "none": spill the uncompressed TSST3 layout (the default —
+    #   bytes on disk identical to previous releases).
+    # - "tsst4": spill compressed columnar blocks (delta-of-delta
+    #   timestamps, XOR floats, zigzag int deltas; zlib/verbatim
+    #   fallbacks; per-block self-describing). Read side is
+    #   format-sniffed per file, so v1-v4 generations mix freely and
+    #   flipping this only changes FUTURE spills; compaction
+    #   re-encodes as generations merge.
+    sstable_codec: str = "none"
+    # Fused decode-plus-aggregate serving (compress/kernels.py): let
+    # eligible downsample queries run straight off TSST4 blocks — the
+    # decoded column exists only inside one XLA program. Answers are
+    # exact (the path declines rather than approximates); off forces
+    # the classic decode-then-reduce scan.
+    sstable_fused_agg: bool = True
 
     # core behavior (names mirror the reference's system properties)
     auto_create_metrics: bool = False   # tsd.core.auto_create_metrics

@@ -151,6 +151,235 @@ def write_artifacts(out: dict) -> None:
             f"(this run in {os.path.basename(suffixed)})")
 
 
+def run_codec_compare(args) -> int:
+    """BENCH_COMPRESS.json: the TSST4 acceptance legs. Two identical
+    corpora (time-major, mid-run checkpoints) differing ONLY in
+    Config.sstable_codec; per leg: ingest dps, on-disk footprint after
+    the final checkpoint (+ per-format byte mix and the record-section
+    compression ratio), cold 1-week 1h-downsample dashboard, warm
+    repeats; on the tsst4 leg additionally the downsample battery
+    fused (served plan, decode-plus-aggregate on the blocks) vs
+    decode-then-reduce (sstable_fused_agg off -> classic scan), with a
+    byte-identical answer check per spec."""
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                   capture_output=True)
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/jax_comp"))
+    except Exception:
+        pass
+    dev = jax.devices()[0]
+    log(f"device: {dev}")
+
+    from opentsdb_tpu.core.tsdb import TSDB
+    from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+    from opentsdb_tpu.storage.kv import MemKVStore
+    from opentsdb_tpu.storage.sharded import ShardedKVStore
+    from opentsdb_tpu.utils.config import Config
+    from opentsdb_tpu.utils.gctune import tune_for_ingest
+    from opentsdb_tpu.utils.nativeext import ext as native_ext
+
+    shards = max(args.shards, 1)
+    base = 1356998400
+    pps = max(args.points // args.series, 1)
+    step = max(args.span // pps, 1)
+    block = min(args.block, pps)
+    end = base + pps * step
+    ckpt_every = args.checkpoint_every or max(args.points // 20, 1)
+    out = {"device": str(dev), "points": args.points,
+           "series": args.series, "step_s": step, "shards": shards,
+           "checkpoint_every": ckpt_every,
+           "native_ext": native_ext is not None,
+           "host": {"cores": os.cpu_count(),
+                    "ram_gb": round(os.sysconf("SC_PAGE_SIZE")
+                                    * os.sysconf("SC_PHYS_PAGES")
+                                    / (1 << 30))},
+           "legs": {}}
+
+    battery = [
+        ("1week_1h_sumavg", 7 * 86400, "sum", (3600, "avg")),
+        ("1week_1h_maxmax", 7 * 86400, "max", (3600, "max")),
+        ("1week_1h_sumsum", 7 * 86400, "sum", (3600, "sum")),
+        ("1week_1h_zimsum_count", 7 * 86400, "zimsum", (3600, "count")),
+        ("1week_1h_p95", 7 * 86400, "p95", (3600, "avg")),
+        ("1day_1h_sumavg", 86400, "sum", (3600, "avg")),
+    ]
+
+    def build_leg(codec: str) -> dict:
+        wd = os.path.join(args.workdir, f"codec-{codec}")
+        shutil.rmtree(wd, ignore_errors=True)
+        os.makedirs(wd)
+        cfg = Config(auto_create_metrics=True, wal_path=wd,
+                     shards=shards, sstable_codec=codec,
+                     enable_sketches=False, device_window=False)
+        store = (ShardedKVStore(wd, shards=shards) if shards > 1
+                 else MemKVStore(wal_path=os.path.join(wd, "wal")))
+        tsdb = TSDB(store, cfg, start_compaction_thread=False)
+        tune_for_ingest()
+        rng = np.random.default_rng(7)
+        phase = rng.integers(0, max(step - 1, 1), size=args.series)
+        tags = [{"host": f"h{si:04d}"} for si in range(args.series)]
+        leg: dict = {"codec": codec}
+        total = 0
+        next_ckpt = ckpt_every
+        ckpt_s = 0.0
+        t0 = time.perf_counter()
+        synth_s = 0.0
+        last_log = t0
+        for boff in range(0, pps, block):
+            bn = min(block, pps - boff)
+            ts0 = time.perf_counter()
+            rel = (boff + np.arange(bn, dtype=np.int64)) * step
+            template = (np.cumsum(
+                rng.normal(0, 1, bn).astype(np.float32)) + 100.0)
+            blocks = [(base + rel + phase[si],
+                       template + np.float32(si))
+                      for si in range(args.series)]
+            synth_s += time.perf_counter() - ts0
+            for si in range(args.series):
+                ts, vals = blocks[si]
+                total += tsdb.add_batch("scale.metric", ts, vals,
+                                        tags[si])
+                if total >= next_ckpt:
+                    tc = time.perf_counter()
+                    tsdb.checkpoint()
+                    ckpt_s += time.perf_counter() - tc
+                    next_ckpt = total + ckpt_every
+            now = time.perf_counter()
+            if now - last_log > 30:
+                log(f"  [{codec}] {total:,} pts, "
+                    f"{total / (now - t0):,.0f} dps, "
+                    f"rss {rss_gb():.1f} GB")
+                last_log = now
+        tc = time.perf_counter()
+        tsdb.checkpoint()
+        ckpt_s += time.perf_counter() - tc
+        wall = time.perf_counter() - t0
+        leg["ingest"] = {
+            "points": total, "wall_s": round(wall, 1),
+            "dps": round(total / wall),
+            "dps_ex_synth": round(total / max(wall - synth_s, 1e-9)),
+            "checkpoint_s": round(ckpt_s, 1)}
+        leg["dir_bytes"] = du(wd)
+        fmt = {f"v{k}": v for k, v in
+               sorted(tsdb.store.sstable_format_bytes().items())}
+        leg["sstable_bytes_by_format"] = fmt
+        raw, enc = tsdb.store.compress_stats()
+        leg["compress_ratio"] = (round(raw / enc, 3) if enc else None)
+        log(f"  [{codec}] ingest {leg['ingest']}")
+        log(f"  [{codec}] dir {leg['dir_bytes'] / (1 << 30):.2f} GB, "
+            f"formats {fmt}, ratio {leg['compress_ratio']}")
+        return leg, tsdb
+
+    def query_leg(tsdb, leg: dict, codec: str) -> None:
+        from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+        ex = QueryExecutor(tsdb, backend="tpu")
+        lo, hi = end - 7 * 86400, end
+        spec = QuerySpec("scale.metric", {}, "sum",
+                         downsample=(3600, "avg"))
+        # jit/uid warm on a shifted range, then cold = first pass over
+        # the target range through the SERVED plan (fused on tsst4,
+        # raw scan on the control), then 3 warm repeats.
+        ex.run(spec, lo - 7 * 86400, hi - 7 * 86400)
+        t0 = time.perf_counter()
+        r_cold, plan, _ = ex.run_with_plan(spec, lo, hi)
+        t_cold = time.perf_counter() - t0
+        warms = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            ex.run(spec, lo, hi)
+            warms.append(time.perf_counter() - t0)
+        leg["cold_1week_scan_s"] = round(t_cold, 4)
+        leg["cold_plan"] = plan
+        leg["warm_dashboard_s"] = round(sorted(warms)[1], 4)
+        leg["warm_all_s"] = [round(w, 4) for w in warms]
+        log(f"  [{codec}] cold 1week {t_cold:.3f}s (plan={plan}), "
+            f"warm {leg['warm_dashboard_s']:.3f}s")
+
+    # Control leg.
+    leg_none, tsdb_none = build_leg("none")
+    query_leg(tsdb_none, leg_none, "none")
+    out["legs"]["none"] = leg_none
+    tsdb_none.shutdown()
+
+    # Compressed leg (+ fused battery).
+    leg_c, tsdb_c = build_leg("tsst4")
+    query_leg(tsdb_c, leg_c, "tsst4")
+    ex = QueryExecutor(tsdb_c, backend="tpu")
+    batt = {}
+    lo_all = end - 7 * 86400
+    for label, span, agg, ds in battery:
+        spec = QuerySpec("scale.metric", {}, agg, downsample=ds)
+        lo = end - span
+        ex.run(spec, lo - span, end - span)       # warm jit
+        t0 = time.perf_counter()
+        r_f, plan_f, _ = ex.run_with_plan(spec, lo, end)
+        t_fused = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r_f2 = ex.run(spec, lo, end)
+        t_fused_warm = time.perf_counter() - t0
+        tsdb_c.config.sstable_fused_agg = False
+        ex.run(spec, lo - span, end - span)       # warm raw jit
+        ex._frag_cache.clear()
+        t0 = time.perf_counter()
+        r_r, plan_r, _ = ex.run_with_plan(spec, lo, end)
+        t_raw = time.perf_counter() - t0
+        tsdb_c.config.sstable_fused_agg = True
+        # Identical bucket grids; values to f32 tolerance (the
+        # devwindow-plan contract — an alternate exact execution plan
+        # may reassociate float32 group sums by an ulp).
+        same = (len(r_f) == len(r_r) and all(
+            np.array_equal(a.timestamps, b.timestamps)
+            and np.allclose(a.values, b.values, rtol=1e-5, atol=1e-5)
+            for a, b in zip(r_f, r_r)))
+        batt[label] = {
+            "fused_s": round(t_fused, 4),
+            "fused_warm_s": round(t_fused_warm, 4),
+            "decode_then_reduce_s": round(t_raw, 4),
+            "speedup": round(t_raw / max(t_fused, 1e-9), 2),
+            "plan_fused": plan_f, "plan_raw": plan_r,
+            "answers_match": bool(same)}
+        log(f"  fused {label}: {t_fused:.3f}s (plan={plan_f}) vs "
+            f"decode-then-reduce {t_raw:.3f}s (x"
+            f"{batt[label]['speedup']}, match={same})")
+    leg_c["fused_battery"] = batt
+    out["legs"]["tsst4"] = leg_c
+    tsdb_c.shutdown()
+
+    out["footprint_reduction"] = round(
+        leg_none["dir_bytes"] / max(leg_c["dir_bytes"], 1), 3)
+    out["cold_scan_ratio_vs_control"] = round(
+        leg_c["cold_1week_scan_s"]
+        / max(leg_none["cold_1week_scan_s"], 1e-9), 3)
+    suffixed = os.path.join(
+        REPO, f"BENCH_COMPRESS_{args.points // 1_000_000}M"
+              f"_S{shards}.json")
+    with open(suffixed, "w") as f:
+        json.dump(out, f, indent=2)
+    canonical = os.path.join(REPO, "BENCH_COMPRESS.json")
+    prev_pts = -1
+    try:
+        with open(canonical) as f:
+            prev_pts = json.load(f)["points"]
+    except Exception:
+        pass
+    if args.points >= prev_pts:
+        with open(canonical, "w") as f:
+            json.dump(out, f, indent=2)
+    else:
+        log(f"clobber guard: BENCH_COMPRESS.json records {prev_pts:,} "
+            f"points; this run kept in {os.path.basename(suffixed)}")
+    log(f"footprint reduction {out['footprint_reduction']}x, cold "
+        f"scan ratio {out['cold_scan_ratio_vs_control']}")
+    print(json.dumps({"footprint_reduction": out["footprint_reduction"],
+                      "cold_scan_ratio":
+                          out["cold_scan_ratio_vs_control"]}))
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--points", type=int, default=1_000_000_000)
@@ -189,9 +418,23 @@ def main() -> int:
                          "vs the legacy full memtable-key sweep). "
                          "Writes _Q-suffixed scale artifacts so plain "
                          "runs are never clobbered")
+    ap.add_argument("--codec", default=None, choices=("tsst4",),
+                    help="run the compressed-columnar comparison "
+                         "instead of the plain scale run: build the "
+                         "corpus TWICE (sstable_codec=none control, "
+                         "then tsst4), measure on-disk footprint, "
+                         "ingest dps, cold 1-week scan, warm "
+                         "dashboard, and the fused decode-aggregate "
+                         "vs decode-then-reduce downsample battery; "
+                         "writes BENCH_COMPRESS.json (+ a size-"
+                         "suffixed _C artifact — plain scale "
+                         "artifacts are never touched)")
     ap.add_argument("--workdir", default="/tmp/tsdb_scale")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
+
+    if args.codec:
+        return run_codec_compare(args)
 
     # Native hot loops (gitignored artifact) before any package import.
     subprocess.run(["make", "-C", os.path.join(REPO, "native")],

@@ -61,6 +61,11 @@ def main(argv=None) -> int:
     p.add_argument("--shards", type=int, default=1)
     p.add_argument("--no-rollups", action="store_true")
     p.add_argument("--delete-heavy", action="store_true")
+    p.add_argument("--codec", default="none",
+                   choices=("none", "tsst4"),
+                   help="write-side sstable codec for the ad-hoc "
+                        "scenario's workload (sst.write.block sites "
+                        "need tsst4 spills to be reachable)")
     p.add_argument("--bug", default=None,
                    help="deliberately re-introduce a historical bug in "
                         "the child (harness.BUGS) — for harness "
@@ -79,7 +84,8 @@ def main(argv=None) -> int:
             label=f"adhoc-{args.site.replace('.', '-')}-{args.mode}",
             site=args.site, mode=args.mode, skip=args.skip,
             shards=args.shards, rollups=not args.no_rollups,
-            delete_heavy=args.delete_heavy, bug=args.bug)]
+            delete_heavy=args.delete_heavy, bug=args.bug,
+            codec=args.codec)]
     else:
         scens = (harness.fast_matrix() if args.fast
                  else harness.build_matrix())

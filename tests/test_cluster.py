@@ -820,6 +820,13 @@ class TestMultiWriterRouter:
             for r in top["replicas"]:
                 assert {"url", "healthy", "ejected", "stale",
                         "lag_ms", "hop_p95_ms"} <= set(r)
+            # The browser view over the same feed (the ROADMAP "Web UI
+            # depth" remainder): self-contained HTML that polls
+            # /api/topology client-side.
+            status, body = await _http(clu.router.port, "/topology")
+            assert status == 200
+            assert b"Cluster topology" in body
+            assert b"/api/topology" in body
             return True
 
         assert _run_cluster(clu, drive)

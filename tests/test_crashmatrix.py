@@ -30,12 +30,12 @@ class TestMatrixShape:
                      "kv.checkpoint.commit", "sst.write.body",
                      "sharded.spill.shard", "rollup.fold.start",
                      "rollup.bracket.flip", "replica.refresh",
-                     "sst.write.footer"):
+                     "sst.write.footer", "sst.write.block"):
             assert want in sites, f"matrix lost coverage of {want}"
 
     def test_fast_subset_resolves(self):
         fast = harness.fast_matrix()
-        assert len(fast) == len(harness.FAST_LABELS) == 9
+        assert len(fast) == len(harness.FAST_LABELS) == 10
 
 
 class TestFastSubset:
