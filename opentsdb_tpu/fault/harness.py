@@ -99,6 +99,11 @@ class Scenario:
     # their faultpoint; verification reopens with the same codec so
     # post-crash checkpoints re-exercise the compressed writers.
     codec: str = "none"
+    # Incremental-catch-up parity row: verification additionally
+    # reopens a pristine copy of the crashed store with
+    # rollup_incremental_catchup=False (the legacy full rebuild) and
+    # demands bit-identical rollup answers from both recovery paths.
+    catchup_compare: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -224,7 +229,7 @@ def open_store(dirpath: str, shards: int, read_only: bool = False):
 
 
 def open_tsdb(dirpath: str, shards: int, rollups: bool,
-              codec: str = "none") -> TSDB:
+              codec: str = "none", incremental: bool = True) -> TSDB:
     """Writer TSDB with the harness profile: cpu backend, sketches and
     device window off (the child must stay jax-free), compactions off
     and no background threads (schedule determinism), rollup catch-up
@@ -235,6 +240,7 @@ def open_tsdb(dirpath: str, shards: int, rollups: bool,
         auto_create_metrics=True, enable_compactions=False,
         enable_sketches=False, device_window=False,
         enable_rollups=rollups, rollup_catchup="sync",
+        rollup_incremental_catchup=incremental,
         sstable_codec=codec,
         # Sub-day sketch columns so the 1h resolution carries digests
         # too (more fold surface for the crash sites to land in).
@@ -299,6 +305,20 @@ def _apply_bug(bug: str) -> None:
     if bug == "torn-bracket":
         from opentsdb_tpu.rollup.tier import RollupTier
         RollupTier.begin_spill = lambda self: None
+        # The fold side defensively re-persists the bracket before
+        # draining spill keys (the peek-persist that makes the
+        # bracket self-healing); a faithful reintroduction of the
+        # bug class must tear BOTH writers of the pending marker, or
+        # the defense quietly repairs the sabotage and the gate goes
+        # vacuously green.
+        orig_write = RollupTier._write_state
+
+        def torn_write(self, pending, inflight=None):
+            if pending:
+                return  # the bracket never opens
+            orig_write(self, pending)
+
+        RollupTier._write_state = torn_write
     else:
         raise ValueError(f"unknown --bug {bug!r} (one of {BUGS})")
 
@@ -512,12 +532,82 @@ def _check_replica(dirpath: str, sc: Scenario, tsdb: TSDB) -> list[str]:
     return problems
 
 
+def _check_catchup_parity(dirpath: str, sc: Scenario, tsdb: TSDB,
+                          oracle) -> list[str]:
+    """Parity of the two crash-recovery paths: the control copy of the
+    crashed store (made BEFORE the primary reopen) recovers with the
+    legacy FULL rebuild, then both engines must give bit-identical
+    rollup-served answers for the whole battery."""
+    from opentsdb_tpu.query.executor import QueryExecutor
+    ctl_dir = dirpath + "-fullctl"
+    if not os.path.isdir(ctl_dir):
+        return ["catchup_compare set but no control copy was made"]
+    problems: list[str] = []
+    try:
+        ctl = open_tsdb(ctl_dir, sc.shards, sc.rollups,
+                        codec=sc.codec, incremental=False)
+    except Exception as e:
+        return [f"full-rebuild control reopen failed: {e!r}"]
+    try:
+        ctl.checkpoint()   # same post-crash fold the primary ran
+        bounds = oracle.bounds()
+        if bounds is None:
+            return problems
+        lo, hi = bounds
+        hi = max(hi, lo + 1)
+        ex_i = QueryExecutor(tsdb, backend="cpu")
+        ex_f = QueryExecutor(ctl, backend="cpu")
+        for spec in _query_specs():
+            try:
+                ri, plan_i, _ = ex_i.run_with_plan(spec, lo, hi)
+                rf, plan_f, _ = ex_f.run_with_plan(spec, lo, hi)
+            except NoSuchUniqueName:
+                continue
+            except Exception as e:
+                problems.append(f"catchup-compare query "
+                                f"{spec.aggregator} failed: {e!r}")
+                continue
+            if plan_i != plan_f:
+                problems.append(
+                    f"catchup-compare {spec.aggregator}/"
+                    f"{spec.downsample}: plans diverge "
+                    f"(incr={plan_i} full={plan_f})")
+                continue
+            k_i = {tuple(sorted(r.tags.items())): r for r in ri}
+            k_f = {tuple(sorted(r.tags.items())): r for r in rf}
+            if set(k_i) != set(k_f):
+                problems.append(f"catchup-compare {spec.aggregator}: "
+                                f"group sets diverge")
+                continue
+            for gk, a in k_i.items():
+                b = k_f[gk]
+                if not (np.array_equal(a.timestamps, b.timestamps)
+                        and np.array_equal(a.values, b.values)):
+                    problems.append(
+                        f"catchup-compare {spec.aggregator}/"
+                        f"{spec.downsample} group={dict(gk)}: "
+                        f"incremental != full-rebuild answer")
+    finally:
+        try:
+            ctl.shutdown()
+        except Exception as e:
+            problems.append(f"control shutdown failed: {e!r}")
+    return problems
+
+
 def verify(dirpath: str, sc: Scenario, ops: list[tuple],
            ops_done: int) -> tuple[list[str], str]:
     """Reopen after the crash and check every invariant. Returns
     (problems, oracle state hash)."""
     from opentsdb_tpu.tools.fsck import run_fsck
     problems: list[str] = []
+    if sc.catchup_compare:
+        # Snapshot the crashed store BEFORE the primary reopen
+        # mutates it: the full-rebuild control must recover from the
+        # same bytes the incremental path saw.
+        import shutil as _sh
+        _sh.copytree(dirpath, dirpath + "-fullctl",
+                     dirs_exist_ok=True)
     try:
         tsdb = open_tsdb(dirpath, sc.shards, sc.rollups,
                          codec=sc.codec)
@@ -557,6 +647,9 @@ def verify(dirpath: str, sc: Scenario, ops: list[tuple],
             tsdb.checkpoint()
             problems += _check_query_parity(tsdb, oracle,
                                             require_rollup=True)
+        if sc.rollups and sc.catchup_compare:
+            problems += _check_catchup_parity(dirpath, sc, tsdb,
+                                              oracle)
         return problems, oracle.state_hash()
     except Exception as e:  # verification machinery itself broke
         import traceback
@@ -867,6 +960,7 @@ FAST_LABELS = (
     "rollup-foldstart-crash-s1",
     "rollup-flip-crash-s1",
     "rollup-folddel-crash-s1",
+    "rollup-foldflush-incrcmp-s1",
     "shard-join-crash-k2",
 )
 
@@ -934,6 +1028,18 @@ def build_matrix() -> list[Scenario]:
         # class (zero records vs surviving coarse windows).
         add(f"rollup-folddel-crash-{t}", "rollup.fold.flush", "crash",
             delete_heavy=True, **{**c, "seed": 77 + shards})
+        # Incremental-catch-up parity rows (ROADMAP "Rollup
+        # incremental catch-up"): the crash lands between spill and
+        # fold commit, the reopen refolds ONLY the persisted inflight
+        # windows, and the verify additionally reopens a pristine
+        # copy with the legacy FULL rebuild — both recovery paths
+        # must give bit-identical rollup answers.
+        add(f"rollup-foldflush-incrcmp-{t}", "rollup.fold.flush",
+            "crash", catchup_compare=True,
+            **{**c, "seed": 4100 + shards})
+        add(f"rollup-folddel-incrcmp-{t}", "rollup.fold.flush",
+            "crash", delete_heavy=True, catchup_compare=True,
+            **{**c, "seed": 4200 + shards})
     # Partial cross-shard spills: crash after exactly k of 4 shards.
     for k in (1, 2, 3):
         add(f"shard-join-crash-k{k}", "sharded.spill.shard", "crash",

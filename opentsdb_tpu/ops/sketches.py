@@ -198,6 +198,65 @@ def hll_estimate(registers: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Moment sketches (power sums; arXiv:1803.01969)
+# ---------------------------------------------------------------------------
+
+DEFAULT_MOMENT_K = 8
+
+
+def moment_init(k: int = DEFAULT_MOMENT_K):
+    """Empty moment state: (count, min, max, moments[k]). Exactly
+    mergeable — fold and merge are pure additions/extrema, so unlike
+    the t-digest there is no compression error to accumulate."""
+    return (jnp.zeros((), jnp.float32), jnp.full((), jnp.inf),
+            jnp.full((), -jnp.inf), jnp.zeros(k, jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def moment_add(count, vmin, vmax, moments, values, valid, *,
+               k: int = DEFAULT_MOMENT_K):
+    """Fold a (padded) batch into the moment state: one vectorized
+    cumulative-product pass builds x^1..x^k for every point, masked
+    sums add them in — the batched device sibling of the host fold
+    (sketch/moment.py; the rollup spill path runs the host twin —
+    this kernel is for device-side aggregation pipelines). Padded
+    lanes are neutralized BEFORE the power ladder: a large pad value
+    would overflow to inf and inf * 0 poisons the sums with NaN.
+    float32 dynamic range bounds |x|^k — at the default k=8, values
+    beyond ~6e4 overflow; pre-scale such feeds (the host twin is
+    float64)."""
+    v = jnp.where(valid, values.astype(jnp.float32), 1.0)
+    ok = valid.astype(jnp.float32)
+    powers = jnp.cumprod(
+        jnp.broadcast_to(v, (k, v.shape[0])), axis=0)     # [k, N]
+    vv = values.astype(jnp.float32)
+    return (count + ok.sum(),
+            jnp.minimum(vmin, jnp.where(valid, vv, jnp.inf).min()),
+            jnp.maximum(vmax, jnp.where(valid, vv, -jnp.inf).max()),
+            moments + (powers * ok[None, :]).sum(axis=1))
+
+
+@jax.jit
+def moment_merge(count_a, vmin_a, vmax_a, mom_a,
+                 count_b, vmin_b, vmax_b, mom_b):
+    """Merge two moment states — pure addition (associative AND
+    exact), so cross-shard fan-in is a psum."""
+    return (count_a + count_b, jnp.minimum(vmin_a, vmin_b),
+            jnp.maximum(vmax_a, vmax_b), mom_a + mom_b)
+
+
+@jax.jit
+def moment_fold_windows(states):
+    """Batched read-side fold: [W, D] per-window moment rows (count,
+    min, max, moments...) reduce to one merged row — the addition
+    fold the planner's bucket merge uses (min/max columns fold by
+    extremum, everything else by sum)."""
+    total = states.sum(axis=0)
+    return total.at[1].set(states[:, 1].min()).at[2].set(
+        states[:, 2].max())
+
+
+# ---------------------------------------------------------------------------
 # Numpy oracles (for tests)
 # ---------------------------------------------------------------------------
 

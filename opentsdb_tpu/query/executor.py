@@ -163,6 +163,11 @@ class QueryExecutor:
         # dropped generation; eligibility (dirty range, format mix) is
         # re-checked per query — only the decode+stage compute caches.
         self._fused_stage_cache = LRUCache(4)
+        # Approx-serving rail cache (sketch/serving.py): per-series
+        # (bucket_ts, est, lo, hi) rails for CLEAN fully-window-
+        # covered percentile ranges, revalidated against the tier's
+        # fold/refresh stamps. Cost = cached buckets.
+        self._sketch_rail_cache = LRUCache(16, max_cost=1 << 22)
         self.qcache_hits = 0
         self.qcache_misses = 0
         self.qcache_bypasses = 0
@@ -482,8 +487,85 @@ class QueryExecutor:
         self.last_plan = plan
         return results, plan, cached
 
+    def run_approx(self, spec: QuerySpec, start: int, end: int,
+                   trace=None, rollup_only: bool = False,
+                   approx=None):
+        """run_with_plan under the APPROXIMATE-SERVING contract
+        (sketch/serving.py): returns ``(results, plan, cached,
+        approx_info)`` where ``approx_info`` is None for an exact
+        answer, an ``ApproxInfo`` for a sketch-served percentile
+        downsample, or a dict describing degraded stale/omitted
+        coverage (rollup-only mode over dirty windows).
+
+        ``approx`` (ApproxSpec): the caller's opt-in + relative error
+        budget. A percentile downsample serves from sketch columns
+        when the caller opted in OR the ladder degraded
+        (``rollup_only``); if the reported bound exceeds the budget,
+        the exact path runs instead — except under rollup-only, where
+        there IS no exact path and the query sheds with 503."""
+        from opentsdb_tpu.sketch.serving import ApproxSpec
+        if approx is None:
+            approx = ApproxSpec()
+        if trace is None:
+            out = self._run_approx_inner(spec, start, end,
+                                         rollup_only, approx)
+        else:
+            with obs_trace.activate(trace):
+                out = self._run_approx_inner(spec, start, end,
+                                             rollup_only, approx)
+            trace.root.tags["plan"] = out[1]
+            trace.root.tags["cached"] = bool(out[2])
+            if out[3] is not None:
+                trace.root.tags["approx"] = True
+        self.last_plan = out[1]
+        return out
+
+    def _run_approx_inner(self, spec: QuerySpec, start: int, end: int,
+                          rollup_only: bool, approx):
+        ds_pct = bool(
+            spec.downsample
+            and Aggregators.get(spec.downsample[1]).kind
+            == "percentile")
+        if ds_pct and (approx.enabled or rollup_only):
+            from opentsdb_tpu.sketch import serving as _serving
+            got = _serving.plan_percentile(self, spec, start, end,
+                                           rollup_only=rollup_only)
+            if got is not None:
+                results, res, info = got
+                if (approx.max_error is None
+                        or info.rel_error <= approx.max_error):
+                    from opentsdb_tpu.rollup.tier import res_label
+                    return (results, f"approx-{res_label(res)}",
+                            False, info)
+                _serving._M_FALLBACK.inc()
+            if rollup_only:
+                from opentsdb_tpu.core.errors import OverloadedError
+                raise OverloadedError(
+                    "shedding load: no approximate answer within the "
+                    "error budget for this percentile query; retry "
+                    "shortly", retry_after=0.5, status=503)
+        meta: dict = {}
+        results, plan, cached = self._run_planned(
+            spec, start, end, rollup_only=rollup_only, meta_out=meta)
+        info = None
+        if rollup_only and (meta.get("stale_windows")
+                            or meta.get("omitted_edges")
+                            or meta.get("missing_windows")):
+            # Rollup-only over a dirty range: stale windows were
+            # SERVED (their records reflect the last fold), edge
+            # windows omitted, never-folded dirty windows ABSENT —
+            # all declared, never silent.
+            info = {"kind": "rollup-stale",
+                    "stale_windows": int(meta.get("stale_windows", 0)),
+                    "omitted_edges": int(meta.get("omitted_edges", 0)),
+                    "missing_windows": int(
+                        meta.get("missing_windows", 0)),
+                    "error": None}
+        return results, plan, cached, info
+
     def _run_planned(self, spec: QuerySpec, start: int, end: int,
                      rollup_only: bool = False,
+                     meta_out: dict | None = None,
                      ) -> tuple[list[QueryResult], str, bool]:
         if end <= start:
             raise BadRequestError(
@@ -508,7 +590,8 @@ class QueryExecutor:
             fusedr = None
             if dev is None:
                 planned = self._plan_rollup(spec, start, end,
-                                            rollup_only=rollup_only)
+                                            rollup_only=rollup_only,
+                                            meta_out=meta_out)
             if dev is None and planned is None and rollup_only:
                 from opentsdb_tpu.core.errors import OverloadedError
                 raise OverloadedError(
@@ -554,12 +637,14 @@ class QueryExecutor:
         return results, "raw", bool(info.get("cached"))
 
     def _plan_rollup(self, spec: QuerySpec, start: int, end: int,
-                     rollup_only: bool = False):
+                     rollup_only: bool = False,
+                     meta_out: dict | None = None):
         if getattr(self.tsdb, "rollups", None) is None:
             return None
         from opentsdb_tpu.rollup import planner
         return planner.plan(self, spec, start, end,
-                            rollup_only=rollup_only)
+                            rollup_only=rollup_only,
+                            meta_out=meta_out)
 
     def _execute_groups(self, spec: QuerySpec, groups: dict,
                         start: int, end: int) -> list[QueryResult]:
@@ -573,6 +658,13 @@ class QueryExecutor:
         # rel-timestamp offsets the kernels use; the float64 oracle
         # serves them instead (they are rare and scan-bound anyway).
         use_cpu = self.backend == "cpu"
+        if not use_cpu and spec.downsample and Aggregators.get(
+                spec.downsample[1]).kind == "percentile":
+            # Percentile DOWNSAMPLERS (1h-p95) run on the float64
+            # oracle: the fused device kernels reduce moments, not
+            # per-bucket order statistics. (The approximate sketch
+            # path is the fast answer; this is the exact one.)
+            use_cpu = True
         if not use_cpu:
             qbase = (start - start % spec.downsample[0]
                      if spec.downsample else start)
@@ -614,7 +706,9 @@ class QueryExecutor:
         dw = getattr(self.tsdb, "devwindow", None)
         if (dw is None or self.backend == "cpu" or self.mesh is not None
                 or not spec.downsample
-                or agg.kind not in ("moment", "percentile")):
+                or agg.kind not in ("moment", "percentile")
+                or Aggregators.get(spec.downsample[1]).kind
+                != "moment"):
             return None
         interval, dsagg = spec.downsample
         qbase = start - start % interval
@@ -866,6 +960,7 @@ class QueryExecutor:
         if (self.backend == "cpu" or self.mesh is not None
                 or not spec.downsample
                 or agg.kind not in ("moment", "percentile")
+                or Aggregators.get(spec.downsample[1]).kind != "moment"
                 or not getattr(cfg, "sstable_fused_agg", True)):
             return None
         store = tsdb.store
@@ -1399,7 +1494,8 @@ class QueryExecutor:
 
     def sketch_quantiles(self, metric: str, tags: dict[str, str],
                          qs: list[float], start: int | None = None,
-                         end: int | None = None) -> dict:
+                         end: int | None = None,
+                         max_error: float | None = None) -> dict:
         """Quantiles of the matching series' merged value distribution.
 
         Without a range: the streaming path — merged per-series
@@ -1417,7 +1513,7 @@ class QueryExecutor:
                 raise BadRequestError(
                     "sketch range needs both start and end (end > start)")
             return self._sketch_quantiles_range(metric, tags, qs,
-                                                start, end)
+                                                start, end, max_error)
         sk = self.tsdb.sketches
         if sk is None:
             raise BadRequestError(
@@ -1433,15 +1529,15 @@ class QueryExecutor:
 
     def _sketch_quantiles_range(self, metric: str, tags: dict[str, str],
                                 qs: list[float], start: int,
-                                end: int) -> dict:
+                                end: int,
+                                max_error: float | None = None) -> dict:
         from opentsdb_tpu.rollup import planner as rplanner
         from opentsdb_tpu.rollup import summary as rsummary
         from opentsdb_tpu.rollup.tier import res_label
+        from opentsdb_tpu.sketch import bounds as _sbounds
+        from opentsdb_tpu.sketch.moment import MomentSketch
 
-        tier = getattr(self.tsdb, "rollups", None)
-        sel = rplanner.sketch_windows(self, tier, metric, tags,
-                                      start, end)
-        if sel is None:
+        def exact_raw() -> dict:
             # Exact raw fallback: pool every in-range value.
             spec = QuerySpec(metric, tags)
             groups = self._find_spans(spec, start, end)
@@ -1459,40 +1555,104 @@ class QueryExecutor:
                     "rollup": "raw",
                     "quantiles": {f"{q:g}": float(v)
                                   for q, v in zip(qs, est)}}
+
+        tier = getattr(self.tsdb, "rollups", None)
+        sel = rplanner.sketch_windows(self, tier, metric, tags,
+                                      start, end)
+        if sel is None:
+            return exact_raw()
         res, records, raw_parts, dirty = sel
+        digest_k = tier.sketch_kinds(res)[0]
+        kind = "tdigest" if digest_k else "moment"
         means: list[np.ndarray] = []
         weights: list[np.ndarray] = []
+        msk: MomentSketch | None = None
+        vmin, vmax = np.inf, -np.inf
+        # Pooled-CDF rank uncertainty: each contributing window
+        # digest's heaviest centroid weight (bounds.py
+        # cdf_uncertainty_w); raw points contribute zero.
+        unc = 0.0
         # Series counted by CONTRIBUTION (digest or raw values), not by
         # which map they appear in: a series whose rollup windows are
         # all dirty contributes only through raw_parts but is still in
         # records, so map-membership tests undercount it.
         contributing: set[bytes] = set()
         for skey, (bases, recs, sketches) in records.items():
+            wstats = {int(b): (float(r["min"]), float(r["max"]))
+                      for b, r in zip(bases, recs)}
             for wb, blob in sketches:
                 if wb in dirty:
                     continue
-                m, w, _ = rsummary.sketch_decode(blob)
-                if len(m):
+                m, w, _r, mblob = rsummary.sketch_decode_full(blob)
+                got = False
+                if kind == "tdigest" and len(m):
                     means.append(m.astype(np.float64))
                     weights.append(w.astype(np.float64))
+                    unc += float(np.max(w))
+                    got = True
+                elif kind == "moment" and mblob is not None:
+                    ms = MomentSketch.decode(mblob)
+                    msk = ms if msk is None else msk.merge(ms)
+                    got = True
+                if got:
                     contributing.add(skey)
+                    lo, hi = wstats.get(int(wb), (np.inf, -np.inf))
+                    vmin, vmax = min(vmin, lo), max(vmax, hi)
         for skey, (ts, vals) in raw_parts.items():
             if len(vals):
-                means.append(vals.astype(np.float32).astype(np.float64))
-                weights.append(np.ones(len(vals)))
+                v32 = vals.astype(np.float32).astype(np.float64)
+                if kind == "tdigest":
+                    means.append(v32)
+                    weights.append(np.ones(len(vals)))
+                else:
+                    add = MomentSketch(
+                        msk.k if msk is not None else
+                        MomentSketch().k).add(v32)
+                    msk = add if msk is None else msk.merge(add)
                 contributing.add(skey)
-        if not means:
-            raise BadRequestError(
-                f"no data for metric {metric} in range")
-        m = np.concatenate(means)
-        w = np.concatenate(weights)
-        if len(m) > (1 << 16):
-            m, w = rsummary.digest_compress(m, w, 4096)
-        est = rsummary.digest_quantile(m, w, qs)
+                vmin = min(vmin, float(v32.min()))
+                vmax = max(vmax, float(v32.max()))
+        if kind == "tdigest" and not means:
+            return exact_raw()
+        if kind == "moment" and (msk is None or msk.count <= 0):
+            return exact_raw()
+        # Estimates + per-quantile enclosures (the error contract).
+        ests, errs = [], {}
+        rel_worst = 0.0
+        if kind == "tdigest":
+            m = np.concatenate(means)
+            w = np.concatenate(weights)
+            if len(m) > (1 << 16):
+                m, w = rsummary.digest_compress(m, w, 4096)
+                # The recompression adds its own within-centroid
+                # uncertainty on top of the pooled windows'.
+                unc += float(np.max(w))
+            for q in qs:
+                qb = _sbounds.tdigest_quantile_bound(
+                    m, w, q, vmin=vmin, vmax=vmax,
+                    cdf_uncertainty_w=unc)
+                ests.append(qb.est)
+                errs[f"{q:g}"] = qb.error
+                rel_worst = max(rel_worst,
+                                qb.error / max(abs(qb.est), 1e-12))
+        else:
+            for q in qs:
+                qb = _sbounds.moment_quantile_bound(msk, q)
+                ests.append(qb.est)
+                errs[f"{q:g}"] = qb.error
+                rel_worst = max(rel_worst,
+                                qb.error / max(abs(qb.est), 1e-12))
+        if max_error is not None and rel_worst > max_error:
+            # The caller's budget is tighter than the sketch can
+            # promise: serve exact instead (slower, never wrong).
+            return exact_raw()
         return {"metric": metric, "series": len(contributing),
                 "rollup": res_label(res),
                 "quantiles": {f"{q:g}": float(v)
-                              for q, v in zip(qs, est)}}
+                              for q, v in zip(qs, ests)},
+                "approx": {"kind": kind, "error": errs,
+                           "rel_error": rel_worst,
+                           "res": res_label(res)}}
 
     def sketch_distinct(self, metric: str, tagk: str,
                         start: int | None = None,
@@ -1575,9 +1735,14 @@ class QueryExecutor:
         from opentsdb_tpu.rollup.tier import res_label
 
         tier = getattr(self.tsdb, "rollups", None)
+        # want_hll: only HLL-bearing resolutions may serve a
+        # distinct-VALUES estimate — a moment-only rung's cells carry
+        # no registers, and folding none of them would return a
+        # confident undercount.
         sel = rplanner.sketch_windows(self, tier, metric, tags,
-                                      start, end)
-        hll_p = getattr(self.tsdb.config, "rollup_hll_p", 0)
+                                      start, end, want_hll=True)
+        hll_p = (tier.sketch_kinds(sel[0])[2]
+                 if sel is not None else 0)
         if sel is None or not hll_p:
             spec = QuerySpec(metric, tags)
             groups = self._find_spans(spec, start, end)
@@ -1602,9 +1767,12 @@ class QueryExecutor:
             if len(vals):
                 rsummary.hll_update(
                     regs, vals.astype(np.float32).view(np.uint32))
+        from opentsdb_tpu.sketch.bounds import hll_error
+        est = int(round(rsummary.hll_estimate(regs)))
         return {"metric": metric, "rollup": res_label(res),
-                "distinct_values": int(round(
-                    rsummary.hll_estimate(regs)))}
+                "distinct_values": est,
+                "approx": {"kind": "hll",
+                           "error": hll_error(hll_p, est)}}
 
     # ------------------------------------------------------------------
     # Cardinality (distinct tag values)

@@ -83,9 +83,15 @@ def parse_m(expr: str) -> ParsedMetric:
             interval_s, _, ds_agg = part.partition("-")
             interval = parse_duration(interval_s)
             _validate_agg(ds_agg)
-            if not Aggregators.is_moment(ds_agg):
+            # Moment downsamplers (the classic set) plus percentile
+            # downsamplers (``1h-p95``): the latter serve exactly via
+            # the float64 oracle, or approximately from rollup sketch
+            # columns under the error contract (sketch/serving.py).
+            kind = Aggregators.get(ds_agg).kind
+            if kind not in ("moment", "percentile"):
                 raise BadRequestError(
-                    f"downsampler must be a moment aggregator: {ds_agg}")
+                    f"downsampler must be a moment or percentile "
+                    f"aggregator: {ds_agg}")
             downsample = (interval, ds_agg)
         else:
             raise BadRequestError(f"Invalid query part: {part} in m={expr}")

@@ -68,12 +68,15 @@ def window_split(start: int, end: int, res: int):
 
 
 def plan(executor, spec, start: int, end: int,
-         rollup_only: bool = False):
+         rollup_only: bool = False, meta_out: dict | None = None):
     """``rollup_only`` (load shedding's degraded step): serve from the
-    tier records alone — no raw stitching, no mostly-dirty bailout —
-    omitting dirty/edge windows instead of scanning them. The caller
-    tags such results degraded; queries the tier can't serve at all
-    still return None (the executor turns that into 503)."""
+    tier records alone — no raw stitching, no mostly-dirty bailout.
+    Dirty windows serve their STALE records (their summaries reflect
+    the last fold; ``meta_out['stale_windows']`` counts them) and the
+    partial edge windows are omitted (``meta_out['omitted_edges']``)
+    — the caller tags such results degraded AND reports the coverage,
+    never a silent partial. Queries the tier can't serve at all still
+    return None (the executor turns that into 503)."""
     tsdb = executor.tsdb
     tier = getattr(tsdb, "rollups", None)
     if tier is None:
@@ -112,6 +115,7 @@ def plan(executor, spec, start: int, end: int,
 
     dirty_arr = (np.fromiter(dirty_set, np.int64, len(dirty_set))
                  if dirty_set else None)
+    stale_served: set[int] = set()
     groups: dict[tuple, list] = {}
     for skey in sorted(set(records) | set(raw_parts)):
         bases_list, recs_list = [], []
@@ -119,8 +123,18 @@ def plan(executor, spec, start: int, end: int,
         if hit is not None:
             bases, recs, _ = hit
             if dirty_arr is not None:
-                keep = ~np.isin(bases, dirty_arr)
-                bases, recs = bases[keep], recs[keep]
+                if rollup_only:
+                    # Degraded: dirty windows' records SERVE (stale —
+                    # they reflect the last fold) instead of being
+                    # silently dropped; the count is reported so the
+                    # caller can declare the coverage.
+                    stale = np.isin(bases, dirty_arr)
+                    if stale.any():
+                        stale_served.update(
+                            int(b) for b in bases[stale])
+                else:
+                    keep = ~np.isin(bases, dirty_arr)
+                    bases, recs = bases[keep], recs[keep]
             if len(bases):
                 bases_list.append(bases)
                 recs_list.append(recs)
@@ -147,6 +161,13 @@ def plan(executor, spec, start: int, end: int,
         groups.setdefault(gkey, []).append(
             _Span(skey, named, bts, bvals))
     tier.note_hit(res)
+    if meta_out is not None and rollup_only:
+        meta_out["stale_windows"] = len(stale_served)
+        meta_out["omitted_edges"] = len(
+            window_split(start, end, res)[2])
+        # Dirty windows NO fold has ever recorded (a fresh hour):
+        # their buckets are absent — declared, never silent.
+        meta_out["missing_windows"] = len(dirty_set - stale_served)
     # The spans are already per-bucket values at bucket-start
     # timestamps: re-downsampling with 'sum' is the identity (one
     # value per bucket), so the whole group stage — interpolation,
@@ -187,7 +208,8 @@ def _scan_raw_parts(executor, metric_uid: bytes, regexp: bytes | None,
 
 
 def sketch_windows(executor, tier, metric: str, tags: dict,
-                   start: int, end: int, presence_only: bool = False):
+                   start: int, end: int, presence_only: bool = False,
+                   want_hll: bool = False):
     """Shared selection for the range-limited sketch endpoints: pick a
     sketch-bearing resolution, split the range, and return
     ``(res, records, raw_parts, dirty_set)`` — records carry sketch
@@ -209,19 +231,28 @@ def sketch_windows(executor, tier, metric: str, tags: dict,
         if tier.resolutions[0] > span:
             tier.note_fallback("short-range")  # no sketch gate involved
             return None
-        res = tier.resolutions[0]
+        candidates = [tier.resolutions[0]]
     else:
-        res = tier.sketch_resolution(span)
-        if res is None:
+        # Coarse to fine: a range WIDE enough for a resolution may
+        # still contain no aligned full window of it (a 28h range
+        # holds no whole day) — fall through to the next finer
+        # sketch-bearing resolution instead of serving raw.
+        # ``want_hll`` (distinct-values) skips moment-only rungs:
+        # their cells carry no registers, and folding zero of them
+        # would return a confident undercount.
+        candidates = tier.sketch_candidates(span, want_hll=want_hll)
+        if not candidates:
             tier.note_fallback("sketch-res")
             return None
-    sel = _select_windows(executor, tier, metric, tags, start, end,
-                          res, want_sketches=not presence_only)
-    if sel is None:
-        return None
-    records, raw_parts, dirty_set = sel
-    tier.note_hit(res)
-    return res, records, raw_parts, dirty_set
+    for res in candidates:
+        sel = _select_windows(executor, tier, metric, tags, start,
+                              end, res,
+                              want_sketches=not presence_only)
+        if sel is not None:
+            records, raw_parts, dirty_set = sel
+            tier.note_hit(res)
+            return res, records, raw_parts, dirty_set
+    return None
 
 
 def _select_windows(executor, tier, metric: str, tags: dict,
@@ -265,8 +296,10 @@ def _select_windows(executor, tier, metric: str, tags: dict,
             sp.tags["dirty_windows"] = int(len(dirty))
     dirty_set = frozenset(int(b) for b in dirty)
     if rollup_only:
-        # Degraded: dirty and edge windows are OMITTED, not stitched —
-        # the whole point is spending zero raw-scan work per query.
+        # Degraded: zero raw-scan work — no stitching. The caller
+        # decides what dirty windows' records mean (plan() serves
+        # them stale and reports the count; the sketch path widens
+        # its error bound by their weight).
         return records, {}, dirty_set
     raw_ranges = _coalesce(
         edges + [(int(w), int(w) + res - 1) for w in dirty_set])

@@ -193,8 +193,11 @@ class RollupTier:
         self._init_layout(tsdb, config)
         store = tsdb.store
         st = self._read_state()
-        needs_rebuild = self._needs_rebuild(st)
-        if needs_rebuild:
+        rebuild = self._needs_rebuild(st)
+        if rebuild == "full":
+            # A FULL rebuild starts from empty stores; the incremental
+            # path keeps them — its windows' records are replaced from
+            # raw and everything else is still valid.
             for dirs in self._dirs.values():
                 for d in dirs:
                     shutil.rmtree(d, ignore_errors=True)
@@ -209,18 +212,28 @@ class RollupTier:
             self.close()
             raise
         store.record_spill_keys = True
-        if needs_rebuild:
+        if rebuild != "none":
+            windows = (self._incr_windows if rebuild == "incr"
+                       else None)
             self._behind = True
-            self._write_state(pending=True)
+            self._full_owed = windows is None
+            # Keep the inflight set durable through an INCREMENTAL
+            # catch-up: a crash mid-catch-up must redo the same
+            # (idempotent) incremental work. A full rebuild persists
+            # a bare pending record — no list, no shortcut.
+            self._write_state(pending=True, inflight=windows)
+            if windows is not None:
+                self._inflight = frozenset(windows)
             mode = getattr(config, "rollup_catchup", "background")
             if mode == "sync":
                 self._rebuilding = True
-                self._rebuild()
+                self._rebuild(windows=windows)
             elif mode == "background":
                 self._rebuilding = True
                 self._rebuild_thread = threading.Thread(
                     target=self._rebuild, daemon=True,
-                    name="rollup-catchup")
+                    name="rollup-catchup",
+                    kwargs={"windows": windows})
                 self._rebuild_thread.start()
             # "off": stays pending/not-ready; planner serves raw.
         else:
@@ -258,6 +271,11 @@ class RollupTier:
         self.digest_k = int(config.rollup_digest_k)
         self.hll_p = int(config.rollup_hll_p)
         self.sketch_min_res = int(config.rollup_sketch_min_res)
+        self.moment_k = int(getattr(config, "rollup_moment_k", 0))
+        self.moment_min_res = int(getattr(config,
+                                          "rollup_moment_min_res", 0))
+        self.sketch_byte_budget = int(getattr(config,
+                                              "sketch_byte_budget", 0))
 
         store = tsdb.store
         self._sharded = hasattr(store, "shards") and hasattr(store, "_route")
@@ -286,6 +304,12 @@ class RollupTier:
         # per-checkpoint folds must not flip the tier ready — only a
         # completed rebuild covers the pre-existing spilled history.
         self._behind = False
+        # True while the owed catch-up must be the FULL rebuild
+        # (foreign layout, never-built tier, crash mid-full-rebuild).
+        # While set, the persisted state must NOT carry an "inflight"
+        # list: an incremental catch-up over a half-built tier would
+        # silently serve the never-folded remainder stale.
+        self._full_owed = False
         self._rebuilding = False
         self._rebuild_error: BaseException | None = None
         self._rebuild_thread: threading.Thread | None = None
@@ -315,16 +339,93 @@ class RollupTier:
                 self._dirs[r] = [f"{base_dirs[0]}.rollup-{r}"]
         self.stores: dict[int, list[MemKVStore]] = {}
 
+        # Per-resolution sketch-column allocation: {res: (digest_k,
+        # moment_k, hll_p)}. With Config.sketch_byte_budget set, a
+        # Storyboard-style optimizer (sketch/budget.py) spends the
+        # budget across resolutions; otherwise the legacy uniform
+        # cutoffs apply (digest at res >= sketch_min_res, moment at
+        # res >= moment_min_res). Participates in the state file, so
+        # a layout change rebuilds and replicas adopt the writer's.
+        self.sketch_alloc = self._compute_alloc()
+        # Cumulative sketch-column bytes written per (resolution,
+        # kind) — process lifetime; /stats `sketch.bytes{kind=}` sums
+        # across resolutions, the bench reads the per-res split (the
+        # moment-vs-digest size story differs by window density).
+        self.sketch_bytes_res: dict[int, dict[str, int]] = {}
+
+    @property
+    def sketch_bytes(self) -> dict[str, int]:
+        out = {"tdigest": 0, "moment": 0, "hll": 0}
+        for kinds in self.sketch_bytes_res.values():
+            for k, v in kinds.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def _compute_alloc(self) -> dict[int, tuple[int, int, int]]:
+        if self.sketch_byte_budget > 0:
+            from opentsdb_tpu.sketch import budget as _budget
+            rows = self._estimate_row_hours()
+            records = {r: max(rows // max(r // MAX_TIMESPAN, 1), 1)
+                       for r in self.resolutions}
+            allocs = _budget.allocate(self.sketch_byte_budget, records,
+                                      hll_p=self.hll_p)
+            return {r: (a.digest_k, a.moment_k,
+                        a.hll_p if a.digest_k else 0)
+                    for r, a in allocs.items()}
+        out = {}
+        for r in self.resolutions:
+            dk = self.digest_k if r >= self.sketch_min_res else 0
+            mk = self.moment_k if r >= self.moment_min_res else 0
+            # HLL registers ride the digest rungs only: a moment-only
+            # resolution keeps its ~200 B cells (the kind's whole
+            # point); /distinct falls back to presence/exact there.
+            out[r] = (dk, mk, self.hll_p if dk else 0)
+        return out
+
+    def _estimate_row_hours(self) -> int:
+        """Rough raw row-hour count (the budget allocator's record-
+        density input): memtable pending keys + sstable index sizes.
+        The allocator quantizes, so order of magnitude is enough."""
+        store = self.tsdb.store
+        n = 0
+        try:
+            n += len(list(store.pending_keys(self.table)))
+        except Exception:
+            pass
+        shards = getattr(store, "shards", None)
+        if isinstance(shards, list):
+            subs = shards
+        else:
+            subs = [store]
+        for s in subs:
+            for sst in getattr(s, "_ssts", []) or []:
+                try:
+                    n += sst.key_count(self.table)
+                except Exception:
+                    pass
+        return max(n, 1)
+
     # -- state file --------------------------------------------------------
 
-    STATE_VERSION = 2
+    STATE_VERSION = 3
 
     def _config_dict(self) -> dict:
         return {"version": self.STATE_VERSION,
                 "resolutions": list(self.resolutions),
                 "pack": self.pack, "digest_k": self.digest_k,
                 "hll_p": self.hll_p,
-                "sketch_min_res": self.sketch_min_res}
+                "sketch_min_res": self.sketch_min_res,
+                "moment_k": self.moment_k,
+                "moment_min_res": self.moment_min_res,
+                "budget": self.sketch_byte_budget,
+                # The APPLIED per-res allocation, not just the knobs:
+                # a budget re-plan (operator re-budgeted) changes the
+                # stored columns and must rebuild like any layout
+                # change. Same-budget reopens ADOPT the persisted
+                # allocation (_needs_rebuild) so record-count drift
+                # around a quantization edge can't flap the layout.
+                "alloc": {str(r): list(self.sketch_alloc[r])
+                          for r in self.resolutions}}
 
     @classmethod
     def adopt_config(cls, state_path: str, config) -> bool:
@@ -344,6 +445,8 @@ class RollupTier:
             digest_k = int(rec["digest_k"])
             hll_p = int(rec["hll_p"])
             sketch_min_res = int(rec["sketch_min_res"])
+            moment_k = int(rec["moment_k"])
+            moment_min_res = int(rec["moment_min_res"])
         except (OSError, ValueError, TypeError, KeyError):
             return False
         config.rollup_resolutions = resolutions
@@ -351,6 +454,8 @@ class RollupTier:
         config.rollup_digest_k = digest_k
         config.rollup_hll_p = hll_p
         config.rollup_sketch_min_res = sketch_min_res
+        config.rollup_moment_k = moment_k
+        config.rollup_moment_min_res = moment_min_res
         return True
 
     def _read_state(self) -> dict | None:
@@ -360,9 +465,20 @@ class RollupTier:
         except (OSError, ValueError):
             return None
 
-    def _write_state(self, pending: bool) -> None:
+    def _write_state(self, pending: bool,
+                     inflight: "frozenset[int] | list | None" = None,
+                     ) -> None:
+        """``inflight``: the hour bases whose spilled rows may be
+        drained-but-unfolded — persisted alongside ``pending`` so a
+        crash can catch up INCREMENTALLY (refold only these windows)
+        instead of rebuilding the whole tier. Invariant maintained by
+        begin_spill/fold_after_spill: at any instant the persisted set
+        is a superset of every window whose raw rows left
+        pending_keys without a durable fold."""
         rec = self._config_dict()
         rec["pending"] = pending
+        if pending and inflight is not None:
+            rec["inflight"] = sorted(int(b) for b in inflight)
         tmp = self.state_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(rec, f)
@@ -370,16 +486,45 @@ class RollupTier:
             os.fsync(f.fileno())
         os.replace(tmp, self.state_path)
 
-    def _needs_rebuild(self, st: dict | None) -> bool:
+    def _needs_rebuild(self, st: dict | None) -> str:
+        """"none" (tier is complete), "full" (wipe + rebuild), or
+        "incr" (pending crash with a usable persisted inflight set —
+        refold only those windows; self._incr_windows is set)."""
+        self._incr_windows: list[int] | None = None
         if st is None:
             # No state: a store that already spilled data has raw
             # history no fold will ever cover; a fresh store starts
             # complete (its whole history is memtable-dirty).
-            return bool(getattr(self.tsdb.store, "spilled", False))
-        if st.get("pending", True):
-            return True
+            return ("full" if getattr(self.tsdb.store, "spilled",
+                                      False) else "none")
         cfg = self._config_dict()
-        return any(st.get(k) != v for k, v in cfg.items())
+        # Same-budget reopen: adopt the persisted allocation before
+        # comparing, so a record-count estimate that drifted across a
+        # quantization edge can't force a rebuild the operator never
+        # asked for (the budget knob itself still does).
+        alloc = st.get("alloc")
+        if (self.sketch_byte_budget > 0 and isinstance(alloc, dict)
+                and st.get("budget") == self.sketch_byte_budget):
+            try:
+                adopted = {int(r): tuple(int(x) for x in v)
+                           for r, v in alloc.items()}
+            except (TypeError, ValueError):
+                adopted = None
+            if adopted is not None and set(adopted) == set(
+                    self.resolutions):
+                self.sketch_alloc = adopted
+                cfg = self._config_dict()
+        config_ok = all(st.get(k) == v for k, v in cfg.items()
+                        if k != "pending")
+        if st.get("pending", True):
+            wins = st.get("inflight")
+            if (config_ok and isinstance(wins, list)
+                    and getattr(self.tsdb.config,
+                                "rollup_incremental_catchup", True)):
+                self._incr_windows = [int(b) for b in wins]
+                return "incr"
+            return "full"
+        return "none" if config_ok else "full"
 
     # -- planner surface ---------------------------------------------------
 
@@ -405,13 +550,35 @@ class RollupTier:
                 best = r
         return best
 
-    def sketch_resolution(self, span: int) -> int | None:
-        """Coarsest sketch-bearing resolution not wider than the range."""
+    def sketch_candidates(self, span: int,
+                          want_hll: bool = False) -> list[int]:
+        """Sketch-bearing resolutions not wider than the range,
+        COARSEST FIRST — the planner's candidate order for the ranged
+        sketch endpoints (a range wide enough for a resolution may
+        still hold no aligned full window of it, so selection falls
+        through to the next). ``want_hll`` keeps only resolutions
+        whose allocation carries HLL registers (distinct-VALUES
+        estimates; moment-only rungs have none and must not serve
+        them)."""
+        out = []
+        for r in reversed(self.resolutions):
+            dk, mk, hp = self.sketch_alloc.get(r, (0, 0, 0))
+            if r > span or not (dk or mk):
+                continue
+            if want_hll and not hp:
+                continue
+            out.append(r)
+        return out
+
+    def sketch_res_for_interval(self, interval: int) -> int | None:
+        """Coarsest sketch-bearing resolution whose windows nest
+        exactly into ``interval`` buckets — the approximate
+        percentile-downsample planner's resolution pick (per-bucket
+        sketches merge from whole windows only)."""
         best = None
-        if not self.digest_k:
-            return None
         for r in self.resolutions:
-            if r >= self.sketch_min_res and r <= span:
+            dk, mk, _ = self.sketch_alloc.get(r, (0, 0, 0))
+            if (dk or mk) and r <= interval and interval % r == 0:
                 best = r
         return best
 
@@ -536,15 +703,24 @@ class RollupTier:
         """Before the raw spill: remember every currently-dirty window
         as in-flight (the spill moves its rows out of pending_keys, the
         fold hasn't covered them yet) and mark the tier pending on
-        disk so a crash mid-window rebuilds."""
-        if self._rebuilding or self._behind:
-            return  # state is already pending
+        disk — WITH the in-flight window list, so a crash catches up
+        incrementally (refold just those windows) instead of
+        rebuilding the whole tier."""
         bases = self.dirty_hour_bases()
         self._inflight = self._inflight | frozenset(
             int(b) for b in bases)
-        self._write_state(pending=True)
+        if self._full_owed:
+            return  # state is already pending (bare: full owed)
+        # During an incremental catch-up the state is already pending,
+        # but the inflight list must still grow: a checkpoint's
+        # spilled keys get deferred to the catch-up thread, and a
+        # crash before that fold lands must know these windows are
+        # owed too.
+        self._write_state(pending=True, inflight=self._inflight)
+        if self._rebuilding or self._behind:
+            return
         # Bracket opened (pending durable), raw spill not started:
-        # crash must rebuild at next open even though no data moved.
+        # crash must catch up at next open even though no data moved.
         _fault("rollup.begin_spill", self.state_path)
 
     def fold_after_spill(self) -> None:
@@ -567,6 +743,15 @@ class RollupTier:
                 if len(k) >= UID_WIDTH + TIMESTAMP_BYTES)
             if not extra <= self._inflight:
                 self._inflight = self._inflight | extra
+                # Persist BEFORE draining: once take_spill_keys runs,
+                # these keys exist only in this process's memory — a
+                # crash must find their windows in the durable
+                # inflight set or the incremental catch-up would
+                # silently skip them (stale summaries). While a full
+                # rebuild is owed the bare pending record stands.
+                if not self._full_owed:
+                    self._write_state(pending=True,
+                                      inflight=self._inflight)
         keys = store.take_spill_keys().get(self.table, [])
         with self._defer_lock:
             if self._rebuilding:
@@ -695,7 +880,66 @@ class RollupTier:
                 buf.count(1)
 
     def _sketchy(self, res: int) -> bool:
-        return bool(self.digest_k) and res >= self.sketch_min_res
+        dk, mk, _ = self.sketch_alloc.get(res, (0, 0, 0))
+        return bool(dk or mk)
+
+    def sketch_kinds(self, res: int) -> tuple[int, int, int]:
+        """(digest_k, moment_k, hll_p) the tier stores at ``res``."""
+        return self.sketch_alloc.get(res, (0, 0, 0))
+
+    def _zero_unemitted(self, hours, buf: _MapBuffer) -> None:
+        """Incremental catch-up's delete pass: zero every previously-
+        recorded slot in the affected windows that the rescan emitted
+        nothing for (its raw rows are gone — deletes whose spilled
+        keys the crash lost). The full rebuild needs no analog: it
+        starts from wiped stores."""
+        zero = np.zeros(1, REC_DTYPE).tobytes()
+        emitted = buf.emitted
+        assert emitted is not None, \
+            "_zero_unemitted needs a tracking buffer"
+        names = self.tsdb.metrics.suggest("", limit=1 << 30)
+        uids = [self.tsdb.metrics.get_id(n) for n in names]
+        for r in self.resolutions:
+            wins = {int(h) - int(h) % r for h in hours}
+            if not wins:
+                continue
+            span = r * self.pack
+            ranges: list[list[int]] = []
+            for sb in sorted({w - w % span for w in wins}):
+                if ranges and sb == ranges[-1][1]:
+                    ranges[-1][1] = sb + span
+                else:
+                    ranges.append([sb, sb + span])
+            empty_sketch = (summary.sketch_encode(
+                np.empty(0, np.float32), np.empty(0, np.float32),
+                None) if self._sketchy(r) else None)
+            for uid in uids:
+                for lo, hi in ranges:
+                    start_key = uid + _u32(max(lo, 0))
+                    stop_key = (_metric_stop(uid) if hi > 0xFFFFFFFF
+                                else uid + _u32(hi))
+                    for s in self.stores[r]:
+                        for key, items in s.scan_raw(
+                                self.table, start_key, stop_key,
+                                family=ROLLUP_FAMILY):
+                            sb = codec.key_base_time(key)
+                            kb = bytes(key)
+                            mask = emitted.get((r, kb), 0)
+                            for q, v in items:
+                                if (q != QUAL_MOMENTS
+                                        or len(v) % summary.ENTRY_SIZE):
+                                    continue
+                                e = summary.decode_moment_map(v)
+                                for idx in e["idx"].tolist():
+                                    wb = sb + int(idx) * r
+                                    if (wb not in wins
+                                            or mask >> int(idx) & 1):
+                                        continue
+                                    ent = buf.entries(r, kb)
+                                    ent[0][int(idx)] = zero
+                                    if empty_sketch is not None:
+                                        ent[1][int(idx)] = empty_sketch
+                                    buf.count(1)
 
     def _rollup_span(self, metric_uid: bytes, lo: int, hi: int,
                      buf: _MapBuffer, seen: set | None = None,
@@ -813,8 +1057,11 @@ class RollupTier:
                     emitted[ek] = emitted.get(ek, 0) | mask
                 buf.count(b - a)
             if self._sketchy(r):
+                dk, mk, hp = self.sketch_alloc[r]
                 sb_arr, blobs = summary.window_sketches(
-                    ts, vals, r, self.digest_k, self.hll_p)
+                    ts, vals, r, dk, hp, mk,
+                    kind_bytes=self.sketch_bytes_res.setdefault(
+                        r, {}))
                 for j, sblob in enumerate(blobs):
                     w = int(sb_arr[j])
                     sb = w - w % span
@@ -824,22 +1071,46 @@ class RollupTier:
 
     # -- catch-up daemon ---------------------------------------------------
 
-    def _rebuild(self) -> None:
-        """Full tier rebuild from the raw store (crash / foreign state
+    def _rebuild(self, windows: "list[int] | None" = None) -> None:
+        """Tier catch-up from the raw store (crash / foreign state
         recovery). Runs on the catch-up thread; checkpoints folding in
-        the meantime defer their spilled keys, drained at the end."""
+        the meantime defer their spilled keys, drained at the end.
+
+        ``windows`` (incremental mode, ROADMAP "Rollup incremental
+        catch-up"): the persisted in-flight hour bases of the crashed
+        bracket — ONLY those windows refold (every other record was
+        durably committed by an earlier fold and records replace from
+        raw idempotently), plus a zero pass for previously-recorded
+        slots in those windows the rescan no longer emits (deleted
+        rows; the crash lost the spilled keys _zero_leftovers would
+        have keyed on). None = the full-tier scan."""
         try:
             import time as _time
             t_catchup0 = _time.perf_counter()
-            buf = _MapBuffer(self)
+            buf = _MapBuffer(self, track_emitted=windows is not None)
             with self._fold_lock:
                 names = self.tsdb.metrics.suggest("", limit=1 << 30)
+                coarse = self.resolutions[-1]
+                spans: list[tuple[int, int]] | None = None
+                if windows is not None:
+                    cw = sorted({int(b) - int(b) % coarse
+                                 for b in windows})
+                    spans = []
+                    for b in cw:
+                        if spans and b == spans[-1][1]:
+                            spans[-1] = (spans[-1][0], b + coarse)
+                        else:
+                            spans.append((b, b + coarse))
                 for name in names:
                     if self._stop.is_set():
                         raise _TierClosed()
                     uid = self.tsdb.metrics.get_id(name)
-                    self._rollup_span(uid, 0, 1 << 33, buf,
-                                      stoppable=True)
+                    for lo, hi in (spans if spans is not None
+                                   else [(0, 1 << 33)]):
+                        self._rollup_span(uid, lo, hi, buf,
+                                          stoppable=True)
+                if windows is not None:
+                    self._zero_unemitted(windows, buf)
                 buf.flush()
                 self.records_written += buf.written
             # Completion commits under the TSDB's checkpoint lock: the
@@ -885,6 +1156,7 @@ class RollupTier:
                         # proceeds as a normal fold — never drops keys.
                         self._rebuilding = False
                         self._behind = False
+                        self._full_owed = False
                     # Catch-up complete in memory, completion not yet
                     # durable: crash re-runs the whole rebuild at next
                     # open (idempotent, never stale).
@@ -922,6 +1194,8 @@ class RollupTier:
                              f"res={res_label(r)}")
         for reason, n in sorted(self.fallbacks.items()):
             collector.record("rollup.fallback", n, f"reason={reason}")
+        for kind, n in sorted(self.sketch_bytes.items()):
+            collector.record("sketch.bytes", n, f"kind={kind}")
 
     def flush(self) -> None:
         for stores in self.stores.values():
@@ -1055,6 +1329,9 @@ class ReadOnlyRollupTier(RollupTier):
         self._ready = (st2 is not None
                        and not st2.get("pending", True)
                        and st2 == st)
+        # Monotonic refresh stamp: record-level caches built over the
+        # previous capture (the approx rail cache) must revalidate.
+        self.refreshes = getattr(self, "refreshes", 0) + 1
         return self._ready
 
     def _open_stores(self) -> dict[int, list[MemKVStore]]:
@@ -1090,6 +1367,19 @@ class ReadOnlyRollupTier(RollupTier):
         self.digest_k = int(st["digest_k"])
         self.hll_p = int(st["hll_p"])
         self.sketch_min_res = int(st["sketch_min_res"])
+        self.moment_k = int(st.get("moment_k", 0))
+        self.moment_min_res = int(st.get("moment_min_res", 0))
+        self.sketch_byte_budget = int(st.get("budget", 0))
+        alloc = st.get("alloc")
+        if isinstance(alloc, dict):
+            try:
+                self.sketch_alloc = {
+                    int(r): tuple(int(x) for x in v)
+                    for r, v in alloc.items()}
+            except (TypeError, ValueError):
+                self.sketch_alloc = self._compute_alloc()
+        else:
+            self.sketch_alloc = self._compute_alloc()
         base = os.path.dirname(self.state_path)
         self._dirs = {}
         for r in self.resolutions:

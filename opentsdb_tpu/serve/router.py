@@ -748,7 +748,12 @@ class RouterServer:
                 degrade=(verdict == DEGRADE))
             if cache_key is not None:
                 status, ctype, body, extra = out
-                if status == 200 and "X-Tsd-Degraded" not in extra:
+                # Approximate answers never cache either: the contract
+                # is per-request (opt-in + budget), and a cached body
+                # would keep serving the approximation to callers who
+                # asked for exact.
+                if status == 200 and "X-Tsd-Degraded" not in extra \
+                        and "X-Tsd-Approx" not in extra:
                     self.rcache.put(
                         cache_key,
                         (time.monotonic() + self.rcache_ms / 1000.0,
@@ -843,6 +848,7 @@ class RouterServer:
 
         results: list[dict] = []
         degraded_tags: set[str] = set()
+        approx_tags: set[str] = set()
         hop_spans: list[dict] = []
         for m, out in zip(ms, outs):
             if isinstance(out, BaseException):
@@ -856,6 +862,9 @@ class RouterServer:
             tag = extra.get("X-Tsd-Degraded")
             if tag:
                 degraded_tags.update(tag.split(","))
+            tag = extra.get("X-Tsd-Approx")
+            if tag:
+                approx_tags.add(tag)
             try:
                 results.extend(json.loads(body))
             except ValueError:
@@ -865,6 +874,28 @@ class RouterServer:
             degraded_tags.add("rollup-only")
 
         extra = {}
+        if approx_tags:
+            # Error-contract propagation: hop answers that declared
+            # themselves approximate stay declared end to end (the
+            # per-result "approx" objects ride the merged JSON bodies
+            # untouched; the header is the no-parse signal).
+            # Re-aggregated into the single-node header FORM
+            # ("kind1,kind2;rel_error=worst") — hop values already
+            # use ';' internally, so joining them raw would be
+            # unparseable.
+            kinds: set[str] = set()
+            rels: list[float] = []
+            for tag in approx_tags:
+                head, _, rel = tag.partition(";rel_error=")
+                kinds.update(k for k in head.split(",") if k)
+                try:
+                    rels.append(float(rel))
+                except ValueError:
+                    pass
+            tagv = ",".join(sorted(kinds))
+            if rels:
+                tagv += f";rel_error={max(rels):.6g}"
+            extra["X-Tsd-Approx"] = tagv
         if degraded_tags:
             tag = ",".join(sorted(degraded_tags))
             extra["X-Tsd-Degraded"] = tag
@@ -1046,6 +1077,8 @@ class RouterServer:
             extra = {}
             if "x-tsd-degraded" in headers:
                 extra["X-Tsd-Degraded"] = headers["x-tsd-degraded"]
+            if "x-tsd-approx" in headers:
+                extra["X-Tsd-Approx"] = headers["x-tsd-approx"]
             if "retry-after" in headers:
                 extra["Retry-After"] = headers["retry-after"]
             return (status,
@@ -1210,6 +1243,8 @@ class RouterServer:
         extra = {}
         if "x-tsd-degraded" in headers:
             extra["X-Tsd-Degraded"] = headers["x-tsd-degraded"]
+        if "x-tsd-approx" in headers:
+            extra["X-Tsd-Approx"] = headers["x-tsd-approx"]
         if "retry-after" in headers:
             extra["Retry-After"] = headers["retry-after"]
         return (status, headers.get("content-type", "text/plain"),

@@ -89,6 +89,21 @@ def _retry_after(seconds: float) -> dict:
     return {"Retry-After": str(max(1, math.ceil(seconds)))}
 
 
+def _parse_max_error(q) -> float | None:
+    """The shared ``max_error=`` budget parse for /q and /sketch:
+    a positive relative half-width, or None when absent."""
+    if "max_error" not in q:
+        return None
+    try:
+        max_error = float(q["max_error"])
+    except ValueError:
+        raise BadRequestError(
+            f"invalid max_error: {q['max_error']}") from None
+    if max_error <= 0:
+        raise BadRequestError("max_error must be > 0")
+    return max_error
+
+
 def _put_prefix_len(buf: bytes) -> int:
     """Byte length of the longest prefix of complete ``put `` lines.
 
@@ -188,6 +203,10 @@ class TSDServer:
             getattr(self.config, "trace_ring", 256))
         # 1-in-N ambient trace sampling counter (Config.trace_sample_n).
         self._trace_sample_seq = 0
+        # Per-plan serve counters (raw / resident / fused / rollup /
+        # approx), the /queries view's feed: bounded label set, bumped
+        # once per sub-query.
+        self.plan_counts: dict[str, int] = {}
         from opentsdb_tpu.obs.selfmon import SelfMonitor
         self.selfmon = SelfMonitor(
             tsdb, self._collect_stats,
@@ -456,6 +475,8 @@ class TSDServer:
             "/sketch": lambda req: self._sketch(req.q),
             "/forecast": lambda req: self._forecast(req.q, req.params),
             "/fault": self._http_fault,
+            "/queries": self._http_queries_page,
+            "/api/queries": self._http_queries,
             "/promote": self._http_promote,
             "/demote": self._http_demote,
             "/healthz": self._http_healthz,
@@ -959,6 +980,77 @@ class TSDServer:
             reasons.append("rollup-only")
         return ",".join(reasons) if reasons else None
 
+    def _note_plan(self, plan: str, approx: bool = False) -> None:
+        """Bump the bounded per-plan counters: planner-choice labels
+        collapse to raw/resident/fused/rollup/approx (rollup
+        resolution labels like "1h" fold into "rollup"; a degraded
+        rollup answer that carries approx metadata counts BOTH)."""
+        if plan.startswith("approx"):
+            key = "approx"
+        elif plan in ("raw", "resident", "fused"):
+            key = plan
+        else:
+            key = "rollup"
+        self.plan_counts[key] = self.plan_counts.get(key, 0) + 1
+        if approx and key != "approx":
+            self.plan_counts["approx"] = \
+                self.plan_counts.get("approx", 0) + 1
+
+    def _http_queries(self, req) -> tuple:
+        """JSON feed behind the /queries browser view: per-plan serve
+        counters, the sketch-serving contract counters, rollup tier
+        state, fragment-cache hit rates — the query-planner sibling of
+        the router's /api/topology."""
+        from opentsdb_tpu.rollup.tier import res_label
+        tier = getattr(self.tsdb, "rollups", None)
+        rollup = None
+        if tier is not None:
+            rollup = {
+                "ready": bool(tier.ready),
+                "resolutions": [res_label(r) for r in tier.resolutions],
+                "hits": {res_label(r): tier.hits.get(r, 0)
+                         for r in tier.resolutions},
+                "fallbacks": dict(tier.fallbacks),
+                "sketch_alloc": {
+                    res_label(r): {"digest_k": a[0], "moment_k": a[1],
+                                   "hll_p": a[2]}
+                    for r, a in sorted(tier.sketch_alloc.items())},
+                "sketch_bytes": dict(tier.sketch_bytes),
+            }
+        sketch: dict = {}
+        for name, kind, tkey, obj in METRICS._snapshot():
+            if not name.startswith("sketch."):
+                continue
+            label = name[len("sketch."):]
+            if tkey:
+                label += "{" + ",".join(
+                    f"{k}={v}" for k, v in tkey) + "}"
+            if kind == "counter":
+                sketch[label] = obj.value
+            elif kind == "timer":
+                sketch[label + ".count"] = obj.count
+                sketch[label + ".p95"] = round(
+                    obj.digest.percentile(95), 4)
+        body = {
+            "uptime_s": int(time.time()) - self.start_time,
+            "plans": dict(self.plan_counts),
+            "sketch": sketch,
+            "rollup": rollup,
+            "qcache": {"hit": self.executor.qcache_hits,
+                       "miss": self.executor.qcache_misses,
+                       "bypass": self.executor.qcache_bypasses},
+            "admission": {
+                "inflight": self.admission.inflight_queries,
+                "degraded": self.admission.query_degraded,
+                "shed_load": self.admission.query_shed_load,
+            },
+        }
+        return (200, "application/json", json.dumps(body).encode(), {})
+
+    def _http_queries_page(self, req) -> tuple:
+        return (200, "text/html; charset=UTF-8",
+                _QUERIES_HTML.encode(), {"Cache-Control": "no-cache"})
+
     def _http_metrics(self, req) -> tuple:
         """Prometheus text exposition: the metrics registry (typed —
         counters, gauges, timer summaries) merged with the classic
@@ -1082,9 +1174,29 @@ class TSDServer:
         # the disk cache both ways — caching one would serve it after
         # recovery, and a cached full answer carries no tag.
         degraded = self._degraded_reason(degrade)
+        # Approximate serving opt-in (sketch/serving.py): ``approx=1``
+        # allows sketch-served percentile downsamples at any reported
+        # bound; ``max_error=X`` (relative half-width) implies the
+        # opt-in AND caps it — a sketch answer whose bound exceeds X
+        # falls back to the exact path. The ladder's degraded step
+        # implies approx for percentile queries (bounded-error
+        # degradation) under Config.degrade_max_error.
+        from opentsdb_tpu.sketch.serving import ApproxSpec
+        max_error = _parse_max_error(q)
+        approx_on = (q.get("approx", "0") not in ("", "0")
+                     or max_error is not None)
+        if degrade and max_error is None:
+            cfg_budget = float(getattr(self.config,
+                                       "degrade_max_error", 0) or 0)
+            max_error = cfg_budget if cfg_budget > 0 else None
+        aspec = ApproxSpec(approx_on, max_error)
         # An explicitly traced request bypasses the /q disk cache both
         # ways: a cached body carries no trace, and a trace of a disk
-        # read would claim the query cost nothing.
+        # read would claim the query cost nothing. Approx opt-in does
+        # NOT bypass: the cache key is the md5 of the full query string
+        # (approx=1/max_error included), so an exact caller can never
+        # land on an approx slot, and X-Tsd-Approx survives hits via
+        # the .meta sidecar like the drag-zoom headers.
         cache_path = (None if want_trace or degraded
                       else self._cache_path(query_string, q))
         now = int(time.time())
@@ -1128,6 +1240,7 @@ class TSDServer:
         result_plans: list[str] = []
         result_cached: list[bool] = []
         result_traces: list[dict | None] = []
+        result_approx: list[dict | None] = []
         for mi, m in enumerate(ms):
             parsed = parse_m(m)
             spec = QuerySpec(
@@ -1148,11 +1261,14 @@ class TSDServer:
             trace = (obs_trace.Trace(
                 m, trace_id=q.get("trace_parent") or None)
                 if do_trace else None)
-            rs, plan, cached = await loop.run_in_executor(
+            rs, plan, cached, ainfo = await loop.run_in_executor(
                 self._pool,
-                functools.partial(self.executor.run_with_plan,
+                functools.partial(self.executor.run_approx,
                                   spec, start, end, trace,
-                                  rollup_only=degrade))
+                                  rollup_only=degrade, approx=aspec))
+            ajson = (ainfo.as_json() if hasattr(ainfo, "as_json")
+                     else ainfo)
+            self._note_plan(plan, approx=ajson is not None)
             tdict = None
             if trace is not None:
                 rec = make_record(
@@ -1177,10 +1293,23 @@ class TSDServer:
             result_plans.extend([plan] * len(rs))
             result_cached.extend([cached] * len(rs))
             result_traces.extend([tdict] * len(rs))
+            result_approx.extend([ajson] * len(rs))
 
         extra: dict = {}
         if degraded:
             extra["X-Tsd-Degraded"] = degraded
+        approx_served = [a for a in result_approx if a]
+        if approx_served:
+            # Declared approximation, header form (the router
+            # propagates it like X-Tsd-Degraded): the kinds involved
+            # plus the worst reported relative bound (when numeric).
+            kinds = sorted({a.get("kind", "?") for a in approx_served})
+            rels = [a.get("rel_error") for a in approx_served
+                    if isinstance(a.get("rel_error"), (int, float))]
+            tagv = ",".join(kinds)
+            if rels:
+                tagv += f";rel_error={max(rels):.6g}"
+            extra["X-Tsd-Approx"] = tagv
         if "ascii" in q:
             body = self._ascii_output(results).encode()
             ctype = "text/plain"
@@ -1189,7 +1318,8 @@ class TSDServer:
                 self._json_output(
                     results, result_plans, result_cached,
                     result_traces if want_trace else None,
-                    degraded=degraded)).encode()
+                    degraded=degraded,
+                    approx=result_approx)).encode()
             ctype = "application/json"
         else:
             t0 = time.time()
@@ -1258,7 +1388,7 @@ class TSDServer:
         return "\n".join(out) + ("\n" if out else "")
 
     def _json_output(self, results, plans=None, cached=None,
-                     traces=None, degraded=None):
+                     traces=None, degraded=None, approx=None):
         out = [{
             "metric": r.metric,
             "tags": r.tags,
@@ -1277,6 +1407,13 @@ class TSDServer:
             # "rollup-only" (load shedding omitted raw stitching).
             for ent in out:
                 ent["degraded"] = degraded
+        if approx:
+            # The error contract: a sketch-served answer carries its
+            # kind + reported bound per result ("approx": {"kind":
+            # "tdigest"|"moment"|"rollup-stale", "error": ...}).
+            for i, ent in enumerate(out):
+                if i < len(approx) and approx[i]:
+                    ent["approx"] = approx[i]
         if traces is not None:
             # ?trace=1 only: the per-sub-query span tree, inline.
             for i, ent in enumerate(out):
@@ -1345,10 +1482,16 @@ class TSDServer:
                 raise BadRequestError(
                     f"no streaming sketch state for metric {q['metric']}"
                     f" / tagk {q['tagk']} (pass start= for a scan)")
+            # The streaming estimate is an HLL — declare it under the
+            # error contract like every other approximate answer.
+            from opentsdb_tpu.sketch.bounds import hll_error
+            err = hll_error(getattr(self.config, "sketch_hll_p", 12), n)
             body = json.dumps({
                 "metric": q["metric"], "tagk": q["tagk"], "distinct": n,
-                "source": "stream"}).encode()
-            return 200, "application/json", body, {}
+                "source": "stream",
+                "approx": {"kind": "hll", "error": err}}).encode()
+            return (200, "application/json", body,
+                    {"X-Tsd-Approx": f"hll;error={err:.6g}"})
         now = int(time.time())
         start = timeparse.parse_date(q["start"], now=now)
         end = timeparse.parse_date(q["end"], now=now) if "end" in q else now
@@ -1420,11 +1563,18 @@ class TSDServer:
             raise BadRequestError(
                 "sketch range needs start= (end= alone would silently "
                 "answer all-time)")
+        max_error = _parse_max_error(q)
         loop = asyncio.get_running_loop()
         out = await loop.run_in_executor(
             self._pool, self.executor.sketch_quantiles, metric, tag_map,
-            qs, start, end)
-        return 200, "application/json", json.dumps(out).encode(), {}
+            qs, start, end, max_error)
+        hdrs = {}
+        ap = out.get("approx") if isinstance(out, dict) else None
+        if ap:
+            hdrs["X-Tsd-Approx"] = (
+                f"{ap.get('kind', '?')}"
+                f";rel_error={ap.get('rel_error', 0):.6g}")
+        return 200, "application/json", json.dumps(out).encode(), hdrs
 
     async def _forecast(self, q, params) -> tuple:
         """Model extension: Holt-Winters / EWMA forecasts + anomaly
@@ -1683,6 +1833,8 @@ class TSDServer:
         c.record("qcache.hit", self.executor.qcache_hits)
         c.record("qcache.miss", self.executor.qcache_misses)
         c.record("qcache.bypass", self.executor.qcache_bypasses)
+        for plan, n in sorted(self.plan_counts.items()):
+            c.record("query.plan", n, f"plan={plan}")
         from opentsdb_tpu.fault import faultpoints as _fp
         fstat = _fp.status()
         c.record("fault.sites_armed", len(fstat["armed"]))
@@ -1713,3 +1865,89 @@ class TSDServer:
         # .count/.sum_ms lines.
         METRICS.collect(c)
         return c.lines
+
+
+# ---------------------------------------------------------------------------
+# /queries: the query-planner dashboard — per-plan serve counters
+# (raw / resident / fused / rollup / approx), the sketch-serving
+# error-contract counters, the rollup tier's per-resolution sketch
+# allocation, fragment-cache rates. The /topology pattern one layer
+# down: one self-contained page over the /api/queries JSON feed,
+# served from memory, auto-refreshing.
+# ---------------------------------------------------------------------------
+
+_QUERIES_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>tsd queries</title>
+<style>
+ body{font:13px/1.45 system-ui,sans-serif;margin:1.2em;background:#fafafa;
+      color:#222}
+ h1{font-size:1.2em;margin:0 0 .2em}
+ h2{font-size:1em;margin:1.2em 0 .3em}
+ table{border-collapse:collapse;background:#fff;min-width:30em}
+ th,td{border:1px solid #ddd;padding:.25em .6em;text-align:left;
+       font-variant-numeric:tabular-nums}
+ th{background:#f0f0f0;font-weight:600}
+ .ok{color:#0a7d32}.bad{color:#c0392b}.warn{color:#b8860b}
+ #meta{color:#666;font-size:.9em;margin-bottom:.8em}
+ .pill{display:inline-block;padding:0 .5em;border-radius:.8em;
+       background:#eee;margin-right:.4em}
+</style></head><body>
+<h1>Query planner</h1>
+<div id="meta">loading /api/queries&hellip;</div>
+<div id="plans"></div><div id="sketch"></div>
+<div id="rollup"></div><div id="caches"></div>
+<script>
+function esc(v){return String(v).replace(/&/g,"&amp;")
+  .replace(/</g,"&lt;").replace(/>/g,"&gt;");}
+function fmt(v){return v===null||v===undefined?"&mdash;":esc(v);}
+function table(title, heads, rows){
+  var h="<h2>"+title+"</h2><table><tr>"+heads.map(
+    function(x){return "<th>"+x+"</th>";}).join("")+"</tr>";
+  h+=rows.map(function(r){return "<tr>"+r.map(
+    function(c){return "<td>"+c+"</td>";}).join("")+"</tr>";}).join("");
+  return h+"</table>";
+}
+function pills(title, obj){
+  return "<h2>"+title+"</h2>"+Object.keys(obj).sort().map(function(k){
+    return "<span class='pill'>"+esc(k)+": "+esc(obj[k])+"</span>";
+  }).join("")||"&mdash;";
+}
+function render(t){
+  document.getElementById("meta").innerHTML=
+    "up "+t.uptime_s+"s &middot; refreshed "+
+    new Date().toLocaleTimeString();
+  var order=["raw","resident","fused","rollup","approx"];
+  var p=t.plans||{};
+  document.getElementById("plans").innerHTML=
+    table("Plans served",["plan","results"],order.filter(function(k){
+      return p[k];}).map(function(k){
+        var cls=k==="approx"?" class='warn'":"";
+        return ["<span"+cls+">"+esc(k)+"</span>", p[k]];}));
+  document.getElementById("sketch").innerHTML=
+    pills("Sketch serving (error contract)", t.sketch||{});
+  var r=t.rollup;
+  if(r){
+    var rows=Object.keys(r.sketch_alloc||{}).map(function(res){
+      var a=r.sketch_alloc[res];
+      return [esc(res),(r.hits||{})[res]||0,a.digest_k,a.moment_k,
+              a.hll_p];});
+    document.getElementById("rollup").innerHTML=
+      table("Rollup tier "+(r.ready?"<span class='ok'>ready</span>"
+        :"<span class='bad'>not ready</span>"),
+        ["res","hits","digest_k","moment_k","hll_p"],rows)
+      +pills("Fallbacks", r.fallbacks||{})
+      +pills("Sketch bytes written", r.sketch_bytes||{});
+  } else { document.getElementById("rollup").innerHTML=""; }
+  document.getElementById("caches").innerHTML=
+    pills("Fragment cache", t.qcache||{})+
+    pills("Admission", t.admission||{});
+}
+function tick(){
+  fetch("/api/queries").then(function(r){return r.json();})
+    .then(render)
+    .catch(function(e){document.getElementById("meta").innerHTML=
+      "<span class='bad'>fetch failed: "+esc(e)+"</span>";});
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>
+"""

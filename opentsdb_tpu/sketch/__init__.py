@@ -1,0 +1,29 @@
+"""Accuracy-budgeted approximate serving (the error-contract tier).
+
+The rollup tier has carried sketch columns since PR 2, but every
+percentile-downsample query still paid a raw scan: there was no
+*contract* under which an approximate answer could be served. This
+package adds one:
+
+- ``moment``  — moment-sketch columns (arXiv:1803.01969): tiny
+  (~100-200 B) records of count/min/max/power-moments (+ log-moments),
+  merged by pure addition, with a maximum-entropy quantile solver on
+  the read side.
+- ``bounds``  — guaranteed error enclosures: Cantelli/Chebyshev-style
+  quantile bounds from moments, neighbor-centroid enclosures from
+  t-digest weights (arXiv:1902.04023-style), HLL standard error.
+- ``serving`` — the planner step that serves ``dsagg pNN`` queries
+  from merged rollup sketch columns when the caller opts in
+  (``approx=1`` / ``max_error=X``) or the admission ladder degrades,
+  attaching a per-result reported bound and falling back to the exact
+  raw path whenever the bound exceeds the caller's budget.
+- ``budget``  — a Storyboard-style (arXiv:2002.03063) allocator that
+  spends ``Config.sketch_byte_budget`` across resolutions (kind +
+  size per resolution) instead of the uniform
+  ``rollup_sketch_min_res`` cutoff.
+
+Contract: an approximate answer always DECLARES itself —
+``"approx": {"kind": ..., "error": ...}`` in ``/q`` JSON and an
+``X-Tsd-Approx`` header — and the reported bound must contain the
+exact-raw answer (scripts/sketch_harness.py asserts exactly that).
+"""

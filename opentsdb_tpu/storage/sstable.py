@@ -107,6 +107,10 @@ BLOCK_RAW_TARGET = 1 << 18
 # Whole-block decode on the read path (scan, point get, copy-merge,
 # fsck round-trip audits) — p50/p95/p99 + count via /stats + /metrics.
 _M_DECODE = _metrics.timer("compress.decode")
+# Blocks decoded by the STREAMING range sweep (iter_rows_range):
+# decoded once into a local buffer and dropped as the sweep advances,
+# never inserted into the per-file point-get cache.
+_M_STREAM = _metrics.counter("compress.stream_blocks")
 
 # Series-identity byte ranges of a data row key (the base-time bytes
 # between them are excluded — the sharder's routing identity,
@@ -1017,6 +1021,10 @@ class SSTable:
 
     def _read_row(self, off: int) -> list[tuple[bytes, bytes, bytes]]:
         mm, off = self._record_buf(off)
+        return self._parse_row(mm, off)
+
+    @staticmethod
+    def _parse_row(mm, off: int) -> list[tuple[bytes, bytes, bytes]]:
         (tlen,) = _U16.unpack_from(mm, off)
         off += 2 + tlen
         (klen,) = _U16.unpack_from(mm, off)
@@ -1110,6 +1118,9 @@ class SSTable:
         keys, offs = idx
         lo = bisect_left(keys, start)
         hi = bisect_left(keys, stop) if stop else len(keys)
+        if self._blk_raw is not None and hi - lo > 1:
+            yield from self._stream_rows(keys, offs, lo, hi, skip)
+            return
         if skip:
             for i in range(lo, hi):
                 if keys[i] not in skip:
@@ -1118,11 +1129,44 @@ class SSTable:
             for i in range(lo, hi):
                 yield keys[i], self._read_row(offs[i])
 
+    def _stream_rows(self, keys, offs, lo: int, hi: int, skip):
+        """Chunked/streamed decode for v4 range sweeps (replica
+        refresh refolds, rollup catch-up scans, full-store sketch
+        rebuilds): rows are grouped by their enclosing block and each
+        block decodes ONCE into a LOCAL buffer, dropped as the sweep
+        advances — peak decode memory is one block (vs filling and
+        churning the 8-slot cache), the per-row block bisect
+        disappears, and the point-get cache keeps its query working
+        set (a whole-generation sweep never evicts it). A cached
+        block is reused but a streamed decode is never inserted."""
+        j = -1
+        braw: bytes | None = None
+        blo = bhi = 0
+        for i in range(lo, hi):
+            if skip and keys[i] in skip:
+                continue
+            off = offs[i]
+            if not blo <= off < bhi or braw is None:
+                j = bisect_right(self._blk_raw, off) - 1
+                blo, bhi = self.block_raw_span(j)
+                braw = self._blk_cache.get(j)
+                if braw is None:
+                    tag, raw_len, _enc = self.block_header(j)
+                    with _M_DECODE.time():
+                        braw = _codecs.decode_block(
+                            tag, self.block_enc(j), raw_len)
+                    _M_STREAM.inc()
+            yield keys[i], self._parse_row(braw, off - blo)
+
     def iter_rows(self, table: str) -> Iterator[
             tuple[bytes, list[tuple[bytes, bytes, bytes]]]]:
         idx = self._index.get(table)
         if not idx:
             return
         keys, offs = idx
+        if self._blk_raw is not None and len(keys) > 1:
+            yield from self._stream_rows(keys, offs, 0, len(keys),
+                                         None)
+            return
         for key, off in zip(keys, offs):
             yield key, self._read_row(off)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import gzip
+import json
 import logging
 import os
 import sys
@@ -68,6 +69,13 @@ def common_args(p: argparse.ArgumentParser) -> None:
                    help="comma-separated rollup window sizes in seconds "
                         "(ascending, each a multiple of 3600 dividing "
                         "the next; default 3600,86400)")
+    p.add_argument("--sketch-byte-budget", type=int, default=None,
+                   help="accuracy-budgeted sketch allocation (sketch/"
+                        "budget.py): spend this many summary bytes "
+                        "across the rollup resolutions (kind + size "
+                        "per resolution, Storyboard-style) instead of "
+                        "the uniform sketch_min_res cutoff; `tsdb "
+                        "sketch-plan` previews the allocation")
     p.add_argument("--auto-metric", action="store_true",
                    help="automatically create metric UIDs (ingest)")
     p.add_argument("--read-only", action="store_true",
@@ -122,6 +130,8 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         table=args.table, uidtable=args.uidtable, wal_path=args.wal,
         backend=args.backend, auto_create_metrics=args.auto_metric,
         sstable_codec=getattr(args, "sstable_codec", "none"))
+    if getattr(args, "sketch_byte_budget", None) is not None:
+        cfg.sketch_byte_budget = int(args.sketch_byte_budget)
     if getattr(args, "rollups", False):
         cfg.enable_rollups = True
     if getattr(args, "rollup_resolutions", None):
@@ -747,6 +757,71 @@ def cmd_stats(args) -> int:
     return 0
 
 
+def cmd_sketch_plan(args) -> int:
+    """Preview the accuracy-budgeted sketch allocation (sketch/
+    budget.py): record densities come from the opened store's raw
+    tier (observed fold statistics), the query-workload profile from
+    a live daemon's trace ring (--url, the PR-6 slow-query ring) or
+    uniform weights. Printing only — the tier applies the budget via
+    --sketch-byte-budget at daemon start (a layout change rebuilds)."""
+    from opentsdb_tpu.core.const import MAX_TIMESPAN
+    from opentsdb_tpu.sketch import budget as _budget
+
+    budget = args.budget
+    if budget is None:
+        budget = getattr(args, "sketch_byte_budget", None)
+    if not budget or budget <= 0:
+        print("sketch-plan needs --budget (or --sketch-byte-budget) "
+              "> 0", file=sys.stderr)
+        return 2
+    tsdb = make_tsdb(args)
+    try:
+        tier = tsdb.rollups
+        if tier is not None:
+            resolutions = tier.resolutions
+            rows = tier._estimate_row_hours()
+            hll_p = tier.hll_p
+        else:
+            cfg = tsdb.config
+            resolutions = tuple(sorted(
+                int(r) for r in cfg.rollup_resolutions))
+            rows = 1
+            hll_p = cfg.rollup_hll_p
+        records = {r: max(rows // max(r // MAX_TIMESPAN, 1), 1)
+                   for r in resolutions}
+        workload = None
+        if args.url:
+            import urllib.request
+            try:
+                with urllib.request.urlopen(
+                        args.url.rstrip("/") + "/api/traces",
+                        timeout=10) as resp:
+                    ring = json.loads(resp.read())
+                workload = _budget.workload_from_ring(ring, resolutions)
+                print(f"workload profile from {args.url}: "
+                      + ", ".join(
+                          f"{r}s={w:g}" for r, w in
+                          sorted(workload.items())))
+            except Exception as e:
+                print(f"could not fetch workload from {args.url}: {e}"
+                      f" (using uniform weights)", file=sys.stderr)
+        allocs = _budget.allocate(int(budget), records, workload,
+                                  hll_p=hll_p)
+        print(_budget.render_plan(allocs, int(budget)))
+        if tier is not None and tier.sketch_byte_budget:
+            current = {r: tuple(a) for r, a in
+                       tier.sketch_alloc.items()}
+            planned = {r: (a.digest_k, a.moment_k, a.hll_p)
+                       for r, a in allocs.items()}
+            if current != planned:
+                print("NOTE: differs from the tier's current applied "
+                      "allocation — restarting the writer with this "
+                      "budget will rebuild the tier")
+        return 0
+    finally:
+        tsdb.shutdown()
+
+
 def cmd_version(args) -> int:
     from opentsdb_tpu.build_data import build_data, version_string
     print(version_string(), end="")
@@ -914,6 +989,20 @@ def main(argv: list[str] | None = None) -> int:
                    help="Prometheus text exposition (/metrics) instead "
                         "of classic stats lines")
     p.set_defaults(fn=cmd_stats)
+
+    p = sub.add_parser(
+        "sketch-plan",
+        help="preview the accuracy-budgeted sketch allocation for a "
+             "byte budget (sketch/budget.py)")
+    common_args(p)
+    p.add_argument("--budget", type=int, default=None,
+                   help="summary-byte budget to plan for (falls back "
+                        "to --sketch-byte-budget)")
+    p.add_argument("--url", default=None,
+                   help="base URL of a live tsd: derive the query-"
+                        "workload profile from its /api/traces ring "
+                        "instead of uniform weights")
+    p.set_defaults(fn=cmd_sketch_plan)
 
     p = sub.add_parser("version", help="print build/version information")
     p.add_argument("--verbose", action="store_true")

@@ -67,6 +67,31 @@ class Config:
     rollup_hll_p: int = 8          # HLL registers exponent per window
     rollup_sketch_min_res: int = 86400  # sketch columns at res >= this
     rollup_catchup: str = "background"  # background | sync | off
+    # After a crash mid-fold, catch up by refolding ONLY the windows
+    # the persisted in-flight snapshot names (ROLLUP.json "inflight")
+    # instead of rebuilding the whole tier. False forces the legacy
+    # full rebuild (the parity oracle for tests).
+    rollup_incremental_catchup: bool = True
+    # Moment-sketch columns (opentsdb_tpu/sketch/moment.py,
+    # arXiv:1803.01969): ~104 B/record of count/min/max/power-moments
+    # (+ log-moments), merged by pure addition — the tiny quantile
+    # column that lets dsagg-pNN queries serve approximately with a
+    # guaranteed error enclosure, at under a quarter of the default
+    # 64-centroid t-digest column's bytes. 0 disables; stored at
+    # resolutions >= rollup_moment_min_res (0 = every resolution).
+    rollup_moment_k: int = 5
+    rollup_moment_min_res: int = 0
+    # Accuracy-budgeted sketch allocation (opentsdb_tpu/sketch/
+    # budget.py, Storyboard-style): > 0 replaces the uniform
+    # sketch_min_res/moment_min_res cutoffs with an optimized
+    # per-resolution kind/size allocation spending this many bytes.
+    # `tsdb sketch-plan` previews the allocation.
+    sketch_byte_budget: int = 0
+    # The admission ladder's bounded-error step: a degraded pNN query
+    # is served approximately whenever its reported relative error
+    # bound is <= this budget (0 = any bound admits; the answer always
+    # REPORTS its bound either way).
+    degrade_max_error: float = 0.0
     # Debug oracle: derive the rollup planner's dirty-window set BOTH
     # ways — the O(1)-maintained store index and the legacy full
     # memtable-key sweep — and fail loudly on divergence. Test-only

@@ -380,6 +380,286 @@ def run_codec_compare(args) -> int:
     return 0
 
 
+def run_sketch_serve(args) -> int:
+    """BENCH_SKETCH.json: the accuracy-budgeted approximate-serving
+    legs. One rollup-backed corpus (digest + moment sketch columns at
+    1h and 1d); after the final fold, a pNN dashboard battery runs
+    three ways — raw-forced (the exact float64 oracle), digest-served
+    (approx=1, t-digest columns), moment-served (same columns, digest
+    rung masked so the moment kind answers) — recording wall time,
+    the REPORTED error bound, and the ACTUAL |exact - approx| error
+    (every answer must sit inside its bound). Plus the tier's
+    per-kind sketch bytes (the moment <= 25%-of-digest claim) and the
+    Storyboard allocator's plan at three byte budgets over the real
+    record densities."""
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                   capture_output=True)
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/jax_comp"))
+    except Exception:
+        pass
+    dev = jax.devices()[0]
+    log(f"device: {dev}")
+
+    from opentsdb_tpu.core.tsdb import TSDB
+    from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+    from opentsdb_tpu.sketch import budget as sbudget
+    from opentsdb_tpu.sketch.serving import ApproxSpec
+    from opentsdb_tpu.storage.kv import MemKVStore
+    from opentsdb_tpu.storage.sharded import ShardedKVStore
+    from opentsdb_tpu.utils.config import Config
+    from opentsdb_tpu.utils.gctune import tune_for_ingest
+    from opentsdb_tpu.utils.nativeext import ext as native_ext
+
+    shards = max(args.shards, 1)
+    base = 1356998400
+    pps = max(args.points // args.series, 1)
+    step = max(args.span // pps, 1)
+    block = min(args.block, pps)
+    end = base + pps * step
+    ckpt_every = args.checkpoint_every or max(args.points // 20, 1)
+    out = {"device": str(dev), "points": args.points,
+           "series": args.series, "step_s": step, "shards": shards,
+           "checkpoint_every": ckpt_every,
+           "native_ext": native_ext is not None,
+           "host": {"cores": os.cpu_count(),
+                    "ram_gb": round(os.sysconf("SC_PAGE_SIZE")
+                                    * os.sysconf("SC_PHYS_PAGES")
+                                    / (1 << 30))}}
+
+    wd = os.path.join(args.workdir, "sketch-serve")
+    shutil.rmtree(wd, ignore_errors=True)
+    os.makedirs(wd)
+    cfg = Config(auto_create_metrics=True, wal_path=wd,
+                 shards=shards, enable_sketches=False,
+                 device_window=False, enable_rollups=True,
+                 rollup_catchup="sync",
+                 rollup_sketch_min_res=3600)  # digests at 1h too
+    store = (ShardedKVStore(wd, shards=shards) if shards > 1
+             else MemKVStore(wal_path=os.path.join(wd, "wal")))
+    tsdb = TSDB(store, cfg, start_compaction_thread=False)
+    tune_for_ingest()
+    rng = np.random.default_rng(7)
+    phase = rng.integers(0, max(step - 1, 1), size=args.series)
+    tags = [{"host": f"h{si:04d}"} for si in range(args.series)]
+    total = 0
+    next_ckpt = ckpt_every
+    ckpt_s = synth_s = 0.0
+    t0 = time.perf_counter()
+    last_log = t0
+    for boff in range(0, pps, block):
+        bn = min(block, pps - boff)
+        ts0 = time.perf_counter()
+        rel = (boff + np.arange(bn, dtype=np.int64)) * step
+        # Lognormal-ish positive values: the moment solver's log
+        # domain and the digests both get realistic latency shapes.
+        template = np.exp(
+            rng.normal(0, 0.6, bn).astype(np.float32)) * 100.0
+        blocks = [(base + rel + phase[si],
+                   template * np.float32(1.0 + si / args.series))
+                  for si in range(args.series)]
+        synth_s += time.perf_counter() - ts0
+        for si in range(args.series):
+            ts, vals = blocks[si]
+            total += tsdb.add_batch("scale.metric", ts, vals,
+                                    tags[si])
+            if total >= next_ckpt:
+                tc = time.perf_counter()
+                tsdb.checkpoint()
+                ckpt_s += time.perf_counter() - tc
+                next_ckpt = total + ckpt_every
+        now = time.perf_counter()
+        if now - last_log > 30:
+            log(f"  {total:,} pts, {total / (now - t0):,.0f} dps, "
+                f"rss {rss_gb():.1f} GB")
+            last_log = now
+    tc = time.perf_counter()
+    tsdb.checkpoint()
+    ckpt_s += time.perf_counter() - tc
+    wall = time.perf_counter() - t0
+    out["ingest"] = {"points": total, "wall_s": round(wall, 1),
+                     "dps": round(total / wall),
+                     "dps_ex_synth": round(
+                         total / max(wall - synth_s, 1e-9)),
+                     "checkpoint_s": round(ckpt_s, 1)}
+    log(f"ingest {out['ingest']}")
+    tier = tsdb.rollups
+    assert tier is not None and tier.ready
+    sk_bytes = dict(tier.sketch_bytes)
+    per_res = {str(r): dict(k) for r, k in
+               sorted(tier.sketch_bytes_res.items())}
+    # The size claim is about EQUIVALENT columns: at the coarsest
+    # resolution the windows are dense enough that the t-digest
+    # saturates its k centroids — that's the column a moment sketch
+    # replaces byte-for-byte. (At sparse fine windows a digest
+    # degenerates to per-point centroids and is smaller than any
+    # fixed-size summary; both numbers are recorded.)
+    coarse = str(max(tier.resolutions))
+    cres = per_res.get(coarse, {})
+    ratio = (cres.get("moment", 0) / max(cres.get("tdigest", 1), 1))
+    out["tier"] = {
+        "records_written": tier.records_written,
+        "sketch_bytes": sk_bytes,
+        "sketch_bytes_by_res": per_res,
+        "moment_vs_tdigest_ratio_coarse": round(ratio, 4),
+        "dir_bytes": du(wd),
+        "sketch_alloc": {str(r): list(a) for r, a in
+                         sorted(tier.sketch_alloc.items())},
+    }
+    log(f"tier: {out['tier']['records_written']:,} records, "
+        f"sketch bytes {sk_bytes}; at {coarse}s "
+        f"moment/tdigest = {ratio:.3f}")
+
+    # Storyboard allocator at three budgets over the REAL densities.
+    rows = tier._estimate_row_hours()
+    records = {r: max(rows // max(r // 3600, 1), 1)
+               for r in tier.resolutions}
+    full_cost = sum(
+        sbudget.record_bytes(128, 8, tier.hll_p) * n
+        for n in records.values())
+    out["budgets"] = []
+    for frac in (0.05, 0.25, 1.0):
+        budget = int(full_cost * frac)
+        allocs = sbudget.allocate(budget, records, hll_p=tier.hll_p)
+        out["budgets"].append({
+            "budget_bytes": budget,
+            "planned_bytes": sum(a.total_bytes
+                                 for a in allocs.values()),
+            "alloc": {str(r): {"digest_k": a.digest_k,
+                               "moment_k": a.moment_k,
+                               "bytes_per_record": a.bytes_per_record}
+                      for r, a in sorted(allocs.items())}})
+        log(f"budget {budget / (1 << 20):,.0f} MB -> "
+            f"{out['budgets'][-1]['alloc']}")
+
+    # The pNN dashboard battery, three serving modes each.
+    ex = QueryExecutor(tsdb, backend="cpu")
+
+    def aligned(span: int, interval: int) -> tuple[int, int]:
+        """Window-aligned [lo, hi] ending at the corpus tail — the
+        dashboard shape (grafana-style panels align their ranges),
+        and what lets the approx rail cache serve repeats."""
+        e = end // interval * interval
+        return e - span, e - 1
+
+    battery = [
+        ("1week_1h_p95", *aligned(7 * 86400, 3600), "max",
+         (3600, "p95")),
+        ("1week_1h_p99", *aligned(7 * 86400, 3600), "avg",
+         (3600, "p99")),
+        ("1month_1d_p99", *aligned(30 * 86400, 86400), "max",
+         (86400, "p99")),
+        ("1week_2h_p50_hostgroup", *aligned(7 * 86400, 7200), "max",
+         (7200, "p50")),
+    ]
+    out["queries"] = []
+    for label, lo, hi, gagg, ds in battery:
+        tags_q = ({"host": "h0000|h0001|h0002|h0003"}
+                  if label.endswith("hostgroup") else {})
+        spec = QuerySpec("scale.metric", tags_q, gagg, downsample=ds)
+        rec = {"label": label, "m": f"{gagg}:{ds[0]}s-{ds[1]}"}
+
+        def timed(fn, n=3):
+            walls = []
+            res = None
+            for _ in range(n):
+                tq = time.perf_counter()
+                res = fn()
+                walls.append(time.perf_counter() - tq)
+            return res, walls
+
+        # Raw-forced (exact): cold first, then warm repeats through
+        # the fragment cache — the sketch legs must beat the WARM
+        # number for the speedup to mean anything.
+        tq = time.perf_counter()
+        exact = ex.run(spec, lo, hi)
+        rec["raw_cold_s"] = round(time.perf_counter() - tq, 4)
+        exact, walls = timed(lambda: ex.run(spec, lo, hi))
+        rec["raw_warm_s"] = round(min(walls), 4)
+
+        def approx_leg(kind_label):
+            got, walls = timed(lambda: ex.run_approx(
+                spec, lo, hi, approx=ApproxSpec(True, None)))
+            rs, plan, _c, info = got
+            leg = {"wall_s": round(min(walls), 4), "plan": plan}
+            if info is None:
+                leg["served"] = False
+                return leg
+            leg.update(served=True, kind=info.kind,
+                       reported_error=info.error,
+                       reported_rel_error=round(info.rel_error, 6))
+            ek = {tuple(sorted(r.tags.items())): r for r in exact}
+            worst = 0.0
+            n_buckets = 0
+            for r in rs:
+                ref = ek.get(tuple(sorted(r.tags.items())))
+                if ref is None:
+                    continue
+                ev = dict(zip(ref.timestamps.tolist(),
+                              ref.values.tolist()))
+                for t, v in zip(r.timestamps.tolist(),
+                                r.values.tolist()):
+                    if t in ev:
+                        worst = max(worst, abs(ev[t] - v))
+                        n_buckets += 1
+            leg["actual_error"] = round(worst, 6)
+            leg["buckets_checked"] = n_buckets
+            leg["within_bounds"] = bool(worst <= info.error + 1e-9)
+            return leg
+
+        rec["digest"] = approx_leg("tdigest")
+        # Moment leg: mask the digest rung so the SAME cells serve
+        # through the moment column (kind selection is per-res).
+        saved = dict(tier.sketch_alloc)
+        tier.sketch_alloc = {r: (0, a[1], 0)
+                             for r, a in saved.items()}
+        try:
+            rec["moment"] = approx_leg("moment")
+        finally:
+            tier.sketch_alloc = saved
+        for leg_name in ("digest", "moment"):
+            leg = rec[leg_name]
+            if leg.get("served"):
+                leg["speedup_vs_raw_warm"] = round(
+                    rec["raw_warm_s"] / max(leg["wall_s"], 1e-9), 1)
+                leg["speedup_vs_raw_cold"] = round(
+                    rec["raw_cold_s"] / max(leg["wall_s"], 1e-9), 1)
+        out["queries"].append(rec)
+        log(f"  {label}: raw {rec['raw_cold_s']}s cold / "
+            f"{rec['raw_warm_s']}s warm; digest "
+            f"{rec['digest'].get('wall_s')}s "
+            f"({rec['digest'].get('speedup_vs_raw_warm')}x, "
+            f"in-bounds={rec['digest'].get('within_bounds')}); "
+            f"moment {rec['moment'].get('wall_s')}s "
+            f"({rec['moment'].get('speedup_vs_raw_warm')}x, "
+            f"in-bounds={rec['moment'].get('within_bounds')})")
+
+    served = [q for q in out["queries"]
+              if q["digest"].get("served")]
+    out["summary"] = {
+        "min_digest_speedup_vs_raw_warm": min(
+            (q["digest"]["speedup_vs_raw_warm"] for q in served),
+            default=None),
+        "all_within_bounds": all(
+            q[leg].get("within_bounds", True)
+            for q in out["queries"] for leg in ("digest", "moment")
+            if q[leg].get("served")),
+        "moment_vs_tdigest_bytes_coarse": round(ratio, 4),
+    }
+    tsdb.shutdown()
+    suffixed = os.path.join(
+        REPO, f"BENCH_SKETCH_{total // 1_000_000}M_S{shards}.json")
+    for path in (suffixed, os.path.join(REPO, "BENCH_SKETCH.json")):
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+    log(f"summary: {out['summary']} -> BENCH_SKETCH.json")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--points", type=int, default=1_000_000_000)
@@ -429,12 +709,25 @@ def main() -> int:
                          "writes BENCH_COMPRESS.json (+ a size-"
                          "suffixed _C artifact — plain scale "
                          "artifacts are never touched)")
+    ap.add_argument("--sketch-serve", action="store_true",
+                    help="run the accuracy-budgeted approximate-"
+                         "serving comparison instead of the plain "
+                         "scale run: one rollup-backed corpus with "
+                         "digest + moment sketch columns, then the "
+                         "pNN dashboard battery raw-forced vs "
+                         "digest-served vs moment-served (wall time, "
+                         "reported vs actual error, within-bounds "
+                         "check), per-kind tier bytes, and the "
+                         "Storyboard allocation at three byte "
+                         "budgets; writes BENCH_SKETCH.json")
     ap.add_argument("--workdir", default="/tmp/tsdb_scale")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
     if args.codec:
         return run_codec_compare(args)
+    if args.sketch_serve:
+        return run_sketch_serve(args)
 
     # Native hot loops (gitignored artifact) before any package import.
     subprocess.run(["make", "-C", os.path.join(REPO, "native")],

@@ -90,10 +90,13 @@ BT = 1356998400
 # Cluster scenarios each boot a FRESH deployment (a promotion changes
 # who the writer is for good); the legacy four share one.
 CLUSTER = ("writer-promote", "zombie-fence", "promote-crash")
+# Rollup-backed deployment (writer folds on a 2 s checkpoint timer,
+# replicas serve the tier read-only): the bounded-error ladder row.
+ROLLUP = ("degraded-approx",)
 FAST = ("replica-kill", "router-partition", "writer-promote",
-        "zombie-fence")
+        "zombie-fence", "degraded-approx")
 ALL = ("replica-kill", "router-partition", "writer-crash",
-       "staleness-contract") + CLUSTER
+       "staleness-contract") + CLUSTER + ROLLUP
 BUGS = ("stale-serve", "split-brain")
 MAX_STALENESS_MS = 1200.0
 WRITER_GRACE_MS = 1000.0
@@ -779,6 +782,106 @@ def scenario_promote_crash(dep: Deployment, seed: int) -> dict:
     return {"problems": problems, "fingerprint_parts": []}
 
 
+def _wait_stats_value(port: int, name: str, want: float,
+                      timeout: float = 60.0) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            _, _, body = http_get(port, "/stats", timeout=5)
+            for ln in body.decode("utf-8", "replace").splitlines():
+                parts = ln.split()
+                if len(parts) >= 3 and parts[0] == name:
+                    if float(parts[2]) == want:
+                        return True
+        except Exception:
+            pass
+        time.sleep(0.2)
+    return False
+
+
+def scenario_degraded_approx(dep: Deployment, seed: int) -> dict:
+    """Ladder semantics, live: at the rollup-only degradation step a
+    pNN query comes back 200, tagged ``degraded`` AND ``approx``
+    with a numeric bound that CONTAINS the writer's exact answer —
+    not a silent partial, not a 503 — while a raw-only query at the
+    same step still sheds 503 + Retry-After (the declared ladder)."""
+    problems: list[str] = []
+    metric = "deg.p95.m"
+    n = 360  # six 1h windows of minutely points
+    dep.ingest_acked(metric, n, BT, seed % 89)
+    # Quiesce: the writer's 2 s checkpoint timer folds the tier, the
+    # replicas adopt it read-only via the tailer.
+    if not _wait_stats_value(dep.ports["writer"],
+                             "tsd.rollup.ready", 1):
+        problems.append("writer rollup tier never became ready")
+    if not _wait_stats_value(dep.ports["writer"],
+                             "tsd.dirty_set.size", 0):
+        problems.append("writer never quiesced (dirty windows left)")
+    for rep in ("replica-a", "replica-b"):
+        if not _wait_stats_value(dep.ports[rep],
+                                 "tsd.rollup.ready", 1):
+            problems.append(f"{rep} rollup tier never became ready")
+    if problems:
+        return {"problems": problems, "fingerprint_parts": []}
+    m = f"max:1h-p95:{metric}"
+    q = f"/q?start={BT - 60}&end={BT + n * 60}&m={m}&json&nocache"
+    status, _, body = http_get(dep.ports["writer"], q)
+    if status != 200:
+        return {"problems": [f"writer exact pNN query {status}"],
+                "fingerprint_parts": []}
+    exact = json.loads(body)
+    exact_dps = {}
+    for ent in exact:
+        exact_dps.update(ent["dps"])
+    golden = answer_hash(body)
+    status, headers, body = http_get(
+        dep.ports["router"], q + "&degrade=rollup-only", timeout=30)
+    if status != 200:
+        problems.append(
+            f"degraded pNN query answered {status} (the bounded-"
+            f"error step must serve): {body[:200]}")
+        return {"problems": problems, "fingerprint_parts": [golden]}
+    if "rollup-only" not in (headers.get("X-Tsd-Degraded") or ""):
+        problems.append("degraded answer missing X-Tsd-Degraded")
+    if not headers.get("X-Tsd-Approx"):
+        problems.append("degraded answer missing X-Tsd-Approx")
+    res = json.loads(body)
+    buckets = 0
+    for ent in res:
+        if "rollup-only" not in (ent.get("degraded") or ""):
+            problems.append("result missing degraded tag")
+        ap = ent.get("approx")
+        if (not ap or ap.get("kind") not in ("tdigest", "moment")
+                or not isinstance(ap.get("error"), (int, float))):
+            problems.append(
+                f"result missing numeric approx bound: {ap}")
+            continue
+        for ts_s, v in ent["dps"].items():
+            buckets += 1
+            ev = exact_dps.get(ts_s)
+            if ev is None:
+                problems.append(f"approx bucket {ts_s} absent from "
+                                f"the exact answer")
+            elif abs(ev - v) > ap["error"] + 1e-9:
+                problems.append(
+                    f"BOUND VIOLATION at {ts_s}: exact={ev} "
+                    f"approx={v} reported_error={ap['error']}")
+    if buckets == 0:
+        problems.append("degraded pNN answer was an empty/silent "
+                        "partial")
+    # The ladder's other face: raw-only work still sheds, loudly.
+    status2, h2, b2 = http_get(
+        dep.ports["router"],
+        f"/q?start={BT - 60}&end={BT + n * 60}&m=sum:{metric}"
+        f"&json&nocache&degrade=rollup-only", timeout=30)
+    if status2 != 503:
+        problems.append(f"raw-only degraded query got {status2}, "
+                        f"want 503: {b2[:200]}")
+    elif not h2.get("Retry-After"):
+        problems.append("503 without Retry-After")
+    return {"problems": problems, "fingerprint_parts": [golden]}
+
+
 SCENARIOS = {
     "replica-kill": scenario_replica_kill,
     "router-partition": scenario_router_partition,
@@ -787,6 +890,7 @@ SCENARIOS = {
     "writer-promote": scenario_writer_promote,
     "zombie-fence": scenario_zombie_fence,
     "promote-crash": scenario_promote_crash,
+    "degraded-approx": scenario_degraded_approx,
 }
 
 
@@ -821,7 +925,17 @@ def _run_one(dep: Deployment, label: str, seed: int,
 def run(labels, workdir: str, seed: int, bug: str | None) -> list[dict]:
     os.makedirs(workdir, exist_ok=True)
     results = []
-    legacy = [lb for lb in labels if lb not in CLUSTER]
+    for label in (lb for lb in labels if lb in ROLLUP):
+        dep = Deployment(os.path.join(workdir, label), seed, bug=bug,
+                         rollups=True)
+        log(f"booting ROLLUP deployment for {label} ...")
+        dep.start()
+        try:
+            results.append(_run_one(dep, label, seed, bug))
+        finally:
+            dep.stop()
+    legacy = [lb for lb in labels
+              if lb not in CLUSTER and lb not in ROLLUP]
     if legacy:
         dep = Deployment(os.path.join(workdir, "legacy"), seed,
                          bug=bug)

@@ -1,0 +1,477 @@
+#!/usr/bin/env python
+"""Golden accuracy harness for the approximate serving tier.
+
+The error CONTRACT under test: every approximate answer —
+``/q?approx=1`` percentile downsamples, ranged ``/sketch``,
+``/distinct`` streaming estimates, and the admission ladder's
+bounded-error degraded step — reports a bound that CONTAINS the
+exact-raw answer. The harness builds a seeded multi-distribution
+corpus (lognormal / pareto / bimodal / heavy-duplicate), serves it
+through a LIVE TSDServer socket at shards 1 and 4, and checks the
+contract through live ingest, a mid-run checkpoint, and a replica
+refresh (read-only store catching up on the writer's state).
+
+``--bug loose-bound`` is the gate: TSDB_SKETCH_BUG=loose-bound makes
+the serving tier report bounds 100x tighter than computed, and the
+harness MUST flag violations (a harness that can't catch a lying
+bound proves nothing). scripts-level artifact: SKETCH_ACCURACY.json.
+
+Usage:
+    python scripts/sketch_harness.py [--fast] [--shards 1,4]
+        [--bug loose-bound] [--json OUT] [--work-dir DIR]
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+BASE = 1356998400
+DISTS = ("lognormal", "pareto", "bimodal", "heavydup")
+
+
+def log(msg: str) -> None:
+    print(f"[sketch-harness] {msg}", flush=True)
+
+
+def dist_values(rng, name, n):
+    if name == "lognormal":
+        return rng.lognormal(1.0, 1.1, n)
+    if name == "pareto":
+        return (rng.pareto(2.2, n) + 1.0) * 3.0
+    if name == "bimodal":
+        return np.concatenate([rng.normal(10, 1, n // 2),
+                               rng.normal(80, 5, n - n // 2)])
+    return rng.choice([1.0, 2.0, 2.0, 5.0, 100.0], n)  # heavydup
+
+
+def build_corpus(tsdb, days, step, seed):
+    """Seeded multi-distribution corpus: one metric per distribution,
+    3 tagged series each."""
+    rng = np.random.default_rng(seed)
+    n = days * 86400 // step
+    for name in DISTS:
+        for si in range(3):
+            ts = (BASE + np.arange(n, dtype=np.int64) * step
+                  + (si * 7) % step)
+            tsdb.add_batch(f"sk.{name}", ts,
+                           dist_values(rng, name, n),
+                           {"host": f"h{si}"})
+
+
+async def http_get(port, target):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n"
+                 "Connection: close\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    headers = {}
+    for ln in head.split(b"\r\n")[1:]:
+        k, _, v = ln.partition(b":")
+        headers[k.strip().lower().decode()] = v.strip().decode()
+    return status, headers, body
+
+
+class Leg:
+    """One shard-count leg: live server + contract checks."""
+
+    def __init__(self, work_dir: str, shards: int, fast: bool) -> None:
+        from opentsdb_tpu.core.tsdb import TSDB
+        from opentsdb_tpu.server.tsd import TSDServer
+        from opentsdb_tpu.storage.kv import MemKVStore
+        from opentsdb_tpu.storage.sharded import ShardedKVStore
+        from opentsdb_tpu.utils.config import Config
+
+        self.shards = shards
+        self.fast = fast
+        self.days = 2 if fast else 3
+        self.step = 600 if fast else 300
+        self.dir = os.path.join(work_dir, f"s{shards}")
+        os.makedirs(self.dir, exist_ok=True)
+        cfg = Config(auto_create_metrics=True, port=0,
+                     bind="127.0.0.1", backend="cpu",
+                     enable_sketches=True, device_window=False,
+                     wal_path=self.dir if shards > 1
+                     else os.path.join(self.dir, "wal"),
+                     enable_rollups=True, rollup_catchup="sync",
+                     rollup_sketch_min_res=3600, shards=shards,
+                     query_max_inflight=4)
+        store = (ShardedKVStore(self.dir, shards=shards)
+                 if shards > 1
+                 else MemKVStore(wal_path=cfg.wal_path))
+        self.tsdb = TSDB(store, cfg, start_compaction_thread=False)
+        self.server = TSDServer(self.tsdb)
+        self.checks = 0
+        self.q_served = 0
+        self.q_declined = 0
+        self.violations: list[dict] = []
+
+    # -- contract assertions ------------------------------------------
+
+    def _violate(self, what: str, **detail) -> None:
+        self.violations.append(dict(what=what, shards=self.shards,
+                                    **detail))
+
+    async def check_q(self, port, phase: str) -> None:
+        """/q percentile downsamples: approx vs exact, per bucket."""
+        qend = BASE + self.days * 86400
+        combos = [("max", "p95", 3600), ("avg", "p50", 7200),
+                  ("sum", "p99", 3600)]
+        if self.fast:
+            combos = combos[:2]
+        for name in DISTS:
+            for gagg, ds, iv in combos:
+                m = f"{gagg}:{iv // 3600}h-{ds}:sk.{name}{{host=*}}"
+                base_q = (f"/q?start={BASE + 900}&end={qend - 900}"
+                          f"&m={m}&json&nocache")
+                s1, _h1, b1 = await http_get(port, base_q)
+                s2, h2, b2 = await http_get(port, base_q + "&approx=1")
+                if s1 != 200 or s2 != 200:
+                    self._violate("q-status", phase=phase, m=m,
+                                  exact=s1, approx=s2)
+                    continue
+                exact = json.loads(b1)
+                approx = json.loads(b2)
+                if not any(e.get("approx") for e in approx):
+                    # The tier may legitimately fall back (bound over
+                    # budget is impossible here — no budget — so a
+                    # missing approx object means sketch-serving
+                    # declined). A single decline is a miss, not a
+                    # violation — but the leg-wide counter below turns
+                    # "declined EVERY combo" into q-never-served, so a
+                    # regression that kills the /q approx path can't
+                    # pass on the other endpoints' checks alone.
+                    self.q_declined += 1
+                    continue
+                self.q_served += 1
+                if "x-tsd-approx" not in h2:
+                    self._violate("missing-approx-header", phase=phase,
+                                  m=m)
+                ek = {tuple(sorted(e["tags"].items())): e
+                      for e in exact}
+                for ent in approx:
+                    self.checks += 1
+                    err = ent["approx"]["error"]
+                    ref = ek.get(tuple(sorted(ent["tags"].items())))
+                    if ref is None:
+                        self._violate("approx-extra-series",
+                                      phase=phase, m=m)
+                        continue
+                    for ts_s, v in ent["dps"].items():
+                        ev = ref["dps"].get(ts_s)
+                        if ev is None:
+                            self._violate("approx-extra-bucket",
+                                          phase=phase, m=m, ts=ts_s)
+                        elif abs(ev - v) > err + 1e-9:
+                            self._violate(
+                                "bound-violated", phase=phase, m=m,
+                                ts=ts_s, exact=ev, approx=v,
+                                reported_error=err,
+                                actual_error=abs(ev - v))
+
+    def _exact_quantiles(self, metric: str, start: int, end: int,
+                         qs) -> dict:
+        """In-process oracle: pool every in-range value (float32-cast
+        like the sketch columns quantize) and np.quantile — exactly
+        the endpoint's exact-raw fallback math."""
+        from opentsdb_tpu.query.executor import (QueryExecutor,
+                                                 QuerySpec)
+        ex = QueryExecutor(self.tsdb, backend="cpu")
+        groups = ex._find_spans(QuerySpec(metric, {}), start, end)
+        vals = [sp.values for spans in groups.values()
+                for sp in spans]
+        pool = np.concatenate(vals).astype(np.float32).astype(
+            np.float64)
+        est = np.quantile(pool, qs)
+        return {f"{q:g}": float(v) for q, v in zip(qs, est)}
+
+    async def check_sketch(self, port, phase: str) -> None:
+        qend = BASE + self.days * 86400
+        for name in DISTS:
+            tgt = (f"/sketch?m=sk.{name}&q=p50,p95,p99"
+                   f"&start={BASE}&end={qend}")
+            s1, _h, b1 = await http_get(port, tgt)
+            if s1 != 200:
+                self._violate("sketch-status", phase=phase, m=name,
+                              status=s1)
+                continue
+            approx = json.loads(b1)
+            ap = approx.get("approx")
+            if not ap:
+                continue  # tier declined: exact answer, nothing to hold
+            exact = self._exact_quantiles(f"sk.{name}", BASE, qend,
+                                          (0.5, 0.95, 0.99))
+            # A max_error budget tighter than the reported bound must
+            # force the exact-raw fallback (unless the bound already
+            # met it — discrete data can honestly report ~0).
+            rel = float(ap.get("rel_error", 0.0))
+            if rel > 1e-9:
+                budget = rel / 10.0
+                s2, _h2, b2 = await http_get(
+                    port, tgt + f"&max_error={budget:g}")
+                if s2 == 200:
+                    forced = json.loads(b2)
+                    got = forced.get("approx")
+                    if got and got.get("rel_error", 0.0) > budget:
+                        self._violate("sketch-budget-ignored",
+                                      phase=phase, m=name)
+            for qk, err in ap["error"].items():
+                self.checks += 1
+                est = approx["quantiles"][qk]
+                exa = exact[qk]
+                if abs(est - exa) > err + 1e-9:
+                    self._violate("sketch-bound-violated", phase=phase,
+                                  m=name, q=qk, exact=exa, approx=est,
+                                  reported_error=err)
+
+    async def check_distinct(self, port, phase: str) -> None:
+        for name in DISTS:
+            s, _h, b = await http_get(
+                port, f"/distinct?metric=sk.{name}&tagk=host")
+            if s != 200:
+                self._violate("distinct-status", phase=phase, m=name)
+                continue
+            out = json.loads(b)
+            ap = out.get("approx")
+            if not ap:
+                self._violate("distinct-missing-approx", phase=phase,
+                              m=name)
+                continue
+            self.checks += 1
+            if abs(out["distinct"] - 3) > max(ap["error"], 0.5):
+                self._violate("distinct-bound-violated", phase=phase,
+                              m=name, est=out["distinct"],
+                              true=3, reported_error=ap["error"])
+
+    async def check_degraded(self, port) -> None:
+        """The ladder's bounded-error step, quiesced (post-fold):
+        tagged degraded + approx, 200, bounds hold."""
+        qend = BASE + self.days * 86400
+        m = "max:1h-p95:sk.lognormal{host=*}"
+        base_q = (f"/q?start={BASE + 3600}&end={qend - 3600}"
+                  f"&m={m}&json&nocache")
+        s1, _h1, b1 = await http_get(port, base_q)
+        adm = self.server.admission
+        adm.inflight_queries = int(self.tsdb.config.query_max_inflight)
+        try:
+            s2, h2, b2 = await http_get(port, base_q)
+        finally:
+            adm.inflight_queries = 0
+        if s1 != 200:
+            self._violate("degraded-exact-status", status=s1)
+            return
+        if s2 != 200:
+            self._violate("degraded-not-served", status=s2,
+                          body=b2.decode()[:200])
+            return
+        exact = json.loads(b1)
+        got = json.loads(b2)
+        if h2.get("x-tsd-degraded") != "rollup-only":
+            self._violate("degraded-header-missing")
+        for ent in got:
+            if ent.get("degraded") != "rollup-only":
+                self._violate("degraded-tag-missing")
+            ap = ent.get("approx")
+            if not ap:
+                self._violate("degraded-approx-missing")
+                continue
+            if ap.get("stale_windows"):
+                continue  # live data raced in: bound is conditional
+            ek = {tuple(sorted(e["tags"].items())): e for e in exact}
+            ref = ek.get(tuple(sorted(ent["tags"].items())))
+            if ref is None:
+                continue
+            for ts_s, v in ent["dps"].items():
+                ev = ref["dps"].get(ts_s)
+                if ev is None:
+                    continue  # edge omission is declared, not silent
+                self.checks += 1
+                if abs(ev - v) > ap["error"] + 1e-9:
+                    self._violate("degraded-bound-violated", ts=ts_s,
+                                  exact=ev, approx=v,
+                                  reported_error=ap["error"])
+
+    def check_replica(self) -> None:
+        """Replica leg: a read-only store refreshed off the writer's
+        durable state serves the same contract."""
+        from opentsdb_tpu.core.tsdb import TSDB
+        from opentsdb_tpu.query.executor import (QueryExecutor,
+                                                 QuerySpec)
+        from opentsdb_tpu.sketch.serving import ApproxSpec
+        from opentsdb_tpu.storage.kv import MemKVStore
+        from opentsdb_tpu.storage.sharded import ShardedKVStore
+        from opentsdb_tpu.utils.config import Config
+
+        cfg = Config(auto_create_metrics=False, backend="cpu",
+                     enable_sketches=False, device_window=False,
+                     wal_path=self.tsdb.config.wal_path,
+                     enable_rollups=True, shards=self.shards,
+                     role="replica")
+        store = (ShardedKVStore(self.dir, shards=self.shards,
+                                read_only=True)
+                 if self.shards > 1
+                 else MemKVStore(wal_path=cfg.wal_path,
+                                 read_only=True))
+        rep = TSDB(store, cfg, start_compaction_thread=False)
+        try:
+            rep.refresh_replica()
+            exw = QueryExecutor(self.tsdb, backend="cpu")
+            exr = QueryExecutor(rep, backend="cpu")
+            qend = BASE + self.days * 86400
+            for name in DISTS:
+                spec = QuerySpec(f"sk.{name}", {"host": "*"}, "max",
+                                 downsample=(3600, "p95"))
+                exact = exw.run(spec, BASE + 3600, qend - 3600)
+                rs, plan, _c, info = exr.run_approx(
+                    spec, BASE + 3600, qend - 3600,
+                    approx=ApproxSpec(True, None))
+                if info is None:
+                    self._violate("replica-approx-declined", m=name,
+                                  plan=plan)
+                    continue
+                ek = {tuple(sorted(e.tags.items())): e for e in exact}
+                for r in rs:
+                    ref = ek.get(tuple(sorted(r.tags.items())))
+                    if ref is None:
+                        continue
+                    evals = dict(zip(ref.timestamps.tolist(),
+                                     ref.values.tolist()))
+                    for t, v in zip(r.timestamps.tolist(),
+                                    r.values.tolist()):
+                        ev = evals.get(t)
+                        if ev is None:
+                            continue
+                        self.checks += 1
+                        if abs(ev - v) > info.error + 1e-9:
+                            self._violate("replica-bound-violated",
+                                          m=name, ts=t, exact=ev,
+                                          approx=v,
+                                          reported_error=info.error)
+        finally:
+            rep.shutdown()
+
+    # -- the leg driver ------------------------------------------------
+
+    async def drive(self) -> None:
+        await self.server.start()
+        port = self.server.port
+        try:
+            log(f"shards={self.shards}: corpus "
+                f"({self.days}d x {len(DISTS)} dists x 3 series)")
+            build_corpus(self.tsdb, self.days, self.step,
+                         seed=1000 + self.shards)
+            # Phase 1: everything memtable-dirty (raw-stitch heavy).
+            await self.check_q(port, "pre-checkpoint")
+            self.tsdb.checkpoint()
+            # Phase 2: folded tier + LIVE ingest on top.
+            rng = np.random.default_rng(77 + self.shards)
+            for name in DISTS:
+                # Offset +13 s so live points never collide with the
+                # step-aligned corpus timestamps.
+                ts = (BASE + self.days * 86400 - 13
+                      - np.arange(60, dtype=np.int64) * 30)
+                self.tsdb.add_batch(
+                    f"sk.{name}", np.sort(ts),
+                    dist_values(rng, name, 60), {"host": "h0"})
+            await self.check_q(port, "live-ingest")
+            await self.check_sketch(port, "live-ingest")
+            await self.check_distinct(port, "live-ingest")
+            # Phase 3: second checkpoint (fold covers the live tail),
+            # degraded ladder + replica refresh.
+            self.tsdb.checkpoint()
+            await self.check_q(port, "post-checkpoint")
+            await self.check_sketch(port, "post-checkpoint")
+            await self.check_degraded(port)
+            if self.q_served == 0:
+                # Post-checkpoint phases had a folded tier under them;
+                # zero approx-served /q combos across the whole leg
+                # means the primary contract surface went untested.
+                self._violate("q-never-served",
+                              declined=self.q_declined)
+            if self.tsdb.config.wal_path:
+                self.check_replica()
+        finally:
+            self.server._pool.shutdown(wait=False)
+            self.server._server.close()
+            await self.server._server.wait_closed()
+
+    def run(self) -> dict:
+        t0 = time.time()
+        try:
+            asyncio.run(self.drive())
+        finally:
+            self.tsdb.shutdown()
+        return {"shards": self.shards, "checks": self.checks,
+                "q_served": self.q_served,
+                "q_declined": self.q_declined,
+                "violations": self.violations,
+                "wall_s": round(time.time() - t0, 2)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 subset: shards 1 only, small corpus")
+    ap.add_argument("--shards", default=None,
+                    help="comma list (default 1,4; --fast: 1)")
+    ap.add_argument("--bug", default=None, choices=["loose-bound"],
+                    help="sabotage the reported bounds; the harness "
+                         "MUST flag violations (the gate)")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--work-dir", default=None)
+    args = ap.parse_args()
+
+    if args.bug:
+        os.environ["TSDB_SKETCH_BUG"] = args.bug
+    shards = ([int(s) for s in args.shards.split(",")] if args.shards
+              else ([1] if args.fast else [1, 4]))
+    work = args.work_dir or tempfile.mkdtemp(prefix="sketch_harness_")
+    os.makedirs(work, exist_ok=True)
+    legs = []
+    try:
+        for s in shards:
+            legs.append(Leg(work, s, args.fast).run())
+    finally:
+        if args.work_dir is None:
+            shutil.rmtree(work, ignore_errors=True)
+    total_checks = sum(x["checks"] for x in legs)
+    total_viol = sum(len(x["violations"]) for x in legs)
+    art = {
+        "generated": int(time.time()),
+        "bug": args.bug,
+        "fast": bool(args.fast),
+        "legs": legs,
+        "checks": total_checks,
+        "violations": total_viol,
+        "passed": total_viol == 0 and total_checks > 0,
+    }
+    out = args.json or os.path.join(REPO, "SKETCH_ACCURACY.json")
+    with open(out, "w") as f:
+        json.dump(art, f, indent=1)
+    log(f"checks={total_checks} violations={total_viol} -> {out}")
+    if args.bug:
+        # Gate semantics: the sabotage MUST be caught.
+        if total_viol == 0:
+            log("GATE FAILED: sabotaged bounds were not flagged")
+            return 1
+        log(f"gate ok: {total_viol} violations flagged under --bug")
+        return 0
+    return 0 if art["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

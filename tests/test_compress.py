@@ -593,6 +593,69 @@ class TestFusedPath:
         finally:
             t.shutdown()
 
+    def test_fused_declines_tsint_blocks_bit_identical(self, tmp_path):
+        """Int-valued series spill as TSINT blocks; the fused path is
+        float-only (TSF32 XOR chains), so it must decline cleanly to
+        the classic scan — and the scan's answers must be bit-
+        identical to a codec=none control store over the same points
+        (guards the float-only eligibility check: a silent
+        misclassification would feed int bit patterns to the f32
+        bitcast)."""
+        import shutil as _sh
+        specs = [QuerySpec("m.int", {}, "sum", downsample=(3600, "sum")),
+                 QuerySpec("m.int", {"host": "*"}, "max",
+                           downsample=(7200, "max")),
+                 QuerySpec("m.int", {}, "p95", downsample=(3600, "avg"))]
+
+        def build(name, codec):
+            d = str(tmp_path / name)
+            os.makedirs(d, exist_ok=True)
+            cfg = Config(auto_create_metrics=True, wal_path=d,
+                         shards=1, backend="tpu",
+                         enable_sketches=False, device_window=False,
+                         sstable_codec=codec)
+            t = TSDB(MemKVStore(wal_path=os.path.join(d, "wal")), cfg,
+                     start_compaction_thread=False)
+            rng = np.random.default_rng(17)
+            for si in range(4):
+                ts = BASE + np.arange(0, 24 * 3600, 300,
+                                      dtype=np.int64) + si
+                vals = rng.integers(-1000, 10_000, len(ts))
+                t.add_batch("m.int", ts, vals, {"host": f"h{si}"})
+            t.checkpoint()
+            return t
+
+        t4 = build("ti4", "tsst4")
+        t0 = build("ti0", "none")
+        try:
+            # The v4 store really holds TSINT blocks (not zlib/f32).
+            from opentsdb_tpu.compress.codecs import TSINT
+            sst = t4.store._ssts[-1]
+            assert sst.format == 4
+            tags = {sst.block_header(j)[0]
+                    for j in range(sst.block_count)}
+            assert TSINT in tags
+            ex4 = QueryExecutor(t4, backend="tpu")
+            ex0 = QueryExecutor(t0, backend="tpu")
+            for spec in specs:
+                r4, plan4, _ = ex4.run_with_plan(spec, BASE + 100,
+                                                 BASE + 20 * 3600)
+                assert plan4 == "raw", \
+                    "TSINT blocks must decline the fused path"
+                r0, plan0, _ = ex0.run_with_plan(spec, BASE + 100,
+                                                 BASE + 20 * 3600)
+                assert plan0 == "raw"
+                assert len(r4) == len(r0)
+                for a, b in zip(r4, r0):
+                    assert a.tags == b.tags
+                    assert np.array_equal(a.timestamps, b.timestamps)
+                    # Bit-identical: same classic scan both sides.
+                    assert np.array_equal(a.values, b.values)
+        finally:
+            t4.shutdown()
+            t0.shutdown()
+            _sh.rmtree(str(tmp_path / "ti4"), ignore_errors=True)
+
     def test_fused_declines_on_v3_store(self, tmp_path):
         d = str(tmp_path / "v3")
         os.makedirs(d, exist_ok=True)

@@ -220,9 +220,16 @@ class TestGrammar:
         assert (p.aggregator, p.metric, p.rate, p.downsample) == \
             ("avg", "sys.mem.free", False, None)
 
+    def test_percentile_downsampler_accepted(self):
+        # dsagg pNN is legal since the approximate serving tier: it
+        # runs exactly on the float64 oracle, or from sketch columns
+        # under the error contract (approx=1 / max_error=X).
+        p = parse_m("max:10m-p95:m")
+        assert p.downsample == (600, "p95")
+
     @pytest.mark.parametrize("bad", [
         "sys.cpu.user", "bogus:sys.cpu.user", "sum:10x-avg:m",
-        "sum:10m-p95:m", "sum:wat:m{a=b}", "",
+        "sum:10m-cardinality:m", "sum:wat:m{a=b}", "",
         "sum:rate{}:m", "sum:rate{bogus}:m", "sum:rate{counter,x}:m",
         "sum:rate{counter,1,2,3}:m",
     ])

@@ -207,13 +207,13 @@ class TestRetry:
         m0 = owner_metric(0)
         # Wedge replica A's executor: queries to it hang well past
         # the deadline.
-        real = dep.ra.executor.run_with_plan
+        real = dep.ra.executor.run_approx
 
         def slow(*a, **kw):
             time.sleep(5.0)
             return real(*a, **kw)
 
-        dep.ra.executor.run_with_plan = slow
+        dep.ra.executor.run_approx = slow
 
         async def drive(dep):
             t0 = time.monotonic()
@@ -235,13 +235,13 @@ class TestHedging:
                          router_retries=0, router_hedge_ms=50.0,
                          router_deadline_ms=10_000.0)
         m0 = owner_metric(0)
-        real = dep.ra.executor.run_with_plan
+        real = dep.ra.executor.run_approx
 
         def slow(*a, **kw):
             time.sleep(1.5)
             return real(*a, **kw)
 
-        dep.ra.executor.run_with_plan = slow
+        dep.ra.executor.run_approx = slow
 
         async def drive(dep):
             q = (f"/q?start={BT - 60}&end={BT + N_POINTS * 60}"

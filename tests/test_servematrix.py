@@ -36,14 +36,15 @@ class TestFastSubset:
     appends rejected)."""
 
     def test_fast_scenarios_pass(self, tmp_path):
-        r, art = run_matrix(tmp_path, "--fast", timeout=600)
+        r, art = run_matrix(tmp_path, "--fast", timeout=700)
         assert art is not None, r.stderr[-2000:]
         assert r.returncode == 0, (
             [x["problems"] for x in art["results"]], r.stderr[-2000:])
-        assert art["passed"] == art["scenarios"] == 4
+        assert art["passed"] == art["scenarios"] == 5
         labels = {x["label"] for x in art["results"]}
         assert labels == {"replica-kill", "router-partition",
-                          "writer-promote", "zombie-fence"}
+                          "writer-promote", "zombie-fence",
+                          "degraded-approx"}
 
 
 class TestStalenessGate:
@@ -93,7 +94,7 @@ class TestFullSweep:
         assert r1.returncode == 0, (
             a1 and [x["problems"] for x in a1["results"]],
             r1.stderr[-2000:])
-        assert a1["passed"] == a1["scenarios"] == 7
+        assert a1["passed"] == a1["scenarios"] == 8
         r2, a2 = run_matrix(tmp_path / "r2", timeout=900)
         assert r2.returncode == 0
         f1 = {x["label"]: x["fingerprint"] for x in a1["results"]}

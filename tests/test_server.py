@@ -292,13 +292,13 @@ class TestHttp:
         tsdb.metrics.get_or_create_id("m.empty")
         target = f"/q?start={BT}&end={BT + 10}&m=sum:m.empty&ascii"
         calls = {"n": 0}
-        real_run = server.executor.run_with_plan
+        real_run = server.executor.run_approx
 
         def counting_run(*a, **k):
             calls["n"] += 1
             return real_run(*a, **k)
 
-        server.executor.run_with_plan = counting_run
+        server.executor.run_approx = counting_run
 
         async def drive(port):
             first = await http_get(port, target)

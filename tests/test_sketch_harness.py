@@ -1,0 +1,59 @@
+"""Accuracy-harness tests (scripts/sketch_harness.py): the tier-1
+fast leg (every approximate /q /sketch /distinct answer's reported
+bound contains the exact-raw answer, through live ingest + a
+checkpoint + a replica refresh), the loose-bound GATE (a harness that
+can't catch a lying bound proves nothing), and the slow full sweep at
+shards 1 and 4."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "sketch_harness.py")
+
+
+def run_harness(tmp_path, *args, timeout=600):
+    out_json = str(tmp_path / "acc.json")
+    r = subprocess.run(
+        [sys.executable, SCRIPT, "--json", out_json,
+         "--work-dir", str(tmp_path / "work")] + list(args),
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    art = None
+    if os.path.exists(out_json):
+        with open(out_json) as f:
+            art = json.load(f)
+    return r, art
+
+
+def test_fast_leg_bounds_hold(tmp_path):
+    r, art = run_harness(tmp_path, "--fast")
+    assert art is not None, r.stderr[-2000:]
+    assert r.returncode == 0, (art["legs"], r.stderr[-2000:])
+    assert art["passed"] and art["checks"] > 100
+    assert art["violations"] == 0
+
+
+def test_loose_bound_gate_catches_sabotage(tmp_path):
+    r, art = run_harness(tmp_path, "--fast", "--bug", "loose-bound")
+    assert art is not None, r.stderr[-2000:]
+    # Gate semantics: rc 0 means the sabotage WAS flagged.
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert art["violations"] > 0, \
+        "sabotaged bounds were not flagged — the harness is toothless"
+    kinds = {v["what"] for leg in art["legs"]
+             for v in leg["violations"]}
+    assert "bound-violated" in kinds
+
+
+@pytest.mark.slow
+def test_full_sweep_shards_1_and_4(tmp_path):
+    r, art = run_harness(tmp_path, timeout=1800)
+    assert art is not None, r.stderr[-2000:]
+    assert r.returncode == 0, (art["legs"], r.stderr[-2000:])
+    assert {leg["shards"] for leg in art["legs"]} == {1, 4}
+    assert art["violations"] == 0

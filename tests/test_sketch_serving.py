@@ -1,0 +1,748 @@
+"""Accuracy-budgeted approximate serving: moment sketches, error
+enclosures, sketch-served percentile downsamples, the byte-budget
+allocator, incremental rollup catch-up, and the server contract
+surface (approx=1 / max_error=X, X-Tsd-Approx, bounded-error ladder).
+
+The load-bearing invariant everywhere: a reported bound CONTAINS the
+exact answer (scripts/sketch_harness.py runs the full multi-
+distribution corpus; these tests pin the unit pieces + a fast slice).
+"""
+
+import asyncio
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.sketch import bounds as sbounds
+from opentsdb_tpu.sketch import budget as sbudget
+from opentsdb_tpu.sketch.moment import MomentSketch, quantile_estimate
+from opentsdb_tpu.sketch.serving import ApproxSpec
+
+from tests.test_rollup import (BASE, METRIC, assert_equal_results,
+                               ingest, make_tsdb)
+
+QS = (0.5, 0.9, 0.95, 0.99)
+
+
+def _dists(rng, n=4000):
+    return {
+        "lognormal": rng.lognormal(0.0, 1.2, n),
+        "pareto": (rng.pareto(2.2, n) + 1.0) * 3.0,
+        "bimodal": np.concatenate([rng.normal(10, 1, n // 2),
+                                   rng.normal(80, 5, n - n // 2)]),
+        "heavy-dup": rng.choice([1.0, 2.0, 2.0, 5.0, 100.0], n),
+        "negative": rng.normal(-50, 20, n),
+    }
+
+
+class TestMomentSketch:
+    def test_roundtrip_and_size(self):
+        rng = np.random.default_rng(0)
+        v = rng.lognormal(0, 1, 500)
+        sk = MomentSketch(8).add(v)
+        blob = sk.encode()
+        assert len(blob) <= 200, len(blob)  # the ~100-200 B contract
+        sk2 = MomentSketch.decode(blob)
+        assert sk2.count == 500
+        assert sk2.vmin == sk.vmin and sk2.vmax == sk.vmax
+        np.testing.assert_array_equal(sk2.moments, sk.moments)
+        np.testing.assert_array_equal(sk2.logs, sk.logs)
+
+    def test_merge_is_exact_addition(self):
+        rng = np.random.default_rng(1)
+        a, b = rng.normal(5, 2, 300), rng.normal(9, 1, 200)
+        whole = MomentSketch(8).add(np.concatenate([a, b]))
+        parts = MomentSketch(8).add(a).merge(MomentSketch(8).add(b))
+        assert parts.count == whole.count
+        np.testing.assert_allclose(parts.moments, whole.moments,
+                                   rtol=1e-12)
+        assert parts.vmin == whole.vmin and parts.vmax == whole.vmax
+
+    def test_non_positive_drops_log_section(self):
+        sk = MomentSketch(8).add(np.array([1.0, -2.0, 3.0]))
+        assert not sk.log_ok
+        sk2 = MomentSketch.decode(sk.encode())
+        assert not sk2.log_ok
+
+    @pytest.mark.parametrize("name", ["lognormal", "pareto", "bimodal",
+                                      "heavy-dup", "negative"])
+    def test_bound_contains_exact(self, name):
+        rng = np.random.default_rng(7)
+        v = _dists(rng)[name].astype(np.float32).astype(np.float64)
+        sk = MomentSketch(8).add(v)
+        for q in QS:
+            exact = float(np.quantile(v, q))
+            qb = sbounds.moment_quantile_bound(sk, q)
+            assert qb.lo <= exact <= qb.hi, (name, q, exact, qb.lo,
+                                             qb.hi)
+
+    def test_estimate_reasonable_on_smooth(self):
+        rng = np.random.default_rng(3)
+        v = rng.lognormal(0, 1.0, 20000)
+        sk = MomentSketch(8).add(v)
+        est = quantile_estimate(sk, np.array([0.5, 0.9]))
+        exact = np.quantile(v, [0.5, 0.9])
+        # Maxent on a smooth unimodal: close, not just enclosed.
+        np.testing.assert_allclose(est, exact, rtol=0.25)
+
+
+class TestDigestBounds:
+    @pytest.mark.parametrize("name", ["lognormal", "bimodal",
+                                      "heavy-dup", "negative"])
+    def test_bound_contains_exact(self, name):
+        from opentsdb_tpu.rollup.summary import digest_compress
+        rng = np.random.default_rng(11)
+        v = _dists(rng)[name]
+        m, w = digest_compress(v, np.ones(len(v)), 64)
+        for q in QS:
+            exact = float(np.quantile(v, q))
+            qb = sbounds.tdigest_quantile_bound(
+                m, w, q, vmin=float(v.min()), vmax=float(v.max()))
+            assert qb.lo <= exact <= qb.hi, (name, q, exact,
+                                             (qb.lo, qb.hi))
+
+    def test_rank_slack_widens(self):
+        from opentsdb_tpu.rollup.summary import digest_compress
+        rng = np.random.default_rng(12)
+        v = rng.normal(0, 1, 5000)
+        m, w = digest_compress(v, np.ones(len(v)), 64)
+        tight = sbounds.tdigest_quantile_bound(m, w, 0.9)
+        wide = sbounds.tdigest_quantile_bound(m, w, 0.9,
+                                              rank_slack=0.2)
+        assert wide.hi - wide.lo > tight.hi - tight.lo
+
+
+class TestJaxMomentFold:
+    def test_matches_numpy_twin(self):
+        from opentsdb_tpu.ops import sketches as jsk
+        rng = np.random.default_rng(5)
+        v = rng.normal(3, 1, 257).astype(np.float32)
+        pad = np.zeros(512, np.float32)
+        pad[:257] = v
+        valid = np.arange(512) < 257
+        count, vmin, vmax, mom = jsk.moment_add(
+            *jsk.moment_init(8), pad, valid)
+        host = MomentSketch(8).add(v.astype(np.float64))
+        assert int(count) == 257
+        assert float(vmin) == pytest.approx(host.vmin, rel=1e-6)
+        assert float(vmax) == pytest.approx(host.vmax, rel=1e-6)
+        # float32 power sums vs float64: loose tolerance at high k.
+        np.testing.assert_allclose(np.asarray(mom)[:4],
+                                   host.moments[:4], rtol=1e-3)
+
+    def test_merge_and_window_fold(self):
+        from opentsdb_tpu.ops import sketches as jsk
+        a = np.array([[3, 1.0, 5.0, 9.0, 35.0],
+                      [2, 2.0, 4.0, 6.0, 20.0]], np.float32)
+        out = np.asarray(jsk.moment_fold_windows(a))
+        assert out[0] == 5 and out[1] == 1.0 and out[2] == 5.0
+        assert out[3] == 15.0 and out[4] == 55.0
+
+
+@pytest.mark.parametrize("shards", [1, 4])
+class TestApproxServing:
+    def test_tdigest_bound_contains_exact(self, tmp_path, shards):
+        tsdb = make_tsdb(str(tmp_path), shards=shards,
+                         rollup_sketch_min_res=3600)
+        try:
+            ingest(tsdb, series=4, days=2, step=300, seed=21)
+            tsdb.checkpoint()
+            # Live ingest on top: dirty windows must raw-stitch.
+            ingest(tsdb, series=2, days=1, step=900, seed=22)
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {"host": "*"}, "max",
+                             downsample=(7200, "p95"))
+            lo, hi = BASE + 1800, BASE + 2 * 86400 - 1800
+            exact = ex.run(spec, lo, hi)
+            rs, plan, _c, info = ex.run_approx(
+                spec, lo, hi, approx=ApproxSpec(True, None))
+            assert plan.startswith("approx-")
+            assert info.kind == "tdigest"
+            by_tags = {tuple(sorted(r.tags.items())): r for r in rs}
+            for e in exact:
+                a = by_tags[tuple(sorted(e.tags.items()))]
+                np.testing.assert_array_equal(e.timestamps,
+                                              a.timestamps)
+                err = np.abs(e.values - a.values)
+                assert (err <= info.error + 1e-9).all(), \
+                    (float(err.max()), info.error)
+        finally:
+            tsdb.shutdown()
+
+    def test_moment_kind_when_digest_absent(self, tmp_path, shards):
+        tsdb = make_tsdb(str(tmp_path), shards=shards,
+                         rollup_digest_k=0)
+        try:
+            ingest(tsdb, series=3, days=2, step=300, seed=31)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum",
+                             downsample=(3600, "p90"))
+            exact = ex.run(spec, BASE, BASE + 86400)
+            rs, plan, _c, info = ex.run_approx(
+                spec, BASE, BASE + 86400, approx=ApproxSpec(True))
+            assert info.kind == "moment"
+            for e, a in zip(exact, rs):
+                np.testing.assert_array_equal(e.timestamps,
+                                              a.timestamps)
+                err = np.abs(e.values - a.values)
+                assert (err <= info.error + 1e-9).all()
+        finally:
+            tsdb.shutdown()
+
+
+class TestApproxContract:
+    def test_max_error_falls_back_to_exact(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path), rollup_sketch_min_res=3600)
+        try:
+            ingest(tsdb, series=3, days=2, step=300, seed=41)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "max",
+                             downsample=(3600, "p99"))
+            # An absurdly tight budget: the sketch bound can't meet
+            # it, so the exact path must serve (plan != approx).
+            rs, plan, _c, info = ex.run_approx(
+                spec, BASE, BASE + 86400,
+                approx=ApproxSpec(True, 1e-9))
+            assert not plan.startswith("approx")
+            assert info is None
+            exact = ex.run(spec, BASE, BASE + 86400)
+            assert_equal_results(rs, exact, exact=True)
+        finally:
+            tsdb.shutdown()
+
+    def test_no_optin_stays_exact(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path), rollup_sketch_min_res=3600)
+        try:
+            ingest(tsdb, series=2, days=1, step=600, seed=42)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum",
+                             downsample=(3600, "p95"))
+            rs, plan, _c, info = ex.run_approx(spec, BASE,
+                                               BASE + 86400)
+            assert info is None and plan == "raw"
+        finally:
+            tsdb.shutdown()
+
+    def test_dev_group_agg_declines(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path), rollup_sketch_min_res=3600)
+        try:
+            ingest(tsdb, series=3, days=1, step=600, seed=43)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "dev",
+                             downsample=(3600, "p95"))
+            rs, plan, _c, info = ex.run_approx(
+                spec, BASE, BASE + 86400, approx=ApproxSpec(True))
+            assert info is None  # non-monotone group agg: exact path
+        finally:
+            tsdb.shutdown()
+
+    def test_rollup_only_serves_bounded_error(self, tmp_path):
+        """The ladder's bounded-error step: a pNN query under
+        rollup-only gets a sketch answer (not a 503) whose bound is
+        honest at a fold-quiesced instant."""
+        tsdb = make_tsdb(str(tmp_path), rollup_sketch_min_res=3600)
+        try:
+            ingest(tsdb, series=3, days=2, step=300, seed=44)
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "max",
+                             downsample=(3600, "p95"))
+            exact = ex.run(spec, BASE, BASE + 86400)
+            rs, plan, _c, info = ex.run_approx(
+                spec, BASE, BASE + 86400, rollup_only=True)
+            assert plan.startswith("approx-")
+            assert info is not None and info.stale_windows == 0
+            for e, a in zip(exact, rs):
+                np.testing.assert_array_equal(e.timestamps,
+                                              a.timestamps)
+                assert (np.abs(e.values - a.values)
+                        <= info.error + 1e-9).all()
+        finally:
+            tsdb.shutdown()
+
+    def test_rollup_only_moment_dsagg_reports_stale(self, tmp_path):
+        """Moment-dsagg under rollup-only: dirty windows serve their
+        STALE records and the answer declares them (never silently
+        dropped)."""
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, series=2, days=1, step=600, seed=45)
+            tsdb.checkpoint()
+            # Backfill INTO folded windows: records now stale.
+            ts = np.arange(BASE + 600, BASE + 7200, 1200,
+                           dtype=np.int64) + 7
+            tsdb.add_batch(METRIC, ts, np.full(len(ts), 1e6),
+                           {"host": "h0"})
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum",
+                             downsample=(3600, "sum"))
+            rs, plan, _c, info = ex.run_approx(
+                spec, BASE, BASE + 86400, rollup_only=True)
+            assert plan == "1h"
+            assert info is not None and info["stale_windows"] >= 1
+            # The stale windows' buckets are PRESENT (served from the
+            # last fold), not omitted.
+            served_ts = set(int(t) for r in rs for t in r.timestamps)
+            assert BASE in served_ts
+        finally:
+            tsdb.shutdown()
+
+    def test_rollup_only_declares_never_folded_windows(self, tmp_path):
+        """A dirty window NO fold ever recorded is absent from a
+        rollup-only answer — and must be DECLARED (missing_windows),
+        not a silent hole."""
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, series=2, days=1, step=600, seed=46)
+            tsdb.checkpoint()
+            # A brand-new hour past everything folded.
+            ts = np.arange(BASE + 86400 + 60, BASE + 86400 + 3600,
+                           300, dtype=np.int64)
+            tsdb.add_batch(METRIC, ts, np.ones(len(ts)),
+                           {"host": "h0"})
+            ex = QueryExecutor(tsdb, backend="cpu")
+            # Moment-dsagg path.
+            spec = QuerySpec(METRIC, {}, "sum",
+                             downsample=(3600, "sum"))
+            rs, plan, _c, info = ex.run_approx(
+                spec, BASE, BASE + 2 * 86400, rollup_only=True)
+            assert info is not None
+            assert info["missing_windows"] >= 1
+            served = {int(t) for r in rs for t in r.timestamps}
+            assert BASE + 86400 not in served
+            # Percentile-dsagg (sketch) path declares it too.
+            spec2 = QuerySpec(METRIC, {}, "max",
+                              downsample=(3600, "p95"))
+            rs2, plan2, _c, info2 = ex.run_approx(
+                spec2, BASE, BASE + 2 * 86400, rollup_only=True)
+            assert plan2.startswith("approx-")
+            assert info2.missing_windows >= 1
+        finally:
+            tsdb.shutdown()
+
+
+class TestDistinctValuesHllGate:
+    def test_moment_only_resolution_never_serves_distinct_values(
+            self, tmp_path):
+        """A range only the moment-only 1h rung can cover must NOT
+        serve distinct-values from (absent) HLL registers — that
+        returned a confident undercount; the exact fallback answers
+        instead. An HLL-bearing range (full days) still estimates."""
+        tsdb = make_tsdb(str(tmp_path))  # default: digest+hll at 1d
+        try:
+            rng = np.random.default_rng(90)
+            n = 2 * 86400 // 600
+            ts = BASE + np.arange(n, dtype=np.int64) * 600
+            vals = rng.choice(np.arange(1.0, 50.0), n)
+            tsdb.add_batch(METRIC, ts, vals, {"host": "h0"})
+            tsdb.checkpoint()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            # 12h aligned range: only the 1h (moment-only) rung fits.
+            out = ex.sketch_distinct_values(METRIC, {}, BASE,
+                                            BASE + 12 * 3600 - 1)
+            truth = len(np.unique(
+                vals[: 12 * 6].astype(np.float32)))
+            assert out["rollup"] == "raw"
+            assert out["distinct_values"] == truth
+            # Full 2 days: the HLL-bearing 1d rung serves.
+            out2 = ex.sketch_distinct_values(METRIC, {}, BASE,
+                                             BASE + 2 * 86400 - 1)
+            assert out2["rollup"] == "1d"
+            truth2 = len(np.unique(vals.astype(np.float32)))
+            assert abs(out2["distinct_values"] - truth2) <= \
+                max(out2["approx"]["error"], 2)
+        finally:
+            tsdb.shutdown()
+
+
+class TestBudgetAllocator:
+    RECORDS = {3600: 500_000, 86400: 20_000}
+
+    def test_zero_budget_allocates_nothing(self):
+        a = sbudget.allocate(0, self.RECORDS)
+        assert all(x.digest_k == 0 and x.moment_k == 0
+                   for x in a.values())
+
+    def test_budget_monotone_and_within(self):
+        prev_bytes = -1
+        for budget in (1 << 20, 16 << 20, 256 << 20):
+            a = sbudget.allocate(budget, self.RECORDS)
+            total = sum(x.total_bytes for x in a.values())
+            assert total <= budget
+            assert total >= prev_bytes
+            prev_bytes = total
+
+    def test_small_budget_prefers_moment_columns(self):
+        # Enough for moment columns everywhere + a digest at the
+        # coarse resolution, nowhere near digests at the fine one
+        # (quantized: 2^20 records x ~700 B for a digest rung).
+        a = sbudget.allocate(256 << 20, self.RECORDS)
+        assert a[3600].moment_k > 0
+        assert a[3600].digest_k == 0
+        # The cheap coarse resolution gets upgraded first.
+        assert a[86400].digest_k > 0
+
+    def test_workload_weighting_steers_bytes(self):
+        fine = sbudget.allocate(
+            600 << 20, self.RECORDS, workload={3600: 1.0, 86400: 0.0})
+        coarse = sbudget.allocate(
+            600 << 20, self.RECORDS, workload={3600: 0.0, 86400: 1.0})
+        # The resolution the workload actually queries gets at least
+        # as many bytes per record as it would under the inverse.
+        assert (fine[3600].bytes_per_record
+                >= coarse[3600].bytes_per_record)
+        assert (coarse[86400].bytes_per_record
+                >= fine[86400].bytes_per_record)
+
+    def test_deterministic(self):
+        a = sbudget.allocate(32 << 20, self.RECORDS)
+        b = sbudget.allocate(32 << 20, self.RECORDS)
+        assert a == b
+
+    def test_render_plan_mentions_budget(self):
+        a = sbudget.allocate(1 << 20, self.RECORDS)
+        out = sbudget.render_plan(a, 1 << 20)
+        assert "budget" in out and "moment_k" in out
+
+    def test_tier_applies_budget_and_adopts_on_reopen(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path), sketch_byte_budget=64 << 20)
+        try:
+            ingest(tsdb, series=2, days=1, step=600, seed=50)
+            tsdb.checkpoint()
+            alloc = dict(tsdb.rollups.sketch_alloc)
+            assert any(mk for _dk, mk, _hp in alloc.values())
+            st = json.load(open(tsdb.rollups.state_path))
+            assert st["budget"] == 64 << 20 and "alloc" in st
+        finally:
+            tsdb.shutdown()
+        # Reopen: persisted allocation adopted, NO rebuild.
+        tsdb2 = make_tsdb(str(tmp_path), sketch_byte_budget=64 << 20)
+        try:
+            assert tsdb2.rollups.sketch_alloc == alloc
+            assert tsdb2.rollups.rebuilds == 0
+            assert tsdb2.rollups.ready
+        finally:
+            tsdb2.shutdown()
+
+
+class TestIncrementalCatchup:
+    def _build_crashed(self, path, **over):
+        """A tier whose bracket crashed between spill and fold: clean
+        fold of day 1, then new + backfilled data spilled (state
+        pending + inflight) and the process dies."""
+        tsdb = make_tsdb(path, **over)
+        ingest(tsdb, series=3, days=3, step=600, seed=60)
+        tsdb.checkpoint()
+        # New data dirties two hours of day 3 ONLY: the incremental
+        # catch-up refolds that day (windows refold at the coarsest
+        # nesting span), the full rebuild redoes all three.
+        ts = np.arange(BASE + 2 * 86400 + 120,
+                       BASE + 2 * 86400 + 2 * 3600, 600,
+                       dtype=np.int64)
+        tsdb.add_batch(METRIC, ts,
+                       np.linspace(1.0, 9.0, len(ts)), {"host": "h0"})
+        tsdb.rollups.begin_spill()
+        st = json.load(open(tsdb.rollups.state_path))
+        assert st["pending"] and st["inflight"]
+        tsdb.store.checkpoint()  # raw spill lands, fold never runs
+        tsdb.store._simulate_crash()
+        tsdb.rollups._simulate_crash()
+        return st
+
+    def test_incremental_matches_full_rebuild(self, tmp_path):
+        a_dir = str(tmp_path / "a")
+        self._build_crashed(a_dir)
+        b_dir = str(tmp_path / "b")
+        shutil.copytree(a_dir, b_dir)
+
+        t_incr = make_tsdb(a_dir)
+        t_full = make_tsdb(b_dir, rollup_incremental_catchup=False)
+        try:
+            assert t_incr.rollups.ready and t_full.rollups.ready
+            assert t_incr.rollups.rebuilds == 1
+            # Incremental refolds ONLY the crashed windows.
+            assert (t_incr.rollups.records_written
+                    < t_full.rollups.records_written)
+            ei = QueryExecutor(t_incr, backend="cpu")
+            ef = QueryExecutor(t_full, backend="cpu")
+            for dsagg in ("sum", "count", "min", "max", "avg"):
+                spec = QuerySpec(METRIC, {}, "sum",
+                                 downsample=(3600, dsagg))
+                ri, plan_i, _ = ei.run_with_plan(spec, BASE,
+                                                 BASE + 3 * 86400)
+                rf, plan_f, _ = ef.run_with_plan(spec, BASE,
+                                                 BASE + 3 * 86400)
+                assert plan_i == plan_f == "1h"
+                assert_equal_results(ri, rf, exact=True)
+            # And incremental matches raw (ground truth) too.
+            spec = QuerySpec(METRIC, {}, "sum",
+                             downsample=(3600, "sum"))
+            a = ei.run(spec, BASE, BASE + 3 * 86400)
+            tier, t_incr.rollups = t_incr.rollups, None
+            try:
+                b = ei.run(spec, BASE, BASE + 3 * 86400)
+            finally:
+                t_incr.rollups = tier
+            assert_equal_results(a, b, exact=True)
+        finally:
+            t_incr.shutdown()
+            t_full.shutdown()
+
+    def test_incremental_zeroes_deleted_windows(self, tmp_path):
+        path = str(tmp_path / "z")
+        tsdb = make_tsdb(path)
+        ingest(tsdb, series=2, days=1, step=600, seed=62)
+        tsdb.checkpoint()
+        # Delete one series' first hour, then crash between spill and
+        # fold: the incremental catch-up must zero the stale record.
+        uid = tsdb.metrics.get_id(METRIC)
+        h0 = tsdb.tagk.get_id("host")
+        v0 = tsdb.tagv.get_id("h0")
+        key = uid + BASE.to_bytes(4, "big") + h0 + v0
+        tsdb.store.delete_row(tsdb.config.table, key)
+        tsdb.rollups.begin_spill()
+        tsdb.store.checkpoint()
+        tsdb.store._simulate_crash()
+        tsdb.rollups._simulate_crash()
+        t2 = make_tsdb(path)
+        try:
+            assert t2.rollups.ready
+            ex = QueryExecutor(t2, backend="cpu")
+            spec = QuerySpec(METRIC, {"host": "h0"}, "sum",
+                             downsample=(3600, "sum"))
+            a, plan, b = (*ex.run_with_plan(spec, BASE, BASE + 86400)[:2],
+                          None)
+            tier, t2.rollups = t2.rollups, None
+            try:
+                b = ex.run(spec, BASE, BASE + 86400)
+            finally:
+                t2.rollups = tier
+            assert plan == "1h"
+            assert_equal_results(a, b, exact=True)
+            # The deleted hour really is gone from rollup serving.
+            assert all(BASE not in r.timestamps for r in a)
+        finally:
+            t2.shutdown()
+
+
+class TestStreamedBlockDecode:
+    def test_sweep_decodes_without_cache_pollution(self, tmp_path):
+        from opentsdb_tpu.obs.registry import METRICS
+        tsdb = make_tsdb(str(tmp_path), enable_rollups=False,
+                         sstable_codec="tsst4")
+        try:
+            ingest(tsdb, series=6, days=2, step=60, seed=70)
+            tsdb.checkpoint()
+            store = tsdb.store
+            sst = store._ssts[-1]
+            assert sst.format == 4 and sst.block_count > 1
+            sst._blk_cache.clear()
+            before = METRICS.counter("compress.stream_blocks").value
+            rows = list(sst.iter_rows_range(
+                tsdb.config.table, b"", None))
+            assert len(rows) > 0
+            assert METRICS.counter(
+                "compress.stream_blocks").value > before
+            # The sweep held its blocks locally: the point-get cache
+            # was not filled (its 8 slots belong to query traffic).
+            assert len(sst._blk_cache) == 0
+            # Parity with the per-row (cached) path.
+            for key, cells in rows[:50]:
+                assert sst.get(tsdb.config.table, key) == cells
+        finally:
+            tsdb.shutdown()
+
+
+class TestServerContract:
+    def _serve(self, tmp_path, **cfg_over):
+        from tests.test_admission import make_server  # reuse harness
+        return make_server(tmp_path, rollups=True, **cfg_over)
+
+    def test_q_approx_json_and_header(self, tmp_path):
+        import asyncio
+        from tests.test_admission import (http_get, make_server,
+                                          run_with_server)
+        server, tsdb = make_server(tmp_path, rollups=True)
+        ingest(tsdb, series=2, days=1, step=600, seed=80)
+        tsdb.checkpoint()
+
+        async def drive(port):
+            a = await http_get(
+                port, f"/q?start={BASE}&end={BASE + 86400}"
+                      f"&m=max:1h-p95:{METRIC}&approx=1&json&nocache")
+            b = await http_get(
+                port, f"/q?start={BASE}&end={BASE + 86400}"
+                      f"&m=max:1h-p95:{METRIC}&json&nocache")
+            return a, b
+
+        (s1, h1, b1), (s2, h2, b2) = run_with_server(server, drive)
+        tsdb.shutdown()
+        assert s1 == 200 and s2 == 200
+        res = json.loads(b1)
+        assert res[0]["rollup"].startswith("approx-")
+        ap = res[0]["approx"]
+        assert ap["kind"] in ("tdigest", "moment")
+        assert ap["error"] >= 0
+        assert "x-tsd-approx" in {k.lower() for k in h1}
+        # Without the opt-in: exact, no approx metadata.
+        res2 = json.loads(b2)
+        assert "approx" not in res2[0]
+        assert "x-tsd-approx" not in {k.lower() for k in h2}
+
+    def test_ladder_pnn_bounded_error_not_503(self, tmp_path):
+        from tests.test_admission import (http_get, make_server,
+                                          run_with_server)
+        server, tsdb = make_server(tmp_path, rollups=True,
+                                   query_max_inflight=1)
+        ingest(tsdb, series=2, days=1, step=600, seed=81)
+        tsdb.checkpoint()
+        server.admission.inflight_queries = 1  # DEGRADE step
+
+        async def drive(port):
+            return await http_get(
+                port, f"/q?start={BASE}&end={BASE + 86400}"
+                      f"&m=max:1h-p95:{METRIC}&json&nocache")
+
+        status, hdrs, body = run_with_server(server, drive)
+        tsdb.shutdown()
+        assert status == 200, body
+        res = json.loads(body)
+        assert res[0]["degraded"] == "rollup-only"
+        assert res[0]["approx"]["kind"] in ("tdigest", "moment")
+        assert hdrs.get("x-tsd-degraded") == "rollup-only"
+        assert "x-tsd-approx" in {k.lower() for k in hdrs}
+
+    def test_sketch_range_reports_bounds(self, tmp_path):
+        from tests.test_admission import (http_get, make_server,
+                                          run_with_server)
+        server, tsdb = make_server(tmp_path, rollups=True)
+        ingest(tsdb, series=2, days=2, step=600, seed=82)
+        tsdb.checkpoint()
+
+        async def drive(port):
+            a = await http_get(
+                port, f"/sketch?m={METRIC}&q=p50,p95"
+                      f"&start={BASE}&end={BASE + 2 * 86400}")
+            b = await http_get(
+                port, f"/sketch?m={METRIC}&q=p50,p95"
+                      f"&start={BASE}&end={BASE + 2 * 86400}"
+                      f"&max_error=0.000000001")
+            return a, b
+
+        (s1, h1, b1), (s2, _h2, b2) = run_with_server(server, drive)
+        out = json.loads(b1)
+        exact = json.loads(b2)
+        tsdb.shutdown()
+        assert s1 == 200 and s2 == 200
+        ap = out["approx"]
+        assert ap["kind"] in ("tdigest", "moment")
+        # The reported per-quantile bound contains the exact answer.
+        assert exact["rollup"] == "raw"  # budget forced exact
+        for qk, err in ap["error"].items():
+            est = out["quantiles"][qk]
+            exa = exact["quantiles"][qk]
+            assert abs(est - exa) <= err + 1e-9, (qk, est, exa, err)
+
+    def test_distinct_stream_declares_hll(self, tmp_path):
+        from tests.test_admission import http_get, run_with_server
+        from opentsdb_tpu.core.tsdb import TSDB
+        from opentsdb_tpu.server.tsd import TSDServer
+        from opentsdb_tpu.storage.kv import MemKVStore
+        from opentsdb_tpu.utils.config import Config
+        cfg = Config(auto_create_metrics=True, port=0,
+                     bind="127.0.0.1", backend="cpu",
+                     enable_sketches=True, device_window=False)
+        tsdb = TSDB(MemKVStore(), cfg, start_compaction_thread=False)
+        server = TSDServer(tsdb)
+        for i in range(20):
+            tsdb.add_point(METRIC, BASE + 60 + i, float(i),
+                           {"host": f"h{i}"})
+
+        async def drive(port):
+            return await http_get(
+                port, f"/distinct?metric={METRIC}&tagk=host")
+
+        status, hdrs, body = run_with_server(server, drive)
+        tsdb.shutdown()
+        assert status == 200
+        out = json.loads(body)
+        assert out["source"] == "stream"
+        ap = out["approx"]
+        assert ap["kind"] == "hll"
+        assert abs(out["distinct"] - 20) <= max(ap["error"], 1)
+
+    def test_queries_view_and_stats(self, tmp_path):
+        from tests.test_admission import (http_get, make_server,
+                                          run_with_server)
+        server, tsdb = make_server(tmp_path, rollups=True)
+        ingest(tsdb, series=2, days=1, step=600, seed=83)
+        tsdb.checkpoint()
+
+        async def drive(port):
+            await http_get(
+                port, f"/q?start={BASE}&end={BASE + 86400}"
+                      f"&m=max:1h-p95:{METRIC}&approx=1&json&nocache")
+            await http_get(
+                port, f"/q?start={BASE}&end={BASE + 86400}"
+                      f"&m=sum:1h-sum:{METRIC}&json&nocache")
+            api = await http_get(port, "/api/queries")
+            page = await http_get(port, "/queries")
+            stats = await http_get(port, "/stats?json")
+            return api, page, stats
+
+        (sa, _, ba), (sp, _, bp), (ss, _, bs) = \
+            run_with_server(server, drive)
+        tsdb.shutdown()
+        assert sa == 200 and sp == 200 and ss == 200
+        feed = json.loads(ba)
+        assert feed["plans"].get("approx", 0) >= 1
+        assert feed["plans"].get("rollup", 0) >= 1
+        assert feed["rollup"]["ready"]
+        assert "sketch_alloc" in feed["rollup"]
+        assert b"Query planner" in bp
+        lines = json.loads(bs)
+        assert any(l.startswith("tsd.query.plan ") and "plan=approx"
+                   in l for l in lines)
+        assert any(l.startswith("tsd.sketch.serve.hit ")
+                   for l in lines)
+        assert any(l.startswith("tsd.sketch.bytes ")
+                   and "kind=moment" in l for l in lines)
+        assert any(l.startswith("tsd.sketch.error.reported ")
+                   for l in lines)
+
+    def test_check_stats_metric_thresholds_sketch_counters(
+            self, tmp_path, capsys):
+        from tests.test_admission import (http_get, make_server,
+                                          run_with_server)
+        from opentsdb_tpu.tools.cli import main as cli_main
+        server, tsdb = make_server(tmp_path, rollups=True)
+        ingest(tsdb, series=2, days=1, step=600, seed=84)
+        tsdb.checkpoint()
+        async def drive(port):
+            await http_get(
+                port, f"/q?start={BASE}&end={BASE + 86400}"
+                      f"&m=max:1h-p95:{METRIC}&approx=1&json&nocache")
+            # tsdb check --stats-metric hits the LIVE server; run it
+            # off the event loop (it blocks on the HTTP fetch).
+            loop = asyncio.get_running_loop()
+            rc_ok = await loop.run_in_executor(None, cli_main, [
+                "check", "-H", "127.0.0.1", "-p", str(port),
+                "--stats-metric", "tsd.sketch.serve.hit",
+                "-x", "lt", "-c", "1"])
+            rc_bad = await loop.run_in_executor(None, cli_main, [
+                "check", "-H", "127.0.0.1", "-p", str(port),
+                "--stats-metric", "tsd.sketch.serve.hit",
+                "-x", "lt", "-c", "1000000"])
+            return rc_ok, rc_bad
+
+        rc_ok, rc_bad = run_with_server(server, drive)
+        tsdb.shutdown()
+        assert rc_ok == 0
+        assert rc_bad == 2
