@@ -17,6 +17,7 @@ TPU-first departures:
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Iterator
@@ -27,8 +28,9 @@ from opentsdb_tpu.core import codec, codec_np, tags as tags_mod
 from opentsdb_tpu.core.compaction import CompactionQueue
 from opentsdb_tpu.core.const import (MAX_TIMESPAN, TIMESTAMP_BYTES,
                                      UID_WIDTH)
-from opentsdb_tpu.core.errors import PleaseThrottleError
+from opentsdb_tpu.core.errors import NoSuchUniqueName, PleaseThrottleError
 from opentsdb_tpu.storage.kv import KVStore
+from opentsdb_tpu.storage.sstable import series_hash
 from opentsdb_tpu.uid.uniqueid import UniqueId
 from opentsdb_tpu.utils.config import Config
 
@@ -126,6 +128,22 @@ class TSDB:
         # state file; replicas open the same stores READ-ONLY
         # (ReadOnlyRollupTier) so the planner serves summaries on the
         # serve tier too, refreshed by refresh_replica().
+        # Tenant cardinality control plane (opentsdb_tpu/tenant/):
+        # per-tenant series accounting + heavy hitters + admission
+        # limits, fed from this write path's series-identity hash.
+        # Writers only — a replica neither admits nor snapshots.
+        self.tenants = None
+        self.tenant_limits = None
+        # skey -> "metric{k=v,...}" memo for the heavy-hitter summary:
+        # the label is invariant per series, so the per-point ingest
+        # path must not rebuild (sort + join) it every point. Cleared
+        # wholesale at the cap — churn past it is the hostile regime
+        # where the rebuild cost is the attacker's, not the steady
+        # workload's.
+        self._series_labels: dict[bytes, str] = {}
+        if (self.config.tenant_accounting
+                and not getattr(store, "read_only", False)):
+            self._init_tenants()
         self.rollups = None
         if (self.config.enable_rollups
                 and getattr(store, "_wal_path", None)):
@@ -262,6 +280,10 @@ class TSDB:
             try:
                 if self.config.enable_sketches:
                     self._init_sketches()
+                if self.config.tenant_accounting:
+                    # The promoted writer owns admission now: adopt
+                    # the dead writer's TENANTS.json (or rebuild).
+                    self._init_tenants()
                 old = self.rollups
                 self.rollups = None
             except BaseException:
@@ -274,6 +296,8 @@ class TSDB:
                 # the store back so the caller's recovery (re-attach a
                 # tailer, let the router try the next candidate) acts
                 # on a genuine replica.
+                self.tenants = None
+                self.tenant_limits = None
                 try:
                     self.store.demote_readonly()
                 except Exception:
@@ -338,6 +362,10 @@ class TSDB:
                 self.compactionq._queue.clear()
             self.store.demote_readonly()
             self.reload_sketches()
+            # A replica neither admits nor snapshots tenant state —
+            # the new writer owns TENANTS.json now.
+            self.tenants = None
+            self.tenant_limits = None
         if (self.config.enable_rollups
                 and getattr(self.store, "_wal_path", None)):
             from opentsdb_tpu.rollup.tier import ReadOnlyRollupTier
@@ -382,6 +410,144 @@ class TSDB:
             series_key, values, [(metric_uid, k, v) for k, v in pairs])
 
     # ------------------------------------------------------------------
+    # Tenant cardinality control plane (opentsdb_tpu/tenant/)
+    # ------------------------------------------------------------------
+
+    def _tenants_path(self) -> str | None:
+        """TENANTS.json next to the WAL: inside the store directory
+        for sharded stores (the SHARDS.json/EPOCH.json convention),
+        ``<wal>.tenants.json`` for a single-file WAL (several single
+        stores may share one directory in tests)."""
+        wal = getattr(self.store, "_wal_path", None)
+        if not wal:
+            return None
+        from opentsdb_tpu.tenant.accounting import STATE_NAME
+        if getattr(self.store, "shard_count", None) is not None:
+            # The sharded store's _wal_path is its <dir>/store naming
+            # root (not a real directory); the snapshot lives beside
+            # SHARDS.json at the store root.
+            return os.path.join(os.path.dirname(wal), STATE_NAME)
+        return wal + ".tenants.json"
+
+    def _init_tenants(self) -> None:
+        """Boot (or promotion) path: load the snapshot and re-fold the
+        WAL-replayed memtable's series on top — the snapshot commits
+        BEFORE each spill, so it always covers the sstable tier and
+        the memtable delta is everything it can be missing. A torn or
+        foreign state file rebuilds from a full storage scan instead
+        (totals exact; per-tenant splits land on the default tenant,
+        declared via recovered_series)."""
+        from opentsdb_tpu.tenant.accounting import TenantAccountant
+        from opentsdb_tpu.tenant.limits import (TenantLimiter,
+                                                parse_overrides)
+
+        cfg = self.config
+        self.tenant_limits = TenantLimiter(
+            max_series=getattr(cfg, "tenant_max_series", 0),
+            global_max=getattr(cfg, "tenant_global_max_series", 0),
+            mode=getattr(cfg, "tenant_limit_mode", "enforce"),
+            overrides=parse_overrides(
+                getattr(cfg, "tenant_overrides", ())))
+        path = self._tenants_path()
+        acct = None
+        if path and os.path.exists(path):
+            try:
+                acct = TenantAccountant.load(
+                    path, exact_cutoff=cfg.tenant_exact_cutoff,
+                    hll_p=cfg.tenant_hll_p, topk=cfg.tenant_topk)
+            except Exception as e:
+                LOG.warning("TENANTS.json at %s torn/foreign (%r); "
+                            "rebuilding tenant accounting from "
+                            "storage", path, e)
+        if acct is not None:
+            # Delta fold: only series the WAL replayed past the
+            # snapshot (the sketches _init_sketches discipline).
+            keys = getattr(self.store, "memtable_keys", None)
+            if keys is not None:
+                acct.fold_recovered(
+                    series_hash(codec.series_key(k))
+                    for k in keys(self.table))
+            else:
+                acct.fold_recovered(self._storage_series_hashes())
+        else:
+            torn = bool(path and os.path.exists(path))
+            acct = TenantAccountant(
+                path=path, exact_cutoff=cfg.tenant_exact_cutoff,
+                hll_p=cfg.tenant_hll_p, topk=cfg.tenant_topk)
+            if torn or self.tenant_limits.enabled:
+                # The full scan is semantically REQUIRED under
+                # enforcement (the limiter must never refuse a
+                # pre-existing series as "new"), and a torn snapshot
+                # means accounting was live here — recover it exactly.
+                acct.fold_recovered(self._storage_series_hashes())
+            else:
+                # Observability-only mode on a store with no snapshot
+                # (first boot, or a pre-tenancy store upgrading):
+                # don't block the constructor on a full raw-storage
+                # scan nobody's limits need. No snapshot also means
+                # no checkpoint ever committed, so any stored rows
+                # live in the WAL-replayed memtable — fold just that
+                # delta (sstable-backed stores only lack a snapshot
+                # on upgrade, where counts re-attribute to their REAL
+                # tenants as series next ingest and the first
+                # checkpoint makes this a one-time transition).
+                keys = getattr(self.store, "memtable_keys", None)
+                if keys is not None:
+                    acct.fold_recovered(
+                        series_hash(codec.series_key(k))
+                        for k in keys(self.table))
+            acct.rebuilt = torn
+        self.tenants = acct
+
+    def _storage_series_hashes(self):
+        """Every distinct series-identity hash currently in storage
+        (raw key scan, no cell decode) — the rebuild source when the
+        snapshot is gone."""
+        seen: set[int] = set()
+        for key, _items in self.store.scan_raw(self.table, b"",
+                                               b"\xff" * 64):
+            h = series_hash(codec.series_key(key))
+            if h not in seen:
+                seen.add(h)
+                yield h
+
+    def _admit_series(self, tenant: str, skey: bytes,
+                      metric: str) -> None:
+        """Tenant admission + accounting for one about-to-be-written
+        series; raises TenantLimitError (enforce mode) when the series
+        is NEW and the tenant (or the directory) is over budget.
+        Counting happens here, BEFORE the storage put, mirroring the
+        sketch directory's note_series placement: over-counting a
+        series whose put then fails hard is harmless and bounded by
+        the error count, while counting after would let a throttled
+        partial batch leave stored rows that look refusable forever."""
+        acct = self.tenants
+        if acct is None:
+            return
+        h = series_hash(skey)
+        if acct.seen(h):
+            return
+        self.tenant_limits.admit_new_series(acct, tenant)
+        acct.note_new_series(tenant, h, metric)
+
+    _SERIES_LABEL_CAP = 65536
+
+    def _account_points(self, tenant: str, metric: str,
+                        tag_map: dict, n: int, skey: bytes) -> None:
+        if self.tenants is None or n <= 0:
+            return
+        label = self._series_labels.get(skey)
+        if label is None:
+            label = metric
+            if tag_map:
+                label += "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(tag_map.items())) + "}"
+            if len(self._series_labels) >= self._SERIES_LABEL_CAP:
+                self._series_labels.clear()
+            self._series_labels[skey] = label
+        self.tenants.note_points(tenant, label, n)
+
+    # ------------------------------------------------------------------
     # Row-key construction
     # ------------------------------------------------------------------
 
@@ -413,6 +579,35 @@ class TSDB:
                       else self.metrics.get_id(metric))
         return metric_uid, self.resolve_tags(tag_map, create_tags)
 
+    def _row_parts_admitted(self, tenant: str, metric: str,
+                            tag_map: dict[str, str],
+                            ) -> tuple[bytes, list[tuple[bytes, bytes]]]:
+        """``_row_parts`` behind the tenant gate. With enforcement on,
+        resolve WITHOUT creating first: a missing UID means the series
+        is certainly NEW, so the tenant/global budget check runs
+        before ``get_or_create`` allocates durable UID mappings — a
+        refused series must not grow the metric/tagk/tagv maps, since
+        that growth is exactly the resource the limiter protects. When
+        every UID resolves the combination may still be new, but the
+        probe minted nothing and ``_admit_series`` settles it against
+        the seen-set once the series hash exists."""
+        if (self.tenants is None or not self.tenant_limits.enabled
+                or self.tenant_limits.mode != "enforce"):
+            return self._row_parts(metric, tag_map)
+        try:
+            return self._row_parts(metric, tag_map, create_metric=False,
+                                   create_tags=False)
+        except NoSuchUniqueName:
+            if not self.config.auto_create_metrics:
+                # The metric itself may be the missing piece, and it
+                # can never be created here — that put dies as
+                # "unknown metric" regardless of any budget, so it
+                # must not masquerade as (or count toward) a tenant
+                # refusal. Re-raises NoSuchUniqueName if so.
+                self.metrics.get_id(metric)
+            self.tenant_limits.admit_new_series(self.tenants, tenant)
+            return self._row_parts(metric, tag_map)
+
     def row_key_for(self, metric: str, tag_map: dict[str, str],
                     base_ts: int, create_metric: bool | None = None,
                     create_tags: bool = True) -> bytes:
@@ -425,7 +620,8 @@ class TSDB:
     # ------------------------------------------------------------------
 
     def add_point(self, metric: str, timestamp: int, value: int | float,
-                  tag_map: dict[str, str], durable: bool = True) -> None:
+                  tag_map: dict[str, str], durable: bool = True,
+                  tenant: str = "default") -> None:
         """Store one data point (reference TSDB.addPoint :236-352)."""
         if timestamp & ~0xFFFFFFFF:
             raise ValueError(
@@ -439,17 +635,23 @@ class TSDB:
         else:
             buf, flags = codec.encode_long(value)
         base_ts = codec.base_time(timestamp)
-        metric_uid, pairs = self._row_parts(metric, tag_map)
+        metric_uid, pairs = self._row_parts_admitted(tenant, metric,
+                                                     tag_map)
         row = codec.row_key(metric_uid, base_ts, pairs)
         qual = codec.encode_qualifier(timestamp - base_ts, flags)
-        # Directory registration precedes the put (see add_batch).
+        skey = codec.series_key(row)
+        # Tenant admission first (a refused NEW series must leave no
+        # trace — _row_parts_admitted already gated UID creation the
+        # same way), then directory registration, then the put (see
+        # add_batch for the ordering argument).
+        self._admit_series(tenant, skey, metric)
         if self.sketches is not None:
-            self.sketches.note_series(codec.series_key(row))
+            self.sketches.note_series(skey)
         self.store.put(self.table, row, FAMILY, qual, buf, durable=durable)
         if self.config.enable_compactions:
             self.compactionq.add(row)
         self.datapoints_added += 1
-        skey = codec.series_key(row)
+        self._account_points(tenant, metric, tag_map, 1, skey)
         self._observe(skey, metric_uid, pairs,
                       np.asarray([value], np.float64))
         if self.devwindow is not None:
@@ -461,7 +663,8 @@ class TSDB:
                   values: np.ndarray, tag_map: dict[str, str],
                   durable: bool = True,
                   is_float: np.ndarray | None = None,
-                  int_values: np.ndarray | None = None) -> int:
+                  int_values: np.ndarray | None = None,
+                  tenant: str = "default") -> int:
         """Columnar ingest for one series: pre-compacted cell per row-hour.
 
         ``values`` may be an integer or floating dtype; float points are
@@ -504,7 +707,8 @@ class TSDB:
             ([0], np.flatnonzero(np.diff(base)) + 1))
         quals, vals = codec_np.encode_cells_multi(deltas, f_s, i_s, m_s,
                                                   row_starts)
-        metric_uid, pairs = self._row_parts(metric, tag_map)
+        metric_uid, pairs = self._row_parts_admitted(tenant, metric,
+                                                     tag_map)
         tmpl = bytes(codec.row_key(metric_uid, 0, pairs))
         # All row keys in one vectorized pass: broadcast the template,
         # stamp the base-time bytes, keep the CONTIGUOUS blob. The
@@ -526,6 +730,12 @@ class TSDB:
         # this series' first rows. (Values fold after the put as
         # before; over-registering an unapplied series is harmless.)
         skey = codec.series_key(kb[:L])
+        # Tenant admission precedes both the directory registration
+        # and the put: a NEW series from an over-budget tenant refuses
+        # here (TenantLimitError, declared on the wire) before any
+        # byte lands — existing series pass the seen-set check and
+        # keep ingesting regardless of the tenant's budget.
+        self._admit_series(tenant, skey, metric)
         if self.sketches is not None:
             self.sketches.note_series(skey)
         # Rows that already held cells BEFORE the put become multi-cell
@@ -558,6 +768,7 @@ class TSDB:
                     self.compactionq.add(kb[i * L:(i + 1) * L])
         n = len(ts_s)
         self.datapoints_added += n
+        self._account_points(tenant, metric, tag_map, n, skey)
         # Sketch fold covers fully applied batches only (a throttled
         # batch raised above); values as stored, floats and ints alike.
         # One float32 conversion shared by both consumers (the digests
@@ -831,6 +1042,12 @@ class TSDB:
             path = self._sketch_path()
             if self.sketches is not None and path:
                 self.sketches.save(path)
+            # Tenant accounting snapshot, same bracket position and
+            # the same coverage argument: committed BEFORE the spill,
+            # so a loaded TENANTS.json always covers the sstable tier
+            # and boot only re-folds the replayed memtable's series.
+            if self.tenants is not None:
+                self.tenants.save()
             # Rollup tier brackets the spill: mark the about-to-spill
             # windows in flight (and the tier pending on disk) BEFORE the
             # raw spill, fold the spilled keys into summary records after —
@@ -860,6 +1077,15 @@ class TSDB:
                 # the next boot, where the replayed memtable is
                 # re-folded on top of it.
                 self.checkpoint()
+            elif self.tenants is not None and self.tenants.path:
+                # Tenant snapshot WITHOUT forcing a spill: accounting
+                # folds are idempotent by series hash, so a snapshot
+                # covering MORE than the sstable tier is harmless on
+                # the next boot (the WAL replay's re-fold dedups) —
+                # and it keeps exact per-tenant attribution for the
+                # memtable-resident series instead of re-attributing
+                # them to the default tenant at reopen.
+                self.tenants.save()
             self.store.flush()
         finally:
             # Rollups close FIRST: their close() stops + joins the
@@ -968,6 +1194,8 @@ class TSDB:
         if self.sketches is not None:
             collector.record("sketches.series",
                              self.sketches.series_count())
+        if self.tenants is not None:
+            self.tenants.collect_stats(collector)
         if self.devwindow is not None:
             self.devwindow.collect_stats(collector)
         if self.rollups is not None:

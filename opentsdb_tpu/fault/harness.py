@@ -104,6 +104,11 @@ class Scenario:
     # rollup_incremental_catchup=False (the legacy full rebuild) and
     # demands bit-identical rollup answers from both recovery paths.
     catchup_compare: bool = False
+    # Tenant accounting tier for the workload (-1 = the Config
+    # default exact cutoff): 0 forces every tenant straight onto the
+    # HLL sketch tier, so the tenant-snapshot crash rows cover the
+    # estimate-within-error recovery contract, not just the exact one.
+    tenant_cutoff: int = -1
 
 
 # ---------------------------------------------------------------------------
@@ -229,12 +234,14 @@ def open_store(dirpath: str, shards: int, read_only: bool = False):
 
 
 def open_tsdb(dirpath: str, shards: int, rollups: bool,
-              codec: str = "none", incremental: bool = True) -> TSDB:
+              codec: str = "none", incremental: bool = True,
+              tenant_cutoff: int = -1) -> TSDB:
     """Writer TSDB with the harness profile: cpu backend, sketches and
     device window off (the child must stay jax-free), compactions off
     and no background threads (schedule determinism), rollup catch-up
     SYNC so a post-crash reopen finishes its rebuild before verify
-    queries run."""
+    queries run. Tenant accounting stays ON (its default): every
+    crash scenario doubles as a TENANTS.json recovery check."""
     cfg = Config(
         wal_path=dirpath, shards=shards, backend="cpu",
         auto_create_metrics=True, enable_compactions=False,
@@ -245,6 +252,8 @@ def open_tsdb(dirpath: str, shards: int, rollups: bool,
         # Sub-day sketch columns so the 1h resolution carries digests
         # too (more fold surface for the crash sites to land in).
         rollup_sketch_min_res=3600)
+    if tenant_cutoff >= 0:
+        cfg.tenant_exact_cutoff = tenant_cutoff
     store = open_store(dirpath, shards)
     return TSDB(store, cfg, start_compaction_thread=False)
 
@@ -332,7 +341,8 @@ def _child_main(args) -> int:
     if args.bug:
         _apply_bug(args.bug)
     tsdb = open_tsdb(args.dir, args.shards, args.rollups,
-                     codec=args.codec)
+                     codec=args.codec,
+                     tenant_cutoff=args.tenant_cutoff)
     with open(args.progress, "a") as pf:
         for i, op in enumerate(ops):
             apply_op(tsdb, op)
@@ -502,6 +512,59 @@ def _check_query_parity(tsdb: TSDB, oracle: Oracle,
     return problems
 
 
+def _check_tenant_accounting(tsdb: TSDB, sc: Scenario) -> list[str]:
+    """The TENANTS.json recovery oracle, run on the freshly reopened
+    store BEFORE any verification ingest:
+
+    - **coverage**: every series with rows in storage must be in the
+      accountant's seen-set (a series the control plane doesn't know
+      is a series no limit can ever govern);
+    - **exact tier**: the tracked total must equal the per-tenant
+      exact counts (the harness workload is single-tenant, so the
+      default tenant's count IS the total) — and after a REBUILD
+      (torn/foreign snapshot) it must equal the stored-series count
+      exactly;
+    - **sketch tier** (tenant_cutoff=0 rows): the HLL estimate must
+      sit within 3x the declared relative error of the true tracked
+      count (clamped to ±2 absolute for tiny populations, where
+      linear counting is effectively exact but the relative bound
+      degenerates)."""
+    from opentsdb_tpu.storage.sstable import series_hash
+    from opentsdb_tpu.tenant.accounting import hll_rel_error
+    acct = tsdb.tenants
+    if acct is None:
+        return ["tenant accounting unexpectedly disabled after reopen"]
+    problems: list[str] = []
+    stored: set[int] = set()
+    for key, _items in tsdb.store.scan_raw(tsdb.table, b"",
+                                           b"\xff" * 64):
+        stored.add(series_hash(codec.series_key(key)))
+    missing = sum(1 for h in stored if not acct.seen(h))
+    if missing:
+        problems.append(f"tenant accounting is missing {missing} of "
+                        f"{len(stored)} stored series")
+    if acct.rebuilt and acct.total_tracked() != len(stored):
+        problems.append(
+            f"rebuilt tenant accounting tracks "
+            f"{acct.total_tracked()} series, storage holds "
+            f"{len(stored)} (rebuild must be exact)")
+    info = acct.snapshot_info()
+    true = acct.total_tracked()
+    est = sum(ent["series"] for ent in info["tenants"].values())
+    tiers = {ent["tier"] for ent in info["tenants"].values()}
+    if tiers == {"exact"}:
+        if est != true:
+            problems.append(f"exact-tier tenant counts sum to {est}, "
+                            f"seen-set holds {true}")
+    elif true:
+        bound = max(3 * hll_rel_error(acct.hll_p) * true, 2)
+        if abs(est - true) > bound:
+            problems.append(
+                f"sketch-tier tenant estimate {est} outside "
+                f"±{bound:.1f} of true {true}")
+    return problems
+
+
 def _check_replica(dirpath: str, sc: Scenario, tsdb: TSDB) -> list[str]:
     """Replica-over-live-writer parity, across a post-crash writer
     checkpoint cycle — the WAL rotation + <wal>.old append + fresh-
@@ -610,7 +673,8 @@ def verify(dirpath: str, sc: Scenario, ops: list[tuple],
                      dirs_exist_ok=True)
     try:
         tsdb = open_tsdb(dirpath, sc.shards, sc.rollups,
-                         codec=sc.codec)
+                         codec=sc.codec,
+                         tenant_cutoff=sc.tenant_cutoff)
     except Exception as e:
         return [f"reopen failed: {e!r}"], ""
     try:
@@ -637,6 +701,10 @@ def verify(dirpath: str, sc: Scenario, ops: list[tuple],
             # record), so a single probe decides its fate.
             oracle.apply(ops[ops_done])
         problems += _check_raw_parity(tsdb, oracle)
+        # Tenant accounting parity BEFORE the replica phase ingests
+        # its extra rows (the oracle compares against storage as the
+        # crash left it + WAL replay).
+        problems += _check_tenant_accounting(tsdb, sc)
         problems += _check_replica(dirpath, sc, tsdb)
         if sc.rollups:
             # Fold the recovered (WAL-replayed) memtable so the tier
@@ -696,6 +764,8 @@ def _run_once(sc: Scenario, workdir: str) -> dict:
         cmd += ["--bug", sc.bug]
     if sc.codec != "none":
         cmd += ["--codec", sc.codec]
+    if sc.tenant_cutoff >= 0:
+        cmd += ["--tenant-cutoff", str(sc.tenant_cutoff)]
     result = {
         "label": sc.label, "site": sc.site, "mode": sc.mode,
         "skip": sc.skip, "shards": sc.shards, "rollups": sc.rollups,
@@ -751,6 +821,8 @@ def repro_command(sc: Scenario) -> str:
         out += f" --bug {sc.bug}"
     if sc.codec != "none":
         out += f" --codec {sc.codec}"
+    if sc.tenant_cutoff >= 0:
+        out += f" --tenant-cutoff {sc.tenant_cutoff}"
     return out
 
 
@@ -961,6 +1033,7 @@ FAST_LABELS = (
     "rollup-flip-crash-s1",
     "rollup-folddel-crash-s1",
     "rollup-foldflush-incrcmp-s1",
+    "tenant-snap-commit-torn-s1",
     "shard-join-crash-k2",
 )
 
@@ -1040,6 +1113,17 @@ def build_matrix() -> list[Scenario]:
         add(f"rollup-folddel-incrcmp-{t}", "rollup.fold.flush",
             "crash", delete_heavy=True, catchup_compare=True,
             **{**c, "seed": 4200 + shards})
+        # TENANTS.json bracket (tenant/accounting.py): a torn TMP
+        # leaves the previous snapshot governing (and the crash
+        # happened BEFORE the spill, so snapshot + replayed memtable
+        # still cover everything); a torn COMMITTED file is the
+        # corruption the storage-scan rebuild must absorb exactly.
+        add(f"tenant-snap-write-torn-{t}", "tenant.snapshot.write",
+            "torn", **{**c, "seed": 5000 + shards})
+        add(f"tenant-snap-commit-torn-{t}", "tenant.snapshot.commit",
+            "torn", **{**c, "seed": 5010 + shards})
+        add(f"tenant-snap-commit-crash-{t}", "tenant.snapshot.commit",
+            "crash", **{**c, "seed": 5020 + shards})
     # Partial cross-shard spills: crash after exactly k of 4 shards.
     for k in (1, 2, 3):
         add(f"shard-join-crash-k{k}", "sharded.spill.shard", "crash",
@@ -1055,6 +1139,11 @@ def build_matrix() -> list[Scenario]:
         shards=1, rollups=False, codec="tsst4", seed=3003)
     add("sst-block-torn-norollup-s4", "sst.write.block", "torn",
         shards=4, rollups=False, codec="tsst4", seed=3004)
+    # Sketch-tier tenant accounting (tenant_cutoff=0 pushes every
+    # tenant straight onto the HLL tier): a torn committed snapshot
+    # must recover to an estimate within the declared error bound.
+    add("tenant-snap-commit-torn-hll", "tenant.snapshot.commit",
+        "torn", shards=1, rollups=True, seed=5101, tenant_cutoff=0)
     # Replica refresh faults (in-process, no child crash).
     add("replica-refresh-ioerror", "replica.refresh", "ioerror",
         shards=1, kind="replica", seed=3101)
@@ -1112,6 +1201,7 @@ def main(argv=None) -> int:
     p.add_argument("--bug", default=None, choices=BUGS)
     p.add_argument("--codec", default="none",
                    choices=("none", "tsst4"))
+    p.add_argument("--tenant-cutoff", type=int, default=-1)
     args = p.parse_args(argv)
     return _child_main(args)
 

@@ -44,18 +44,25 @@ class TokenBucket:
     negative, so one oversized request can't mortgage the future.
     """
 
-    def __init__(self, rate: float, burst: float) -> None:
+    def __init__(self, rate: float, burst: float,
+                 tokens: float | None = None) -> None:
         if rate <= 0:
             raise ValueError(f"rate must be > 0, got {rate}")
         self.rate = float(rate)
         self.burst = max(float(burst), 1.0)
-        self._tokens = self.burst
+        # ``tokens`` overrides the initial fill (default: full burst).
+        # The eviction path mints COLD buckets — see _bucket.
+        self._tokens = self.burst if tokens is None else float(tokens)
         self._t = time.monotonic()
+        # Last take() wall-clock (monotonic): the idle signal the
+        # tenant-bucket LRU eviction keys on.
+        self.last_take = self._t
         self._lock = threading.Lock()
 
     def take(self, n: float = 1.0, now: float | None = None) -> float:
         now = time.monotonic() if now is None else now
         with self._lock:
+            self.last_take = now
             # max(0, ...): a caller-injected clock (tests) may start
             # below the construction-time monotonic stamp; time never
             # flows backwards through the bucket.
@@ -94,6 +101,16 @@ class AdmissionController:
         self.query_shed_quota = 0
         self.query_shed_load = 0
         self.query_degraded = 0
+        # Tenant-bucket table churn at MAX_TENANTS (see _bucket).
+        self.tenants_evicted = 0
+        self.tenants_collapsed = 0
+        # Per bucket-table (keyed by id()): the earliest monotonic
+        # time any current bucket could turn idle, recorded when an
+        # eviction scan found NO victim. Until then every uncached
+        # tenant collapses straight to the shared bucket without
+        # re-scanning — the saturated-table attack otherwise pays an
+        # O(MAX_TENANTS) scan under self._lock on EVERY request.
+        self._no_idle_before: dict[int, float] = {}
 
     # -- ingest ----------------------------------------------------------
 
@@ -172,24 +189,70 @@ class AdmissionController:
 
     # -- plumbing --------------------------------------------------------
 
-    # Distinct tenants tracked before new ones collapse onto the
-    # shared bucket: the ?tenant= parameter is client-controlled, so
-    # an uncapped dict would grow one bucket per request — unbounded
-    # memory (each fresh tenant also minting a fresh burst allowance)
-    # inside the component whose job is shedding before memory does.
+    # Distinct tenants tracked per bucket table: the ?tenant=
+    # parameter is client-controlled, so an uncapped dict would grow
+    # one bucket per request — unbounded memory (each fresh tenant
+    # also minting a fresh burst allowance) inside the component whose
+    # job is shedding before memory does.
+    #
+    # At the cap, a NEW tenant first tries to EVICT the least-recently
+    # -used bucket that has sat idle for >= IDLE_EVICT_S — so a
+    # cardinality attack spraying fresh ?tenant= ids churns the
+    # attacker's own abandoned buckets while every actively-ingesting
+    # tenant keeps its quota untouched. A bucket minted through an
+    # eviction starts COLD (zero tokens, earning at ``rate`` from its
+    # first request): a full-burst grant here would let an attacker
+    # cycle abandoned ids into ~MAX_TENANTS/IDLE_EVICT_S fresh burst
+    # allowances per second forever. A legitimate newcomer arriving
+    # mid-attack pays a one-time Retry-After instead of being
+    # collapsed onto the shared bucket. Only when no bucket is idle
+    # (every slot genuinely active) does the newcomer collapse onto
+    # the shared "default" bucket — bounded memory AND no
+    # fresh-burst-per-uuid once the attack saturates the table.
     MAX_TENANTS = 1024
+    IDLE_EVICT_S = 30.0
 
     def _bucket(self, buckets: dict, tenant: str, rate: float,
-                burst: float) -> TokenBucket:
+                burst: float, now: float | None = None) -> TokenBucket:
         b = buckets.get(tenant)
         if b is None or b.rate != rate:
+            now = time.monotonic() if now is None else now
+            cold = False
             with self._lock:
                 if (tenant not in buckets
                         and len(buckets) >= self.MAX_TENANTS):
-                    tenant = "default"
+                    victim = None
+                    # Scan only when a victim is possible: a failed
+                    # scan records when the oldest bucket COULD turn
+                    # idle, and takes only push that later, so the
+                    # stamp is a sound skip — at most one O(n) scan
+                    # per idle window instead of one per request.
+                    if now >= self._no_idle_before.get(id(buckets),
+                                                       0.0):
+                        v_last = now - self.IDLE_EVICT_S
+                        oldest = None
+                        for name, vb in buckets.items():
+                            if name == "default":
+                                continue
+                            lt = vb.last_take
+                            if oldest is None or lt < oldest:
+                                oldest = lt
+                            if lt <= v_last:
+                                victim, v_last = name, lt
+                        if victim is None and oldest is not None:
+                            self._no_idle_before[id(buckets)] = (
+                                oldest + self.IDLE_EVICT_S)
+                    if victim is not None:
+                        del buckets[victim]
+                        self.tenants_evicted += 1
+                        cold = True
+                    else:
+                        tenant = "default"
+                        self.tenants_collapsed += 1
                 b = buckets.get(tenant)
                 if b is None or b.rate != rate:
-                    b = buckets[tenant] = TokenBucket(rate, burst)
+                    b = buckets[tenant] = TokenBucket(
+                        rate, burst, tokens=0.0 if cold else None)
         return b
 
     def collect_stats(self, collector) -> None:
@@ -207,3 +270,10 @@ class AdmissionController:
                          "path=query reason=load")
         collector.record("admission.degraded_queries",
                          self.query_degraded)
+        collector.record("admission.tenants",
+                         max(len(self._ingest_buckets),
+                             len(self._query_buckets)))
+        collector.record("admission.tenants_evicted",
+                         self.tenants_evicted)
+        collector.record("admission.tenants_collapsed",
+                         self.tenants_collapsed)

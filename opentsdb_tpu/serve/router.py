@@ -372,6 +372,12 @@ class RouterServer:
         # One lazily-opened upstream per writer URL: a multi-writer
         # cluster fans one client connection across N owner writers.
         upstreams: dict[str, tuple] = {}
+        # Connection-scoped tenant: a `tenant <id>` line binds every
+        # later put AND is replayed ahead of the forwarded stream on
+        # each upstream connection, so the writer's admission buckets
+        # and cardinality accounting see the same id the client told
+        # the router — attribution no longer stops at the front door.
+        tenant = "default"
         try:
             buf = first
             while True:
@@ -391,6 +397,20 @@ class RouterServer:
                     continue
                 if text == "exit":
                     return
+                if text == "tenant" or text.startswith("tenant "):
+                    parts = text.split()
+                    if len(parts) == 2 and parts[1]:
+                        tenant = parts[1]
+                        # Already-open upstreams switch in-stream
+                        # (ordering preserved: the line lands before
+                        # any later put on the same connection).
+                        for _r, up_w in upstreams.values():
+                            up_w.write(f"tenant {tenant}\n".encode())
+                        writer.write(f"tenant {tenant}\n".encode())
+                    else:
+                        writer.write(b"tenant: need exactly one id\n")
+                    await writer.drain()
+                    continue
                 if not text.startswith("put "):
                     writer.write(b"unknown command: "
                                  + text.split(" ", 1)[0].encode()
@@ -403,7 +423,7 @@ class RouterServer:
                                  b"router\n")
                     await writer.drain()
                     continue
-                wait = self.admission.admit_ingest(1)
+                wait = self.admission.admit_ingest(1, tenant)
                 if wait > 0:
                     writer.write(
                         f"put: Please throttle writes: over ingest "
@@ -417,6 +437,11 @@ class RouterServer:
                         upstream = await asyncio.open_connection(
                             target.host, target.port)
                         upstreams[target.url] = upstream
+                        if tenant != "default":
+                            # Fresh upstream: replay the attribution
+                            # before the first forwarded put.
+                            upstream[1].write(
+                                f"tenant {tenant}\n".encode())
                     upstream[1].write(line + b"\n")
                     await upstream[1].drain()
                     self.telnet_lines_forwarded += 1
@@ -430,8 +455,16 @@ class RouterServer:
                     up_writer.write_eof()
                     back = await asyncio.wait_for(up_reader.read(),
                                                   timeout=5.0)
-                    if back:
-                        writer.write(back)
+                    # Swallow the `tenant <id>` acks our own
+                    # attribution replays provoked (the router is this
+                    # upstream's only writer, so any tenant line here
+                    # is ours, and the client already got the
+                    # router's ack); everything else is a put error
+                    # the client must see.
+                    keep = [ln for ln in back.split(b"\n")
+                            if ln and not ln.startswith(b"tenant ")]
+                    if keep:
+                        writer.write(b"\n".join(keep) + b"\n")
                         await writer.drain()
                 except Exception:
                     pass
@@ -523,10 +556,147 @@ class RouterServer:
                     _TOPOLOGY_HTML.encode(), {})
         if path == "/api/cluster/handoff":
             return await self._handoff(q)
+        if path == "/api/tenants":
+            # Tenant accounting lives on the WRITER(s) (the admission
+            # point); proxy there so the control plane has one front
+            # door. Replicas answer enabled:false, so the replica
+            # fallback below still yields a well-formed body.
+            # When a writer IS configured but unreachable, the outage
+            # is DECLARED (503) — falling through to a replica would
+            # answer a healthy-looking enabled:false, and monitoring
+            # could not tell a config choice from a down writer. The
+            # replica fallback serves only the no-writer-configured
+            # router shape.
+            if self._writer is not None:
+                try:
+                    status, headers, body = await _http_fetch(
+                        self._writer.host, self._writer.port, target,
+                        timeout_s=5.0)
+                    return (status,
+                            headers.get("content-type",
+                                        "application/json"), body, {})
+                except HopError:
+                    return (503, "application/json", json.dumps({
+                        "error": "writer unreachable",
+                        "writer": self._writer.url}).encode(), {})
+            if self.writer_backends:
+                merged = await self._tenants_fanout(target)
+                if merged is None:
+                    return (503, "application/json", json.dumps({
+                        "error": "no writer reachable",
+                        "writers": len(self.writer_backends)}).encode(),
+                        {})
+                return (200, "application/json",
+                        json.dumps(merged).encode(), {})
+            return await self._proxy_any(target)
         if path in ("/aggregators", "/version", "/suggest"):
             # Storage-free passthroughs any healthy replica answers.
             return await self._proxy_any(target)
         return 404, "text/plain", b"Page Not Found\n", {}
+
+    async def _tenants_fanout(self, target: str) -> dict | None:
+        """Multi-writer /api/tenants: every owner accounts its own
+        ownership-disjoint slice of the series space, so per-tenant
+        series/points/refusal counts SUM exactly across writers;
+        heavy-hitter summaries merge by key with count+err addition
+        (the standard SpaceSaving merge — errors stay upper bounds);
+        a tenant's tier degrades to hll (max declared error) when any
+        writer's slice is past its cutoff. Unreachable or
+        accounting-off writers are DECLARED via writers_unreachable,
+        never silently averaged away. Returns None when no writer
+        answered with accounting enabled (caller falls back)."""
+        outs = await asyncio.gather(
+            *(_http_fetch(b.host, b.port, target, timeout_s=5.0)
+              for b in self.writer_backends),
+            return_exceptions=True)
+        bodies = []
+        unreachable = disabled = 0
+        for out in outs:
+            if isinstance(out, BaseException):
+                unreachable += 1
+                continue
+            status, _headers, body = out
+            try:
+                data = json.loads(body) if status == 200 else None
+            except ValueError:
+                data = None
+            if data is None:
+                unreachable += 1
+            elif data.get("enabled"):
+                bodies.append(data)
+            else:
+                disabled += 1
+        if not bodies:
+            if disabled:
+                # Writers answered — accounting is genuinely off
+                # fleet-wide (or on none of the reachable ones); a
+                # truthful enabled:false, not an outage.
+                return {"enabled": False,
+                        "writers": len(self.writer_backends),
+                        "writers_unreachable": unreachable}
+            return None
+
+        def _merge_hh(key: str, ents: list[dict], label: str,
+                      weight: str) -> list[dict]:
+            acc: dict[str, list[int]] = {}
+            for ent in ents:
+                for row in ent.get(key, ()):
+                    slot = acc.setdefault(str(row[label]), [0, 0])
+                    slot[0] += int(row[weight])
+                    slot[1] += int(row.get("err", 0))
+            ranked = sorted(acc.items(), key=lambda kv: -kv[1][0])
+            width = max((len(ent.get(key, ())) for ent in ents),
+                        default=0)
+            return [{label: k, weight: c, "err": e}
+                    for k, (c, e) in ranked[:width]]
+
+        tenants: dict[str, dict] = {}
+        for data in bodies:
+            for name, ent in data.get("tenants", {}).items():
+                t = tenants.get(name)
+                if t is None:
+                    tenants[name] = t = {
+                        "series": 0, "tier": "exact", "error": 0.0,
+                        "points": 0, "refused": 0, "would_refuse": 0,
+                        "_hh": []}
+                    if "limit" in ent:
+                        t["limit"] = ent["limit"]
+                t["series"] += int(ent.get("series", 0))
+                t["points"] += int(ent.get("points", 0))
+                t["refused"] += int(ent.get("refused", 0))
+                t["would_refuse"] += int(ent.get("would_refuse", 0))
+                if ent.get("tier") == "hll":
+                    t["tier"] = "hll"
+                t["error"] = max(t["error"],
+                                 float(ent.get("error", 0.0)))
+                t["_hh"].append(ent)
+        for t in tenants.values():
+            ents = t.pop("_hh")
+            t["top_series"] = _merge_hh("top_series", ents,
+                                        "series", "points")
+            t["top_prefixes"] = _merge_hh("top_prefixes", ents,
+                                          "prefix", "new_series")
+        first = bodies[0]
+        merged = {
+            "enabled": True,
+            "tenants": tenants,
+            "total_series": sum(int(d.get("total_series", 0))
+                                for d in bodies),
+            "tracked_series": sum(int(d.get("tracked_series", 0))
+                                  for d in bodies),
+            "recovered_series": sum(int(d.get("recovered_series", 0))
+                                    for d in bodies),
+            "snapshots_written": sum(
+                int(d.get("snapshots_written", 0)) for d in bodies),
+            "exact_cutoff": first.get("exact_cutoff"),
+            "hll_p": first.get("hll_p"),
+            "writers": len(self.writer_backends),
+            "writers_unreachable": unreachable,
+        }
+        for k in ("mode", "global_limit"):
+            if k in first:
+                merged[k] = first[k]
+        return merged
 
     def _healthz(self) -> tuple:
         ok = any(b.healthy for b in self.backends)

@@ -58,6 +58,8 @@ class HttpRequest(NamedTuple):
     q: dict                    # last-value-wins query params
     params: dict               # full multi-value query params
     query_string: str
+    body: bytes = b""          # request body (bounded at
+    #                            MAX_BODY_BYTES; b"" for GETs)
 
 LOG = logging.getLogger(__name__)
 
@@ -300,6 +302,14 @@ class TSDServer:
 
     async def _handle_telnet(self, first: bytes, reader, writer) -> None:
         buf = first
+        # Connection-scoped tenant id (the telnet analog of ?tenant=):
+        # a `tenant <id>` line attributes every LATER put on this
+        # connection — admission buckets and the cardinality
+        # accounting see the same id the router's HTTP face sees. The
+        # router forwards the line ahead of forwarded puts, so
+        # attribution survives the hop (it used to stop at the
+        # router).
+        conn = {"tenant": "default"}
         # Per-connection two-stage ingest pipeline (SURVEY §2.9 PP row):
         # chunk N's decode runs in the pool while chunk N-1's ingest is
         # still applying — the server-loop form of wire.pipelined_ingest.
@@ -336,7 +346,8 @@ class TSDServer:
                             await older
                         older, pending = pending, asyncio.create_task(
                             self._bulk_puts_pipelined(
-                                chunk, pending, writer))
+                                chunk, pending, writer,
+                                conn["tenant"]))
                         continue
                 # Ordering: bulk results (error lines, stats) land
                 # before any later single-line command executes.
@@ -351,7 +362,7 @@ class TSDServer:
                 if not words:
                     continue
                 self.telnet_rpcs += 1
-                if not await self._telnet_command(words, writer):
+                if not await self._telnet_command(words, writer, conn):
                     return
         finally:
             # Retrieve both tasks (even on error paths) so no exception
@@ -366,7 +377,8 @@ class TSDServer:
 
     async def _bulk_puts_pipelined(self, chunk: bytes,
                                    prev: asyncio.Task | None,
-                                   writer) -> None:
+                                   writer,
+                                   tenant: str = "default") -> None:
         """Stage A (decode) runs immediately in the pool — overlapping
         the previous chunk's stage B — then awaits ``prev`` so ingest
         and error reporting stay in arrival order."""
@@ -382,7 +394,8 @@ class TSDServer:
         # with a throttle line + retry hint BEFORE it allocates store
         # work — collectors already understand "Please throttle".
         npts = len(batch.sid)
-        wait = self.admission.admit_ingest(npts) if npts else 0.0
+        wait = self.admission.admit_ingest(npts, tenant) if npts \
+            else 0.0
         if wait > 0:
             self.telnet_rpcs += npts + len(batch.errors)
             self.requests_put += npts + len(batch.errors)
@@ -395,7 +408,9 @@ class TSDServer:
             return
         try:
             n, series_errors = await loop.run_in_executor(
-                self._pool, wire.ingest_batch, self.tsdb, batch)
+                self._pool,
+                functools.partial(wire.ingest_batch, self.tsdb, batch,
+                                  tenant=tenant))
         finally:
             if npts:
                 self.admission.ingest_done(npts)
@@ -414,6 +429,16 @@ class TSDServer:
                 self.hbase_errors_put += 1
                 writer.write(
                     f"put: Please throttle writes: {err}\n".encode())
+            elif "[tenant-limit]" in err:
+                # Declared cardinality refusal (tenant/limits.py),
+                # tagged by wire.ingest_batch: NOT a throttle — the
+                # series can never ingest until the limit moves, so
+                # the line must not invite a retry loop. The rest of
+                # the batch (existing series) already applied.
+                self.hbase_errors_put += 1
+                writer.write(
+                    f"put: tenant series limit exceeded: {err}\n"
+                    .encode())
             elif "read-only" in err:
                 self.hbase_errors_put += 1
                 writer.write(
@@ -439,7 +464,11 @@ class TSDServer:
 
     def register_telnet(self, command: str, handler) -> None:
         """Register ``handler(words, writer) -> bool | None`` for a
-        telnet command; returning False closes the connection."""
+        telnet command; returning False closes the connection. A
+        handler carrying a truthy ``_wants_conn`` attribute is called
+        ``handler(words, writer, conn)`` with the per-connection state
+        dict instead (the built-in ``put``/``tenant`` pair use it for
+        connection-scoped tenant attribution)."""
         self.telnet_commands[command] = handler
 
     def register_http(self, route: str, handler) -> None:
@@ -449,7 +478,8 @@ class TSDServer:
 
     def _register_default_commands(self) -> None:
         self.telnet_commands = {
-            "put": self._telnet_put,
+            "put": self._cmd_put,
+            "tenant": self._cmd_tenant,
             "version": lambda words, writer: writer.write(
                 self._version_text().encode()),
             "stats": lambda words, writer: writer.write(
@@ -477,6 +507,9 @@ class TSDServer:
             "/fault": self._http_fault,
             "/queries": self._http_queries_page,
             "/api/queries": self._http_queries,
+            "/tenants": self._http_tenants_page,
+            "/api/tenants": self._http_tenants,
+            "/api/put": self._http_put,
             "/promote": self._http_promote,
             "/demote": self._http_demote,
             "/healthz": self._http_healthz,
@@ -487,6 +520,21 @@ class TSDServer:
             "/favicon.ico": self._http_favicon,
         }
 
+    def _cmd_tenant(self, words, writer, conn):
+        # Connection-scoped attribution: `tenant <id>` binds every
+        # later put to <id>'s quota + cardinality budget.
+        if len(words) != 2 or not words[1]:
+            _M_TELNET_ERRORS.inc()
+            writer.write(b"tenant: need exactly one id\n")
+        else:
+            conn["tenant"] = words[1]
+            writer.write(f"tenant {words[1]}\n".encode())
+    _cmd_tenant._wants_conn = True
+
+    def _cmd_put(self, words, writer, conn):
+        self._telnet_put(words, writer, conn["tenant"])
+    _cmd_put._wants_conn = True
+
     def _cmd_dropcaches(self, words, writer):
         self.tsdb.drop_caches()
         writer.write(b"Caches dropped.\n")
@@ -496,8 +544,11 @@ class TSDServer:
         self.request_shutdown()
         return False
 
-    async def _telnet_command(self, words: list[str], writer) -> bool:
-        """Dispatch one telnet command; False closes the connection."""
+    async def _telnet_command(self, words: list[str], writer,
+                              conn: dict | None = None) -> bool:
+        """Dispatch one telnet command; False closes the connection.
+        ``conn`` is the per-connection state dict (tenant id)."""
+        conn = conn if conn is not None else {"tenant": "default"}
         handler = self.telnet_commands.get(words[0])
         if handler is None:
             self.rpcs_unknown += 1
@@ -509,7 +560,10 @@ class TSDServer:
         # put pipeline bypasses this dispatcher by design — it's
         # covered by rpc.latency/put and the wal.* instruments.
         with METRICS.timer("telnet.handler", {"cmd": words[0]}).time():
-            out = handler(words, writer)
+            if getattr(handler, "_wants_conn", False):
+                out = handler(words, writer, conn)
+            else:
+                out = handler(words, writer)
             if asyncio.iscoroutine(out):
                 out = await out
         # Per-command backpressure: a slow reader pipelining commands
@@ -517,12 +571,14 @@ class TSDServer:
         await writer.drain()
         return out is not False
 
-    def _telnet_put(self, words: list[str], writer) -> None:
+    def _telnet_put(self, words: list[str], writer,
+                    tenant: str = "default") -> None:
         """Parity: reference PutDataPointRpc.importDataPoint (:93-123)."""
+        from opentsdb_tpu.core.errors import TenantLimitError
         t0 = time.time()
         self.requests_put += 1
         try:
-            wait = self.admission.admit_ingest(1)
+            wait = self.admission.admit_ingest(1, tenant)
             if wait > 0:
                 # Shed: admit_ingest took NO slot, so nothing to
                 # release (pairing ingest_done here would free
@@ -545,10 +601,21 @@ class TSDServer:
             for tag in words[4:]:
                 tags_mod.parse(tag_map, tag)
             if is_float:
-                self.tsdb.add_point(metric, timestamp, fval, tag_map)
+                self.tsdb.add_point(metric, timestamp, fval, tag_map,
+                                    tenant=tenant)
             else:
-                self.tsdb.add_point(metric, timestamp, ival, tag_map)
+                self.tsdb.add_point(metric, timestamp, ival, tag_map,
+                                    tenant=tenant)
             self.put_latency.add((time.time() - t0) * 1000)
+        except TenantLimitError as e:
+            # Declared cardinality refusal (tenant/limits.py): a
+            # DISTINCT line from the throttle — collectors must not
+            # treat it as transient; the put can never succeed until
+            # the limit is raised. Existing series keep ingesting.
+            self.hbase_errors_put += 1
+            _M_TELNET_ERRORS.inc()
+            writer.write(
+                f"put: tenant series limit exceeded: {e}\n".encode())
         except NoSuchUniqueName as e:
             self.unknown_metrics_put += 1
             _M_TELNET_ERRORS.inc()
@@ -633,14 +700,14 @@ class TSDServer:
                 if not chunk:
                     return
                 data += chunk
-            data = data[clen:]
+            req_body, data = data[:clen], data[clen:]
             keep = (version.strip().upper() == "HTTP/1.1"
                     and headers.get("connection", "").lower() != "close")
 
             t0 = time.time()
             try:
-                status, ctype, body, extra = await self._route(method,
-                                                               target)
+                status, ctype, body, extra = await self._route(
+                    method, target, req_body)
             except BadRequestError as e:
                 status, extra = e.status, {}
                 ctype, body = self._error_body(target, str(e))
@@ -699,7 +766,8 @@ class TSDServer:
         writer.write(("\r\n".join(hdrs) + "\r\n\r\n").encode() + body)
         await writer.drain()
 
-    async def _route(self, method: str, target: str):
+    async def _route(self, method: str, target: str,
+                     body: bytes = b""):
         self.http_rpcs += 1
         parsed = urllib.parse.urlsplit(target)
         path = parsed.path
@@ -714,7 +782,7 @@ class TSDServer:
             self.rpcs_unknown += 1
             return 404, "text/plain", b"Page Not Found\n", {}
         req = HttpRequest(method=method, path=path, q=q, params=params,
-                          query_string=parsed.query)
+                          query_string=parsed.query, body=body)
         # Per-endpoint latency timer: tagged by the ROUTE (a bounded
         # label set), never the raw path — /metrics cardinality must
         # not scale with request strings.
@@ -1050,6 +1118,101 @@ class TSDServer:
     def _http_queries_page(self, req) -> tuple:
         return (200, "text/html; charset=UTF-8",
                 _QUERIES_HTML.encode(), {"Cache-Control": "no-cache"})
+
+    # ------------------------------------------------------------------
+    # Tenant cardinality control plane (opentsdb_tpu/tenant/)
+    # ------------------------------------------------------------------
+
+    def _http_tenants(self, req) -> tuple:
+        """JSON feed behind the /tenants view: per-tenant series
+        cardinality (exact or HLL tier, error declared), the limit
+        governing each tenant, refusal counters, and the heavy-hitter
+        summaries (top series by points, top metric prefixes by new
+        series). Replicas and accounting-off daemons answer with
+        enabled: false instead of 404 — the fleet shape is uniform."""
+        acct = getattr(self.tsdb, "tenants", None)
+        if acct is None:
+            body = {"enabled": False,
+                    "role": getattr(self.config, "role", "writer")}
+            return (200, "application/json",
+                    json.dumps(body).encode(), {})
+        body = acct.snapshot_info(
+            getattr(self.tsdb, "tenant_limits", None))
+        body["enabled"] = True
+        admission = self.admission
+        body["admission"] = {
+            "tenants": max(len(admission._ingest_buckets),
+                           len(admission._query_buckets)),
+            "evicted": admission.tenants_evicted,
+            "collapsed": admission.tenants_collapsed,
+        }
+        return (200, "application/json", json.dumps(body).encode(), {})
+
+    def _http_tenants_page(self, req) -> tuple:
+        return (200, "text/html; charset=UTF-8",
+                _TENANTS_HTML.encode(), {"Cache-Control": "no-cache"})
+
+    async def _http_put(self, req) -> tuple:
+        """HTTP ingest: a POST body of telnet-format ``put`` lines
+        (no leading "put " required per line — both spellings
+        accepted), attributed to ``?tenant=``. The HTTP face of the
+        tenant-limit contract: when every line was refused by the
+        cardinality limiter the answer is 429 naming the limit;
+        partial refusals report per-series errors in a 200 body so
+        the caller can split permanent refusals from parse noise."""
+        from opentsdb_tpu.server import wire
+        if req.method != "POST":
+            raise BadRequestError("POST a body of put lines", 405)
+        if not req.body.strip():
+            raise BadRequestError("empty body")
+        tenant = req.q.get("tenant", "default")
+        raw = req.body
+        if not raw.endswith(b"\n"):
+            raw += b"\n"
+        # Accept bare "metric ts value tags" lines by prefixing the
+        # telnet verb; lines already carrying it pass through.
+        lines = []
+        for ln in raw.split(b"\n"):
+            if ln and not ln.startswith(b"put "):
+                ln = b"put " + ln
+            lines.append(ln)
+        raw = b"\n".join(lines)
+        loop = asyncio.get_running_loop()
+        batch = await loop.run_in_executor(self._pool, wire.decode_puts,
+                                           raw)
+        npts = len(batch.sid)
+        wait = self.admission.admit_ingest(npts, tenant) if npts \
+            else 0.0
+        if wait > 0:
+            raise OverloadedError(
+                f"over ingest quota for tenant {tenant!r}", wait,
+                status=429)
+        try:
+            n, series_errors = await loop.run_in_executor(
+                self._pool,
+                functools.partial(wire.ingest_batch, self.tsdb, batch,
+                                  tenant=tenant))
+        finally:
+            if npts:
+                self.admission.ingest_done(npts)
+        self.requests_put += n
+        errors = list(batch.errors) + series_errors
+        refused = [e for e in series_errors if "[tenant-limit]" in e]
+        body = {"points": n, "errors": errors,
+                "tenant": tenant,
+                "refused_series": len(refused)}
+        if refused and n == 0:
+            # Everything the caller sent was a refused NEW series:
+            # the declared 429 face, naming the limit — and no
+            # Retry-After, because a retry cannot succeed until the
+            # limit moves (this is not a throttle).
+            limits = getattr(self.tsdb, "tenant_limits", None)
+            body["error"] = refused[0]
+            body["limit"] = (limits.limit_for(tenant)
+                             if limits is not None else None)
+            return (429, "application/json",
+                    json.dumps(body).encode(), {})
+        return 200, "application/json", json.dumps(body).encode(), {}
 
     def _http_metrics(self, req) -> tuple:
         """Prometheus text exposition: the metrics registry (typed —
@@ -1800,6 +1963,7 @@ class TSDServer:
 <li>/q?start=1h-ago&amp;m=sum:metric&#123;tag=value&#125;&amp;ascii</li>
 <li>/suggest?type=metrics&amp;q=prefix</li>
 <li><a href="/stats">/stats</a></li>
+<li><a href="/tenants">/tenants</a></li>
 <li><a href="/metrics">/metrics</a></li>
 <li><a href="/api/traces">/api/traces</a></li>
 <li><a href="/version">/version</a></li>
@@ -1875,6 +2039,92 @@ class TSDServer:
 # down: one self-contained page over the /api/queries JSON feed,
 # served from memory, auto-refreshing.
 # ---------------------------------------------------------------------------
+
+_TENANTS_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>tsd tenants</title>
+<style>
+ body{font:13px/1.45 system-ui,sans-serif;margin:1.2em;background:#fafafa;
+      color:#222}
+ h1{font-size:1.2em;margin:0 0 .2em}
+ h2{font-size:1em;margin:1.2em 0 .3em}
+ table{border-collapse:collapse;background:#fff;min-width:36em}
+ th,td{border:1px solid #ddd;padding:.25em .6em;text-align:left;
+       font-variant-numeric:tabular-nums}
+ th{background:#f0f0f0;font-weight:600}
+ .ok{color:#0a7d32}.bad{color:#c0392b}.warn{color:#b8860b}
+ #meta{color:#666;font-size:.9em;margin-bottom:.8em}
+ .pill{display:inline-block;padding:0 .5em;border-radius:.8em;
+       background:#eee;margin-right:.4em}
+ small{color:#888}
+</style></head><body>
+<h1>Tenant cardinality</h1>
+<div id="meta">loading /api/tenants&hellip;</div>
+<div id="tenants"></div><div id="hh"></div><div id="adm"></div>
+<script>
+function esc(v){return String(v).replace(/&/g,"&amp;")
+  .replace(/</g,"&lt;").replace(/>/g,"&gt;");}
+function fmt(v){return v===null||v===undefined?"&mdash;":esc(v);}
+function table(title, heads, rows){
+  var h="<h2>"+title+"</h2><table><tr>"+heads.map(
+    function(x){return "<th>"+x+"</th>";}).join("")+"</tr>";
+  h+=rows.map(function(r){return "<tr>"+r.map(
+    function(c){return "<td>"+c+"</td>";}).join("")+"</tr>";}).join("");
+  return h+"</table>";
+}
+function pills(title, obj){
+  return "<h2>"+title+"</h2>"+Object.keys(obj).sort().map(function(k){
+    return "<span class='pill'>"+esc(k)+": "+esc(obj[k])+"</span>";
+  }).join("")||"&mdash;";
+}
+function render(t){
+  if(!t.enabled){
+    document.getElementById("meta").innerHTML=
+      "tenant accounting is off on this daemon (role "+
+      fmt(t.role)+")";
+    return;
+  }
+  document.getElementById("meta").innerHTML=
+    "tracked series "+t.tracked_series+" &middot; mode "+fmt(t.mode)+
+    " &middot; global limit "+(t.global_limit||"&infin;")+
+    " &middot; snapshots "+t.snapshots_written+
+    " &middot; refreshed "+new Date().toLocaleTimeString();
+  var names=Object.keys(t.tenants||{});
+  var rows=names.map(function(n){
+    var e=t.tenants[n];
+    var over=e.limit&&e.series>=e.limit;
+    var ser=e.series+(e.tier==="hll"
+      ?" <small>&plusmn;"+Math.round(e.error*100)+"% (hll)</small>":"");
+    return [esc(n), over?"<span class='bad'>"+ser+"</span>":ser,
+      e.limit?esc(e.limit):"&infin;", e.points,
+      e.refused?"<span class='bad'>"+e.refused+"</span>":0,
+      e.would_refuse||0];});
+  document.getElementById("tenants").innerHTML=
+    table("Tenants",["tenant","series","limit","points","refused",
+                     "would refuse"],rows);
+  var hh="";
+  names.forEach(function(n){
+    var e=t.tenants[n];
+    if((e.top_series||[]).length)
+      hh+=table("Heavy hitters &mdash; "+esc(n),
+        ["series","points","err","","prefix","new series","err"],
+        e.top_series.map(function(s,i){
+          var p=(e.top_prefixes||[])[i]||{};
+          return [esc(s.series),s.points,s.err,"",
+            fmt(p.prefix),fmt(p.new_series),fmt(p.err)];}));
+  });
+  document.getElementById("hh").innerHTML=hh;
+  document.getElementById("adm").innerHTML=
+    pills("Admission buckets", t.admission||{});
+}
+function tick(){
+  fetch("/api/tenants").then(function(r){return r.json();})
+    .then(render)
+    .catch(function(e){document.getElementById("meta").innerHTML=
+      "<span class='bad'>fetch failed: "+esc(e)+"</span>";});
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>
+"""
 
 _QUERIES_HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>tsd queries</title>

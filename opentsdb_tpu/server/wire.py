@@ -184,7 +184,8 @@ def _decode_python(buf: bytes) -> DecodedBatch:
 
 def pipelined_ingest(tsdb, chunks, durable: bool = True,
                      use_native: bool | None = None,
-                     max_queue: int = 2) -> tuple[int, list[str]]:
+                     max_queue: int = 2,
+                     tenant: str = "default") -> tuple[int, list[str]]:
     """Two-stage host pipeline over a stream of byte chunks: a worker
     thread decodes chunk N+1 while the caller's thread ingests batch N —
     the pipeline-parallelism analog for this workload (SURVEY.md §2.9 PP
@@ -228,7 +229,8 @@ def pipelined_ingest(tsdb, chunks, durable: bool = True,
     try:
         while (batch := q.get()) is not None:
             errors += batch.errors  # parse errors, like the one-shot path
-            n, errs = ingest_batch(tsdb, batch, durable)
+            n, errs = ingest_batch(tsdb, batch, durable,
+                                   tenant=tenant)
             total += n
             errors += errs
     finally:
@@ -251,8 +253,8 @@ def pipelined_ingest(tsdb, chunks, durable: bool = True,
     return total, errors
 
 
-def ingest_batch(tsdb, batch: DecodedBatch,
-                 durable: bool = True) -> tuple[int, list[str]]:
+def ingest_batch(tsdb, batch: DecodedBatch, durable: bool = True,
+                 tenant: str = "default") -> tuple[int, list[str]]:
     """Feed a decoded batch into the TSDB via the columnar write path.
 
     Series are ingested independently: one series failing (unknown
@@ -277,14 +279,21 @@ def ingest_batch(tsdb, batch: DecodedBatch,
             n += tsdb.add_batch(
                 metric, batch.timestamps[run], batch.fvalues[run],
                 tag_map, durable=durable, is_float=batch.is_float[run],
-                int_values=batch.ivalues[run])
+                int_values=batch.ivalues[run], tenant=tenant)
         except Exception as e:
-            # Stable machine-readable tag for the fence refusal
-            # (cluster/epoch.py): the server's error classifier keys
-            # on "[fenced]", not on exception message wording that
-            # could drift.
-            from opentsdb_tpu.core.errors import FencedWriterError
-            tag = "[fenced] " if isinstance(e, FencedWriterError) \
-                else ""
+            # Stable machine-readable tags for policy refusals: the
+            # server's error classifier keys on "[fenced]" /
+            # "[tenant-limit]", not on exception message wording that
+            # could drift. A tenant-limit refusal is per-series:
+            # the tenant's EXISTING series in this batch still
+            # ingested above/below — only the new one refused.
+            from opentsdb_tpu.core.errors import (FencedWriterError,
+                                                  TenantLimitError)
+            if isinstance(e, FencedWriterError):
+                tag = "[fenced] "
+            elif isinstance(e, TenantLimitError):
+                tag = "[tenant-limit] "
+            else:
+                tag = ""
             errors.append(f"{metric}: {tag}{e}")
     return n, errors

@@ -121,10 +121,14 @@ _IDENT_HI = UID_WIDTH + TIMESTAMP_BYTES
 
 # Fixed per-table bloom geometry (see module docstring: fixed so
 # compaction can OR source blooms). 2^20 bits = 128 KiB per table per
-# generation; at 2k series and k=2 the false-positive rate is ~1e-5,
+# generation; at 2k series and k=3 the false-positive rate is ~2e-7,
 # and a false positive only costs one needless generation scan.
+# K doubles as the bloom FORMAT discriminator: the reader ignores a
+# stored bloom whose (k, nbits) mismatch the current geometry, so
+# files written before the k=2->3 probe fix degrade to bloomless
+# (never a false negative) and age out through compaction.
 BLOOM_BITS = 1 << 20
-BLOOM_K = 2
+BLOOM_K = 3
 
 # Tests set this to 2 to produce bloomless legacy-format files; the
 # reader handles both forever (mixed-format stores are first-class:
@@ -145,14 +149,21 @@ def series_hash(series_key: bytes) -> int:
 
 
 def _bloom_positions(h1: "np.ndarray") -> "np.ndarray":
-    """[n, BLOOM_K] bit positions from 32-bit identity hashes. The
-    second probe derives from h1 (Kirsch-Mitzenmacher with a mixed
-    h2): 32-bit identity collisions collapse the pair, which costs a
-    handful of false positives at million-series scale — never a false
-    negative."""
+    """[n, BLOOM_K] bit positions from 32-bit identity hashes
+    (Kirsch-Mitzenmacher). h2 MUST mix h1's HIGH bits: positions are
+    taken mod the power-of-two BLOOM_BITS, so an h2 derived from h1
+    by multiply-add alone is a pure function of h1 mod BLOOM_BITS and
+    the extra probes add no independence (the original k=2 derivation
+    behaved as k=1 — ~10x the theoretical false-positive rate under
+    the hostile-cardinality regime). Deriving from h1 >> 16 (odd-
+    forced so the k*h2 strides cycle the whole table) restores the
+    (1 - e^{-kn/m})^k envelope; 32-bit identity collisions still
+    collapse pairs — a handful of false positives at million-series
+    scale, never a false negative."""
     h1 = h1.astype(np.uint64)
-    h2 = (h1 * np.uint64(0x9E3779B1) + np.uint64(0x7FEB352D)) \
-        & np.uint64(0xFFFFFFFF)
+    h2 = ((h1 >> np.uint64(16)) * np.uint64(0x9E3779B1)
+          + np.uint64(0x7FEB352D)) & np.uint64(0xFFFFFFFF)
+    h2 = h2 | np.uint64(1)
     ks = np.arange(BLOOM_K, dtype=np.uint64)
     return (h1[:, None] + ks * h2[:, None]) % np.uint64(BLOOM_BITS)
 
@@ -876,7 +887,8 @@ class SSTable:
         bits = self._blooms.get(table)
         if bits is None:
             return True
-        h2 = (h1 * 0x9E3779B1 + 0x7FEB352D) & 0xFFFFFFFF
+        h2 = (((h1 >> 16) * 0x9E3779B1 + 0x7FEB352D)
+              & 0xFFFFFFFF) | 1
         for k in range(BLOOM_K):
             pos = (h1 + k * h2) % BLOOM_BITS
             if not (bits[pos >> 3] >> (pos & 7)) & 1:

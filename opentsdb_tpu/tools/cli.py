@@ -190,9 +190,22 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         cfg.tail_interval_s = getattr(args, "tail_interval", 0.25)
         cfg.query_max_inflight = getattr(args, "query_max_inflight", 0)
         cfg.query_rate = getattr(args, "query_rate", 0.0)
+        cfg.query_burst = getattr(args, "query_burst", 8.0)
         cfg.ingest_rate = getattr(args, "ingest_rate", 0.0)
         cfg.ingest_queue_points = getattr(args, "ingest_queue_points",
                                           0)
+        # Tenant cardinality control plane (opentsdb_tpu/tenant/).
+        if getattr(args, "no_tenant_accounting", False):
+            cfg.tenant_accounting = False
+        cfg.tenant_max_series = getattr(args, "tenant_max_series", 0)
+        cfg.tenant_global_max_series = getattr(
+            args, "tenant_global_max_series", 0)
+        cfg.tenant_limit_mode = getattr(args, "tenant_limit_mode",
+                                        "enforce")
+        cfg.tenant_overrides = tuple(
+            getattr(args, "tenant_override", []) or ())
+        cfg.tenant_exact_cutoff = getattr(args, "tenant_exact_cutoff",
+                                          4096)
     read_only = getattr(args, "read_only", False)
     shards = getattr(args, "shards", 0) or 0
     from opentsdb_tpu.storage.sharded import manifest_path
@@ -355,6 +368,7 @@ def _cmd_router(args) -> int:
         router_eject_after=getattr(args, "router_eject_after", 3),
         query_max_inflight=getattr(args, "query_max_inflight", 0),
         query_rate=getattr(args, "query_rate", 0.0),
+        query_burst=getattr(args, "query_burst", 8.0),
         ingest_rate=getattr(args, "ingest_rate", 0.0),
         ingest_queue_points=getattr(args, "ingest_queue_points", 0),
         # Cluster write tier: automatic failover grace, multi-writer
@@ -822,6 +836,64 @@ def cmd_sketch_plan(args) -> int:
         tsdb.shutdown()
 
 
+def cmd_tenants(args) -> int:
+    """Per-tenant cardinality report: series counts (exact or HLL
+    tier, error declared), the limit governing each tenant, refusal
+    counters, and the heavy-hitter summaries — from a live daemon's
+    /api/tenants (--url) or an opened store's TENANTS.json-backed
+    accountant."""
+    if args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(
+                args.url.rstrip("/") + "/api/tenants", timeout=15) as r:
+            info = json.loads(r.read())
+        if not info.get("enabled", True):
+            print("tenant accounting is off on that daemon "
+                  f"(role {info.get('role', '?')})")
+            return 0
+    else:
+        tsdb = make_tsdb(args)
+        try:
+            if tsdb.tenants is None:
+                print("tenant accounting is off (replica store or "
+                      "--no-tenant-accounting)", file=sys.stderr)
+                return 2
+            info = tsdb.tenants.snapshot_info(tsdb.tenant_limits)
+        finally:
+            tsdb.shutdown()
+    if args.json_out:
+        json.dump(info, sys.stdout, indent=1)
+        print()
+        return 0
+    print(f"tracked series: {info['tracked_series']}"
+          f"  (total ever admitted: {info['total_series']}, "
+          f"recovered: {info['recovered_series']})")
+    if info.get("mode"):
+        print(f"limit mode: {info['mode']}  global limit: "
+              f"{info.get('global_limit') or 'unlimited'}")
+    hdr = (f"{'tenant':20s} {'series':>10s} {'tier':>6s} "
+           f"{'limit':>10s} {'points':>12s} {'refused':>8s} "
+           f"{'would':>6s}")
+    print(hdr)
+    for name, ent in sorted(info["tenants"].items(),
+                            key=lambda kv: -kv[1]["series"]):
+        err = (f"±{ent['error'] * 100:.0f}%"
+               if ent["tier"] == "hll" else "")
+        print(f"{name[:20]:20s} {ent['series']:>10d} "
+              f"{ent['tier'] + err:>6s} "
+              f"{ent.get('limit') or '∞':>10} "
+              f"{ent['points']:>12d} {ent['refused']:>8d} "
+              f"{ent['would_refuse']:>6d}")
+        for hh in ent["top_series"][:args.top]:
+            print(f"    series {hh['series']}  points~{hh['points']} "
+                  f"(err {hh['err']})")
+        for hh in ent["top_prefixes"][:args.top]:
+            print(f"    prefix {hh['prefix']}  new-series~"
+                  f"{hh['new_series']} (err {hh['err']})")
+    return 0
+
+
 def cmd_version(args) -> int:
     from opentsdb_tpu.build_data import build_data, version_string
     print(version_string(), end="")
@@ -928,12 +1000,40 @@ def main(argv: list[str] | None = None) -> int:
                    help="trace 1 in N queries into /api/traces even "
                         "when fast — ambient baselines between "
                         "incidents (0 disables)")
+    # Tenant cardinality control plane (opentsdb_tpu/tenant/).
+    p.add_argument("--tenant-max-series", type=int, default=0,
+                   help="refuse a NEW series from any tenant already "
+                        "at this many distinct series (declared "
+                        "refusal, never a throttle; existing series "
+                        "keep ingesting; 0 = unlimited)")
+    p.add_argument("--tenant-global-max-series", type=int, default=0,
+                   help="directory-wide series cap across every "
+                        "tenant (0 = unlimited)")
+    p.add_argument("--tenant-limit-mode", default="enforce",
+                   choices=["enforce", "warn"],
+                   help="warn: count + log would-be refusals "
+                        "(tenant.would_refuse) without refusing — "
+                        "the dry run before enforcement")
+    p.add_argument("--tenant-override", action="append", default=[],
+                   metavar="TENANT=LIMIT",
+                   help="per-tenant series cap beating "
+                        "--tenant-max-series (repeatable; 0 = "
+                        "unlimited for that tenant)")
+    p.add_argument("--tenant-exact-cutoff", type=int, default=4096,
+                   help="distinct series per tenant before its exact "
+                        "accounting set folds into an HLL sketch "
+                        "(bounded memory under hostile cardinality)")
+    p.add_argument("--no-tenant-accounting", action="store_true",
+                   help="disable per-tenant series accounting + "
+                        "TENANTS.json snapshots entirely")
     # Admission control (any role; all off by default).
     p.add_argument("--query-max-inflight", type=int, default=0,
                    help="load-shedding ladder threshold N: N..2N in "
                         "flight degrades (rollup-only), 2N sheds 503")
     p.add_argument("--query-rate", type=float, default=0.0,
                    help="per-tenant queries/s quota (429 when dry)")
+    p.add_argument("--query-burst", type=float, default=8.0,
+                   help="per-tenant query bucket burst allowance")
     p.add_argument("--ingest-rate", type=float, default=0.0,
                    help="per-tenant ingest points/s quota")
     p.add_argument("--ingest-queue-points", type=int, default=0,
@@ -1003,6 +1103,20 @@ def main(argv: list[str] | None = None) -> int:
                         "workload profile from its /api/traces ring "
                         "instead of uniform weights")
     p.set_defaults(fn=cmd_sketch_plan)
+
+    p = sub.add_parser(
+        "tenants",
+        help="per-tenant series cardinality, limits, refusals and "
+             "heavy hitters (opentsdb_tpu/tenant/)")
+    common_args(p)
+    p.add_argument("--url", default=None,
+                   help="base URL of a live tsd: fetch its "
+                        "/api/tenants instead of opening a store")
+    p.add_argument("--json", dest="json_out", action="store_true",
+                   help="raw JSON instead of the table")
+    p.add_argument("--top", type=int, default=3,
+                   help="heavy-hitter rows to print per tenant")
+    p.set_defaults(fn=cmd_tenants)
 
     p = sub.add_parser("version", help="print build/version information")
     p.add_argument("--verbose", action="store_true")

@@ -236,6 +236,33 @@ class Config:
     query_burst: float = 8.0
     query_max_inflight: int = 0
 
+    # Tenant cardinality control plane (opentsdb_tpu/tenant/):
+    # - tenant_accounting: track per-tenant series cardinality from
+    #   the ingest path's series-identity hash (exact set below
+    #   tenant_exact_cutoff distinct series, HLL above it) plus
+    #   heavy-hitter summaries; snapshotted to TENANTS.json in the
+    #   checkpoint bracket and rebuilt from storage on a torn/foreign
+    #   state file. Writer daemons only (replicas never account).
+    # - tenant_max_series: refuse a NEW series from a tenant already
+    #   at this many distinct series (0 = unlimited). Existing series
+    #   keep ingesting; the refusal is a declared wire error (telnet
+    #   "tenant series limit exceeded" line / HTTP 429), never a
+    #   retryable throttle.
+    # - tenant_global_max_series: directory-wide backstop across all
+    #   tenants (0 = unlimited).
+    # - tenant_limit_mode: "enforce" refuses; "warn" only counts +
+    #   logs what would have been refused (tenant.would_refuse).
+    # - tenant_overrides: ("name=limit", ...) per-tenant caps beating
+    #   the blanket tenant_max_series; 0 = unlimited for that tenant.
+    tenant_accounting: bool = True
+    tenant_max_series: int = 0
+    tenant_global_max_series: int = 0
+    tenant_limit_mode: str = "enforce"
+    tenant_overrides: tuple = ()
+    tenant_exact_cutoff: int = 4096
+    tenant_hll_p: int = 12
+    tenant_topk: int = 16
+
     # Query router (serve/router.py; role="router" only).
     # - router_backends: replica base URLs ("http://host:port").
     # - writer_url: where forwarded telnet puts go (None = reject).

@@ -720,6 +720,20 @@ def main() -> int:
                          "check), per-kind tier bytes, and the "
                          "Storyboard allocation at three byte "
                          "budgets; writes BENCH_SKETCH.json")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="hostile-workload profile (ISSUE 14): spread "
+                         "the series over N tenant ids so the timed "
+                         "ingest pays per-tenant cardinality "
+                         "accounting (opentsdb_tpu/tenant/) in the "
+                         "hot path; the artifact records the "
+                         "accounting snapshot (tenant count, tiers, "
+                         "TENANTS.json bytes). 0 = single default "
+                         "tenant (accounting still on unless "
+                         "--no-tenant-accounting)")
+    ap.add_argument("--no-tenant-accounting", action="store_true",
+                    help="disable tenant accounting entirely — the "
+                         "control leg for measuring the accounting "
+                         "tax on ingest dps")
     ap.add_argument("--workdir", default="/tmp/tsdb_scale")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
@@ -769,7 +783,8 @@ def main() -> int:
 
     cfg = Config(auto_create_metrics=True, wal_path=wal,
                  shards=max(args.shards, 1),
-                 enable_rollups=args.rollup, rollup_catchup="sync")
+                 enable_rollups=args.rollup, rollup_catchup="sync",
+                 tenant_accounting=not args.no_tenant_accounting)
     tsdb = TSDB(store, cfg, start_compaction_thread=False)
     tune_for_ingest()
 
@@ -943,8 +958,10 @@ def main() -> int:
             # this block before any series sees the next one ---
             for si in range(args.series):
                 ts, vals = blocks[si]
-                total += tsdb.add_batch("scale.metric", ts, vals,
-                                        tags_by_series[si])
+                total += tsdb.add_batch(
+                    "scale.metric", ts, vals, tags_by_series[si],
+                    tenant=(f"t{si % args.tenants}" if args.tenants
+                            else "default"))
                 if total >= next_ckpt:
                     _ckpt_join()  # previous spill must land first
                     t = threading.Thread(target=_ckpt_run, args=(total,),
@@ -1009,6 +1026,26 @@ def main() -> int:
         out["ingest"]["worst_ckpt_wall_s"] = max(
             m["wall_s"] for m in mid_ckpts)
     out["wal_bytes"] = wal_bytes()
+    if tsdb.tenants is not None:
+        # The hostile-workload profile's accounting story: what the
+        # control plane cost to keep (snapshot bytes, tier split)
+        # rides the same artifact as the dps it may have taxed.
+        info = tsdb.tenants.snapshot_info()
+        tiers: dict = {}
+        for ent in info["tenants"].values():
+            tiers[ent["tier"]] = tiers.get(ent["tier"], 0) + 1
+        out["tenant_accounting"] = {
+            "tenants": len(info["tenants"]),
+            "tracked_series": info["tracked_series"],
+            "tiers": tiers,
+            "snapshots_written": info["snapshots_written"],
+            "state_bytes": (os.path.getsize(tsdb.tenants.path)
+                            if tsdb.tenants.path
+                            and os.path.exists(tsdb.tenants.path)
+                            else 0),
+        }
+    elif args.no_tenant_accounting:
+        out["tenant_accounting"] = {"disabled": True}
     if mid_ckpts:
         out["mid_checkpoints"] = mid_ckpts
     log(f"ingested {total:,} in {ingest_s:,.0f}s "

@@ -303,6 +303,101 @@ def run_cluster_bench(args) -> int:
     return 0 if out["pass"] else 1
 
 
+def run_hot_tenant_leg(dep, targets, args) -> int:
+    """The hostile-workload serve profile (ISSUE 14): four flat-out
+    clients share ONE tenant id against a per-tenant router quota
+    while a paced background tenant keeps querying. The gate is
+    ISOLATION — the hot tenant sheds on its own quota, every shed is
+    declared (429 + Retry-After), and the background tenant stays
+    served — plus liveness: the hot tenant still gets its quota's
+    worth, not a blackout."""
+    print("hot-tenant isolation leg ...", file=sys.stderr, flush=True)
+    duration = args.duration
+    hot_outs = [dict() for _ in range(4)]
+    threads = [threading.Thread(
+        target=run_queries,
+        args=(dep.ports["router"], targets, duration, hot_outs[w],
+              "hot"))
+        for w in range(4)]
+    bg = {"served": 0, "shed": 0, "errors": 0, "lat_ms": []}
+    t_end = time.time() + duration
+
+    def bg_loop():
+        i = 0
+        while time.time() < t_end:
+            t0 = time.perf_counter()
+            try:
+                st, hdrs, _ = http_get(
+                    dep.ports["router"],
+                    targets[i % len(targets)] + "&tenant=background",
+                    timeout=60)
+            except Exception:
+                bg["errors"] += 1
+                i += 1
+                continue
+            if st == 200:
+                bg["served"] += 1
+                bg["lat_ms"].append(
+                    (time.perf_counter() - t0) * 1000.0)
+            elif st in (429, 503):
+                bg["shed"] += 1
+            else:
+                bg["errors"] += 1
+            i += 1
+            time.sleep(0.35)   # ~3 qps: well under the 10/s quota
+
+    bt = threading.Thread(target=bg_loop)
+    for t in threads:
+        t.start()
+    bt.start()
+    for t in threads:
+        t.join()
+    bt.join()
+    hot_lat = [ms for o in hot_outs for ms in o.get("lat_ms", [])]
+    hot_shed = [s for o in hot_outs for s in o.get("shed", [])]
+    hot_errors = [e for o in hot_outs for e in o.get("errors", [])]
+    shed_429 = sum(1 for s, _ in hot_shed if s == 429)
+    gate = {
+        "hot_tenant_sheds_on_quota": shed_429 > 0,
+        "retry_after_on_every_shed":
+            all(ra for _, ra in hot_shed) if hot_shed else False,
+        "hot_tenant_still_served": len(hot_lat) > 0,
+        "background_tenant_unharmed":
+            bg["served"] > 0
+            and bg["shed"] <= max(bg["served"] // 10, 1),
+        "no_undeclared_errors":
+            not hot_errors and bg["errors"] == 0,
+    }
+    out = {
+        "profile": "hot-tenant",
+        "router_query_rate": 10.0,
+        "duration_s": duration,
+        "hot": {
+            "clients": len(hot_outs),
+            "served": len(hot_lat),
+            "shed_429": shed_429,
+            "shed_503": sum(1 for s, _ in hot_shed if s == 503),
+            "errors": len(hot_errors),
+            "p99_ms": round(pct(hot_lat, 99), 3) if hot_lat else None,
+        },
+        "background": {
+            "clients": 1,
+            "served": bg["served"],
+            "shed": bg["shed"],
+            "errors": bg["errors"],
+            "p99_ms": round(pct(bg["lat_ms"], 99), 3)
+            if bg["lat_ms"] else None,
+        },
+        "gate": gate,
+        "pass": all(gate.values()),
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(args.json, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+    return 0 if out["pass"] else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--points", type=int, default=200_000)
@@ -315,16 +410,32 @@ def main() -> int:
                          "(ownership-map sharded ingest vs a single-"
                          "writer control + byte-parity gate) instead "
                          "of the overload bench")
+    ap.add_argument("--hot-tenant", action="store_true",
+                    help="hostile-workload profile (ISSUE 14): add a "
+                         "per-tenant query quota to the router and "
+                         "run a third leg where N flat-out clients "
+                         "share ONE tenant id while a paced "
+                         "background tenant keeps querying — gates "
+                         "that the hot tenant's sheds are declared "
+                         "(429 + Retry-After) and the background "
+                         "tenant stays served (quota isolation, not "
+                         "fleet-wide collapse)")
     args = ap.parse_args()
     if args.writers > 1:
         return run_cluster_bench(args)
     if args.json is None:
-        args.json = "BENCH_SERVE.json"
+        args.json = ("BENCH_SERVE_HOT.json" if args.hot_tenant
+                     else "BENCH_SERVE.json")
 
     work = args.work_dir or tempfile.mkdtemp(prefix="benchserve-")
     os.makedirs(work, exist_ok=True)
-    dep = Deployment(work, seed=42, rollups=True, router_args=[
-        "--query-max-inflight", str(INFLIGHT_N)])
+    router_args = ["--query-max-inflight", str(INFLIGHT_N)]
+    if args.hot_tenant:
+        # Per-tenant quota well under one flat-out client's demand,
+        # comfortably above the paced background tenant's.
+        router_args += ["--query-rate", "10", "--query-burst", "5"]
+    dep = Deployment(work, seed=42, rollups=True,
+                     router_args=router_args)
     print("booting deployment (rollups on) ...", file=sys.stderr,
           flush=True)
     dep.start()
@@ -357,6 +468,9 @@ def main() -> int:
         # Warm both replicas' fragment caches out of the measurement.
         for tgt in targets:
             http_get(dep.ports["router"], tgt, timeout=120)
+
+        if args.hot_tenant:
+            return run_hot_tenant_leg(dep, targets, args)
 
         print("unloaded leg ...", file=sys.stderr, flush=True)
         unloaded: dict = {}

@@ -66,6 +66,10 @@ def main(argv=None) -> int:
                    help="write-side sstable codec for the ad-hoc "
                         "scenario's workload (sst.write.block sites "
                         "need tsst4 spills to be reachable)")
+    p.add_argument("--tenant-cutoff", type=int, default=-1,
+                   help="tenant accounting exact-tier cutoff for the "
+                        "ad-hoc scenario's workload (0 forces the HLL "
+                        "sketch tier; -1 = config default)")
     p.add_argument("--bug", default=None,
                    help="deliberately re-introduce a historical bug in "
                         "the child (harness.BUGS) — for harness "
@@ -85,7 +89,7 @@ def main(argv=None) -> int:
             site=args.site, mode=args.mode, skip=args.skip,
             shards=args.shards, rollups=not args.no_rollups,
             delete_heavy=args.delete_heavy, bug=args.bug,
-            codec=args.codec)]
+            codec=args.codec, tenant_cutoff=args.tenant_cutoff)]
     else:
         scens = (harness.fast_matrix() if args.fast
                  else harness.build_matrix())

@@ -803,6 +803,44 @@ class TestMultiWriterRouter:
 
         assert _run_cluster(clu, drive)
 
+    def test_api_tenants_fans_out_and_merges(self, tmp_path):
+        """Multi-writer /api/tenants: self._writer is None (two
+        writers), so the router must fan out to every owner and merge
+        the ownership-disjoint per-tenant slices — not fall through to
+        a replica's enabled:false body."""
+        clu = _Cluster(tmp_path)
+
+        async def drive(clu):
+            m0 = _cluster_metric(clu, 0)
+            m1 = _cluster_metric(clu, 1)
+            for i in range(3):
+                clu.writers[0].add_point(m0, BT + i * 60, 1,
+                                         {"id": str(i)}, tenant="t")
+            for i in range(2):
+                clu.writers[1].add_point(m1, BT + i * 60, 1,
+                                         {"id": str(i)}, tenant="t")
+            clu.writers[1].add_point(m1, BT, 1, {"id": "u0"},
+                                     tenant="u")
+            status, body = await _http(clu.router.port, "/api/tenants")
+            assert status == 200, body
+            data = json.loads(body)
+            assert data["enabled"] is True
+            assert data["writers"] == 2
+            assert data["writers_unreachable"] == 0
+            # Ownership-disjoint slices sum exactly.
+            assert data["tenants"]["t"]["series"] == 5
+            assert data["tenants"]["t"]["points"] == 5
+            assert data["tenants"]["u"]["series"] == 1
+            assert data["tracked_series"] == 6
+            # Heavy hitters merged across writers: both prefixes of
+            # tenant t's series space show up.
+            prefixes = {row["prefix"]
+                        for row in data["tenants"]["t"]["top_prefixes"]}
+            assert prefixes  # non-empty merge
+            return True
+
+        assert _run_cluster(clu, drive)
+
     def test_topology_endpoint(self, tmp_path):
         clu = _Cluster(tmp_path)
 

@@ -35,7 +35,7 @@ class TestMatrixShape:
 
     def test_fast_subset_resolves(self):
         fast = harness.fast_matrix()
-        assert len(fast) == len(harness.FAST_LABELS) == 11
+        assert len(fast) == len(harness.FAST_LABELS) == 12
 
 
 class TestFastSubset:

@@ -1,0 +1,111 @@
+"""Hostile-workload harness tests (scripts/hostile_harness.py): the
+tier-1 fast subsets (cardinality/churn/backfill in-process legs, plus
+the hot-tenant leg — a live multi-process router under asymmetric
+load), the ``--bug no-limit`` sabotage GATE (a disabled tenant limiter
+must be caught), and the slow full-scale sweep."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "hostile_harness.py")
+
+
+def run_harness(tmp_path, *args, timeout=420):
+    out_json = str(tmp_path / "hostile.json")
+    r = subprocess.run(
+        [sys.executable, SCRIPT, "--json", out_json,
+         "--work-dir", str(tmp_path / "work")] + list(args),
+        capture_output=True, text=True, timeout=timeout, cwd=REPO)
+    art = None
+    if os.path.exists(out_json):
+        with open(out_json) as f:
+            art = json.load(f)
+    return r, art
+
+
+def violations(art):
+    return [v for leg in art["legs"] for v in leg["violations"]]
+
+
+class TestFastLegs:
+    def test_cardinality_churn_backfill(self, tmp_path):
+        """The in-process legs: directory/bloom pressure with tenant
+        limits binding, churn cycles with warm/cold parity, and
+        backfill storms racing rollup folds."""
+        r, art = run_harness(tmp_path, "--fast", "--series", "8000",
+                             "--legs", "cardinality,churn,backfill")
+        assert art is not None, r.stderr[-2000:]
+        assert r.returncode == 0, (violations(art), r.stderr[-2000:])
+        assert art["violations"] == 0
+        legs = {x["leg"]: x for x in art["legs"]}
+        assert set(legs) == {"cardinality", "churn", "backfill"}
+        card = legs["cardinality"]
+        # The limiter actually bound (refusals happened and were all
+        # declared) and the heavy-hitter summary named the flood.
+        assert card["series_refused"] > 0
+        assert card["attacker_refused"] > 0
+        assert legs["backfill"]["rollup_served_specs"] > 0
+
+    def test_hot_tenant_asymmetric_router(self, tmp_path):
+        """The ROADMAP's untested scenario: a real multi-process
+        deployment, one replica slowed via a /fault delay faultpoint
+        while a hot-key tenant hammers its slot. Hedges must fire and
+        win, per-tenant quota sheds must be declared (429 +
+        Retry-After), the slow replica must eject and readmit, and
+        /api/topology must attribute per-replica hop p95."""
+        r, art = run_harness(tmp_path, "--fast",
+                             "--legs", "hot-tenant", timeout=600)
+        assert art is not None, r.stderr[-2000:]
+        assert r.returncode == 0, (violations(art), r.stderr[-2000:])
+        leg = art["legs"][0]
+        assert leg["hedges"] > 0 and leg["hedge_wins"] > 0
+        assert leg["shed"] > 0 and leg["undeclared"] == 0
+        assert leg["ejections"] >= 1 and leg["readmissions"] >= 1
+        assert all(v is not None
+                   for v in leg["hop_p95_ms"].values())
+
+
+class TestNoLimitGate:
+    def test_disabled_limiter_is_caught(self, tmp_path):
+        """TSDB_TENANT_BUG=no-limit silently disables enforcement;
+        the harness must FLAG the missing refusals (exit 0 under
+        --bug iff violations were found) — a harness that cannot
+        catch a disabled limiter is theater."""
+        r, art = run_harness(tmp_path, "--fast", "--series", "6000",
+                             "--legs", "cardinality",
+                             "--bug", "no-limit")
+        assert art is not None, r.stderr[-2000:]
+        assert r.returncode == 0, \
+            "gate failed: sabotage was NOT flagged\n" + r.stdout[-2000:]
+        whats = {v["what"] for v in violations(art)}
+        assert "limit-refusal-count" in whats
+        assert art["bug"] == "no-limit"
+
+    def test_unsabotaged_run_flags_nothing(self, tmp_path):
+        """The gate's control arm: the same leg without the bug has
+        zero violations (so the gate discriminates, not just fires)."""
+        r, art = run_harness(tmp_path, "--fast", "--series", "6000",
+                             "--legs", "cardinality")
+        assert art is not None, r.stderr[-2000:]
+        assert r.returncode == 0, violations(art)
+        assert art["violations"] == 0
+
+
+@pytest.mark.slow
+class TestFullSweep:
+    def test_million_series_and_all_legs(self, tmp_path):
+        """The BENCH_HOSTILE.json shape: million-distinct-series
+        cardinality leg + churn + backfill + hot-tenant at full
+        scale, all checks green."""
+        r, art = run_harness(tmp_path, timeout=3600)
+        assert art is not None, r.stderr[-2000:]
+        assert r.returncode == 0, (violations(art), r.stderr[-2000:])
+        card = [x for x in art["legs"] if x["leg"] == "cardinality"][0]
+        assert card["series_tried"] == 1_000_000
+        assert card["series_refused"] > 0
+        assert card["attacker_tier"] == "hll"

@@ -1,0 +1,844 @@
+"""Multi-tenant cardinality control plane (opentsdb_tpu/tenant/):
+accounting tiers (exact set / HLL / SpaceSaving heavy hitters),
+admission limits + refusal contract, TENANTS.json snapshot recovery,
+the wire faces (telnet line, HTTP 429, /api/tenants, /stats gauges),
+the admission tier's idle-bucket LRU eviction, and end-to-end tenant
+attribution through the router."""
+
+import asyncio
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.errors import TenantLimitError
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.tenant.accounting import (RECOVERED_TENANT,
+                                            SpaceSaving,
+                                            TenantAccountant,
+                                            hll_rel_error,
+                                            metric_prefix)
+from opentsdb_tpu.tenant.limits import TenantLimiter, parse_overrides
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+
+
+def make_tsdb(tmp_path, name="wal", **cfg_kw):
+    wal = str(tmp_path / name)
+    kw = dict(wal_path=wal, backend="cpu", auto_create_metrics=True,
+              enable_compactions=False, enable_sketches=False,
+              device_window=False)
+    kw.update(cfg_kw)
+    cfg = Config(**kw)
+    return TSDB(MemKVStore(wal_path=wal), cfg,
+                start_compaction_thread=False)
+
+
+def reopen(tsdb, tmp_path, name="wal", **cfg_kw):
+    tsdb.shutdown()
+    return make_tsdb(tmp_path, name=name, **cfg_kw)
+
+
+# ---------------------------------------------------------------------------
+# SpaceSaving heavy hitters
+# ---------------------------------------------------------------------------
+
+class TestSpaceSaving:
+    def test_heavy_key_guaranteed_tracked(self):
+        ss = SpaceSaving(8)
+        # One key with >1/8 of the stream weight plus 100 distractors.
+        for i in range(100):
+            ss.offer(f"noise{i}", 1)
+        ss.offer("whale", 50)
+        top = ss.top(3)
+        assert top[0][0] == "whale"
+        count, err = top[0][1], top[0][2]
+        # count - err is a guaranteed lower bound on the true weight.
+        assert count - err <= 50 <= count
+
+    def test_capacity_bounded(self):
+        ss = SpaceSaving(4)
+        for i in range(1000):
+            ss.offer(f"k{i}")
+        assert len(ss.items) == 4
+        assert ss.total == 1000
+
+    def test_json_round_trip(self):
+        ss = SpaceSaving(4)
+        for i in range(40):
+            ss.offer(f"k{i % 6}", i)
+        back = SpaceSaving.from_json(4, ss.to_json())
+        assert back.items == ss.items
+
+    def test_metric_prefix(self):
+        assert metric_prefix("sys.cpu.user") == "sys.cpu"
+        assert metric_prefix("sys.cpu") == "sys.cpu"
+        assert metric_prefix("flat") == "flat"
+
+
+# ---------------------------------------------------------------------------
+# Accounting tiers + snapshots
+# ---------------------------------------------------------------------------
+
+class TestTenantAccountant:
+    def test_exact_tier_counts_and_idempotence(self):
+        acct = TenantAccountant(exact_cutoff=100)
+        for h in range(10):
+            acct.note_new_series("a", h, "sys.cpu.user")
+            acct.note_new_series("a", h, "sys.cpu.user")  # dup ignored
+        assert acct.count("a") == 10
+        assert acct.total_tracked() == 10
+        assert acct.seen(3) and not acct.seen(99)
+        info = acct.snapshot_info()
+        assert info["tenants"]["a"]["tier"] == "exact"
+        assert info["tenants"]["a"]["error"] == 0.0
+
+    def test_hll_promotion_and_accuracy(self):
+        acct = TenantAccountant(exact_cutoff=64, hll_p=12)
+        n = 50_000
+        rng = np.random.default_rng(7)
+        hashes = rng.choice(1 << 32, size=n, replace=False)
+        for h in hashes.tolist():
+            acct.note_new_series("big", int(h), "m.x")
+        info = acct.snapshot_info()
+        assert info["tenants"]["big"]["tier"] == "hll"
+        est = acct.count("big")
+        assert abs(est - n) <= 3 * hll_rel_error(12) * n
+
+    def test_heavy_hitter_prefix_names_the_flood(self):
+        acct = TenantAccountant(exact_cutoff=10_000)
+        for h in range(300):
+            m = "attack.flood.m1" if h < 250 else f"bg.svc{h}.lat"
+            acct.note_new_series("t", h, m)
+        top = acct.snapshot_info()["tenants"]["t"]["top_prefixes"]
+        assert top[0]["prefix"] == "attack.flood"
+        assert top[0]["new_series"] >= 250
+
+    def test_points_heavy_hitter(self):
+        acct = TenantAccountant()
+        acct.note_points("t", "m{host=a}", 5)
+        acct.note_points("t", "m{host=b}", 500)
+        top = acct.snapshot_info()["tenants"]["t"]["top_series"]
+        assert top[0]["series"] == "m{host=b}"
+
+    def test_snapshot_round_trip_exact_and_hll(self, tmp_path):
+        path = str(tmp_path / "TENANTS.json")
+        acct = TenantAccountant(path=path, exact_cutoff=32, hll_p=10)
+        for h in range(20):
+            acct.note_new_series("small", h, "a.b.c")
+        for h in range(1000, 1200):
+            acct.note_new_series("big", h, "d.e.f")
+        acct.note_points("small", "a.b.c{x=1}", 7)
+        acct.save()
+        back = TenantAccountant.load(path)
+        assert back.exact_cutoff == 32 and back.hll_p == 10
+        assert back.count("small") == 20
+        # Sketch tier: estimate survives within its declared error.
+        assert abs(back.count("big") - 200) <= \
+            max(3 * hll_rel_error(10) * 200, 2)
+        assert back.total_tracked() == 220
+        assert back.seen(1100) and not back.seen(5000)
+        info = back.snapshot_info()
+        assert info["tenants"]["small"]["points"] == 7
+
+    def test_torn_and_foreign_snapshots_raise(self, tmp_path):
+        path = str(tmp_path / "TENANTS.json")
+        acct = TenantAccountant(path=path)
+        acct.note_new_series("t", 1, "m.x")
+        acct.save()
+        body = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(body[:len(body) // 2])
+        with pytest.raises(Exception):
+            TenantAccountant.load(path)
+        with open(path, "w") as f:
+            json.dump({"version": 99}, f)
+        with pytest.raises(ValueError):
+            TenantAccountant.load(path)
+
+    def test_fold_recovered_is_declared(self):
+        acct = TenantAccountant()
+        acct.note_new_series("t", 1, "m.x")
+        added = acct.fold_recovered([1, 2, 3])
+        assert added == 2                      # 1 was already seen
+        assert acct.recovered_series == 2
+        assert acct.count(RECOVERED_TENANT) == 2
+
+
+# ---------------------------------------------------------------------------
+# Limits policy
+# ---------------------------------------------------------------------------
+
+class TestTenantLimiter:
+    def test_parse_overrides(self):
+        assert parse_overrides(("a=5", "b=0")) == {"a": 5, "b": 0}
+        with pytest.raises(ValueError):
+            parse_overrides(("nolimit",))
+
+    def test_enforce_refuses_at_cap(self):
+        acct = TenantAccountant()
+        lim = TenantLimiter(max_series=2)
+        for h in range(2):
+            lim.admit_new_series(acct, "t")
+            acct.note_new_series("t", h, "m.x")
+        with pytest.raises(TenantLimitError) as ei:
+            lim.admit_new_series(acct, "t")
+        assert ei.value.tenant == "t" and ei.value.limit == 2
+        assert ei.value.status == 429
+        assert not isinstance(ei.value, OSError)
+        assert "series limit exceeded" in str(ei.value)
+        assert acct.snapshot_info()["tenants"]["t"]["refused"] == 1
+
+    def test_override_beats_blanket_and_zero_is_unlimited(self):
+        acct = TenantAccountant()
+        lim = TenantLimiter(max_series=1, overrides={"vip": 0,
+                                                     "tiny": 1})
+        for h in range(50):
+            lim.admit_new_series(acct, "vip")
+            acct.note_new_series("vip", h, "m.x")
+        acct.note_new_series("tiny", 1000, "m.y")
+        with pytest.raises(TenantLimitError):
+            lim.admit_new_series(acct, "tiny")
+        assert lim.limit_for("vip") == 0
+        assert lim.limit_for("other") == 1
+
+    def test_global_cap_backstops(self):
+        acct = TenantAccountant()
+        lim = TenantLimiter(global_max=3)
+        for h in range(3):
+            lim.admit_new_series(acct, f"t{h}")
+            acct.note_new_series(f"t{h}", h, "m.x")
+        with pytest.raises(TenantLimitError) as ei:
+            lim.admit_new_series(acct, "fresh")
+        assert ei.value.scope == "global"
+        assert "global" in str(ei.value)
+
+    def test_warn_mode_counts_without_refusing(self):
+        acct = TenantAccountant()
+        lim = TenantLimiter(max_series=1, mode="warn")
+        acct.note_new_series("t", 1, "m.x")
+        lim.admit_new_series(acct, "t")        # would refuse; doesn't
+        info = acct.snapshot_info()
+        assert info["tenants"]["t"]["would_refuse"] == 1
+        assert info["tenants"]["t"]["refused"] == 0
+
+    def test_bug_hook_disables_enforcement(self, monkeypatch):
+        monkeypatch.setenv("TSDB_TENANT_BUG", "no-limit")
+        acct = TenantAccountant()
+        acct.note_new_series("t", 1, "m.x")
+        TenantLimiter(max_series=1).admit_new_series(acct, "t")
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError):
+            TenantLimiter(mode="audit")
+
+
+# ---------------------------------------------------------------------------
+# TSDB integration: admission, snapshot bracket, rebuild
+# ---------------------------------------------------------------------------
+
+class TestTSDBIntegration:
+    def test_new_series_refused_existing_keeps_ingesting(self,
+                                                         tmp_path):
+        tsdb = make_tsdb(tmp_path, tenant_max_series=2)
+        try:
+            ts = np.asarray([BT], np.int64)
+            val = np.asarray([1.0])
+            tsdb.add_batch("m.a", ts, val, {"id": "0"}, tenant="t")
+            tsdb.add_point("m.a", BT, 2.0, {"id": "1"}, tenant="t")
+            before = tsdb.datapoints_added
+            with pytest.raises(TenantLimitError):
+                tsdb.add_batch("m.a", ts, val, {"id": "2"},
+                               tenant="t")
+            with pytest.raises(TenantLimitError):
+                tsdb.add_point("m.a", BT, 3.0, {"id": "3"},
+                               tenant="t")
+            # The refusal left no trace: no points, no series growth.
+            assert tsdb.datapoints_added == before
+            assert tsdb.tenants.count("t") == 2
+            # EXISTING series still ingest at the cap.
+            tsdb.add_batch("m.a", ts + 60, val, {"id": "0"},
+                           tenant="t")
+            # Another tenant is untouched by t's budget.
+            tsdb.add_batch("m.a", ts, val, {"id": "9"}, tenant="u")
+        finally:
+            tsdb.shutdown()
+
+    def test_refused_series_allocates_no_uids(self, tmp_path):
+        """A refused NEW series must not grow the metric/tagk/tagv
+        UID maps either — that growth is exactly the resource the
+        limiter protects, and get_or_create allocations are durable."""
+        from opentsdb_tpu.core.errors import NoSuchUniqueName
+        tsdb = make_tsdb(tmp_path, tenant_max_series=1)
+        try:
+            ts = np.asarray([BT], np.int64)
+            val = np.asarray([1.0])
+            tsdb.add_batch("m.a", ts, val, {"id": "0"}, tenant="t")
+            with pytest.raises(TenantLimitError):
+                tsdb.add_point("m.leak", BT, 1.0, {"leakk": "leakv"},
+                               tenant="t")
+            with pytest.raises(TenantLimitError):
+                tsdb.add_batch("m.leak2", ts, val, {"id": "xx"},
+                               tenant="t")
+            for uid_map, name in ((tsdb.metrics, "m.leak"),
+                                  (tsdb.metrics, "m.leak2"),
+                                  (tsdb.tagk, "leakk"),
+                                  (tsdb.tagv, "leakv"),
+                                  (tsdb.tagv, "xx")):
+                with pytest.raises(NoSuchUniqueName):
+                    uid_map.get_id(name)
+        finally:
+            tsdb.shutdown()
+
+    def test_unknown_metric_not_masked_as_refusal(self, tmp_path):
+        """auto_create off + tenant at cap: a put naming a metric
+        that can never be created must die as unknown-metric, not
+        count (or present) as a tenant-limit refusal."""
+        from opentsdb_tpu.core.errors import NoSuchUniqueName
+        tsdb = make_tsdb(tmp_path, tenant_max_series=1,
+                         auto_create_metrics=False)
+        try:
+            tsdb.metrics.get_or_create_id("m.a")
+            tsdb.add_point("m.a", BT, 1.0, {"id": "0"}, tenant="t")
+            with pytest.raises(NoSuchUniqueName):
+                tsdb.add_point("m.nope", BT, 1.0, {"id": "0"},
+                               tenant="t")
+            assert tsdb.tenants.snapshot_info()["tenants"]["t"][
+                "refused"] == 0
+            # A creatable series still refuses on the budget.
+            with pytest.raises(TenantLimitError):
+                tsdb.add_point("m.a", BT, 1.0, {"id": "fresh"},
+                               tenant="t")
+        finally:
+            tsdb.shutdown()
+
+    def test_missing_snapshot_scan_gated_on_limits(self, tmp_path):
+        """No TENANTS.json + limits configured: boot rebuilds from
+        the storage scan (enforcement must know every pre-existing
+        series). Limits off: boot still covers the WAL-replayed
+        memtable, so counts survive a lost snapshot here too."""
+        tsdb = make_tsdb(tmp_path, tenant_max_series=5)
+        ts = np.asarray([BT], np.int64)
+        val = np.asarray([1.0])
+        for i in range(3):
+            tsdb.add_batch("m.a", ts, val, {"id": str(i)}, tenant="t")
+        tsdb.checkpoint()
+        os.remove(tsdb.tenants.path)
+        tsdb = reopen(tsdb, tmp_path, tenant_max_series=5)
+        try:
+            assert tsdb.tenants.rebuilt is False  # no torn file
+            assert tsdb.tenants.total_tracked() == 3
+            # Enforcement sees them as existing, not new.
+            tsdb.add_batch("m.a", ts + 60, val, {"id": "0"},
+                           tenant="whoever")
+        finally:
+            tsdb.shutdown()
+
+    def test_warn_mode_admits_and_counts(self, tmp_path):
+        tsdb = make_tsdb(tmp_path, tenant_max_series=1,
+                         tenant_limit_mode="warn")
+        try:
+            ts = np.asarray([BT], np.int64)
+            val = np.asarray([1.0])
+            tsdb.add_batch("m.a", ts, val, {"id": "0"}, tenant="t")
+            tsdb.add_batch("m.a", ts, val, {"id": "1"}, tenant="t")
+            info = tsdb.tenants.snapshot_info()
+            assert info["tenants"]["t"]["would_refuse"] == 1
+            assert tsdb.tenants.count("t") == 2
+        finally:
+            tsdb.shutdown()
+
+    def test_snapshot_through_checkpoint_and_reopen(self, tmp_path):
+        tsdb = make_tsdb(tmp_path)
+        ts = np.asarray([BT], np.int64)
+        val = np.asarray([1.0])
+        for i in range(5):
+            tsdb.add_batch("m.a", ts, val, {"id": str(i)}, tenant="a")
+        for i in range(3):
+            tsdb.add_batch("m.b", ts, val, {"id": str(i)}, tenant="b")
+        tsdb.checkpoint()
+        assert os.path.exists(tsdb.tenants.path)
+        tsdb = reopen(tsdb, tmp_path)
+        try:
+            assert tsdb.tenants.count("a") == 5
+            assert tsdb.tenants.count("b") == 3
+            assert not tsdb.tenants.rebuilt
+            # Reopened seen-set still gates: re-ingest of an existing
+            # series is not a NEW series.
+            tsdb.add_batch("m.a", ts + 60, val, {"id": "0"},
+                           tenant="a")
+            assert tsdb.tenants.count("a") == 5
+        finally:
+            tsdb.shutdown()
+
+    def test_torn_snapshot_rebuilds_from_storage(self, tmp_path):
+        tsdb = make_tsdb(tmp_path)
+        ts = np.asarray([BT], np.int64)
+        val = np.asarray([1.0])
+        for i in range(7):
+            tsdb.add_batch("m.a", ts, val, {"id": str(i)}, tenant="a")
+        path = tsdb.tenants.path
+        tsdb.shutdown()
+        body = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(body[: len(body) // 2])
+        tsdb = make_tsdb(tmp_path)
+        try:
+            acct = tsdb.tenants
+            assert acct.rebuilt
+            # Rebuild is EXACT in total; attribution lands on the
+            # default tenant and is declared via recovered_series.
+            assert acct.total_tracked() == 7
+            assert acct.recovered_series == 7
+            assert acct.count(RECOVERED_TENANT) == 7
+        finally:
+            tsdb.shutdown()
+
+    def test_replica_has_no_accounting(self, tmp_path):
+        w = make_tsdb(tmp_path)
+        w.add_point("m.a", BT, 1.0, {"id": "0"}, tenant="t")
+        cfg = Config(wal_path=str(tmp_path / "wal"), backend="cpu",
+                     enable_sketches=False, device_window=False)
+        r = TSDB(MemKVStore(wal_path=str(tmp_path / "wal"),
+                            read_only=True), cfg,
+                 start_compaction_thread=False)
+        assert r.tenants is None and r.tenant_limits is None
+        r.shutdown()
+        w.shutdown()
+
+    def test_accounting_off_is_really_off(self, tmp_path):
+        tsdb = make_tsdb(tmp_path, tenant_accounting=False)
+        try:
+            tsdb.add_point("m.a", BT, 1.0, {"id": "0"}, tenant="t")
+            assert tsdb.tenants is None
+        finally:
+            tsdb.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Admission: idle-bucket LRU eviction at the tenant cap
+# ---------------------------------------------------------------------------
+
+class TestBucketEviction:
+    def make_admission(self, **kw):
+        from opentsdb_tpu.serve.admission import AdmissionController
+        cfg = Config(**dict({"query_rate": 10.0, "query_burst": 4.0},
+                            **kw))
+        return AdmissionController(cfg)
+
+    def test_idle_bucket_evicted_not_active(self, monkeypatch):
+        from opentsdb_tpu.serve import admission as adm
+        monkeypatch.setattr(adm.AdmissionController, "MAX_TENANTS", 3)
+        a = self.make_admission()
+        for t in ("alive", "idle1", "idle2"):
+            a.admit_query(t)
+            a.query_done()
+        # Age two buckets past the idle threshold; keep one hot.
+        now = time.monotonic()
+        a._query_buckets["idle1"].last_take = now - 120.0
+        a._query_buckets["idle2"].last_take = now - 600.0
+        a._query_buckets["alive"].last_take = now
+        verdict, retry = a.admit_query("fresh")
+        # The LEAST recently used idle bucket went, actives stayed.
+        assert "idle2" not in a._query_buckets
+        assert "alive" in a._query_buckets
+        assert "idle1" in a._query_buckets
+        assert "fresh" in a._query_buckets
+        assert a.tenants_evicted == 1
+        assert a.tenants_collapsed == 0
+        # A bucket minted THROUGH an eviction starts cold: cycling
+        # abandoned ids must not mint fresh burst allowances, so the
+        # newcomer's first request sheds with a Retry-After and the
+        # bucket earns tokens at the sustained rate only.
+        from opentsdb_tpu.serve.admission import SHED_QUOTA
+        assert verdict == SHED_QUOTA and retry > 0
+        # An ordinary fresh tenant (table under the cap) still gets
+        # the full burst — cold start is eviction-pressure only.
+        ok, _ = self.make_admission().admit_query("roomy")
+        assert ok == "ok"
+
+    def test_all_active_collapses_to_default(self, monkeypatch):
+        from opentsdb_tpu.serve import admission as adm
+        monkeypatch.setattr(adm.AdmissionController, "MAX_TENANTS", 2)
+        a = self.make_admission()
+        a.admit_query("a")
+        a.query_done()
+        a.admit_query("b")
+        a.query_done()
+        a.admit_query("spray")          # every slot genuinely active
+        a.query_done()
+        assert "spray" not in a._query_buckets
+        assert "default" in a._query_buckets
+        assert a.tenants_collapsed == 1
+        # A cardinality attack cannot mint fresh burst allowances:
+        # the attacker's next uuid shares the default bucket too.
+        a.admit_query("spray2")
+        a.query_done()
+        assert len(a._query_buckets) <= 3
+
+
+# ---------------------------------------------------------------------------
+# Wire faces: telnet line, HTTP 429, /api/tenants, /stats
+# ---------------------------------------------------------------------------
+
+def run_server(server, coro_fn):
+    async def main():
+        await server.start()
+        try:
+            return await coro_fn(server.port)
+        finally:
+            server._pool.shutdown(wait=False)
+            server._server.close()
+            await server._server.wait_closed()
+    return asyncio.run(main())
+
+
+async def telnet(port, lines, read_bytes=400, wait=0.15):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    for line in lines:
+        writer.write(line.encode() + b"\n")
+    await writer.drain()
+    await asyncio.sleep(wait)
+    data = b""
+    if read_bytes:
+        try:
+            data = await asyncio.wait_for(reader.read(read_bytes), 1.0)
+        except asyncio.TimeoutError:
+            pass
+    writer.close()
+    return data
+
+
+async def http(port, target, method="GET", body=b""):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    req = (f"{method} {target} HTTP/1.1\r\nHost: x\r\n"
+           f"Content-Length: {len(body)}\r\n"
+           "Connection: close\r\n\r\n").encode() + body
+    writer.write(req)
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, resp = data.partition(b"\r\n\r\n")
+    return int(head.split(b" ", 2)[1]), resp
+
+
+def make_server(tmp_path, **cfg_kw):
+    from opentsdb_tpu.server.tsd import TSDServer
+    wal = str(tmp_path / "wal")
+    kw = dict(wal_path=wal, backend="cpu", auto_create_metrics=True,
+              enable_sketches=False, device_window=False,
+              port=0, bind="127.0.0.1")
+    kw.update(cfg_kw)
+    cfg = Config(**kw)
+    tsdb = TSDB(MemKVStore(wal_path=wal), cfg,
+                start_compaction_thread=False)
+    return TSDServer(tsdb), tsdb
+
+
+class TestWireFaces:
+    def test_telnet_tenant_attribution_and_refusal_line(self,
+                                                        tmp_path):
+        server, tsdb = make_server(tmp_path, tenant_max_series=1)
+
+        async def drive(port):
+            out1 = await telnet(port, [
+                "tenant acme",
+                f"put wire.m {BT} 1 id=0",
+            ])
+            out2 = await telnet(port, [
+                "tenant acme",
+                f"put wire.m {BT} 1 id=1",     # NEW series, over cap
+                f"put wire.m {BT + 60} 2 id=0",  # existing: fine
+            ])
+            bad = await telnet(port, ["tenant"])
+            return out1, out2, bad
+
+        out1, out2, bad = run_server(server, drive)
+        tsdb.shutdown()
+        assert b"tenant acme" in out1
+        # The refusal is a DISTINCT declared line, not a throttle.
+        assert b"put: tenant series limit exceeded" in out2
+        assert b"throttle" not in out2
+        assert b"tenant: need exactly one id" in bad
+        assert tsdb.tenants.count("acme") == 1
+        info = tsdb.tenants.snapshot_info()
+        assert info["tenants"]["acme"]["refused"] == 1
+        # The existing series' second point landed.
+        assert tsdb.datapoints_added == 2
+
+    def test_bulk_pipeline_tags_tenant_refusals(self, tmp_path):
+        server, tsdb = make_server(tmp_path, tenant_max_series=1)
+
+        async def drive(port):
+            # One big chunk takes the pipelined bulk path.
+            lines = ["tenant bulk"]
+            lines += [f"put bulk.m {BT + i} {i} id=0"
+                      for i in range(300)]
+            lines += [f"put bulk.m {BT} 1 id=new{i}"
+                      for i in range(3)]
+            return await telnet(port, lines, read_bytes=4000,
+                                wait=0.6)
+
+        out = run_server(server, drive)
+        tsdb.shutdown()
+        assert b"put: tenant series limit exceeded" in out
+        assert tsdb.tenants.count("bulk") == 1
+        assert tsdb.datapoints_added == 300
+
+    def test_http_put_429_names_the_limit(self, tmp_path):
+        server, tsdb = make_server(tmp_path, tenant_max_series=1)
+
+        async def drive(port):
+            st0, _ = await http(
+                port, "/api/put?tenant=web", method="POST",
+                body=f"http.m {BT} 1 id=0\n".encode())
+            # All-new-series body from the capped tenant: 429.
+            st1, body1 = await http(
+                port, "/api/put?tenant=web", method="POST",
+                body=f"http.m {BT} 1 id=1\n".encode())
+            # Mixed body: existing series lands, new one refused, 200.
+            st2, body2 = await http(
+                port, "/api/put?tenant=web", method="POST",
+                body=(f"put http.m {BT + 60} 2 id=0\n"
+                      f"put http.m {BT} 1 id=2\n").encode())
+            return st0, st1, json.loads(body1), st2, json.loads(body2)
+
+        st0, st1, b1, st2, b2 = run_server(server, drive)
+        tsdb.shutdown()
+        assert st0 == 200 and st1 == 429 and st2 == 200
+        assert b1["limit"] == 1 and b1["points"] == 0
+        assert "[tenant-limit]" in b1["error"]
+        assert b2["points"] == 1 and b2["refused_series"] == 1
+
+    def test_api_tenants_and_stats_gauges(self, tmp_path):
+        server, tsdb = make_server(tmp_path, tenant_max_series=5)
+
+        async def drive(port):
+            for i in range(3):
+                await http(port, "/api/put?tenant=acme",
+                           method="POST",
+                           body=f"gauge.m {BT} 1 id={i}\n".encode())
+            st, body = await http(port, "/api/tenants")
+            st_html, page = await http(port, "/tenants")
+            st_s, stats = await http(port, "/stats")
+            return st, json.loads(body), st_html, page, stats
+
+        st, info, st_html, page, stats = run_server(server, drive)
+        tsdb.shutdown()
+        assert st == 200 and info["enabled"]
+        ent = info["tenants"]["acme"]
+        assert ent["series"] == 3 and ent["tier"] == "exact"
+        assert ent["limit"] == 5
+        assert ent["top_prefixes"][0]["prefix"] == "gauge.m"
+        assert "admission" in info
+        assert st_html == 200 and b"Tenant cardinality" in page
+        text = stats.decode()
+        assert "tenant.count" in text
+        assert "tenant.series" in text and "tenant=acme" in text
+
+    def test_replica_api_tenants_uniform_shape(self, tmp_path):
+        w = make_tsdb(tmp_path)
+        w.add_point("m.a", BT, 1.0, {"id": "0"})
+        from opentsdb_tpu.server.tsd import TSDServer
+        cfg = Config(wal_path=str(tmp_path / "wal"), backend="cpu",
+                     enable_sketches=False, device_window=False,
+                     port=0, bind="127.0.0.1", role="replica",
+                     max_staleness_ms=60000.0)
+        r = TSDB(MemKVStore(wal_path=str(tmp_path / "wal"),
+                            read_only=True), cfg,
+                 start_compaction_thread=False)
+        server = TSDServer(r)
+
+        async def drive(port):
+            st, body = await http(port, "/api/tenants")
+            return st, json.loads(body)
+
+        st, info = run_server(server, drive)
+        r.shutdown()
+        w.shutdown()
+        assert st == 200 and info["enabled"] is False
+        assert info["role"] == "replica"
+
+
+# ---------------------------------------------------------------------------
+# Router: tenant id survives the hop (telnet forward + query hop)
+# ---------------------------------------------------------------------------
+
+class TestRouterTenantPropagation:
+    def test_telnet_tenant_line_forwarded_to_writer(self, tmp_path):
+        from opentsdb_tpu.serve.router import Backend, RouterServer
+        from opentsdb_tpu.server.tsd import TSDServer
+        wdir = tmp_path / "w"
+        wdir.mkdir()
+        wserver, wtsdb = make_server(wdir)
+
+        async def drive():
+            await wserver.start()
+            cfg = Config(port=0, bind="127.0.0.1", role="router",
+                         router_backends=(
+                             f"http://127.0.0.1:{wserver.port}",),
+                         probe_interval_s=3600.0)
+            router = RouterServer(cfg)
+            await router.start()
+            router.writer_url = f"http://127.0.0.1:{wserver.port}"
+            router._writer = Backend(router.writer_url)
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", router.port)
+                writer.write(b"tenant acme\n")
+                writer.write(f"put fwd.m {BT} 1 id=0\n".encode())
+                writer.write(f"put fwd.m {BT} 1 id=1\n".encode())
+                await writer.drain()
+                await asyncio.sleep(0.5)
+                writer.close()
+            finally:
+                await router.stop()
+                wserver._pool.shutdown(wait=False)
+                wserver._server.close()
+                await wserver._server.wait_closed()
+
+        asyncio.run(drive())
+        wtsdb.shutdown()
+        # The writer's accounting saw the ROUTER CLIENT's tenant id —
+        # attribution no longer stops at the front door.
+        assert wtsdb.tenants.count("acme") == 2
+
+    def test_query_hop_propagates_tenant_param(self, tmp_path):
+        from opentsdb_tpu.serve.router import RouterServer
+        from opentsdb_tpu.serve.tailer import WalTailer
+        from opentsdb_tpu.server.tsd import TSDServer
+        w = make_tsdb(tmp_path)
+        ts = np.arange(10, dtype=np.int64) * 60 + BT
+        w.add_batch("hop.m", ts, (ts % 7).astype(np.float64),
+                    {"id": "0"})
+        cfg = Config(wal_path=str(tmp_path / "wal"), backend="cpu",
+                     enable_sketches=False, device_window=False,
+                     port=0, bind="127.0.0.1", role="replica",
+                     max_staleness_ms=60000.0,
+                     query_rate=1000.0, query_burst=1000.0)
+        r = TSDB(MemKVStore(wal_path=str(tmp_path / "wal"),
+                            read_only=True), cfg,
+                 start_compaction_thread=False)
+        rserver = TSDServer(r)
+        tailer = WalTailer(r, interval_s=3600.0)
+        rserver.attach_tailer(tailer)
+        tailer.run_once()
+
+        async def drive():
+            await rserver.start()
+            rcfg = Config(port=0, bind="127.0.0.1", role="router",
+                          router_backends=(
+                              f"http://127.0.0.1:{rserver.port}",),
+                          probe_interval_s=3600.0)
+            router = RouterServer(rcfg)
+            await router.start()
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", router.port)
+                writer.write(
+                    (f"GET /q?start={BT - 60}&end={BT + 700}"
+                     f"&m=sum:hop.m&json&tenant=acme&nocache=1 "
+                     "HTTP/1.1\r\nHost: x\r\n"
+                     "Connection: close\r\n\r\n").encode())
+                await writer.drain()
+                data = await reader.read()
+                writer.close()
+                return int(data.split(b" ", 2)[1])
+            finally:
+                await router.stop()
+                rserver._pool.shutdown(wait=False)
+                rserver._server.close()
+                await rserver._server.wait_closed()
+
+        status = asyncio.run(drive())
+        r.shutdown()
+        w.shutdown()
+        assert status == 200
+        # The REPLICA's per-tenant query bucket saw the router
+        # client's tenant id on the forwarded hop.
+        assert "acme" in rserver.admission._query_buckets
+
+
+# ---------------------------------------------------------------------------
+# CLI + check thresholds
+# ---------------------------------------------------------------------------
+
+class TestToolingFaces:
+    def test_cli_tenants_reads_the_store(self, tmp_path, capsys):
+        from opentsdb_tpu.tools import cli
+        tsdb = make_tsdb(tmp_path, tenant_max_series=10)
+        ts = np.asarray([BT], np.int64)
+        val = np.asarray([1.0])
+        for i in range(4):
+            tsdb.add_batch("cli.m", ts, val, {"id": str(i)},
+                           tenant="ops")
+        tsdb.shutdown()
+        rv = cli.main(["tenants", "--wal", str(tmp_path / "wal"),
+                       "--backend", "cpu"])
+        out = capsys.readouterr().out
+        assert rv == 0
+        assert "ops" in out and "tracked series: 4" in out
+        rv = cli.main(["tenants", "--wal", str(tmp_path / "wal"),
+                       "--backend", "cpu", "--json"])
+        out = capsys.readouterr().out
+        assert rv == 0
+        assert json.loads(out)["tenants"]["ops"]["series"] == 4
+
+    def test_check_stats_metric_tenant_series(self, tmp_path,
+                                              capsys):
+        import argparse
+        import threading
+
+        from opentsdb_tpu.tools import ops
+        server, tsdb = make_server(tmp_path)
+        tsdb.add_point("chk.m", BT, 1.0, {"id": "0"}, tenant="acme")
+        tsdb.add_point("chk.m", BT, 1.0, {"id": "1"}, tenant="acme")
+        started = threading.Event()
+        holder = {}
+
+        def run_srv():
+            async def main():
+                await server.start()
+                holder["loop"] = asyncio.get_running_loop()
+                holder["stop"] = asyncio.Event()
+                started.set()
+                await holder["stop"].wait()
+            asyncio.run(main())
+
+        t = threading.Thread(target=run_srv, daemon=True)
+        t.start()
+        assert started.wait(5)
+
+        def args(**kw):
+            ns = argparse.Namespace(
+                host="127.0.0.1", port=server.port, metric=None,
+                tag=[], duration=600, downsample="none",
+                downsample_window=60, aggregator="sum",
+                comparator="gt", rate=False, warning=None,
+                critical=None, no_result_ok=False, ignore_recent=0,
+                timeout=5, verbose=False, stats_metric=None)
+            for k, v in kw.items():
+                setattr(ns, k, v)
+            return ns
+
+        try:
+            # Cardinality alert: tenant.series over threshold fires.
+            a = args(stats_metric="tsd.tenant.series", critical=1.0)
+            assert ops.cmd_check(a) == ops.CRITICAL
+            out = capsys.readouterr().out
+            assert "tsd.tenant.series" in out
+            a = args(stats_metric="tsd.tenant.series", critical=100.0)
+            assert ops.cmd_check(a) == ops.OK
+            capsys.readouterr()
+            a = args(stats_metric="tsd.tenant.refused", critical=0.5)
+            assert ops.cmd_check(a) == ops.OK
+            capsys.readouterr()
+        finally:
+            holder["loop"].call_soon_threadsafe(holder["stop"].set)
+            t.join(5)
+            tsdb.shutdown()
