@@ -25,12 +25,14 @@ Decode steps, all vectorized:
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from opentsdb_tpu.ops.kernels import _window_series_stage
+from opentsdb_tpu.parallel.compile import compile_with_plan
+from opentsdb_tpu.parallel.mesh import SERIES_AXIS
+from opentsdb_tpu.parallel.plan import ExecPlan
 
 
 def _varbytes_u32(pay: jnp.ndarray, nb: jnp.ndarray) -> jnp.ndarray:
@@ -78,14 +80,61 @@ def decode_points(ts_nb, ts_pay, v_nb, v_pay, first_idx, blk_first,
     return rel_ts, vals
 
 
-decode_points_jit = jax.jit(decode_points)
+decode_points_jit = compile_with_plan(
+    decode_points, ExecPlan(name="compress.decode_points", axis="block"))
+
+_FUSED_STATICS = ("num_series", "num_buckets", "interval", "agg_down",
+                  "rate", "counter", "drop_resets")
+
+# The fused stage's mesh leg is the plane's pjit-preferred style: the
+# point stream (the concatenation of whole compressed blocks) shards
+# over the mesh while the payload byte streams and scalars replicate;
+# the [S, B] stage grids come back replicated. The body stays the
+# global-view program below — GSPMD partitions the segment reductions
+# and scans and inserts the collectives, which is exactly why the
+# plan prefers pjit when explicit shardings exist (SNIPPETS.md's
+# Titanax compile_step_with_plan shape). Answers carry the fused
+# path's existing f32-tolerance contract (partial-sum order changes).
+FUSED_STAGE_PLAN = ExecPlan(
+    name="compress.fused_stage", axis="block", style="pjit",
+    static_argnames=_FUSED_STATICS,
+    in_specs=(P(SERIES_AXIS), P(), P(SERIES_AXIS), P(),
+              P(SERIES_AXIS), P(SERIES_AXIS), P(SERIES_AXIS),
+              P(SERIES_AXIS), P(SERIES_AXIS), P(), P(), P(),
+              P(), P()),
+    out_specs=(P(), P(), P(), P(), P()))
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_series", "num_buckets", "interval",
-                     "agg_down", "rate", "counter", "drop_resets"))
-def fused_block_stage(ts_nb, ts_pay, v_nb, v_pay, first_idx, blk_first,
+def _fused_block_stage_ops(ts_nb, ts_pay, v_nb, v_pay, first_idx,
+                           blk_first, rel_base, sid, valid, lo, hi,
+                           shift, counter_max, reset_value, *,
+                           num_series, num_buckets, interval,
+                           agg_down, rate=False, counter=False,
+                           drop_resets=False):
+    """All-positional face of the fused stage for the pjit mesh leg
+    (pjit rejects call-time kwargs once shardings are specified).
+    counter_max/reset_value ride as replicated scalar OPERANDS — they
+    are client-controlled query params, and baking them static would
+    let one hostile dashboard mint a fresh XLA compile per request."""
+    return _fused_block_stage(
+        ts_nb, ts_pay, v_nb, v_pay, first_idx, blk_first, rel_base,
+        sid, valid, lo, hi, shift, num_series=num_series,
+        num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+        rate=rate, counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)
+
+
+def fused_block_stage_mesh(mesh, **statics):
+    """The fused stage compiled for ``mesh`` with the SHAPE statics
+    pre-bound; takes the 12 point-stream args + (counter_max,
+    reset_value) positionally. The executor asks per dispatch; the
+    plane's cache answers."""
+    st = tuple(sorted(statics.items()))
+    return compile_with_plan(_fused_block_stage_ops, FUSED_STAGE_PLAN,
+                             mesh, statics=st)
+
+
+def _fused_block_stage(ts_nb, ts_pay, v_nb, v_pay, first_idx, blk_first,
                       rel_base, sid, valid, lo, hi, shift, *,
                       num_series, num_buckets, interval, agg_down,
                       rate=False, counter_max=0.0, reset_value=0.0,
@@ -110,3 +159,9 @@ def fused_block_stage(ts_nb, ts_pay, v_nb, v_pay, first_idx, blk_first,
         interval=interval, agg_down=agg_down, rate=rate,
         counter_max=counter_max, reset_value=reset_value,
         counter=counter, drop_resets=drop_resets)
+
+
+fused_block_stage = compile_with_plan(
+    _fused_block_stage,
+    ExecPlan(name="compress.fused_stage", axis="block",
+             static_argnames=_FUSED_STATICS))

@@ -20,13 +20,23 @@ tests compare the two.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from opentsdb_tpu.core.const import NOLERP_AGGS
+from opentsdb_tpu.parallel.compile import compile_with_plan, jit_plan
+from opentsdb_tpu.parallel.plan import ExecPlan
+
+# Execution plans (parallel/plan.py): every jitted kernel in this
+# module compiles through the mesh execution plane. With no mesh (the
+# plane's default) each plan is exactly the per-site jax.jit it
+# replaced — same statics, same donation, bit-identical programs; the
+# plane is where the batch axis each kernel shards over is DECLARED
+# (series-hash for the window/downsample family) so mesh legs
+# (parallel/sharded.py, compress/) stay partition-aware without
+# per-site plumbing.
+_RATE_STATICS = ("rate", "counter", "drop_resets")
 
 # Plain Python floats: creating jnp scalars at import time would
 # instantiate a device array and eagerly initialize the backend.
@@ -429,9 +439,10 @@ def _stage_tail(series_values, series_mask, presence, *, num_buckets,
     return series_values, series_mask, filled, in_range, presence
 
 
-@functools.partial(
-    jax.jit, donate_argnums=(4, 5, 6, 7, 8),
-    static_argnames=("num_series", "num_buckets", "interval", "need"))
+@jit_plan(ExecPlan(
+    name="window.chunk_fold", axis="series",
+    static_argnames=("num_series", "num_buckets", "interval", "need"),
+    donate_argnums=(4, 5, 6, 7, 8)))
 def _chunk_fold(rel_ts, vals, sid, valid, count, total, m2, mn, mx,
                 lo, hi, shift, *, num_series, num_buckets, interval,
                 need):
@@ -483,10 +494,10 @@ def _chunk_fold(rel_ts, vals, sid, valid, count, total, m2, mn, mx,
     return count, total, m2, mn, mx
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_series", "num_buckets", "interval", "agg_down",
-                     "rate", "counter", "drop_resets"))
+@jit_plan(ExecPlan(
+    name="window.chunk_stage_finish", axis="series",
+    static_argnames=("num_series", "num_buckets", "interval", "agg_down")
+    + _RATE_STATICS))
 def _chunk_stage_finish(count, total, m2, mn, mx, *, num_series,
                         num_buckets, interval, agg_down, rate=False,
                         counter_max=0.0, reset_value=0.0, counter=False,
@@ -555,27 +566,31 @@ def window_series_stage_chunks(chunks, lo, hi, shift, *, num_series,
         counter=counter, drop_resets=drop_resets)
 
 
-window_series_stage = functools.partial(
-    jax.jit, static_argnames=("num_series", "num_buckets", "interval",
-                              "agg_down", "rate", "counter",
-                              "drop_resets"))(_window_series_stage)
+WINDOW_STAGE_PLAN = ExecPlan(
+    name="window.stage", axis="series",
+    static_argnames=("num_series", "num_buckets", "interval",
+                     "agg_down") + _RATE_STATICS)
+WINDOW_MOMENT_APPLY_PLAN = ExecPlan(
+    name="window.moment_apply", axis="series",
+    static_argnames=("num_groups", "agg_group", "g_out", "b_out",
+                     "wire_bf16"))
+WINDOW_QUANTILE_APPLY_PLAN = ExecPlan(
+    name="window.quantile_apply", axis="series",
+    static_argnames=("num_groups", "g_out", "b_out", "wire_bf16"))
 
-window_moment_apply = functools.partial(
-    jax.jit, static_argnames=("num_groups", "agg_group",
-                              "g_out", "b_out",
-                              "wire_bf16"))(_moment_apply)
-
-window_quantile_apply = functools.partial(
-    jax.jit, static_argnames=("num_groups",
-                              "g_out", "b_out",
-                              "wire_bf16"))(_quantile_apply)
+window_series_stage = compile_with_plan(_window_series_stage,
+                                        WINDOW_STAGE_PLAN)
+window_moment_apply = compile_with_plan(_moment_apply,
+                                        WINDOW_MOMENT_APPLY_PLAN)
+window_quantile_apply = compile_with_plan(_quantile_apply,
+                                          WINDOW_QUANTILE_APPLY_PLAN)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_series", "num_groups", "num_buckets", "interval",
-                     "agg_down", "agg_group", "rate", "counter",
-                     "drop_resets"))
+@jit_plan(ExecPlan(
+    name="window.query", axis="series",
+    static_argnames=("num_series", "num_groups", "num_buckets",
+                     "interval", "agg_down", "agg_group")
+    + _RATE_STATICS))
 def window_query(rel_ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
                  valid_in: jnp.ndarray, include: jnp.ndarray,
                  gmap: jnp.ndarray, lo, hi, shift, *, num_series: int,
@@ -645,10 +660,10 @@ def _series_stage(ts, vals, sid, valid, *, num_series, num_buckets,
         .astype(jnp.int32)
     return series_values, series_mask, series_ts
 
-@functools.partial(
-    jax.jit,
+@jit_plan(ExecPlan(
+    name="downsample.group", axis="series",
     static_argnames=("num_series", "num_buckets", "interval", "agg_down",
-                     "agg_group", "rate", "counter", "drop_resets"))
+                     "agg_group") + _RATE_STATICS))
 def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
                      valid: jnp.ndarray, *, num_series: int,
                      num_buckets: int, interval: int, agg_down: str,
@@ -725,11 +740,11 @@ def downsample_group(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
     }
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_series", "num_groups", "num_buckets", "interval",
-                     "agg_down", "agg_group", "rate", "counter",
-                     "drop_resets"))
+@jit_plan(ExecPlan(
+    name="downsample.multigroup", axis="series",
+    static_argnames=("num_series", "num_groups", "num_buckets",
+                     "interval", "agg_down", "agg_group")
+    + _RATE_STATICS))
 def downsample_multigroup(ts: jnp.ndarray, vals: jnp.ndarray,
                           sid: jnp.ndarray, valid: jnp.ndarray,
                           group_of_sid: jnp.ndarray, *, num_series: int,
@@ -799,7 +814,7 @@ def _key_to_float(key: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.bitcast_convert_type(b, jnp.float32)
 
 
-@jax.jit
+@jit_plan(ExecPlan(name="quantile.axis0", axis="series"))
 def masked_quantile_axis0(vals: jnp.ndarray, mask: jnp.ndarray,
                           q: jnp.ndarray):
     """Per-column quantiles across series (axis 0) with a validity mask.
@@ -858,7 +873,8 @@ def masked_quantile_axis0(vals: jnp.ndarray, mask: jnp.ndarray,
     return jax.vmap(one)(jnp.atleast_1d(jnp.asarray(q, jnp.float32)))
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups",))
+@jit_plan(ExecPlan(name="quantile.groups", axis="series",
+                   static_argnames=("num_groups",)))
 def masked_quantile_groups(vals: jnp.ndarray, mask: jnp.ndarray,
                            gmap: jnp.ndarray, q: jnp.ndarray, *,
                            num_groups: int):
@@ -908,10 +924,10 @@ def masked_quantile_groups(vals: jnp.ndarray, mask: jnp.ndarray,
     return jax.vmap(one)(jnp.atleast_1d(jnp.asarray(q, jnp.float32)))
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_series", "num_groups", "num_buckets", "interval",
-                     "agg_down", "rate", "counter", "drop_resets"))
+@jit_plan(ExecPlan(
+    name="downsample.multigroup_quantile", axis="series",
+    static_argnames=("num_series", "num_groups", "num_buckets",
+                     "interval", "agg_down") + _RATE_STATICS))
 def downsample_multigroup_quantile(
         ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
         valid: jnp.ndarray, group_of_sid: jnp.ndarray, q: jnp.ndarray, *,
@@ -986,7 +1002,8 @@ def _flat_rate(ts, vals, sid, valid, counter_max, reset_value, *,
     return jnp.where(ok, r, 0.0), ok
 
 
-@functools.partial(jax.jit, static_argnames=("counter", "drop_resets"))
+@jit_plan(ExecPlan(name="rate.flat", axis="series",
+                   static_argnames=("counter", "drop_resets")))
 def flat_rate(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
               valid: jnp.ndarray, counter_max: float = 0.0,
               reset_value: float = 0.0, *, counter: bool = False,
@@ -1008,7 +1025,8 @@ def flat_rate(ts: jnp.ndarray, vals: jnp.ndarray, sid: jnp.ndarray,
 # Union-grid group aggregation with interpolation (reference-parity path)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("interp",))
+@jit_plan(ExecPlan(name="grid.contributions", axis="series",
+                   static_argnames=("interp",)))
 def series_contributions(ts: jnp.ndarray, vals: jnp.ndarray,
                          counts: jnp.ndarray, grid: jnp.ndarray, *,
                          interp: str = "lerp"):
@@ -1054,7 +1072,7 @@ def series_contributions(ts: jnp.ndarray, vals: jnp.ndarray,
 
     return jax.vmap(one_series)(ts, vals, counts)
 
-@jax.jit
+@jit_plan(ExecPlan(name="grid.union", axis="series"))
 def union_grid(ts: jnp.ndarray, counts: jnp.ndarray):
     """Deduplicated sorted union of S padded timestamp rows.
 
@@ -1077,7 +1095,8 @@ def union_grid(ts: jnp.ndarray, counts: jnp.ndarray):
     return sorted_ts[order], gmask[order]
 
 
-@functools.partial(jax.jit, static_argnames=("agg", "interp"))
+@jit_plan(ExecPlan(name="grid.group_interpolate", axis="series",
+                   static_argnames=("agg", "interp")))
 def group_interpolate(ts: jnp.ndarray, vals: jnp.ndarray,
                       counts: jnp.ndarray, *, agg: str,
                       interp: str = "lerp"):

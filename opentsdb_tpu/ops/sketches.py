@@ -21,11 +21,18 @@ Both sketches are designed for XLA:
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from opentsdb_tpu.parallel.compile import jit_plan
+from opentsdb_tpu.parallel.plan import ExecPlan
+
+# Sketch kernels compile through the mesh execution plane
+# (parallel/plan.py + parallel/compile.py): with no mesh each plan is
+# the per-site jax.jit it replaced; the sketch folds' batch axis is the
+# series-hash axis (merges are psum/pmax-shaped, so mesh fan-in rides
+# the sharded kernels in parallel/sharded.py).
 
 # ---------------------------------------------------------------------------
 # t-digest
@@ -40,7 +47,8 @@ def tdigest_init(compression: int = DEFAULT_COMPRESSION):
             jnp.zeros(compression, jnp.float32))
 
 
-@functools.partial(jax.jit, static_argnames=("compression",))
+@jit_plan(ExecPlan(name="sketch.tdigest_compress", axis="series",
+                   static_argnames=("compression",)))
 def _compress(means: jnp.ndarray, weights: jnp.ndarray, *,
               compression: int):
     """Sort centroids and merge them into <= compression clusters.
@@ -72,7 +80,8 @@ def _compress(means: jnp.ndarray, weights: jnp.ndarray, *,
     return new_means, wsum
 
 
-@functools.partial(jax.jit, static_argnames=("compression",))
+@jit_plan(ExecPlan(name="sketch.tdigest_add", axis="series",
+                   static_argnames=("compression",)))
 def tdigest_add(means: jnp.ndarray, weights: jnp.ndarray,
                 values: jnp.ndarray, valid: jnp.ndarray, *,
                 compression: int = DEFAULT_COMPRESSION):
@@ -82,7 +91,8 @@ def tdigest_add(means: jnp.ndarray, weights: jnp.ndarray,
     return _compress(m, w, compression=compression)
 
 
-@functools.partial(jax.jit, static_argnames=("compression",))
+@jit_plan(ExecPlan(name="sketch.tdigest_merge", axis="series",
+                   static_argnames=("compression",)))
 def tdigest_merge(means_a, weights_a, means_b, weights_b, *,
                   compression: int = DEFAULT_COMPRESSION):
     """Merge two digests (associative, commutative up to compression error)."""
@@ -91,7 +101,7 @@ def tdigest_merge(means_a, weights_a, means_b, weights_b, *,
     return _compress(m, w, compression=compression)
 
 
-@jax.jit
+@jit_plan(ExecPlan(name="sketch.tdigest_quantile"))
 def tdigest_quantile(means: jnp.ndarray, weights: jnp.ndarray,
                      q: jnp.ndarray):
     """Estimate quantiles q (in [0,1]) by interpolating between centroids.
@@ -148,7 +158,7 @@ def hll_init(p: int = DEFAULT_HLL_P):
     return jnp.zeros(1 << p, jnp.int32)
 
 
-@jax.jit
+@jit_plan(ExecPlan(name="sketch.hash32"))
 def hash32(x: jnp.ndarray) -> jnp.ndarray:
     """32-bit avalanche mixer (murmur3 finalizer) over int32/uint32 input."""
     h = x.astype(jnp.uint32)
@@ -160,7 +170,8 @@ def hash32(x: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
-@functools.partial(jax.jit, static_argnames=("p",))
+@jit_plan(ExecPlan(name="sketch.hll_add", axis="series",
+                   static_argnames=("p",)))
 def hll_add(registers: jnp.ndarray, items: jnp.ndarray,
             valid: jnp.ndarray, *, p: int = DEFAULT_HLL_P):
     """Fold hashed items (e.g. tagv UIDs as int32) into the registers."""
@@ -176,12 +187,12 @@ def hll_add(registers: jnp.ndarray, items: jnp.ndarray,
     return jnp.maximum(registers, new)
 
 
-@jax.jit
+@jit_plan(ExecPlan(name="sketch.hll_merge", axis="series"))
 def hll_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(a, b)
 
 
-@jax.jit
+@jit_plan(ExecPlan(name="sketch.hll_estimate"))
 def hll_estimate(registers: jnp.ndarray) -> jnp.ndarray:
     """Cardinality estimate with small/large-range corrections."""
     m = registers.shape[0]
@@ -212,7 +223,8 @@ def moment_init(k: int = DEFAULT_MOMENT_K):
             jnp.full((), -jnp.inf), jnp.zeros(k, jnp.float32))
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@jit_plan(ExecPlan(name="sketch.moment_add", axis="series",
+                   static_argnames=("k",)))
 def moment_add(count, vmin, vmax, moments, values, valid, *,
                k: int = DEFAULT_MOMENT_K):
     """Fold a (padded) batch into the moment state: one vectorized
@@ -236,7 +248,7 @@ def moment_add(count, vmin, vmax, moments, values, valid, *,
             moments + (powers * ok[None, :]).sum(axis=1))
 
 
-@jax.jit
+@jit_plan(ExecPlan(name="sketch.moment_merge", axis="series"))
 def moment_merge(count_a, vmin_a, vmax_a, mom_a,
                  count_b, vmin_b, vmax_b, mom_b):
     """Merge two moment states — pure addition (associative AND
@@ -245,7 +257,7 @@ def moment_merge(count_a, vmin_a, vmax_a, mom_a,
             jnp.maximum(vmax_a, vmax_b), mom_a + mom_b)
 
 
-@jax.jit
+@jit_plan(ExecPlan(name="sketch.moment_fold_windows", axis="series"))
 def moment_fold_windows(states):
     """Batched read-side fold: [W, D] per-window moment rows (count,
     min, max, moments...) reduce to one merged row — the addition

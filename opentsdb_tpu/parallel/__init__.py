@@ -20,6 +20,12 @@ from opentsdb_tpu.parallel.mesh import (
     TIME_AXIS,
     make_mesh,
 )
+from opentsdb_tpu.parallel.plan import (
+    ExecPlan,
+    build_mesh,
+    flatten_series_mesh,
+)
 
 __all__ = ["make_mesh", "SERIES_AXIS", "TIME_AXIS", "EXPERT_AXIS",
-           "HOST_AXIS"]
+           "HOST_AXIS", "ExecPlan", "build_mesh",
+           "flatten_series_mesh"]

@@ -19,7 +19,6 @@ stays static-shaped for XLA.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple, Sequence
 
 import jax
@@ -28,8 +27,17 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from opentsdb_tpu.ops import sketches
-from opentsdb_tpu.ops.kernels import downsample_group
-from opentsdb_tpu.parallel.mesh import EXPERT_AXIS, shard_map
+from opentsdb_tpu.ops.kernels import (
+    _finish,
+    _segment_moments,
+    downsample_group,
+    gap_fill,
+    group_moments,
+    masked_quantile_axis0,
+)
+from opentsdb_tpu.parallel.compile import compile_with_plan
+from opentsdb_tpu.parallel.mesh import EXPERT_AXIS
+from opentsdb_tpu.parallel.plan import ExecPlan
 
 FAMILIES = ("moment", "percentile", "cardinality")
 FAMILY_ID = {name: i for i, name in enumerate(FAMILIES)}
@@ -150,15 +158,8 @@ def plan_expert_batch(queries: Sequence[dict], n_devices: int) -> ExpertPlan:
                       valid, slot_of)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "specs"))
-def expert_query_step(fam, ts, vals, items, sid, valid, *, mesh,
-                      specs: ExpertSpecs):
-    """One mixed-family batch over the mesh's expert axis.
-
-    fam [D]; point arrays [D, Q, N]. Returns (values [D, Q, OUT],
-    mask [D, Q, OUT]) — device d's rows hold that device's routed
-    queries, trimmed by the mask.
-    """
+def _expert_query_body(fam, ts, vals, items, sid, valid, *,
+                       specs: ExpertSpecs):
     out = specs.out_len()
     mspec, pspec, cspec = specs.moment, specs.percentile, specs.cardinality
     qs = jnp.asarray(pspec.qs, jnp.float32)
@@ -198,18 +199,30 @@ def expert_query_step(fam, ts, vals, items, sid, valid, *, mesh,
             one, (ts, vals, items, valid))
         return pad_to(cv, jnp.ones_like(cv, bool))
 
-    def shard_fn(fam, ts, vals, items, sid, valid):
-        my_fam = fam[0]
-        v, m = jax.lax.switch(
-            my_fam,
-            [run_moment, run_percentile, run_cardinality],
-            ts[0], vals[0], items[0], sid[0], valid[0])
-        return v[None], m[None]
+    my_fam = fam[0]
+    v, m = jax.lax.switch(
+        my_fam,
+        [run_moment, run_percentile, run_cardinality],
+        ts[0], vals[0], items[0], sid[0], valid[0])
+    return v[None], m[None]
 
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(EXPERT_AXIS),) * 6,
-        out_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS)))
+
+EXPERT_QUERY_PLAN = ExecPlan(
+    name="expert.query_step", axis="expert", style="shard_map",
+    in_specs=(P(EXPERT_AXIS),) * 6,
+    out_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS)))
+
+
+def expert_query_step(fam, ts, vals, items, sid, valid, *, mesh,
+                      specs: ExpertSpecs):
+    """One mixed-family batch over the mesh's expert axis.
+
+    fam [D]; point arrays [D, Q, N]. Returns (values [D, Q, OUT],
+    mask [D, Q, OUT]) — device d's rows hold that device's routed
+    queries, trimmed by the mask.
+    """
+    fn = compile_with_plan(_expert_query_body, EXPERT_QUERY_PLAN, mesh,
+                           statics=(("specs", specs),))
     return fn(fam, ts, vals, items, sid, valid)
 
 
@@ -239,3 +252,212 @@ def run_mixed_batch(queries: Sequence[dict], mesh, specs: ExpertSpecs):
             out = row[0]
         results.append(out)
     return results
+
+
+# ---------------------------------------------------------------------------
+# Expert-parallel DASHBOARD batches (the /q serving face)
+# ---------------------------------------------------------------------------
+#
+# The legacy expert_query_step above is the research kernel (its own
+# family specs, t-digest percentiles). Dashboard serving needs exact
+# /q semantics: each sub-query's answer must match the serial leg's
+# fused downsample+group kernel (ops/kernels.downsample_group and the
+# percentile branch of the executor) to f32 tolerance. So the dash
+# families are (moment, percentile) with the SERIAL kernels' exact op
+# sequence per slot — the downsample aggregator and the group
+# aggregator are per-slot TRACED switch indices (computing every
+# segment statistic and selecting is bitwise-identical to the gated
+# serial form, each statistic being an independent segment reduction),
+# so one compile serves a whole dashboard of mixed sum/avg/max/pNN
+# panels and slots pack by family instead of serializing.
+
+DASH_FAMILIES = ("moment", "percentile")
+DASH_AGGS = ("sum", "min", "max", "avg", "dev", "count")
+DASH_AGG_ID = {name: i for i, name in enumerate(DASH_AGGS)}
+
+
+def _finish_switch(agg_id, stats):
+    """_finish with a traced aggregator: every statistic is already
+    computed; the switch selects the finishing arithmetic."""
+    branches = [lambda s, a=a: _finish(a, *s) for a in DASH_AGGS]
+    return jax.lax.switch(agg_id, branches, stats)
+
+
+class DashPlan(NamedTuple):
+    """Host-side routing of one dashboard batch (the plan_expert_batch
+    shape plus per-slot traced aggregator ids and quantiles)."""
+    fam: np.ndarray        # [D] int32 family id per device
+    ts: np.ndarray         # [D, Q, N] int32 rel offsets
+    vals: np.ndarray       # [D, Q, N] float32
+    sid: np.ndarray        # [D, Q, N] int32
+    valid: np.ndarray      # [D, Q, N] bool
+    ds_id: np.ndarray      # [D, Q] int32 downsample-agg switch index
+    agg_id: np.ndarray     # [D, Q] int32 group-agg switch index
+    q: np.ndarray          # [D, Q] float32 quantile (percentile slots)
+    slot_of: list          # query index -> (device, slot)
+
+
+def plan_dashboard_batch(queries: Sequence[dict],
+                         n_devices: int) -> DashPlan:
+    """Route dashboard sub-queries onto device groups by family.
+
+    Each query dict: {"family": "moment"|"percentile", "ts": [n] rel
+    offsets, "vals": [n], "sid": [n], "dsagg": str, "agg": str} plus
+    "quantile" for percentile slots. Devices split proportionally to
+    family query counts (each present family gets >= 1); queries
+    round-robin within their family's group.
+    """
+    fam_id = {name: i for i, name in enumerate(DASH_FAMILIES)}
+    for qi, qq in enumerate(queries):
+        if qq["family"] not in fam_id:
+            raise ValueError(f"query {qi}: unknown dash family "
+                             f"{qq['family']!r}")
+    present = [f for f in DASH_FAMILIES
+               if any(qq["family"] == f for qq in queries)]
+    if not present:
+        raise ValueError("empty dashboard batch")
+    if n_devices < len(present):
+        raise ValueError(f"{len(present)} families need >= that many "
+                         f"devices, have {n_devices}")
+    counts = {f: sum(qq["family"] == f for qq in queries)
+              for f in present}
+    total = sum(counts.values())
+    alloc = {f: max(1, n_devices * counts[f] // total) for f in present}
+    while sum(alloc.values()) > n_devices:
+        alloc[max(alloc, key=lambda f: alloc[f])] -= 1
+    while sum(alloc.values()) < n_devices:
+        alloc[max(present, key=lambda f: counts[f] / alloc[f])] += 1
+
+    dev_fam = []
+    group_devs: dict[str, list[int]] = {}
+    for f in present:
+        group_devs[f] = list(range(len(dev_fam), len(dev_fam) + alloc[f]))
+        dev_fam += [fam_id[f]] * alloc[f]
+
+    slots: list[list[int]] = [[] for _ in range(n_devices)]
+    slot_of: list[tuple[int, int]] = []
+    rr = {f: 0 for f in present}
+    for qi, qq in enumerate(queries):
+        devs = group_devs[qq["family"]]
+        d = devs[rr[qq["family"]] % len(devs)]
+        rr[qq["family"]] += 1
+        slot_of.append((d, len(slots[d])))
+        slots[d].append(qi)
+
+    q_max = max(len(sl) for sl in slots)
+    n_max = max((len(np.atleast_1d(qq["vals"])) for qq in queries),
+                default=1)
+    n_max = max(n_max, 1)
+    shape = (n_devices, q_max, n_max)
+    ts = np.zeros(shape, np.int32)
+    vals = np.zeros(shape, np.float32)
+    sid = np.zeros(shape, np.int32)
+    valid = np.zeros(shape, bool)
+    ds_id = np.zeros((n_devices, q_max), np.int32)
+    agg_id = np.zeros((n_devices, q_max), np.int32)
+    qarr = np.zeros((n_devices, q_max), np.float32)
+    for d, devq in enumerate(slots):
+        for sl, qi in enumerate(devq):
+            qq = queries[qi]
+            n = len(qq["vals"])
+            ts[d, sl, :n] = np.asarray(qq["ts"], np.int32)
+            vals[d, sl, :n] = np.asarray(qq["vals"], np.float32)
+            sid[d, sl, :n] = np.asarray(qq["sid"], np.int32)
+            valid[d, sl, :n] = True
+            ds_id[d, sl] = DASH_AGG_ID[qq["dsagg"]]
+            if qq["family"] == "moment":
+                agg_id[d, sl] = DASH_AGG_ID[qq["agg"]]
+            else:
+                qarr[d, sl] = float(qq["quantile"])
+    return DashPlan(np.asarray(dev_fam, np.int32), ts, vals, sid,
+                    valid, ds_id, agg_id, qarr, slot_of)
+
+
+def _dash_series_stage(t, v, s, m, ds_id, *, num_series, num_buckets,
+                       interval):
+    """The serial kernels' series stage with a traced downsampler: one
+    fused segment reduction producing [S, B] grids (the op sequence of
+    ops.kernels._series_stage, every statistic materialized so the
+    per-slot switch can pick)."""
+    bucket = jnp.clip(t // interval, 0, num_buckets - 1)
+    nseg = num_series * num_buckets + 1
+    seg = jnp.where(m, s * num_buckets + bucket, nseg - 1)
+    count, total, m2, mn, mx = _segment_moments(v, seg, m, nseg)
+    per = _finish_switch(ds_id, (count, total, m2, mn, mx))
+    shape = (num_series, num_buckets)
+    return per[:-1].reshape(shape), count[:-1].reshape(shape) > 0
+
+
+def _expert_dash_body(fam, ts, vals, sid, valid, ds_id, agg_id, q, *,
+                      num_series, num_buckets, interval):
+    """Per-device body: run this device's routed slots under its
+    family's kernel (lax.switch on the routed family id; every device
+    traces both, executes one)."""
+    my_fam = fam[0]
+    ts, vals, sid, valid = ts[0], vals[0], sid[0], valid[0]
+    ds_id, agg_id, q = ds_id[0], agg_id[0], q[0]
+
+    def moment_slot(args):
+        t, v, s, m, di, ai, _ = args
+        sv, sm = _dash_series_stage(
+            t, v, s, m, di, num_series=num_series,
+            num_buckets=num_buckets, interval=interval)
+        filled, in_range = gap_fill(sv, sm, num_buckets)
+        g_n, g_total, g_m2, _, g_mn, g_mx = group_moments(filled,
+                                                          in_range)
+        gv = _finish_switch(ai, (g_n, g_total, g_m2, g_mn, g_mx))
+        return gv, sm.any(axis=0)
+
+    def pct_slot(args):
+        t, v, s, m, di, _, qq = args
+        sv, sm = _dash_series_stage(
+            t, v, s, m, di, num_series=num_series,
+            num_buckets=num_buckets, interval=interval)
+        filled, in_range = gap_fill(sv, sm, num_buckets)
+        gv = masked_quantile_axis0(filled, in_range, qq[None])[0]
+        return gv, sm.any(axis=0)
+
+    operands = (ts, vals, sid, valid, ds_id, agg_id, q)
+
+    def run_moment(ops):
+        return jax.lax.map(moment_slot, ops)
+
+    def run_pct(ops):
+        return jax.lax.map(pct_slot, ops)
+
+    gv, gm = jax.lax.switch(my_fam, [run_moment, run_pct], operands)
+    return gv[None], gm[None]
+
+
+EXPERT_DASH_PLAN = ExecPlan(
+    name="expert.dashboard_step", axis="expert", style="shard_map",
+    in_specs=(P(EXPERT_AXIS),) * 8,
+    out_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS)))
+
+
+def run_dashboard_batch(queries: Sequence[dict], mesh, *,
+                        num_series: int, num_buckets: int,
+                        interval: int):
+    """Plan, execute and unpack one mixed dashboard batch over the
+    mesh's expert axis. Returns [(values [B] f32, mask [B] bool)] per
+    query, semantics matching the serial fused kernels (f32 tolerance
+    — group sums reduce in a different padding order)."""
+    from opentsdb_tpu.parallel.plan import flatten_series_mesh
+    devs = flatten_series_mesh(mesh).devices.reshape(-1)
+    from jax.sharding import Mesh
+    emesh = Mesh(devs, (EXPERT_AXIS,))
+    plan = plan_dashboard_batch(queries, n_devices=devs.size)
+    fn = compile_with_plan(
+        _expert_dash_body, EXPERT_DASH_PLAN, emesh,
+        statics=(("num_series", num_series),
+                 ("num_buckets", num_buckets),
+                 ("interval", interval)))
+    values, mask = fn(plan.fam, plan.ts, plan.vals, plan.sid,
+                      plan.valid, plan.ds_id, plan.agg_id, plan.q)
+    values = np.asarray(values)
+    mask = np.asarray(mask)
+    out = []
+    for qi in range(len(queries)):
+        d, sl = plan.slot_of[qi]
+        out.append((values[d, sl], mask[d, sl]))
+    return out

@@ -30,8 +30,6 @@ regardless of point count.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
@@ -40,7 +38,9 @@ from jax.sharding import PartitionSpec as P
 from opentsdb_tpu.core.const import NOLERP_AGGS
 from opentsdb_tpu.ops import sketches
 from opentsdb_tpu.ops.kernels import _finish
-from opentsdb_tpu.parallel.mesh import HOST_AXIS, SERIES_AXIS, shard_map
+from opentsdb_tpu.parallel.compile import compile_with_plan
+from opentsdb_tpu.parallel.mesh import HOST_AXIS, SERIES_AXIS
+from opentsdb_tpu.parallel.plan import ExecPlan
 from opentsdb_tpu.parallel.sharded import _local_group_moments
 
 
@@ -94,10 +94,42 @@ def make_hybrid_mesh(n_hosts: int | None = None,
     return Mesh(grid, (HOST_AXIS, SERIES_AXIS))
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("mesh", "series_per_shard", "num_buckets", "interval",
-                     "agg_down", "agg_group"))
+def _hybrid_group_body(ts, vals, sid, valid, *, series_per_shard,
+                       num_buckets, interval, agg_down, agg_group):
+    ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
+    n, total, m2, mean, mn, mx, any_real = _local_group_moments(
+        ts, vals, sid, valid, num_series=series_per_shard,
+        num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+        lerp=agg_group not in NOLERP_AGGS)
+
+    def chan(axis, n, total, m2, mean):
+        c_n = jax.lax.psum(n, axis)
+        c_total = jax.lax.psum(total, axis)
+        c_mean = c_total / jnp.maximum(c_n, 1.0)
+        c_m2 = jax.lax.psum(m2 + n * (mean - c_mean) ** 2, axis)
+        return c_n, c_total, c_m2, c_mean
+
+    # Level 1 (ICI): chips of one host.
+    h_n, h_total, h_m2, h_mean = chan(SERIES_AXIS, n, total, m2, mean)
+    h_mn = jax.lax.pmin(mn, SERIES_AXIS)
+    h_mx = jax.lax.pmax(mx, SERIES_AXIS)
+    h_any = jax.lax.pmax(any_real.astype(jnp.int32), SERIES_AXIS)
+    # Level 2 (DCN): [B]-sized partials only.
+    g_n, g_total, g_m2, _ = chan(HOST_AXIS, h_n, h_total, h_m2, h_mean)
+    g_mn = jax.lax.pmin(h_mn, HOST_AXIS)
+    g_mx = jax.lax.pmax(h_mx, HOST_AXIS)
+    g_any = jax.lax.pmax(h_any, HOST_AXIS) > 0
+
+    out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
+    return out[None], g_any[None]
+
+
+HYBRID_GROUP_PLAN = ExecPlan(
+    name="multihost.downsample_group", axis="host", style="shard_map",
+    in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 4,
+    out_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2)
+
+
 def hybrid_downsample_group(ts, vals, sid, valid, *, mesh,
                             series_per_shard: int, num_buckets: int,
                             interval: int, agg_down: str, agg_group: str):
@@ -109,84 +141,65 @@ def hybrid_downsample_group(ts, vals, sid, valid, *, mesh,
     [B]-sized host partials combine over DCN. Returns (group_values [B],
     group_mask [B]).
     """
-
-    def shard_fn(ts, vals, sid, valid):
-        ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
-        n, total, m2, mean, mn, mx, any_real = _local_group_moments(
-            ts, vals, sid, valid, num_series=series_per_shard,
-            num_buckets=num_buckets, interval=interval, agg_down=agg_down,
-            lerp=agg_group not in NOLERP_AGGS)
-
-        def chan(axis, n, total, m2, mean):
-            c_n = jax.lax.psum(n, axis)
-            c_total = jax.lax.psum(total, axis)
-            c_mean = c_total / jnp.maximum(c_n, 1.0)
-            c_m2 = jax.lax.psum(m2 + n * (mean - c_mean) ** 2, axis)
-            return c_n, c_total, c_m2, c_mean
-
-        # Level 1 (ICI): chips of one host.
-        h_n, h_total, h_m2, h_mean = chan(SERIES_AXIS, n, total, m2, mean)
-        h_mn = jax.lax.pmin(mn, SERIES_AXIS)
-        h_mx = jax.lax.pmax(mx, SERIES_AXIS)
-        h_any = jax.lax.pmax(any_real.astype(jnp.int32), SERIES_AXIS)
-        # Level 2 (DCN): [B]-sized partials only.
-        g_n, g_total, g_m2, _ = chan(HOST_AXIS, h_n, h_total, h_m2, h_mean)
-        g_mn = jax.lax.pmin(h_mn, HOST_AXIS)
-        g_mx = jax.lax.pmax(h_mx, HOST_AXIS)
-        g_any = jax.lax.pmax(h_any, HOST_AXIS) > 0
-
-        out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
-        return out[None], g_any[None]
-
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 4,
-        out_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2)
+    fn = compile_with_plan(
+        _hybrid_group_body, HYBRID_GROUP_PLAN, mesh,
+        statics=(("series_per_shard", series_per_shard),
+                 ("num_buckets", num_buckets), ("interval", interval),
+                 ("agg_down", agg_down), ("agg_group", agg_group)))
     group_values, group_mask = fn(ts, vals, sid, valid)
     return group_values[0], group_mask[0]
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "p"))
+def _hybrid_hll_body(items, valid, *, p):
+    regs = sketches.hll_init(p)
+    regs = sketches.hll_add(regs, items[0], valid[0], p=p)
+    host = jax.lax.pmax(regs, SERIES_AXIS)
+    merged = jax.lax.pmax(host, HOST_AXIS)
+    return sketches.hll_estimate(merged)[None]
+
+
+HYBRID_HLL_PLAN = ExecPlan(
+    name="multihost.hll_distinct", axis="host", style="shard_map",
+    in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2,
+    out_specs=P((HOST_AXIS, SERIES_AXIS)))
+
+
 def hybrid_hll_distinct(items, valid, *, mesh, p: int = 14):
     """Distinct count over [H*C, N_shard] shards: register pmax over ICI,
     then over DCN — 2**p bytes cross hosts, independent of point count."""
-
-    def shard_fn(items, valid):
-        regs = sketches.hll_init(p)
-        regs = sketches.hll_add(regs, items[0], valid[0], p=p)
-        host = jax.lax.pmax(regs, SERIES_AXIS)
-        merged = jax.lax.pmax(host, HOST_AXIS)
-        return sketches.hll_estimate(merged)[None]
-
-    fn = shard_map(shard_fn, mesh=mesh,
-                       in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2,
-                       out_specs=P((HOST_AXIS, SERIES_AXIS)))
+    fn = compile_with_plan(_hybrid_hll_body, HYBRID_HLL_PLAN, mesh,
+                           statics=(("p", p),))
     return fn(items, valid)[0]
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "compression"))
+def _hybrid_tdigest_body(values, valid, qs, *, compression):
+    means, weights = sketches.tdigest_init(compression)
+    means, weights = sketches.tdigest_add(
+        means, weights, values[0], valid[0], compression=compression)
+    # ICI: merge this host's chip digests.
+    hm = jax.lax.all_gather(means, SERIES_AXIS).reshape(-1)
+    hw = jax.lax.all_gather(weights, SERIES_AXIS).reshape(-1)
+    hm, hw = sketches._compress(hm, hw, compression=compression)
+    # DCN: merge the per-host digests.
+    gm = jax.lax.all_gather(hm, HOST_AXIS).reshape(-1)
+    gw = jax.lax.all_gather(hw, HOST_AXIS).reshape(-1)
+    gm, gw = sketches._compress(gm, gw, compression=compression)
+    return sketches.tdigest_quantile(gm, gw, qs[0])[None]
+
+
+HYBRID_TDIGEST_PLAN = ExecPlan(
+    name="multihost.tdigest", axis="host", style="shard_map",
+    in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2 + (P(),),
+    out_specs=P((HOST_AXIS, SERIES_AXIS)))
+
+
 def hybrid_tdigest(values, valid, qs, *, mesh, compression: int = 128):
     """Quantiles over [H*C, N_shard] shards with two-level digest merge:
     all_gather raw chip digests over ICI and recompress to one host
     digest, then all_gather only the compressed host digests over DCN —
     DCN traffic is O(hosts * compression), not O(chips * compression).
     """
-
-    def shard_fn(values, valid):
-        means, weights = sketches.tdigest_init(compression)
-        means, weights = sketches.tdigest_add(
-            means, weights, values[0], valid[0], compression=compression)
-        # ICI: merge this host's chip digests.
-        hm = jax.lax.all_gather(means, SERIES_AXIS).reshape(-1)
-        hw = jax.lax.all_gather(weights, SERIES_AXIS).reshape(-1)
-        hm, hw = sketches._compress(hm, hw, compression=compression)
-        # DCN: merge the per-host digests.
-        gm = jax.lax.all_gather(hm, HOST_AXIS).reshape(-1)
-        gw = jax.lax.all_gather(hw, HOST_AXIS).reshape(-1)
-        gm, gw = sketches._compress(gm, gw, compression=compression)
-        return sketches.tdigest_quantile(gm, gw, qs)[None]
-
-    fn = shard_map(shard_fn, mesh=mesh,
-                       in_specs=(P((HOST_AXIS, SERIES_AXIS)),) * 2,
-                       out_specs=P((HOST_AXIS, SERIES_AXIS)))
-    return fn(values, valid)[0]
+    import numpy as np
+    fn = compile_with_plan(_hybrid_tdigest_body, HYBRID_TDIGEST_PLAN,
+                           mesh, statics=(("compression", compression),))
+    return fn(values, valid, np.asarray(qs, np.float32)[None])[0]

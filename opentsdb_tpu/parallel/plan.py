@@ -1,0 +1,144 @@
+"""Declarative execution plans for the unified mesh execution plane.
+
+Every device-side hot path used to compile and dispatch ad hoc at its
+own call site — ``functools.partial(jax.jit, static_argnames=...)`` in
+ops/kernels.py, hand-rolled ``shard_map`` wrappers in parallel/*, one
+more jit in compress/kernels.py — so nothing could span more than one
+device without bespoke plumbing. An :class:`ExecPlan` is the declarative
+replacement: one small, hashable record naming the kernel, the axis its
+batch dimension shards over (series-hash for window reductions and
+sketch folds, block for the fused TSST4 stage, time for tile sharding,
+expert for mixed dashboard batches), the static/donated arguments, and
+— for mesh execution — the partition specs of its inputs and outputs.
+
+``parallel/compile.py:compile_with_plan(fn, plan, mesh)`` consumes these:
+with no mesh it is exactly the old per-site ``jax.jit`` (the migration
+alone is a no-op, bit for bit); with a mesh it prefers ``pjit``-style
+explicit shardings when the plan declares them and falls back to a
+``shard_map``-wrapped jit (the Titanax ``compile_step_with_plan``
+shape), cached per (fn, plan, mesh, statics) so repeat dashboards never
+rebuild or recompile anything.
+
+Axis vocabulary (parallel/mesh.py): ``series`` (series-hash blocks, the
+DP analog), ``time`` (bucket-aligned tiles), ``expert`` (aggregator
+families), ``host`` (DCN), plus the plane's ``block`` label for the
+TSST4 compressed-block axis (blocks shard like series: each block's
+points stay whole on one device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from jax.sharding import Mesh
+
+from opentsdb_tpu.parallel.mesh import (
+    HOST_AXIS,
+    SERIES_AXIS,
+    make_mesh,
+)
+
+# Batch-axis labels a plan may declare. "block" is the TSST4 compressed
+# block axis — physically it shards over the mesh's series axis (a
+# block, like a series, is an indivisible unit of points), the distinct
+# name keeps fused-path plans self-describing.
+BATCH_AXES = ("series", "time", "expert", "host", "block", None)
+
+# Compile styles compile_with_plan understands:
+# - "jit":       plain jax.jit; the single-device leg and the no-mesh
+#                default for every plan.
+# - "pjit":      explicit-shardings-preferred: jax.jit with
+#                in_shardings/out_shardings built from the plan's
+#                PartitionSpecs over the mesh (GSPMD partitions the
+#                global-view program; XLA inserts the collectives).
+# - "shard_map": map-style fallback for kernels written with explicit
+#                collectives (psum/all_gather inside the body).
+STYLES = ("jit", "pjit", "shard_map")
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecPlan:
+    """How one kernel compiles and (optionally) shards over a mesh.
+
+    Hashable and frozen: a plan IS a cache-key component. ``in_specs``/
+    ``out_specs`` are PartitionSpec trees (tuples of jax.sharding
+    PartitionSpec) used by both mesh styles; ``None`` means the plan
+    only ever runs single-device ("jit" style regardless of mesh).
+    """
+    name: str
+    axis: str | None = None          # batch axis label (BATCH_AXES)
+    style: str = "jit"               # preferred mesh style (STYLES)
+    static_argnames: tuple = ()
+    donate_argnums: tuple = ()
+    in_specs: tuple | None = None
+    out_specs: object | None = None
+
+    def __post_init__(self):
+        if self.axis not in BATCH_AXES:
+            raise ValueError(f"plan {self.name}: unknown axis "
+                             f"{self.axis!r} (expected one of "
+                             f"{BATCH_AXES})")
+        if self.style not in STYLES:
+            raise ValueError(f"plan {self.name}: unknown style "
+                             f"{self.style!r} (expected one of "
+                             f"{STYLES})")
+
+    def with_specs(self, in_specs, out_specs) -> "ExecPlan":
+        """A variant of this plan with different partition specs —
+        for kernels whose arity varies (e.g. an optional traced
+        quantile argument). Same name, so observability rolls up."""
+        return dataclasses.replace(self, in_specs=in_specs,
+                                   out_specs=out_specs)
+
+
+# ---------------------------------------------------------------------------
+# Mesh construction from the config knob
+# ---------------------------------------------------------------------------
+
+def build_mesh(shape: str, devices=None) -> Mesh:
+    """Mesh from the ``Config.mesh_shape`` / ``tsd --mesh`` knob.
+
+    ``"N"`` builds a 1-D series mesh over the first N local devices;
+    ``"RxC"`` builds the 2-D hybrid (host, series) mesh — R host rows
+    (DCN) of C chips (ICI). On CPU the virtual device count comes from
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (the gloo
+    testing recipe, README "Mesh execution"); asking for more devices
+    than the platform has is a loud boot error, not a silent
+    single-device fallback.
+    """
+    shape = shape.strip().lower()
+    if not shape:
+        raise ValueError("empty mesh shape")
+    if "x" in shape:
+        r_s, _, c_s = shape.partition("x")
+        r, c = int(r_s), int(c_s)
+        if r <= 0 or c <= 0:
+            raise ValueError(f"bad mesh shape {shape!r}")
+        from opentsdb_tpu.parallel.multihost import make_hybrid_mesh
+        import jax
+        devs = list(jax.devices()) if devices is None else list(devices)
+        if r * c > len(devs):
+            raise ValueError(
+                f"mesh {shape} needs {r * c} devices, have {len(devs)} "
+                "(on CPU set XLA_FLAGS="
+                "--xla_force_host_platform_device_count)")
+        return make_hybrid_mesh(r, c, devices=devs[:r * c])
+    n = int(shape)
+    if n <= 0:
+        raise ValueError(f"bad mesh shape {shape!r}")
+    return make_mesh(n, devices=devices)
+
+
+def flatten_series_mesh(mesh: Mesh) -> Mesh:
+    """1-D series-axis view of any mesh: the series-sharded query
+    kernels and the window-fold kernel run over every device regardless
+    of the (host, series) factorization — the hybrid structure matters
+    only to the DCN-aware multihost kernels."""
+    if getattr(mesh, "axis_names", None) in (None, (SERIES_AXIS,)):
+        # Not a Mesh (test sentinels) or already the 1-D series form.
+        return mesh
+    return Mesh(mesh.devices.reshape(-1), (SERIES_AXIS,))
+
+
+__all__ = ["ExecPlan", "build_mesh", "flatten_series_mesh", "BATCH_AXES",
+           "STYLES", "HOST_AXIS", "SERIES_AXIS"]

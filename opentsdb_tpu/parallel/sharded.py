@@ -17,8 +17,6 @@ merged digest).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -26,6 +24,8 @@ from jax.sharding import PartitionSpec as P
 from opentsdb_tpu.core.const import NOLERP_AGGS
 from opentsdb_tpu.ops import sketches
 from opentsdb_tpu.ops.kernels import (
+    _NEG_INF,
+    _POS_INF,
     _finish,
     _needs,
     _segment_moments,
@@ -36,7 +36,27 @@ from opentsdb_tpu.ops.kernels import (
     masked_quantile_groups,
     step_fill,
 )
-from opentsdb_tpu.parallel.mesh import SERIES_AXIS, shard_map
+from opentsdb_tpu.parallel.compile import compile_with_plan
+from opentsdb_tpu.parallel.mesh import SERIES_AXIS
+from opentsdb_tpu.parallel.plan import ExecPlan
+
+# Every mesh kernel in this module dispatches through the mesh
+# execution plane (parallel/compile.py): the per-shard bodies live at
+# module level (stable cache identities), their statics bind through
+# compile_with_plan's ``statics`` tuple, and the shard_map-wrapped jit
+# (the plan's map-style fallback — these bodies spell their psum /
+# all_gather collectives out) caches per (body, plan, mesh, statics)
+# so repeat dashboards never rebuild a wrapper.
+
+
+def _rate_params(counter_max, reset_value):
+    """[1, 2] float32 replicated operand carrying the client-controlled
+    rate parameters into the mesh bodies TRACED (a static would mint a
+    fresh XLA compile per distinct counterMax/resetValue — a hostile
+    dashboard could recompile-DoS the mesh leg)."""
+    import numpy as np
+
+    return np.asarray([[counter_max, reset_value]], np.float32)
 
 
 def _local_filled(ts, vals, sid, valid, *, num_series, num_buckets,
@@ -91,10 +111,42 @@ def _multigroup_emission(sm, gmap, num_groups: int, num_buckets: int):
     return g_real.reshape(num_groups, num_buckets)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("mesh", "series_per_shard", "num_buckets", "interval",
-                     "agg_down", "agg_group") + _RATE_STATICS)
+def _sharded_group_body(ts, vals, sid, valid, rate_params, *,
+                        series_per_shard, num_buckets, interval,
+                        agg_down, agg_group, rate, counter,
+                        drop_resets):
+    # rate_params [1, 2] replicated: (counter_max, reset_value) stay
+    # TRACED — they are client-controlled query params, and baking
+    # them static would let one hostile dashboard mint a fresh XLA
+    # compile per request.
+    counter_max, reset_value = rate_params[0, 0], rate_params[0, 1]
+    ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
+    n, total, m2, mean, mn, mx, any_real = _local_group_moments(
+        ts, vals, sid, valid, num_series=series_per_shard,
+        num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+        lerp=agg_group not in NOLERP_AGGS, rate=rate,
+        counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)
+    # Cross-chip exact moment combination (Chan et al.).
+    g_n = jax.lax.psum(n, SERIES_AXIS)
+    g_total = jax.lax.psum(total, SERIES_AXIS)
+    g_mean = g_total / jnp.maximum(g_n, 1.0)
+    corr = n * (mean - g_mean) ** 2
+    g_m2 = jax.lax.psum(m2 + corr, SERIES_AXIS)
+    g_mn = jax.lax.pmin(mn, SERIES_AXIS)
+    g_mx = jax.lax.pmax(mx, SERIES_AXIS)
+    g_any = jax.lax.pmax(any_real.astype(jnp.int32), SERIES_AXIS) > 0
+
+    out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
+    return out[None], g_any[None]
+
+
+SHARDED_GROUP_PLAN = ExecPlan(
+    name="sharded.downsample_group", axis="series", style="shard_map",
+    in_specs=(P(SERIES_AXIS),) * 4 + (P(),),
+    out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
+
+
 def sharded_downsample_group(ts, vals, sid, valid, *, mesh,
                              series_per_shard: int, num_buckets: int,
                              interval: int, agg_down: str, agg_group: str,
@@ -108,42 +160,46 @@ def sharded_downsample_group(ts, vals, sid, valid, *, mesh,
     [0, series_per_shard)); returns (group_values [B], group_mask [B])
     replicated on every chip.
     """
-
-    def shard_fn(ts, vals, sid, valid):
-        ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
-        n, total, m2, mean, mn, mx, any_real = _local_group_moments(
-            ts, vals, sid, valid, num_series=series_per_shard,
-            num_buckets=num_buckets, interval=interval, agg_down=agg_down,
-            lerp=agg_group not in NOLERP_AGGS, rate=rate,
-            counter_max=counter_max, reset_value=reset_value,
-            counter=counter, drop_resets=drop_resets)
-        # Cross-chip exact moment combination (Chan et al.).
-        g_n = jax.lax.psum(n, SERIES_AXIS)
-        g_total = jax.lax.psum(total, SERIES_AXIS)
-        g_mean = g_total / jnp.maximum(g_n, 1.0)
-        corr = n * (mean - g_mean) ** 2
-        g_m2 = jax.lax.psum(m2 + corr, SERIES_AXIS)
-        g_mn = jax.lax.pmin(mn, SERIES_AXIS)
-        g_mx = jax.lax.pmax(mx, SERIES_AXIS)
-        g_any = jax.lax.pmax(any_real.astype(jnp.int32), SERIES_AXIS) > 0
-
-        out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
-        return out[None], g_any[None]
-
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(SERIES_AXIS), P(SERIES_AXIS), P(SERIES_AXIS),
-                  P(SERIES_AXIS)),
-        out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
-    group_values, group_mask = fn(ts, vals, sid, valid)
+    fn = compile_with_plan(
+        _sharded_group_body, SHARDED_GROUP_PLAN, mesh,
+        statics=(("series_per_shard", series_per_shard),
+                 ("num_buckets", num_buckets), ("interval", interval),
+                 ("agg_down", agg_down), ("agg_group", agg_group),
+                 ("rate", rate), ("counter", counter),
+                 ("drop_resets", drop_resets)))
+    group_values, group_mask = fn(ts, vals, sid, valid,
+                                  _rate_params(counter_max,
+                                               reset_value))
     # Every shard returned the identical replicated answer; take shard 0.
     return group_values[0], group_mask[0]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("mesh", "series_per_shard", "num_buckets", "interval",
-                     "agg_down") + _RATE_STATICS)
+def _sharded_quantile_body(ts, vals, sid, valid, q, rate_params, *,
+                           series_per_shard, num_buckets, interval,
+                           agg_down, rate, counter, drop_resets):
+    counter_max, reset_value = rate_params[0, 0], rate_params[0, 1]
+    ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
+    filled, in_range, sm = _local_filled(
+        ts, vals, sid, valid, num_series=series_per_shard,
+        num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+        rate=rate, counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)
+    all_filled = jax.lax.all_gather(filled, SERIES_AXIS)
+    all_range = jax.lax.all_gather(in_range, SERIES_AXIS)
+    S = all_filled.shape[0] * all_filled.shape[1]
+    out = masked_quantile_axis0(
+        all_filled.reshape(S, -1), all_range.reshape(S, -1), q[0])
+    g_any = jax.lax.pmax(
+        sm.any(axis=0).astype(jnp.int32), SERIES_AXIS) > 0
+    return out[None], g_any[None]
+
+
+SHARDED_QUANTILE_PLAN = ExecPlan(
+    name="sharded.downsample_quantile", axis="series", style="shard_map",
+    in_specs=(P(SERIES_AXIS),) * 4 + (P(), P()),
+    out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
+
+
 def sharded_downsample_quantile(ts, vals, sid, valid, q, *, mesh,
                                 series_per_shard: int, num_buckets: int,
                                 interval: int, agg_down: str,
@@ -164,37 +220,71 @@ def sharded_downsample_quantile(ts, vals, sid, valid, q, *, mesh,
     fine for query-sized B. ``q`` is a [K] array; returns
     (values [K, B], group_mask [B]) replicated on every chip.
     """
-
-    def shard_fn(ts, vals, sid, valid, q):
-        ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
-        filled, in_range, sm = _local_filled(
-            ts, vals, sid, valid, num_series=series_per_shard,
-            num_buckets=num_buckets, interval=interval, agg_down=agg_down,
-            rate=rate, counter_max=counter_max, reset_value=reset_value,
-            counter=counter, drop_resets=drop_resets)
-        all_filled = jax.lax.all_gather(filled, SERIES_AXIS)
-        all_range = jax.lax.all_gather(in_range, SERIES_AXIS)
-        S = all_filled.shape[0] * all_filled.shape[1]
-        out = masked_quantile_axis0(
-            all_filled.reshape(S, -1), all_range.reshape(S, -1), q[0])
-        g_any = jax.lax.pmax(
-            sm.any(axis=0).astype(jnp.int32), SERIES_AXIS) > 0
-        return out[None], g_any[None]
-
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(SERIES_AXIS), P(SERIES_AXIS), P(SERIES_AXIS),
-                  P(SERIES_AXIS), P()),
-        out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
-    values, mask = fn(ts, vals, sid, valid, q[None])
+    fn = compile_with_plan(
+        _sharded_quantile_body, SHARDED_QUANTILE_PLAN, mesh,
+        statics=(("series_per_shard", series_per_shard),
+                 ("num_buckets", num_buckets), ("interval", interval),
+                 ("agg_down", agg_down), ("rate", rate),
+                 ("counter", counter), ("drop_resets", drop_resets)))
+    values, mask = fn(ts, vals, sid, valid, q[None],
+                      _rate_params(counter_max, reset_value))
     return values[0], mask[0]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("mesh", "series_per_shard", "num_groups",
-                     "num_buckets", "interval", "agg_down",
-                     "agg_group") + _RATE_STATICS)
+def _sharded_multigroup_body(ts, vals, sid, valid, gmap, rate_params,
+                             *, series_per_shard, num_groups,
+                             num_buckets, interval, agg_down,
+                             agg_group, rate, counter, drop_resets):
+    counter_max, reset_value = rate_params[0, 0], rate_params[0, 1]
+    ts, vals, sid, valid, gmap = (
+        x[0] for x in (ts, vals, sid, valid, gmap))
+    filled, in_range, sm = _local_filled(
+        ts, vals, sid, valid, num_series=series_per_shard,
+        num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+        lerp=agg_group not in NOLERP_AGGS, rate=rate,
+        counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)
+    # Local per-(group, bucket) partial moments via one fused segment
+    # reduction over the [S, B] contribution grid.
+    b_idx = jnp.arange(num_buckets, dtype=jnp.int32)
+    gb = gmap[:, None] * num_buckets + b_idx[None, :]
+    gn = num_groups * num_buckets + 1
+    gseg = jnp.where(in_range, gb,
+                     num_groups * num_buckets).reshape(-1)
+    flat_range = in_range.reshape(-1)
+    need = _needs(agg_group)
+    n, total, m2, mn, mx = _segment_moments(
+        filled.reshape(-1), gseg, flat_range, gn, need=need)
+    n, total, m2, mn, mx = (
+        None if x is None else x[:-1] for x in (n, total, m2, mn, mx))
+    # Chan et al. exact cross-chip moment combination per cell; each
+    # statistic combines only when the aggregator needs it.
+    g_n = jax.lax.psum(n, SERIES_AXIS)
+    g_total = g_m2 = g_mn = g_mx = None
+    if total is not None:
+        g_total = jax.lax.psum(total, SERIES_AXIS)
+    if m2 is not None:
+        mean = total / jnp.maximum(n, 1.0)
+        g_mean = g_total / jnp.maximum(g_n, 1.0)
+        g_m2 = jax.lax.psum(m2 + n * (mean - g_mean) ** 2,
+                            SERIES_AXIS)
+    if mn is not None:
+        g_mn = jax.lax.pmin(mn, SERIES_AXIS)
+    if mx is not None:
+        g_mx = jax.lax.pmax(mx, SERIES_AXIS)
+    out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
+    g_real = _multigroup_emission(sm, gmap, num_groups, num_buckets)
+    shape = (num_groups, num_buckets)
+    return out.reshape(shape)[None], g_real[None]
+
+
+SHARDED_MULTIGROUP_PLAN = ExecPlan(
+    name="sharded.downsample_multigroup", axis="series",
+    style="shard_map",
+    in_specs=(P(SERIES_AXIS),) * 5 + (P(),),
+    out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
+
+
 def sharded_downsample_multigroup(ts, vals, sid, valid, gmap, *, mesh,
                                   series_per_shard: int, num_groups: int,
                                   num_buckets: int, interval: int,
@@ -214,62 +304,51 @@ def sharded_downsample_multigroup(ts, vals, sid, valid, gmap, *, mesh,
     sharded_downsample_group. Returns (group_values [G, B],
     group_mask [G, B]) replicated on every chip.
     """
-
-    def shard_fn(ts, vals, sid, valid, gmap):
-        ts, vals, sid, valid, gmap = (
-            x[0] for x in (ts, vals, sid, valid, gmap))
-        filled, in_range, sm = _local_filled(
-            ts, vals, sid, valid, num_series=series_per_shard,
-            num_buckets=num_buckets, interval=interval, agg_down=agg_down,
-            lerp=agg_group not in NOLERP_AGGS, rate=rate,
-            counter_max=counter_max, reset_value=reset_value,
-            counter=counter, drop_resets=drop_resets)
-        # Local per-(group, bucket) partial moments via one fused segment
-        # reduction over the [S, B] contribution grid.
-        b_idx = jnp.arange(num_buckets, dtype=jnp.int32)
-        gb = gmap[:, None] * num_buckets + b_idx[None, :]
-        gn = num_groups * num_buckets + 1
-        gseg = jnp.where(in_range, gb,
-                         num_groups * num_buckets).reshape(-1)
-        flat_range = in_range.reshape(-1)
-        need = _needs(agg_group)
-        n, total, m2, mn, mx = _segment_moments(
-            filled.reshape(-1), gseg, flat_range, gn, need=need)
-        n, total, m2, mn, mx = (
-            None if x is None else x[:-1] for x in (n, total, m2, mn, mx))
-        # Chan et al. exact cross-chip moment combination per cell; each
-        # statistic combines only when the aggregator needs it.
-        g_n = jax.lax.psum(n, SERIES_AXIS)
-        g_total = g_m2 = g_mn = g_mx = None
-        if total is not None:
-            g_total = jax.lax.psum(total, SERIES_AXIS)
-        if m2 is not None:
-            mean = total / jnp.maximum(n, 1.0)
-            g_mean = g_total / jnp.maximum(g_n, 1.0)
-            g_m2 = jax.lax.psum(m2 + n * (mean - g_mean) ** 2,
-                                SERIES_AXIS)
-        if mn is not None:
-            g_mn = jax.lax.pmin(mn, SERIES_AXIS)
-        if mx is not None:
-            g_mx = jax.lax.pmax(mx, SERIES_AXIS)
-        out = _finish(agg_group, g_n, g_total, g_m2, g_mn, g_mx)
-        g_real = _multigroup_emission(sm, gmap, num_groups, num_buckets)
-        shape = (num_groups, num_buckets)
-        return out.reshape(shape)[None], g_real[None]
-
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(SERIES_AXIS),) * 5,
-        out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
-    group_values, group_mask = fn(ts, vals, sid, valid, gmap)
+    fn = compile_with_plan(
+        _sharded_multigroup_body, SHARDED_MULTIGROUP_PLAN, mesh,
+        statics=(("series_per_shard", series_per_shard),
+                 ("num_groups", num_groups),
+                 ("num_buckets", num_buckets), ("interval", interval),
+                 ("agg_down", agg_down), ("agg_group", agg_group),
+                 ("rate", rate), ("counter", counter),
+                 ("drop_resets", drop_resets)))
+    group_values, group_mask = fn(ts, vals, sid, valid, gmap,
+                                  _rate_params(counter_max,
+                                               reset_value))
     return group_values[0], group_mask[0]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("mesh", "series_per_shard", "num_groups",
-                     "num_buckets", "interval", "agg_down")
-    + _RATE_STATICS)
+def _sharded_multigroup_quantile_body(ts, vals, sid, valid, gmap, q,
+                                      rate_params, *, series_per_shard,
+                                      num_groups, num_buckets,
+                                      interval, agg_down, rate,
+                                      counter, drop_resets):
+    counter_max, reset_value = rate_params[0, 0], rate_params[0, 1]
+    ts, vals, sid, valid, gmap = (
+        x[0] for x in (ts, vals, sid, valid, gmap))
+    filled, in_range, sm = _local_filled(
+        ts, vals, sid, valid, num_series=series_per_shard,
+        num_buckets=num_buckets, interval=interval, agg_down=agg_down,
+        rate=rate, counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)
+    all_filled = jax.lax.all_gather(filled, SERIES_AXIS)
+    all_range = jax.lax.all_gather(in_range, SERIES_AXIS)
+    all_gmap = jax.lax.all_gather(gmap, SERIES_AXIS).reshape(-1)
+    S = all_filled.shape[0] * all_filled.shape[1]
+    gv = masked_quantile_groups(
+        all_filled.reshape(S, -1), all_range.reshape(S, -1),
+        all_gmap, q[0], num_groups=num_groups)[0]
+    g_real = _multigroup_emission(sm, gmap, num_groups, num_buckets)
+    return gv[None], g_real[None]
+
+
+SHARDED_MULTIGROUP_QUANTILE_PLAN = ExecPlan(
+    name="sharded.downsample_multigroup_quantile", axis="series",
+    style="shard_map",
+    in_specs=(P(SERIES_AXIS),) * 5 + (P(), P()),
+    out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
+
+
 def sharded_downsample_multigroup_quantile(
         ts, vals, sid, valid, gmap, q, *, mesh, series_per_shard: int,
         num_groups: int, num_buckets: int, interval: int, agg_down: str,
@@ -285,69 +364,153 @@ def sharded_downsample_multigroup_quantile(
     radix select (ops.kernels.masked_quantile_groups) on the full set —
     the same gather shape as sharded_downsample_quantile. Returns
     (group_values [G, B] for q[0], group_mask [G, B]) replicated."""
-
-    def shard_fn(ts, vals, sid, valid, gmap, q):
-        ts, vals, sid, valid, gmap = (
-            x[0] for x in (ts, vals, sid, valid, gmap))
-        filled, in_range, sm = _local_filled(
-            ts, vals, sid, valid, num_series=series_per_shard,
-            num_buckets=num_buckets, interval=interval, agg_down=agg_down,
-            rate=rate, counter_max=counter_max, reset_value=reset_value,
-            counter=counter, drop_resets=drop_resets)
-        all_filled = jax.lax.all_gather(filled, SERIES_AXIS)
-        all_range = jax.lax.all_gather(in_range, SERIES_AXIS)
-        all_gmap = jax.lax.all_gather(gmap, SERIES_AXIS).reshape(-1)
-        S = all_filled.shape[0] * all_filled.shape[1]
-        gv = masked_quantile_groups(
-            all_filled.reshape(S, -1), all_range.reshape(S, -1),
-            all_gmap, q[0], num_groups=num_groups)[0]
-        g_real = _multigroup_emission(sm, gmap, num_groups, num_buckets)
-        return gv[None], g_real[None]
-
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(SERIES_AXIS),) * 5 + (P(),),
-        out_specs=(P(SERIES_AXIS), P(SERIES_AXIS)))
-    group_values, group_mask = fn(ts, vals, sid, valid, gmap, q[None])
+    fn = compile_with_plan(
+        _sharded_multigroup_quantile_body,
+        SHARDED_MULTIGROUP_QUANTILE_PLAN, mesh,
+        statics=(("series_per_shard", series_per_shard),
+                 ("num_groups", num_groups),
+                 ("num_buckets", num_buckets), ("interval", interval),
+                 ("agg_down", agg_down), ("rate", rate),
+                 ("counter", counter), ("drop_resets", drop_resets)))
+    group_values, group_mask = fn(ts, vals, sid, valid, gmap, q[None],
+                                  _rate_params(counter_max,
+                                               reset_value))
     return group_values[0], group_mask[0]
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "p"))
+def _sharded_hll_body(items, valid, *, p):
+    regs = sketches.hll_init(p)
+    regs = sketches.hll_add(regs, items[0], valid[0], p=p)
+    merged = jax.lax.pmax(regs, SERIES_AXIS)
+    return sketches.hll_estimate(merged)[None]
+
+
+SHARDED_HLL_PLAN = ExecPlan(
+    name="sharded.hll_distinct", axis="series", style="shard_map",
+    in_specs=(P(SERIES_AXIS), P(SERIES_AXIS)),
+    out_specs=P(SERIES_AXIS))
+
+
 def sharded_hll_distinct(items, valid, *, mesh, p: int = 14):
     """Distinct count over [D, N_shard] sharded items: local HLL registers,
     pmax merge across chips, single estimate."""
-
-    def shard_fn(items, valid):
-        regs = sketches.hll_init(p)
-        regs = sketches.hll_add(regs, items[0], valid[0], p=p)
-        merged = jax.lax.pmax(regs, SERIES_AXIS)
-        return sketches.hll_estimate(merged)[None]
-
-    fn = shard_map(shard_fn, mesh=mesh,
-                       in_specs=(P(SERIES_AXIS), P(SERIES_AXIS)),
-                       out_specs=P(SERIES_AXIS))
+    fn = compile_with_plan(_sharded_hll_body, SHARDED_HLL_PLAN, mesh,
+                           statics=(("p", p),))
     return fn(items, valid)[0]
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "compression"))
+def _sharded_tdigest_body(values, valid, qs, *, compression):
+    means, weights = sketches.tdigest_init(compression)
+    means, weights = sketches.tdigest_add(
+        means, weights, values[0], valid[0], compression=compression)
+    all_means = jax.lax.all_gather(means, SERIES_AXIS).reshape(-1)
+    all_weights = jax.lax.all_gather(weights, SERIES_AXIS).reshape(-1)
+    m, w = sketches._compress(all_means, all_weights,
+                              compression=compression)
+    return sketches.tdigest_quantile(m, w, qs[0])[None]
+
+
+SHARDED_TDIGEST_PLAN = ExecPlan(
+    name="sharded.tdigest", axis="series", style="shard_map",
+    in_specs=(P(SERIES_AXIS), P(SERIES_AXIS), P()),
+    out_specs=P(SERIES_AXIS))
+
+
 def sharded_tdigest(values, valid, qs, *, mesh, compression: int = 128):
     """Quantiles over [D, N_shard] sharded values: local digests,
     all_gather + recompress, shared quantile answer."""
+    import numpy as np
+    fn = compile_with_plan(_sharded_tdigest_body, SHARDED_TDIGEST_PLAN,
+                           mesh, statics=(("compression", compression),))
+    return fn(values, valid, np.asarray(qs, np.float32)[None])[0]
 
-    def shard_fn(values, valid):
-        means, weights = sketches.tdigest_init(compression)
-        means, weights = sketches.tdigest_add(
-            means, weights, values[0], valid[0], compression=compression)
-        all_means = jax.lax.all_gather(means, SERIES_AXIS).reshape(-1)
-        all_weights = jax.lax.all_gather(weights, SERIES_AXIS).reshape(-1)
-        m, w = sketches._compress(all_means, all_weights,
-                                  compression=compression)
-        return sketches.tdigest_quantile(m, w, qs)[None]
 
-    fn = shard_map(shard_fn, mesh=mesh,
-                       in_specs=(P(SERIES_AXIS), P(SERIES_AXIS)),
-                       out_specs=P(SERIES_AXIS))
-    return fn(values, valid)[0]
+# ---------------------------------------------------------------------------
+# Mesh-sharded rollup window fold
+# ---------------------------------------------------------------------------
+
+def _sharded_window_fold_body(ts, vals, sid, valid, *, series_per_shard,
+                              num_windows, res):
+    """Per-shard half of the rollup window fold: summarize the local
+    series' points into per-(series, window) record columns. Everything
+    is shard-local (a series lives wholly on one shard — the
+    series-hash axis), so the cross-shard combine is a pure
+    ``all_gather``: byte-exact, no arithmetic crosses the mesh."""
+    ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
+    nseg = series_per_shard * num_windows + 1
+    widx = jnp.clip(ts // res, 0, num_windows - 1)
+    seg = jnp.where(valid, sid * num_windows + widx, nseg - 1)
+    count = jax.ops.segment_sum(valid.astype(jnp.float32), seg, nseg)
+    total = jax.ops.segment_sum(jnp.where(valid, vals, 0.0), seg, nseg)
+    mn = jax.ops.segment_min(jnp.where(valid, vals, _POS_INF), seg, nseg)
+    mx = jax.ops.segment_max(jnp.where(valid, vals, _NEG_INF), seg, nseg)
+    # first/last ride the min/max member timestamp: points are
+    # deduplicated per series, so exactly one point matches and the
+    # masked segment_sum below is a pure select, not an addition.
+    big = jnp.int32(2**31 - 1)
+    t_min = jax.ops.segment_min(jnp.where(valid, ts, big), seg, nseg)
+    t_max = jax.ops.segment_max(jnp.where(valid, ts, -1), seg, nseg)
+    is_first = valid & (ts == t_min[seg])
+    is_last = valid & (ts == t_max[seg])
+    first = jax.ops.segment_sum(jnp.where(is_first, vals, 0.0), seg,
+                                nseg)
+    last = jax.ops.segment_sum(jnp.where(is_last, vals, 0.0), seg, nseg)
+    shape = (series_per_shard, num_windows)
+
+    def grid(x):
+        return x[:-1].reshape(shape)
+
+    # The timestamp planes ride the f32 tensor BITCAST, not cast: a
+    # float32 cast rounds offsets past 2^24 s (~194 days from the fold
+    # origin) by whole seconds — silently, since short-span parity
+    # tests never notice. The host side bitcasts back to int32.
+    out = jnp.stack([grid(count), grid(total), grid(mn), grid(mx),
+                     grid(first), grid(last),
+                     grid(jax.lax.bitcast_convert_type(
+                         t_min, jnp.float32)),
+                     grid(jax.lax.bitcast_convert_type(
+                         t_max, jnp.float32))])
+    # [8, S_local, W] per shard; the plane's out_spec concatenates the
+    # shards along a leading mesh axis -> [D, 8, S_local, W].
+    return out[None]
+
+
+SHARDED_WINDOW_FOLD_PLAN = ExecPlan(
+    name="rollup.window_fold", axis="series", style="shard_map",
+    in_specs=(P(SERIES_AXIS),) * 4,
+    out_specs=P(SERIES_AXIS))
+
+
+def sharded_window_fold(ts, vals, sid, valid, *, mesh,
+                        series_per_shard: int, num_windows: int,
+                        res: int):
+    """Rollup window fold sharded over the mesh's series-hash axis.
+
+    Args are [D, N_shard] stacked shards (``pack_shards`` layout;
+    ``ts`` are offsets from the fold's window-grid origin, deduplicated
+    per series). Returns [D, 8, series_per_shard, num_windows] float32
+    grids — count, sum, min, max, first, last, first_ts, last_ts per
+    (shard-local series, window); the two timestamp planes are int32
+    BITCAST into the f32 tensor (view them back with
+    ``.view(np.int32)``) so offsets past 2^24 s stay exact.
+    ``shard_placement`` maps (d, local) back to global series.
+
+    Byte-exactness contract: a series' points never split across
+    shards, every reduction is shard-local, and the combine is an
+    all_gather — so the sharded fold is bit-identical to the same
+    kernel on a 1-device mesh over the same per-series point order
+    (proven at shards 1 vs 4 in tests/test_mesh_plane.py and across
+    real gloo processes by scripts/multihost_run.py --plane). The
+    CHECKPOINT fold (rollup/tier.py) deliberately stays on the float64
+    host twin — stored records must stay bit-comparable with raw
+    float64 scans; this kernel serves the read-side/mesh batteries
+    (rollup/summary.py window_summaries_sharded).
+    """
+    fn = compile_with_plan(
+        _sharded_window_fold_body, SHARDED_WINDOW_FOLD_PLAN, mesh,
+        statics=(("series_per_shard", series_per_shard),
+                 ("num_windows", num_windows), ("res", res)))
+    return fn(ts, vals, sid, valid)
 
 
 # ---------------------------------------------------------------------------

@@ -36,8 +36,6 @@ the collectives ride ICI on a real pod.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -55,7 +53,9 @@ from opentsdb_tpu.ops.kernels import (
     masked_quantile_axis0,
     step_fill,
 )
-from opentsdb_tpu.parallel.mesh import TIME_AXIS, shard_map
+from opentsdb_tpu.parallel.compile import compile_with_plan
+from opentsdb_tpu.parallel.mesh import TIME_AXIS
+from opentsdb_tpu.parallel.plan import ExecPlan
 
 _I32_BIG = np.int32(2**31 - 1)
 
@@ -139,11 +139,70 @@ def _cross_tile_gap_fill(series_values, series_mask, *, d, bps):
                     right_idx=right_idx, right_val=right_val)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("mesh", "num_series", "buckets_per_shard", "interval",
-                     "agg_down", "agg_group", "rate", "counter",
-                     "drop_resets"))
+def _timeshard_group_body(ts, vals, sid, valid, q, rate_params, *,
+                          num_series, buckets_per_shard, interval,
+                          agg_down, agg_group, rate, counter,
+                          drop_resets, with_quantile):
+    """Per-tile body of timeshard_downsample_group; ``q`` is the [1, 1]
+    replicated quantile array (ignored unless ``with_quantile``) —
+    traced, so p50/p90/p99 over one range share a single compile.
+    ``rate_params`` [1, 2] carries (counter_max, reset_value) traced:
+    client-controlled values must never be compile statics."""
+    counter_max, reset_value = rate_params[0, 0], rate_params[0, 1]
+    bps = buckets_per_shard
+    ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
+    d = jax.lax.axis_index(TIME_AXIS).astype(jnp.int32)
+    # Tile-local bucketing: tiles are bucket-aligned so no bucket
+    # straddles chips; every point's bucket is chip-local.
+    local = ts - d * bps * interval
+    bucket = jnp.clip(local // interval, 0, bps - 1)
+    seg = jnp.where(valid, sid * bps + bucket, num_series * bps)
+    nseg = num_series * bps + 1
+    count, total, m2, mn, mx = _segment_moments(
+        vals, seg, valid, nseg, need=_needs(agg_down))
+    per = _finish(agg_down, count, total, m2, mn, mx)
+    shape = (num_series, bps)
+    series_values = per[:-1].reshape(shape)
+    series_mask = count[:-1].reshape(shape) > 0
+
+    if rate:
+        l_i, l_v, _, _ = _cross_tile_edges(
+            series_values, series_mask, d=d, bps=bps)
+        series_values, series_mask = bucket_rate(
+            series_values, series_mask, interval, counter_max,
+            reset_value, counter=counter, drop_resets=drop_resets,
+            glob_offset=d * bps, left_idx=l_i, left_val=l_v)
+
+    if agg_group in NOLERP_AGGS and not with_quantile:
+        # No-lerp family: no cross-tile carries needed either — a
+        # series contributes only where it has a real bucket.
+        filled, in_range = series_values, series_mask
+    elif rate:
+        # Rates step-hold; edges recomputed on the post-rate grid.
+        l_i, l_v, r_i, _ = _cross_tile_edges(
+            series_values, series_mask, d=d, bps=bps)
+        filled, in_range = step_fill(
+            series_values, series_mask, bps,
+            left_idx=l_i, left_val=l_v, right_idx=r_i)
+    else:
+        filled, in_range = _cross_tile_gap_fill(
+            series_values, series_mask, d=d, bps=bps)
+    if with_quantile:
+        group_values = masked_quantile_axis0(filled, in_range, q[0])[0]
+    else:
+        g_n, g_total, g_m2, _, g_mn, g_mx = group_moments(
+            filled, in_range)
+        group_values = _finish(agg_group, g_n, g_total, g_m2, g_mn,
+                               g_mx)
+    return group_values, series_mask.any(axis=0)
+
+
+TIMESHARD_GROUP_PLAN = ExecPlan(
+    name="timeshard.downsample_group", axis="time", style="shard_map",
+    in_specs=(P(TIME_AXIS),) * 4 + (P(), P()),
+    out_specs=(P(TIME_AXIS), P(TIME_AXIS)))
+
+
 def timeshard_downsample_group(ts, vals, sid, valid, *, mesh,
                                num_series: int, buckets_per_shard: int,
                                interval: int, agg_down: str, agg_group: str,
@@ -177,67 +236,95 @@ def timeshard_downsample_group(ts, vals, sid, valid, *, mesh,
     Returns (group_values [D*bps], group_mask [D*bps]) — the full bucket
     grid, concatenated across tiles by shard_map's output spec.
     """
-    bps = buckets_per_shard
-
-    def shard_fn(ts, vals, sid, valid):
-        ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
-        d = jax.lax.axis_index(TIME_AXIS).astype(jnp.int32)
-        # Tile-local bucketing: tiles are bucket-aligned so no bucket
-        # straddles chips; every point's bucket is chip-local.
-        local = ts - d * bps * interval
-        bucket = jnp.clip(local // interval, 0, bps - 1)
-        seg = jnp.where(valid, sid * bps + bucket, num_series * bps)
-        nseg = num_series * bps + 1
-        count, total, m2, mn, mx = _segment_moments(
-            vals, seg, valid, nseg, need=_needs(agg_down))
-        per = _finish(agg_down, count, total, m2, mn, mx)
-        shape = (num_series, bps)
-        series_values = per[:-1].reshape(shape)
-        series_mask = count[:-1].reshape(shape) > 0
-
-        if rate:
-            l_i, l_v, _, _ = _cross_tile_edges(
-                series_values, series_mask, d=d, bps=bps)
-            series_values, series_mask = bucket_rate(
-                series_values, series_mask, interval, counter_max,
-                reset_value, counter=counter, drop_resets=drop_resets,
-                glob_offset=d * bps, left_idx=l_i, left_val=l_v)
-
-        if agg_group in NOLERP_AGGS and quantile is None:
-            # No-lerp family: no cross-tile carries needed either — a
-            # series contributes only where it has a real bucket.
-            filled, in_range = series_values, series_mask
-        elif rate:
-            # Rates step-hold; edges recomputed on the post-rate grid.
-            l_i, l_v, r_i, _ = _cross_tile_edges(
-                series_values, series_mask, d=d, bps=bps)
-            filled, in_range = step_fill(
-                series_values, series_mask, bps,
-                left_idx=l_i, left_val=l_v, right_idx=r_i)
-        else:
-            filled, in_range = _cross_tile_gap_fill(
-                series_values, series_mask, d=d, bps=bps)
-        if quantile is not None:
-            group_values = masked_quantile_axis0(
-                filled, in_range,
-                jnp.array([quantile], jnp.float32))[0]
-        else:
-            g_n, g_total, g_m2, _, g_mn, g_mx = group_moments(
-                filled, in_range)
-            group_values = _finish(agg_group, g_n, g_total, g_m2, g_mn,
-                                   g_mx)
-        return group_values, series_mask.any(axis=0)
-
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS)),
-        out_specs=(P(TIME_AXIS), P(TIME_AXIS)))
-    return fn(ts, vals, sid, valid)
+    fn = compile_with_plan(
+        _timeshard_group_body, TIMESHARD_GROUP_PLAN, mesh,
+        statics=(("num_series", num_series),
+                 ("buckets_per_shard", buckets_per_shard),
+                 ("interval", interval), ("agg_down", agg_down),
+                 ("agg_group", agg_group), ("rate", rate),
+                 ("counter", counter), ("drop_resets", drop_resets),
+                 ("with_quantile", quantile is not None)))
+    q = np.asarray([0.0 if quantile is None else quantile],
+                   np.float32)[None]
+    rp = np.asarray([[counter_max, reset_value]], np.float32)
+    return fn(ts, vals, sid, valid, q, rp)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("mesh", "num_series", "counter", "drop_resets"))
+def _timeshard_rate_body(ts, vals, sid, valid, rate_params, *,
+                         num_series, counter, drop_resets):
+    """Per-point rate with the time axis sharded: each tile's first point
+    per series differences against a carried-in predecessor found by an
+    ``all_gather`` of per-series (last_ts, last_val) tile summaries — a
+    gap can span many tiles, so the nearest predecessor may live on any
+    earlier tile, not just the neighbor.
+
+    Args are [D, N_tile]; each tile's points must be sorted by (sid, ts)
+    and tile d's timestamps all precede tile d+1's (per series). Matches
+    ops.kernels.flat_rate run on the globally concatenated sorted arrays:
+    the first point of each series overall has no rate; first points of
+    later tiles difference against the carried-in predecessor.
+
+    Returns (rates [D, N_tile], ok [D, N_tile]) — shaped for
+    the plane's out_specs; the wrapper returns them directly.
+    """
+    counter_max, reset_value = rate_params[0, 0], rate_params[0, 1]
+    ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
+    n = ts.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    seg = jnp.where(valid, sid, num_series)
+    nseg = num_series + 1
+
+    # Per-series last valid point in this tile.
+    last_pos = jax.ops.segment_max(
+        jnp.where(valid, pos, -1), seg, nseg)[:num_series]
+    has_last = last_pos >= 0
+    lp = jnp.clip(last_pos, 0, n - 1)
+    tile_last_ts = ts[lp]
+    tile_last_val = vals[lp]
+
+    # Nearest predecessor per series across *all* earlier tiles: a
+    # series may be absent from whole tiles, so a one-hop neighbor
+    # exchange isn't enough; gather the tiny [D, S] summaries (one
+    # stacked collective, values bitcast to int32) and max-scan for
+    # the closest earlier tile that has the series.
+    d = jax.lax.axis_index(TIME_AXIS).astype(jnp.int32)
+    payload = jnp.stack([
+        has_last.astype(jnp.int32), tile_last_ts,
+        jax.lax.bitcast_convert_type(tile_last_val, jnp.int32),
+    ], axis=1)
+    allp = jax.lax.all_gather(payload, TIME_AXIS)  # [D, S, 3]
+    all_has = allp[:, :, 0] > 0
+    all_ts = allp[:, :, 1]
+    all_val = jax.lax.bitcast_convert_type(allp[:, :, 2], jnp.float32)
+    dev = jnp.arange(all_has.shape[0], dtype=jnp.int32)
+    cand = jnp.where((dev[:, None] < d) & all_has, dev[:, None], -1)
+    sel = jnp.argmax(cand, axis=0)
+    has_carry = jnp.take_along_axis(cand, sel[None, :], axis=0)[0] >= 0
+    carry_ts = jnp.take_along_axis(all_ts, sel[None, :], axis=0)[0]
+    carry_val = jnp.take_along_axis(all_val, sel[None, :], axis=0)[0]
+
+    # First valid point of each series in this tile uses the carry;
+    # the shared _flat_rate core does the differences and
+    # counter/reset semantics (one implementation for both paths).
+    first_pos = jax.ops.segment_min(
+        jnp.where(valid, pos, _I32_BIG), seg, nseg)[:num_series]
+    sidc = jnp.clip(sid, 0, num_series - 1)
+    is_first = valid & (pos == first_pos[sidc])
+    use_carry = is_first & has_carry[sidc]
+    r, ok = _flat_rate(
+        ts, vals, sid, valid, counter_max, reset_value,
+        counter=counter, drop_resets=drop_resets,
+        carry_ts=carry_ts[sidc], carry_val=carry_val[sidc],
+        use_carry=use_carry)
+    return r[None], ok[None]
+
+
+TIMESHARD_RATE_PLAN = ExecPlan(
+    name="timeshard.rate", axis="time", style="shard_map",
+    in_specs=(P(TIME_AXIS),) * 4 + (P(),),
+    out_specs=(P(TIME_AXIS), P(TIME_AXIS)))
+
+
 def timeshard_rate(ts, vals, sid, valid, *, mesh, num_series: int,
                    counter_max: float = 0.0, reset_value: float = 0.0,
                    counter: bool = False, drop_resets: bool = False):
@@ -255,63 +342,12 @@ def timeshard_rate(ts, vals, sid, valid, *, mesh, num_series: int,
 
     Returns (rates [D, N_tile], ok [D, N_tile]).
     """
-
-    def shard_fn(ts, vals, sid, valid):
-        ts, vals, sid, valid = (x[0] for x in (ts, vals, sid, valid))
-        n = ts.shape[0]
-        pos = jnp.arange(n, dtype=jnp.int32)
-        seg = jnp.where(valid, sid, num_series)
-        nseg = num_series + 1
-
-        # Per-series last valid point in this tile.
-        last_pos = jax.ops.segment_max(
-            jnp.where(valid, pos, -1), seg, nseg)[:num_series]
-        has_last = last_pos >= 0
-        lp = jnp.clip(last_pos, 0, n - 1)
-        tile_last_ts = ts[lp]
-        tile_last_val = vals[lp]
-
-        # Nearest predecessor per series across *all* earlier tiles: a
-        # series may be absent from whole tiles, so a one-hop neighbor
-        # exchange isn't enough; gather the tiny [D, S] summaries (one
-        # stacked collective, values bitcast to int32) and max-scan for
-        # the closest earlier tile that has the series.
-        d = jax.lax.axis_index(TIME_AXIS).astype(jnp.int32)
-        payload = jnp.stack([
-            has_last.astype(jnp.int32), tile_last_ts,
-            jax.lax.bitcast_convert_type(tile_last_val, jnp.int32),
-        ], axis=1)
-        allp = jax.lax.all_gather(payload, TIME_AXIS)  # [D, S, 3]
-        all_has = allp[:, :, 0] > 0
-        all_ts = allp[:, :, 1]
-        all_val = jax.lax.bitcast_convert_type(allp[:, :, 2], jnp.float32)
-        dev = jnp.arange(all_has.shape[0], dtype=jnp.int32)
-        cand = jnp.where((dev[:, None] < d) & all_has, dev[:, None], -1)
-        sel = jnp.argmax(cand, axis=0)
-        has_carry = jnp.take_along_axis(cand, sel[None, :], axis=0)[0] >= 0
-        carry_ts = jnp.take_along_axis(all_ts, sel[None, :], axis=0)[0]
-        carry_val = jnp.take_along_axis(all_val, sel[None, :], axis=0)[0]
-
-        # First valid point of each series in this tile uses the carry;
-        # the shared _flat_rate core does the differences and
-        # counter/reset semantics (one implementation for both paths).
-        first_pos = jax.ops.segment_min(
-            jnp.where(valid, pos, _I32_BIG), seg, nseg)[:num_series]
-        sidc = jnp.clip(sid, 0, num_series - 1)
-        is_first = valid & (pos == first_pos[sidc])
-        use_carry = is_first & has_carry[sidc]
-        r, ok = _flat_rate(
-            ts, vals, sid, valid, counter_max, reset_value,
-            counter=counter, drop_resets=drop_resets,
-            carry_ts=carry_ts[sidc], carry_val=carry_val[sidc],
-            use_carry=use_carry)
-        return r[None], ok[None]
-
-    fn = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS), P(TIME_AXIS)),
-        out_specs=(P(TIME_AXIS), P(TIME_AXIS)))
-    return fn(ts, vals, sid, valid)
+    fn = compile_with_plan(
+        _timeshard_rate_body, TIMESHARD_RATE_PLAN, mesh,
+        statics=(("num_series", num_series), ("counter", counter),
+                 ("drop_resets", drop_resets)))
+    rp = np.asarray([[counter_max, reset_value]], np.float32)
+    return fn(ts, vals, sid, valid, rp)
 
 
 # ---------------------------------------------------------------------------

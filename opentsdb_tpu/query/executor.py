@@ -37,7 +37,8 @@ import jax
 import numpy as np
 
 from opentsdb_tpu.core import codec
-from opentsdb_tpu.core.const import MAX_TIMESPAN, TIMESTAMP_BYTES, UID_WIDTH
+from opentsdb_tpu.core.const import (MAX_TIMESPAN, NOLERP_AGGS,
+                                     TIMESTAMP_BYTES, UID_WIDTH)
 from opentsdb_tpu.core.errors import BadRequestError
 from opentsdb_tpu.fault.faultpoints import fire as _fault
 from opentsdb_tpu.obs import trace as obs_trace
@@ -123,6 +124,13 @@ class QueryExecutor:
         scale-up axis)."""
         self.tsdb = tsdb
         self.backend = backend or tsdb.config.backend
+        if mesh is not None:
+            # The query kernels shard over the series-hash axis; any
+            # (host, series) factorization flattens here — the hybrid
+            # structure matters to the DCN-aware multihost kernels,
+            # not to dashboard reductions.
+            from opentsdb_tpu.parallel.plan import flatten_series_mesh
+            mesh = flatten_series_mesh(mesh)
         self.mesh = mesh
         # Scan-phase latency digest, the analog of TsdbQuery.scanlatency
         # (reference src/core/TsdbQuery.java:52,278).
@@ -563,6 +571,123 @@ class QueryExecutor:
                     "error": None}
         return results, plan, cached, info
 
+    # -- expert-parallel dashboard batches ----------------------------
+
+    def run_expert_batch(self, specs: "list[QuerySpec]", start: int,
+                         end: int):
+        """Serve a whole mixed dashboard batch in ONE mesh dispatch.
+
+        With a mesh configured (Config.mesh_shape) and expert serving
+        on (Config.expert_parallel), heterogeneous `/q` sub-queries —
+        mixed sum/avg/dev panels and pNN percentile panels — pack into
+        expert buckets (parallel/expert.py run_dashboard_batch): the
+        mesh partitions by aggregator family and every family's slots
+        run concurrently under one program, so a mixed batch costs
+        ~max(family) wall-clock instead of sum(sub-queries). Answers
+        match the serial leg's fused kernels (f32 tolerance: group
+        sums reduce in a shared-padding order).
+
+        Returns ``(per_spec_results, None)`` on success or
+        ``(None, reason)`` on a DECLINE — the caller reports the
+        decline (`plan: "expert-decline"` per result + counter, the
+        TSINT fused-decline discipline) and runs the serial leg.
+        Declines are exact-or-fall-back, never approximate: ragged
+        intervals, rate/no-lerp aggregators, non-moment downsamplers,
+        int32-unsafe ranges all fall off the path loudly.
+        """
+        from opentsdb_tpu.parallel.expert import DASH_AGG_ID
+        if self.mesh is None:
+            return None, "no-mesh"
+        if int(self.mesh.devices.size) < 2:
+            return None, "single-device-mesh"
+        if self.backend == "cpu":
+            return None, "cpu-backend"
+        if len(specs) < 2:
+            return None, "single-query"
+        if end <= start:
+            raise BadRequestError(
+                f"end time {end} is <= start time {start}")
+        intervals = set()
+        for spec in specs:
+            if not spec.downsample:
+                return None, "no-downsample"
+            interval, dsagg = spec.downsample
+            ds = NOLERP_AGGS.get(dsagg, dsagg)
+            if (Aggregators.get(dsagg).kind != "moment"
+                    or ds not in DASH_AGG_ID):
+                return None, "downsampler"
+            agg = Aggregators.get(spec.aggregator)
+            if agg.kind == "moment":
+                if (spec.aggregator in NOLERP_AGGS
+                        or spec.aggregator not in DASH_AGG_ID):
+                    # The no-lerp family skips gap filling; the dash
+                    # kernel is the lerp family only.
+                    return None, "no-lerp-agg"
+            elif agg.kind != "percentile":
+                return None, "agg-family"
+            if spec.rate:
+                return None, "rate"
+            intervals.add(interval)
+        if len(intervals) != 1:
+            # Mixed downsample intervals = ragged bucket grids: slots
+            # must share one static [S, B] layout.
+            return None, "ragged-intervals"
+        interval = intervals.pop()
+        qbase = start - start % interval
+        if end - qbase > 2**31 - 1:
+            return None, "range"
+        num_buckets = _pad_size(int((end - qbase) // interval + 1))
+        per_spec_groups = []
+        s_max = 1
+        for spec in specs:
+            with obs_trace.span("scan"):
+                groups = self._find_spans(spec, start, end)
+            per_spec_groups.append(groups)
+            for spans in groups.values():
+                s_max = max(s_max, len(spans))
+        S = _pad_size(s_max)
+        if S * num_buckets >= 2**31:
+            return None, "grid"
+        queries = []
+        refs = []
+        for si, (spec, groups) in enumerate(zip(specs,
+                                                per_spec_groups)):
+            _, dsagg = spec.downsample
+            ds = NOLERP_AGGS.get(dsagg, dsagg)
+            agg = Aggregators.get(spec.aggregator)
+            for gkey in sorted(groups):
+                spans = groups[gkey]
+                rel, vals, sid, valid = self._flatten_spans(spans,
+                                                            qbase)
+                qq = {"family": ("percentile"
+                                 if agg.kind == "percentile"
+                                 else "moment"),
+                      "ts": rel, "vals": vals, "sid": sid,
+                      "dsagg": ds}
+                if agg.kind == "percentile":
+                    qq["quantile"] = agg.quantile
+                else:
+                    qq["agg"] = spec.aggregator
+                queries.append(qq)
+                refs.append((si, spans))
+        per_spec: list[list[QueryResult]] = [[] for _ in specs]
+        if not queries:
+            return per_spec, None
+        from opentsdb_tpu.parallel.expert import run_dashboard_batch
+        with obs_trace.span("aggregate"):
+            got = run_dashboard_batch(
+                queries, self.mesh, num_series=S,
+                num_buckets=num_buckets, interval=interval)
+        for (si, spans), (gv, gm) in zip(refs, got):
+            tags, aggregated = self._group_tags(spans)
+            mask = np.asarray(gm)
+            grid_ts = (np.flatnonzero(mask).astype(np.int64) * interval
+                       + qbase)
+            per_spec[si].append(QueryResult(
+                specs[si].metric, tags, aggregated, grid_ts,
+                np.asarray(gv)[mask].astype(np.float64)))
+        return per_spec, None
+
     def _run_planned(self, spec: QuerySpec, start: int, end: int,
                      rollup_only: bool = False,
                      meta_out: dict | None = None,
@@ -957,7 +1082,7 @@ class QueryExecutor:
         risk, or int32 overflow declines to the scan path."""
         tsdb = self.tsdb
         cfg = tsdb.config
-        if (self.backend == "cpu" or self.mesh is not None
+        if (self.backend == "cpu"
                 or not spec.downsample
                 or agg.kind not in ("moment", "percentile")
                 or Aggregators.get(spec.downsample[1]).kind != "moment"
@@ -1057,17 +1182,44 @@ class QueryExecutor:
                 out = np.zeros(_pad_size(max(len(a), 1)), np.uint8)
                 out[:len(a)] = a
                 return out
-            stage = list(_ckernels.fused_block_stage(
-                pad(src.ts_nb, np.int32), padbuf(src.ts_pay),
-                pad(src.v_nb, np.int32), padbuf(src.v_pay),
-                pad(src.first_idx, np.int32),
-                pad(src.blk_first, np.int32),
-                pad(src.rel_base_pt, np.int32),
-                pad(np.minimum(src.sid_pt, S_pad - 1), np.int32),
-                pad(src.valid, bool, False),
-                lo32, hi32, shift32,
-                num_series=S_pad, num_buckets=num_buckets,
-                interval=interval, agg_down=dsagg, **rate_kw)) + [None]
+            # With a mesh configured the fused stage runs through the
+            # plane's pjit-preferred leg: the point stream (whole
+            # compressed blocks) shards over the mesh, payloads and
+            # the [S, B] outputs replicate (compress/kernels.py
+            # FUSED_STAGE_PLAN). Shapes that don't divide the mesh
+            # run the single-device compile — never a decline.
+            if (self.mesh is not None
+                    and P_pad % int(self.mesh.devices.size) == 0):
+                fused_fn = _ckernels.fused_block_stage_mesh(
+                    self.mesh, num_series=S_pad,
+                    num_buckets=num_buckets, interval=interval,
+                    agg_down=dsagg, rate=rate_kw["rate"],
+                    counter=rate_kw["counter"],
+                    drop_resets=rate_kw["drop_resets"])
+                stage = list(fused_fn(
+                    pad(src.ts_nb, np.int32), padbuf(src.ts_pay),
+                    pad(src.v_nb, np.int32), padbuf(src.v_pay),
+                    pad(src.first_idx, np.int32),
+                    pad(src.blk_first, np.int32),
+                    pad(src.rel_base_pt, np.int32),
+                    pad(np.minimum(src.sid_pt, S_pad - 1), np.int32),
+                    pad(src.valid, bool, False),
+                    lo32, hi32, shift32,
+                    np.float32(rate_kw["counter_max"]),
+                    np.float32(rate_kw["reset_value"]))) + [None]
+            else:
+                stage = list(_ckernels.fused_block_stage(
+                    pad(src.ts_nb, np.int32), padbuf(src.ts_pay),
+                    pad(src.v_nb, np.int32), padbuf(src.v_pay),
+                    pad(src.first_idx, np.int32),
+                    pad(src.blk_first, np.int32),
+                    pad(src.rel_base_pt, np.int32),
+                    pad(np.minimum(src.sid_pt, S_pad - 1), np.int32),
+                    pad(src.valid, bool, False),
+                    lo32, hi32, shift32,
+                    num_series=S_pad, num_buckets=num_buckets,
+                    interval=interval, agg_down=dsagg,
+                    **rate_kw)) + [None]
             # Key the entry on the SNAPSHOT the stage was actually
             # computed from (src.spans — not a fresh encoded_range,
             # which a checkpoint racing this query could have moved

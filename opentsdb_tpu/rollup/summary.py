@@ -422,3 +422,84 @@ def window_sketches(ts: np.ndarray, vals: np.ndarray, res: int,
                                         + len(moment))
         blobs.append(sketch_encode(m, w, regs, moment))
     return bases[starts], blobs
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded window fold (the execution plane's rollup-fold leg)
+# ---------------------------------------------------------------------------
+
+def window_summaries_sharded(series, res: int, mesh):
+    """Fold MANY series' points into per-window records across a mesh.
+
+    ``series``: [(ts int64 sorted+deduplicated, vals)] — the same
+    per-series inputs :func:`window_summaries` takes one at a time.
+    The fold shards over the mesh's series-hash axis via the execution
+    plane (parallel/sharded.sharded_window_fold): each device folds
+    its series block locally, the combine is an all_gather, so the
+    answer is BYTE-IDENTICAL across mesh widths (1 vs N devices —
+    proven in tests/test_mesh_plane.py and across real gloo processes
+    by scripts/multihost_run.py --plane).
+
+    Returns [(wbase int64 [W_i], rec float32 structured array with
+    count/sum/min/max/first/last/first_dt/last_dt)] per series.
+
+    float32, deliberately: this is the device fold for mesh batteries
+    and read-side aggregation pipelines. The CHECKPOINT fold stays on
+    the float64 host twin above — stored records carry the planner's
+    bit-exactness contract against raw float64 scans, which a float32
+    device sum cannot honor (the long-standing "no device round trips
+    at spill" design note).
+    """
+    from opentsdb_tpu.parallel.sharded import (
+        pack_shards,
+        shard_placement,
+        sharded_window_fold,
+    )
+
+    out_dtype = np.dtype([
+        ("count", "<f4"), ("sum", "<f4"), ("min", "<f4"),
+        ("max", "<f4"), ("first", "<f4"), ("last", "<f4"),
+        ("first_dt", "<u4"), ("last_dt", "<u4")])
+    if not series:
+        return []
+    nonempty = [i for i, (ts, _) in enumerate(series) if len(ts)]
+    results = [(np.empty(0, np.int64), np.empty(0, out_dtype))
+               for _ in series]
+    if not nonempty:
+        return results
+    origin = min(int(series[i][0][0]) for i in nonempty)
+    origin -= origin % res
+    hi = max(int(series[i][0][-1]) for i in nonempty)
+    num_windows = int((hi - origin) // res) + 1
+    D = int(mesh.devices.size)
+    packed = [((np.asarray(series[i][0], np.int64) - origin)
+               .astype(np.int64),
+               np.asarray(series[i][1], np.float32))
+              for i in nonempty]
+    ts, vals, sid, valid, sps = pack_shards(packed, D)
+    grids = np.asarray(sharded_window_fold(
+        ts, vals, sid, valid, mesh=mesh, series_per_shard=sps,
+        num_windows=num_windows, res=res))
+    place = shard_placement(len(packed), D)
+    for gi, (d, local) in zip(nonempty, place):
+        g = grids[d, :, local, :]                  # [8, W]
+        mask = g[0] > 0
+        w_idx = np.flatnonzero(mask)
+        rec = np.empty(len(w_idx), out_dtype)
+        rec["count"] = g[0][mask]
+        rec["sum"] = g[1][mask]
+        rec["min"] = g[2][mask]
+        rec["max"] = g[3][mask]
+        rec["first"] = g[4][mask]
+        rec["last"] = g[5][mask]
+        wbase = origin + w_idx.astype(np.int64) * res
+        # Timestamp planes are int32 bitcast into the f32 grid (exact
+        # past 2^24 s, unlike a float cast) — view the bits back.
+        t_min = np.ascontiguousarray(g[6][mask]).view(np.int32)
+        t_max = np.ascontiguousarray(g[7][mask]).view(np.int32)
+        rec["first_dt"] = (t_min.astype(np.int64)
+                           + origin - wbase).astype(np.uint32)
+        rec["last_dt"] = (t_max.astype(np.int64)
+                          + origin - wbase).astype(np.uint32)
+        results[gi] = (wbase, rec)
+    return results

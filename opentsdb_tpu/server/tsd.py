@@ -162,13 +162,29 @@ class TSDServer:
         self.tsdb = tsdb
         if executor is None:
             mesh = None
-            if tsdb.config.mesh_devices > 1:
+            shape = getattr(tsdb.config, "mesh_shape", "") or ""
+            if shape:
+                from opentsdb_tpu.parallel.plan import build_mesh
+
+                mesh = build_mesh(shape)
+            elif tsdb.config.mesh_devices > 1:
                 from opentsdb_tpu.parallel import make_mesh
 
                 mesh = make_mesh(tsdb.config.mesh_devices)
+            if mesh is not None:
+                from opentsdb_tpu.parallel.compile import \
+                    set_mesh_devices
+                set_mesh_devices(int(mesh.devices.size))
             executor = QueryExecutor(tsdb, mesh=mesh)
         self.executor = executor
         self.config = tsdb.config
+        # Expert-parallel dashboard serving: the knob alone arms the
+        # ATTEMPT — a knob-on daemon without a (multi-device) mesh
+        # still DECLARES the decline (plan: "expert-decline",
+        # mesh.expert.decline{reason=no-mesh}) instead of silently
+        # serving serially, so a misconfigured fleet is visible.
+        self.expert_enabled = bool(
+            getattr(self.config, "expert_parallel", False))
         if self.config.cachedir:
             # The /q disk cache writes <hash>.txt.tmp files here; create
             # the directory up front so a fresh --cachedir works without
@@ -1055,7 +1071,7 @@ class TSDServer:
         rollup answer that carries approx metadata counts BOTH)."""
         if plan.startswith("approx"):
             key = "approx"
-        elif plan in ("raw", "resident", "fused"):
+        elif plan in ("raw", "resident", "fused", "expert"):
             key = plan
         else:
             key = "rollup"
@@ -1099,11 +1115,30 @@ class TSDServer:
                 sketch[label + ".count"] = obj.count
                 sketch[label + ".p95"] = round(
                     obj.digest.percentile(95), 4)
+        from opentsdb_tpu.parallel.compile import cache_info
+        mesh_ex = getattr(self.executor, "mesh", None)
+        expert_counts = {"serve": 0, "decline": 0}
+        for name, kind, tkey, obj in METRICS._snapshot():
+            if name == "mesh.expert.serve":
+                expert_counts["serve"] += obj.value
+            elif name == "mesh.expert.decline":
+                expert_counts["decline"] += obj.value
         body = {
             "uptime_s": int(time.time()) - self.start_time,
             "plans": dict(self.plan_counts),
             "sketch": sketch,
             "rollup": rollup,
+            # The mesh execution plane's compile-cache line: devices
+            # in the configured mesh, plan-cache size/hit/miss (a
+            # steady dashboard should stop missing after warmup), and
+            # the expert serve/decline counters.
+            "mesh": {
+                "devices": (int(mesh_ex.devices.size)
+                            if mesh_ex is not None else 1),
+                "expert_enabled": bool(self.expert_enabled),
+                "compile_cache": cache_info(),
+                "expert": expert_counts,
+            },
             "qcache": {"hit": self.executor.qcache_hits,
                        "miss": self.executor.qcache_misses,
                        "bypass": self.executor.qcache_bypasses},
@@ -1404,14 +1439,70 @@ class TSDServer:
         result_cached: list[bool] = []
         result_traces: list[dict | None] = []
         result_approx: list[dict | None] = []
+        # Expert-parallel batch serving (parallel/expert.py, behind
+        # Config.expert_parallel + a mesh): a mixed multi-sub-query
+        # dashboard packs into expert buckets and runs in ONE mesh
+        # dispatch. Attempted only on the full-service path (tracing,
+        # the degrade ladder, and approx contracts keep their serial
+        # semantics); a decline is DECLARED — per-result
+        # plan: "expert-decline" + the mesh.expert.decline counter —
+        # and the batch serves serially, answers unchanged.
+        expert_label = None
+        expert_specs: list | None = None
+        if (self.expert_enabled and len(ms) >= 2 and not do_trace
+                and not degrade and not aspec.enabled):
+            specs = []
+            for m in ms:
+                parsed = parse_m(m)
+                specs.append(QuerySpec(
+                    metric=parsed.metric, tags=parsed.tags,
+                    aggregator=parsed.aggregator, rate=parsed.rate,
+                    downsample=parsed.downsample,
+                    counter=parsed.counter,
+                    counter_max=parsed.counter_max,
+                    reset_value=parsed.reset_value))
+            per_spec, reason = await loop.run_in_executor(
+                self._pool,
+                functools.partial(self.executor.run_expert_batch,
+                                  specs, start, end))
+            if per_spec is not None:
+                # Counters bump PER SUB-QUERY, the serial loop's unit —
+                # the /queries plans table must not mix units across a
+                # mesh rollout.
+                METRICS.counter("mesh.expert.serve").inc(len(ms))
+                for _ in ms:
+                    self._note_plan("expert")
+                expert_label = "expert"
+                for mi, rs in enumerate(per_spec):
+                    results.extend(rs)
+                    result_opts.extend(
+                        [os_[mi] if mi < len(os_) else ""] * len(rs))
+                    result_plans.extend(["expert"] * len(rs))
+                    result_cached.extend([False] * len(rs))
+                    result_traces.extend([None] * len(rs))
+                    result_approx.extend([None] * len(rs))
+                ms = ()
+            else:
+                METRICS.counter("mesh.expert.decline",
+                                {"reason": reason}).inc(len(ms))
+                self.plan_counts["expert-decline"] = \
+                    self.plan_counts.get("expert-decline", 0) + len(ms)
+                expert_label = "expert-decline"
+                # The serial fallback reuses the parsed specs — a
+                # declined batch must not pay the parse twice.
+                expert_specs = specs
         for mi, m in enumerate(ms):
-            parsed = parse_m(m)
-            spec = QuerySpec(
-                metric=parsed.metric, tags=parsed.tags,
-                aggregator=parsed.aggregator, rate=parsed.rate,
-                downsample=parsed.downsample, counter=parsed.counter,
-                counter_max=parsed.counter_max,
-                reset_value=parsed.reset_value)
+            if expert_specs is not None:
+                spec = expert_specs[mi]
+            else:
+                parsed = parse_m(m)
+                spec = QuerySpec(
+                    metric=parsed.metric, tags=parsed.tags,
+                    aggregator=parsed.aggregator, rate=parsed.rate,
+                    downsample=parsed.downsample,
+                    counter=parsed.counter,
+                    counter_max=parsed.counter_max,
+                    reset_value=parsed.reset_value)
             # Planner choice for this sub-query ("raw", "resident", or
             # a rollup resolution label) — surfaced in JSON metadata.
             # Returned with the results: reading it back off the shared
@@ -1482,7 +1573,8 @@ class TSDServer:
                     results, result_plans, result_cached,
                     result_traces if want_trace else None,
                     degraded=degraded,
-                    approx=result_approx)).encode()
+                    approx=result_approx,
+                    expert=expert_label)).encode()
             ctype = "application/json"
         else:
             t0 = time.time()
@@ -1551,7 +1643,8 @@ class TSDServer:
         return "\n".join(out) + ("\n" if out else "")
 
     def _json_output(self, results, plans=None, cached=None,
-                     traces=None, degraded=None, approx=None):
+                     traces=None, degraded=None, approx=None,
+                     expert=None):
         out = [{
             "metric": r.metric,
             "tags": r.tags,
@@ -1564,6 +1657,15 @@ class TSDServer:
             "dps": {str(int(t)): float(v)
                     for t, v in zip(r.timestamps, r.values)},
         } for i, r in enumerate(results)]
+        if expert:
+            # Expert-path provenance, DECLARED either way: "expert"
+            # when the batch served through the mesh's expert buckets,
+            # "expert-decline" when it was eligible for the attempt
+            # but fell off the path (ragged shapes, rate, no-lerp
+            # aggs) and served serially — the TSINT fused-decline
+            # discipline: falling back is fine, silently is not.
+            for ent in out:
+                ent["plan"] = expert
         if degraded:
             # Anything less than full service is DECLARED per result:
             # "stale" (replica lag beyond the contract) and/or
@@ -2166,7 +2268,8 @@ function render(t){
   document.getElementById("meta").innerHTML=
     "up "+t.uptime_s+"s &middot; refreshed "+
     new Date().toLocaleTimeString();
-  var order=["raw","resident","fused","rollup","approx"];
+  var order=["raw","resident","fused","rollup","approx","expert",
+             "expert-decline"];
   var p=t.plans||{};
   document.getElementById("plans").innerHTML=
     table("Plans served",["plan","results"],order.filter(function(k){
@@ -2188,7 +2291,16 @@ function render(t){
       +pills("Fallbacks", r.fallbacks||{})
       +pills("Sketch bytes written", r.sketch_bytes||{});
   } else { document.getElementById("rollup").innerHTML=""; }
+  var mesh=t.mesh||{};
+  var cc=mesh.compile_cache||{};
   document.getElementById("caches").innerHTML=
+    pills("Mesh execution ("+(mesh.devices||1)+" device"+
+          ((mesh.devices||1)>1?"s":"")+
+          (mesh.expert_enabled?", expert on":"")+")",
+          {"compile cache":(cc.size||0)+" plans",
+           "hit":cc.hit||0,"miss":cc.miss||0,
+           "expert served":(mesh.expert||{}).serve||0,
+           "expert declined":(mesh.expert||{}).decline||0})+
     pills("Fragment cache", t.qcache||{})+
     pills("Admission", t.admission||{});
 }

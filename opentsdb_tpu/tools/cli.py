@@ -180,6 +180,8 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
             # work the tailer's lag clock never sees.
             cfg.checkpoint_interval = 5.0
         cfg.mesh_devices = getattr(args, "mesh_devices", 0)
+        cfg.mesh_shape = getattr(args, "mesh", "") or ""
+        cfg.expert_parallel = getattr(args, "expert_parallel", False)
         cfg.slow_query_ms = getattr(args, "slow_query_ms", 0.0)
         cfg.selfmon_interval_s = getattr(args, "selfmon_interval", 0.0)
         cfg.trace_sample_n = getattr(args, "trace_sample_n", 0)
@@ -925,6 +927,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--mesh-devices", type=int, default=0,
                    help="shard fused queries over the first N local "
                         "chips (0 = single-device)")
+    p.add_argument("--mesh", default="",
+                   help="unified mesh execution plane: 'N' = 1-D "
+                        "series-hash mesh over N local devices, "
+                        "'RxC' = hybrid (host, series) mesh. Eligible "
+                        "query reductions + the fused TSST4 stage run "
+                        "sharded; supersedes --mesh-devices. On CPU "
+                        "set XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N "
+                        "first (see README 'Mesh execution')")
+    p.add_argument("--expert-parallel", action="store_true",
+                   help="with --mesh: pack mixed /q dashboard batches "
+                        "into expert buckets (one mesh dispatch per "
+                        "batch; declines declared per-result as "
+                        "plan: expert-decline)")
     p.add_argument("--slow-query-ms", type=float, default=0.0,
                    help="trace every /q and log one-line JSON records "
                         "(span tree + plan) for queries at/over this "

@@ -291,6 +291,26 @@ class Config:
     # device mesh for distributed query execution: 0 = single-device;
     # N>1 = shard fused downsample queries over the first N local chips
     mesh_devices: int = 0
+    # Unified mesh execution plane (opentsdb_tpu/parallel/compile.py):
+    # "" = no mesh (every kernel single-device, unchanged bytes);
+    # "N" = a 1-D series-hash mesh over the first N local devices;
+    # "RxC" = the 2-D hybrid (host, series) mesh — R DCN rows of C
+    # ICI chips. With a mesh, eligible query reductions shard via
+    # psum/all-gather combines, the fused TSST4 stage shards on the
+    # block axis (pjit leg), and expert_parallel can route mixed
+    # dashboard batches. Supersedes mesh_devices when set. On CPU the
+    # virtual device count comes from
+    # XLA_FLAGS=--xla_force_host_platform_device_count=N.
+    mesh_shape: str = ""
+    # Expert-parallel dashboard serving (parallel/expert.py): with a
+    # mesh, a mixed /q batch (>= 2 sub-queries, one shared downsample
+    # interval, moment + percentile aggregators) packs into expert
+    # buckets and runs under ONE mesh dispatch instead of
+    # serializing. Batches that fall off the path DECLINE loudly
+    # (per-result plan: "expert-decline" + mesh.expert.decline
+    # counter) and serve serially — exact-or-fall-back, the TSINT
+    # fused-decline discipline.
+    expert_parallel: bool = False
 
     # network
     port: int = 4242

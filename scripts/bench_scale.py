@@ -660,6 +660,314 @@ def run_sketch_serve(args) -> int:
     return 0
 
 
+def run_mesh_bench(args) -> int:
+    """BENCH_MESH.json: the unified-mesh-execution-plane batteries.
+
+    Kernel-level over the synthesized corpus columns (mesh execution
+    is a compute-plane property; the storage tiers feed it the same
+    flat columns either way):
+
+    - FOLD battery: rollup window fold over every series, sharded
+      across the mesh (rollup/summary.window_summaries_sharded ->
+      parallel/sharded.sharded_window_fold) vs a 1-device-mesh
+      control — wall time both legs, result compared BYTE-for-byte
+      (series never split shards; the combine is an all_gather), plus
+      the float64 host fold for reference.
+    - DASHBOARD battery: fused downsample+group reductions
+      (sum/avg/dev moments and an exact p95) sharded over the mesh vs
+      the single-device kernels — wall time + parity (f32 tolerance
+      for moments; a dense integer-valued leg is compared
+      byte-for-byte, the exactness argument of the gloo smoke).
+    - EXPERT battery: one mixed moment+percentile dashboard batch
+      through parallel/expert.run_dashboard_batch vs the serial
+      kernel loop.
+    """
+    shape = args.mesh.strip().lower()
+    if "x" in shape:
+        r_s, _, c_s = shape.partition("x")
+        want_devs = int(r_s) * int(c_s)
+    else:
+        want_devs = int(shape)
+    if args.cpu or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{want_devs}").strip()
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from opentsdb_tpu.parallel.compile import (cache_info,
+                                               set_mesh_devices)
+    from opentsdb_tpu.parallel.mesh import make_mesh
+    from opentsdb_tpu.parallel.plan import (build_mesh,
+                                            flatten_series_mesh)
+    from opentsdb_tpu.parallel.sharded import (
+        pack_shards,
+        sharded_downsample_group,
+        sharded_downsample_quantile,
+    )
+    from opentsdb_tpu.ops import kernels
+    from opentsdb_tpu.rollup import summary
+    from opentsdb_tpu.parallel import expert
+
+    mesh = flatten_series_mesh(build_mesh(shape))
+    D = int(mesh.devices.size)
+    set_mesh_devices(D)
+    one = make_mesh(1, devices=mesh.devices.reshape(-1)[:1])
+    log(f"mesh: {shape} -> {D} devices "
+        f"({mesh.devices.reshape(-1)[0].platform})")
+
+    base = 1356998400
+    pps = max(args.points // args.series, 1)
+    step = max(args.span // pps, 1)
+    rng = np.random.default_rng(7)
+    log(f"synthesizing {args.series} series x {pps} points "
+        f"(step {step}s)...")
+    t0 = time.perf_counter()
+    series = []
+    for si in range(args.series):
+        ts = (np.arange(pps, dtype=np.int64) * step
+              + int(rng.integers(0, max(step - 1, 1))))
+        vals = np.cumsum(rng.normal(0, 1, pps)) + 50.0
+        series.append((ts, vals))
+    synth_s = time.perf_counter() - t0
+    total_points = args.series * pps
+
+    out = {"mesh": shape, "devices": D,
+           "platform": str(mesh.devices.reshape(-1)[0].platform),
+           "target_points": args.points,
+           "actual_points": int(total_points),
+           "series": args.series, "span_s": args.span,
+           "synth_s": round(synth_s, 2),
+           "host": {"cores": os.cpu_count()}}
+
+    def timed(fn, repeats=3):
+        fn()                        # warm (compile)
+        best = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            r = fn()
+            best.append(time.perf_counter() - t0)
+        return r, min(best)
+
+    # -- FOLD battery ------------------------------------------------
+    res = 3600
+    log("fold battery (sharded rollup window fold)...")
+    fold_mesh, t_mesh = timed(
+        lambda: summary.window_summaries_sharded(series, res, mesh))
+    fold_one, t_one = timed(
+        lambda: summary.window_summaries_sharded(series, res, one))
+    byte_ok = all(
+        np.array_equal(wa, wb) and ra.tobytes() == rb.tobytes()
+        for (wa, ra), (wb, rb) in zip(fold_one, fold_mesh))
+    t0 = time.perf_counter()
+    for ts, vals in series:
+        summary.window_summaries(ts, vals, res)
+    t_host = time.perf_counter() - t0
+    out["fold"] = {
+        "res_s": res,
+        "mesh_s": round(t_mesh, 3),
+        "single_device_s": round(t_one, 3),
+        "speedup": round(t_one / max(t_mesh, 1e-9), 2),
+        "host_float64_s": round(t_host, 3),
+        "byte_identical_vs_control": bool(byte_ok),
+    }
+    log(f"  fold: mesh {t_mesh:.3f}s vs 1-dev {t_one:.3f}s "
+        f"(host f64 {t_host:.3f}s), byte_ok={byte_ok}")
+    assert byte_ok, "sharded fold diverged from single-device control"
+    del fold_mesh, fold_one
+
+    # -- DASHBOARD battery -------------------------------------------
+    interval = 3600
+    B = args.span // interval
+    log("dashboard battery (sharded reductions)...")
+    packed = pack_shards(series, D)
+    ts_d, vals_d, sid_d, valid_d, sps = packed
+    packed1 = pack_shards(series, 1)
+    ts_1, vals_1, sid_1, valid_1, sps1 = packed1
+    dash = {}
+    for agg_down, agg_group, label in (
+            ("avg", "sum", "sum-of-avg"),
+            ("sum", "max", "max-of-sum"),
+            ("avg", "dev", "dev-of-avg")):
+        def mesh_leg():
+            gv, gm = sharded_downsample_group(
+                ts_d, vals_d, sid_d, valid_d, mesh=mesh,
+                series_per_shard=sps, num_buckets=B,
+                interval=interval, agg_down=agg_down,
+                agg_group=agg_group)
+            return np.asarray(gv), np.asarray(gm)
+
+        def ctrl_leg():
+            gv, gm = sharded_downsample_group(
+                ts_1, vals_1, sid_1, valid_1, mesh=one,
+                series_per_shard=sps1, num_buckets=B,
+                interval=interval, agg_down=agg_down,
+                agg_group=agg_group)
+            return np.asarray(gv), np.asarray(gm)
+
+        (gv_m, gm_m), tm = timed(mesh_leg)
+        (gv_c, gm_c), tc = timed(ctrl_leg)
+        assert (gm_m == gm_c).all()
+        # ELEMENTWISE relative diff (floored at |1.0| so near-zero
+        # buckets read as absolute error) — a max|diff|/max|control|
+        # ratio would let one small bucket be 100% wrong while a big
+        # bucket hides it.
+        rel = float((np.abs(gv_m[gm_m] - gv_c[gm_c])
+                     / np.maximum(np.abs(gv_c[gm_c]), 1.0)).max()) \
+            if gm_c.any() else 0.0
+        assert rel < 1e-4, (label, rel)
+        dash[label] = {"mesh_s": round(tm, 4),
+                       "single_device_s": round(tc, 4),
+                       "speedup": round(tc / max(tm, 1e-9), 2),
+                       "max_rel_diff": rel}
+        log(f"  {label}: mesh {tm:.4f}s vs 1-dev {tc:.4f}s "
+            f"(rel diff {rel:.2e})")
+
+    def p95_mesh():
+        gv, gm = sharded_downsample_quantile(
+            ts_d, vals_d, sid_d, valid_d,
+            np.array([0.95], np.float32), mesh=mesh,
+            series_per_shard=sps, num_buckets=B, interval=interval,
+            agg_down="avg")
+        return np.asarray(gv[0]), np.asarray(gm)
+
+    def p95_ctrl():
+        gv, gm = sharded_downsample_quantile(
+            ts_1, vals_1, sid_1, valid_1,
+            np.array([0.95], np.float32), mesh=one,
+            series_per_shard=sps1, num_buckets=B, interval=interval,
+            agg_down="avg")
+        return np.asarray(gv[0]), np.asarray(gm)
+
+    (qv_m, qm_m), tqm = timed(p95_mesh)
+    (qv_c, qm_c), tqc = timed(p95_ctrl)
+    assert (qm_m == qm_c).all()
+    np.testing.assert_allclose(qv_m[qm_m], qv_c[qm_c], rtol=1e-5,
+                               atol=1e-4)
+    dash["p95-of-avg"] = {"mesh_s": round(tqm, 4),
+                          "single_device_s": round(tqc, 4),
+                          "speedup": round(tqc / max(tqm, 1e-9), 2)}
+    log(f"  p95-of-avg: mesh {tqm:.4f}s vs 1-dev {tqc:.4f}s")
+
+    # Dense integer byte-parity leg (the gloo smoke's exactness
+    # argument, at bench scale): every contribution an exact integer,
+    # so mesh width cannot change a bit.
+    int_series = []
+    for si in range(min(args.series, 256)):
+        its = (np.arange(B, dtype=np.int64) * interval
+               + (si * 7) % interval)
+        int_series.append(
+            (its, rng.integers(-500, 500, B).astype(np.float64)))
+    pi = pack_shards(int_series, D)
+    p1 = pack_shards(int_series, 1)
+    gv_i, gm_i = sharded_downsample_group(
+        pi[0], pi[1], pi[2], pi[3], mesh=mesh, series_per_shard=pi[4],
+        num_buckets=B, interval=interval, agg_down="sum",
+        agg_group="sum")
+    gv_i1, gm_i1 = sharded_downsample_group(
+        p1[0], p1[1], p1[2], p1[3], mesh=one, series_per_shard=p1[4],
+        num_buckets=B, interval=interval, agg_down="sum",
+        agg_group="sum")
+    int_byte_ok = (np.asarray(gv_i).tobytes()
+                   == np.asarray(gv_i1).tobytes())
+    assert int_byte_ok
+    dash["integer_sum_byte_identical"] = bool(int_byte_ok)
+    out["dashboard"] = dash
+
+    # -- EXPERT battery ----------------------------------------------
+    log("expert battery (mixed dashboard batch)...")
+    S_e, B_e = 64, min(B, 256)
+    n_e = min(pps, 20_000)
+
+    def subq(fam, agg=None, qn=None, dsagg="avg", seed=0):
+        r = np.random.default_rng(100 + seed)
+        ts = r.integers(0, B_e * interval, n_e).astype(np.int32)
+        vals = r.normal(50, 9, n_e).astype(np.float32)
+        sid = r.integers(0, S_e, n_e).astype(np.int32)
+        d = {"family": fam, "ts": ts, "vals": vals, "sid": sid,
+             "dsagg": dsagg}
+        if fam == "moment":
+            d["agg"] = agg
+        else:
+            d["quantile"] = qn
+        return d
+
+    batch = [subq("moment", agg="sum", seed=0),
+             subq("moment", agg="avg", dsagg="max", seed=1),
+             subq("percentile", qn=0.95, seed=2),
+             subq("moment", agg="dev", seed=3),
+             subq("percentile", qn=0.5, seed=4),
+             subq("moment", agg="max", seed=5)]
+
+    def expert_leg():
+        return expert.run_dashboard_batch(
+            batch, mesh, num_series=S_e, num_buckets=B_e,
+            interval=interval)
+
+    def serial_leg():
+        outs = []
+        for q in batch:
+            o = kernels.downsample_group(
+                q["ts"], q["vals"], q["sid"],
+                np.ones(n_e, bool), num_series=S_e,
+                num_buckets=B_e, interval=interval,
+                agg_down=q["dsagg"], agg_group=q.get("agg", "count"))
+            gm = np.asarray(o["group_mask"])
+            if q["family"] == "moment":
+                outs.append((np.asarray(o["group_values"]), gm))
+            else:
+                filled, in_range = kernels.gap_fill(
+                    o["series_values"], o["series_mask"], B_e)
+                outs.append((np.asarray(
+                    kernels.masked_quantile_axis0(
+                        filled, in_range,
+                        np.array([q["quantile"]],
+                                 np.float32))[0]), gm))
+        return outs
+
+    got_e, te = timed(expert_leg)
+    got_s, ts_serial = timed(serial_leg)
+    for (gv, gm), (wv, wm) in zip(got_e, got_s):
+        assert (np.asarray(gm) == wm).all()
+        np.testing.assert_allclose(np.asarray(gv)[wm], wv[wm],
+                                   rtol=1e-4, atol=1e-3)
+    out["expert"] = {"batch": len(batch),
+                     "points_per_subquery": n_e,
+                     "expert_s": round(te, 4),
+                     "serial_s": round(ts_serial, 4),
+                     "speedup": round(ts_serial / max(te, 1e-9), 2),
+                     "answers_match_serial": True}
+    log(f"  expert: batch {te:.4f}s vs serial {ts_serial:.4f}s")
+
+    out["compile_cache"] = cache_info()
+    out["iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+    suffixed = os.path.join(
+        REPO, f"BENCH_MESH_{total_points // 1_000_000}M_"
+              f"{shape.replace('x', 'x')}.json")
+    with open(suffixed, "w") as f:
+        json.dump(out, f, indent=2)
+    canonical = os.path.join(REPO, "BENCH_MESH.json")
+    prev_pts = -1
+    if os.path.exists(canonical):
+        try:
+            with open(canonical) as f:
+                prev_pts = int(json.load(f).get("actual_points", -1))
+        except Exception:
+            prev_pts = -1
+    if total_points >= prev_pts:
+        with open(canonical, "w") as f:
+            json.dump(out, f, indent=2)
+        log(f"wrote BENCH_MESH.json ({total_points:,} points, "
+            f"mesh {shape})")
+    else:
+        log(f"clobber guard: BENCH_MESH.json records {prev_pts:,} "
+            f"points; this run kept in {os.path.basename(suffixed)}")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--points", type=int, default=1_000_000_000)
@@ -736,8 +1044,20 @@ def main() -> int:
                          "tax on ingest dps")
     ap.add_argument("--workdir", default="/tmp/tsdb_scale")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh execution plane battery: 'N' or 'RxC'. "
+                         "Runs the sharded rollup-fold and dashboard-"
+                         "reduction batteries over the synthesized "
+                         "corpus, mesh vs single-device control, and "
+                         "writes BENCH_MESH.json (+ a size/mesh-"
+                         "suffixed artifact; the canonical file is "
+                         "clobber-guarded by corpus size). With --cpu "
+                         "the virtual device count is forced "
+                         "automatically")
     args = ap.parse_args()
 
+    if args.mesh:
+        return run_mesh_bench(args)
     if args.codec:
         return run_codec_compare(args)
     if args.sketch_serve:

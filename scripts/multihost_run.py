@@ -22,6 +22,18 @@ Cases:
 Run: python scripts/multihost_run.py    (parent forks both children)
 Writes MULTIHOST_PROC.json to the repo root from process 0.
 
+``--plane`` runs the MESH EXECUTION PLANE smoke instead (PR 15): the
+same two gloo processes build a flat 8-device series mesh through
+parallel/compile.compile_with_plan and prove that (a) the sharded
+rollup window fold and (b) a sharded dashboard query reduction are
+BYTE-IDENTICAL to single-device controls — the fold because a series
+never splits across shards and its combine is an all_gather, the
+reduction because the battery's values are integer-valued float32
+(every partial sum exact below 2^24), so psum reassociation cannot
+change a bit. Each process byte-checks its own addressable output
+shards; process 0 additionally checks the replicated reduction row
+against the single-device control and writes MESH_PLANE_PROC.json.
+
 Parity: the reference's analog is many TSDs over one HBase cluster via
 asynchbase RPC (src/core/TSDB.java:479-494); here the inter-node fabric
 is the XLA collective runtime.
@@ -60,6 +72,144 @@ def synth(host: int, chip: int):
     sid = np.zeros(N_PER_SHARD, np.int32)      # one series per shard
     valid = np.arange(N_PER_SHARD) < n_real
     return ts, vals, sid, valid
+
+
+def synth_plane(shard: int):
+    """Deterministic DENSE INTEGER-VALUED per-shard data for the
+    plane's byte-parity legs: unique timestamps covering every
+    downsample bucket (so the group stage's lerp fill never
+    interpolates — every contribution is an exact integer) and values
+    small enough that f32 partial sums stay exact under ANY psum
+    reassociation (< 2^24). Byte-parity then follows from arithmetic,
+    not from a lucky reduction order."""
+    import numpy as np
+
+    rng = np.random.default_rng(7000 + shard)
+    # Unique timestamps, dense across the span: one per permutation
+    # slot of the first N positions — with N_PER_SHARD=4096 over
+    # SPAN=7200 every 300 s bucket holds many points.
+    ts = rng.permutation(SPAN)[:N_PER_SHARD].astype(np.int32)
+    vals = rng.integers(-500, 500, N_PER_SHARD).astype(np.float32)
+    sid = np.zeros(N_PER_SHARD, np.int32)   # one series per shard
+    valid = np.ones(N_PER_SHARD, bool)
+    # Density invariant the exactness argument rests on.
+    assert len(np.unique(ts // INTERVAL)) == SPAN // INTERVAL
+    return ts, vals, sid, valid
+
+
+def child_plane(process_id: int, coordinator: str) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=N_PROC,
+                               process_id=process_id)
+    import functools
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from opentsdb_tpu.parallel.compile import (cache_info,
+                                               set_mesh_devices)
+    from opentsdb_tpu.parallel.mesh import SERIES_AXIS
+    from opentsdb_tpu.parallel.sharded import (
+        _sharded_window_fold_body,
+        sharded_downsample_group,
+        sharded_window_fold,
+    )
+
+    assert jax.process_count() == N_PROC
+    rows = N_PROC * CHIPS_PER_PROC
+    mesh = Mesh(np.asarray(jax.devices()), (SERIES_AXIS,))
+    set_mesh_devices(rows)
+    sharding = NamedSharding(mesh, P(SERIES_AXIS))
+
+    def gmake(col: int, dtype):
+        def cb(index):
+            r = index[0]
+            shards = [synth_plane(r0)[col] for r0 in range(rows)[r]]
+            return np.stack(shards).astype(dtype)
+        return jax.make_array_from_callback(
+            (rows, N_PER_SHARD), sharding, cb)
+
+    ts = gmake(0, np.int32)
+    vals = gmake(1, np.float32)
+    sid = gmake(2, np.int32)
+    valid = gmake(3, bool)
+
+    res = 600
+    num_windows = SPAN // res
+    # (a) Sharded rollup window fold over the REAL cross-process mesh.
+    folded = sharded_window_fold(
+        ts, vals, sid, valid, mesh=mesh, series_per_shard=1,
+        num_windows=num_windows, res=res)
+    folded.block_until_ready()
+    # Single-device control: the same fold body, plain-jitted, on each
+    # addressable shard's local data — BYTE-compared. (The body has no
+    # collectives; the mesh combine is the out-spec concat itself.)
+    body = jax.jit(functools.partial(
+        _sharded_window_fold_body, series_per_shard=1,
+        num_windows=num_windows, res=res))
+    fold_shards_checked = 0
+    for sh in folded.addressable_shards:
+        d = sh.index[0].start or 0
+        t0, v0, s0, m0 = synth_plane(d)
+        want = np.asarray(body(t0[None], v0[None], s0[None], m0[None]))
+        got = np.asarray(sh.data)
+        assert got.tobytes() == want.tobytes(), \
+            f"fold shard {d} diverges from single-device control"
+        fold_shards_checked += 1
+    assert fold_shards_checked == CHIPS_PER_PROC, fold_shards_checked
+
+    # (b) Sharded dashboard reduction (psum combine) — integer-valued
+    # data makes the f32 partial sums exact, so the replicated mesh
+    # answer must equal the 1-device-mesh control byte for byte.
+    B = SPAN // INTERVAL
+    gv, gm = sharded_downsample_group(
+        ts, vals, sid, valid, mesh=mesh, series_per_shard=1,
+        num_buckets=B, interval=INTERVAL, agg_down="sum",
+        agg_group="sum")
+    gv.block_until_ready()
+    if process_id != 0:
+        return 0
+    allsh = [synth_plane(d) for d in range(rows)]
+    one = Mesh(np.asarray(jax.local_devices()[:1]), (SERIES_AXIS,))
+    c_ts = np.concatenate([s[0] for s in allsh])[None]
+    c_vals = np.concatenate([s[1] for s in allsh])[None]
+    c_sid = np.concatenate(
+        [np.full(N_PER_SHARD, d, np.int32) for d in range(rows)])[None]
+    c_valid = np.concatenate([s[3] for s in allsh])[None]
+    c_gv, c_gm = sharded_downsample_group(
+        c_ts, c_vals, c_sid, c_valid, mesh=one, series_per_shard=rows,
+        num_buckets=B, interval=INTERVAL, agg_down="sum",
+        agg_group="sum")
+    gv_h, gm_h = np.asarray(gv), np.asarray(gm)
+    c_gv, c_gm = np.asarray(c_gv), np.asarray(c_gm)
+    assert (gm_h == c_gm).all(), "reduction masks disagree"
+    assert gv_h.tobytes() == c_gv.tobytes(), \
+        "mesh reduction diverges from single-device control bytes"
+
+    out = {
+        "mode": "plane",
+        "process_count": int(jax.process_count()),
+        "devices_global": len(jax.devices()),
+        "devices_local": jax.local_device_count(),
+        "fold_shards_byte_checked_per_proc": fold_shards_checked,
+        "fold_windows": int(num_windows),
+        "reduction_buckets": int(B),
+        "reduction_byte_identical": True,
+        "compile_cache": cache_info(),
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(os.path.join(REPO, "MESH_PLANE_PROC.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    return 0
 
 
 def child(process_id: int, coordinator: str) -> int:
@@ -190,7 +340,11 @@ def child(process_id: int, coordinator: str) -> int:
 
 def main() -> int:
     role = os.environ.get("MH_PROCESS_ID")
+    mode = os.environ.get("MH_MODE") or (
+        "plane" if "--plane" in sys.argv[1:] else "hybrid")
     if role is not None:
+        if mode == "plane":
+            return child_plane(int(role), os.environ["MH_COORDINATOR"])
         return child(int(role), os.environ["MH_COORDINATOR"])
     # parent: pick a free port, fork both children
     with socket.socket() as s:
@@ -203,6 +357,7 @@ def main() -> int:
         + f" --xla_force_host_platform_device_count={CHIPS_PER_PROC}"
     ).strip()
     env_base["MH_COORDINATOR"] = coord
+    env_base["MH_MODE"] = mode
     procs = []
     for pid in range(N_PROC):
         env = dict(env_base)

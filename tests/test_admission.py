@@ -141,7 +141,8 @@ def make_server(tmp_path=None, rollups=False, **cfg_kw):
         kw.update(wal_path=wal, enable_rollups=rollups,
                   rollup_catchup="sync")
         store = MemKVStore(wal_path=wal)
-    cfg = Config(**kw, **cfg_kw)
+    kw.update(cfg_kw)  # caller overrides win (e.g. backend="tpu")
+    cfg = Config(**kw)
     tsdb = TSDB(store, cfg, start_compaction_thread=False)
     return TSDServer(tsdb), tsdb
 

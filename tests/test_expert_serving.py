@@ -1,0 +1,230 @@
+"""Expert-parallel dashboard serving (parallel/expert.py dash layer +
+QueryExecutor.run_expert_batch + the /q route behind
+Config.expert_parallel).
+
+Routing a mixed batch to expert buckets is an execution strategy,
+never a semantics change: every sub-query's answer must match the
+serial leg (f32 tolerance — slots share one padded [S, B] layout, so
+group sums reduce in a different association). Batches that fall off
+the path DECLINE loudly (per-result plan: "expert-decline" + the
+mesh.expert.decline counter) and serve serially, answers unchanged.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.parallel.mesh import make_mesh
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+
+
+def _tsdb(**cfg_kw):
+    kw = dict(auto_create_metrics=True, backend="tpu",
+              enable_sketches=False, device_window=False)
+    kw.update(cfg_kw)
+    return TSDB(MemKVStore(), Config(**kw),
+                start_compaction_thread=False)
+
+
+def _load(t, metrics=("m.cpu", "m.mem"), series=5, hours=6):
+    rng = np.random.default_rng(17)
+    for mi, metric in enumerate(metrics):
+        for si in range(series):
+            ts = BT + np.arange(0, hours * 3600, 120,
+                                dtype=np.int64) + si
+            vals = rng.normal(40 + 10 * mi, 8, len(ts))
+            t.add_batch(metric, ts, vals,
+                        {"host": f"h{si}",
+                         "dc": "e" if si % 2 else "w"})
+
+
+def _compare(serial_results, expert_results):
+    assert len(serial_results) == len(expert_results)
+    ks = {tuple(sorted(r.tags.items())): r for r in serial_results}
+    ke = {tuple(sorted(r.tags.items())): r for r in expert_results}
+    assert set(ks) == set(ke)
+    for k in ks:
+        assert np.array_equal(ks[k].timestamps, ke[k].timestamps)
+        np.testing.assert_allclose(ke[k].values, ks[k].values,
+                                   rtol=2e-6, atol=1e-4)
+        assert ks[k].aggregated_tags == ke[k].aggregated_tags
+
+
+BATCH = [
+    QuerySpec("m.cpu", {}, "sum", downsample=(600, "avg")),
+    QuerySpec("m.mem", {}, "p95", downsample=(600, "avg")),
+    QuerySpec("m.cpu", {"host": "*"}, "max", downsample=(600, "max")),
+    QuerySpec("m.mem", {"dc": "e"}, "dev", downsample=(600, "sum")),
+    QuerySpec("m.cpu", {}, "p50", downsample=(600, "count")),
+]
+
+
+class TestExecutorBatch:
+    def test_mixed_batch_matches_serial(self):
+        t = _tsdb()
+        _load(t)
+        try:
+            exm = QueryExecutor(t, mesh=make_mesh(8))
+            ex0 = QueryExecutor(t)
+            per_spec, reason = exm.run_expert_batch(
+                BATCH, BT + 60, BT + 5 * 3600)
+            assert reason is None, reason
+            assert len(per_spec) == len(BATCH)
+            for spec, got in zip(BATCH, per_spec):
+                want, plan, _ = ex0.run_with_plan(spec, BT + 60,
+                                                  BT + 5 * 3600)
+                _compare(want, got)
+        finally:
+            t.shutdown()
+
+    def test_group_by_packs_each_group_as_a_slot(self):
+        t = _tsdb()
+        _load(t)
+        try:
+            exm = QueryExecutor(t, mesh=make_mesh(8))
+            specs = [
+                QuerySpec("m.cpu", {"host": "*"}, "sum",
+                          downsample=(600, "avg")),
+                QuerySpec("m.mem", {"dc": "*"}, "p95",
+                          downsample=(600, "avg"))]
+            per_spec, reason = exm.run_expert_batch(
+                specs, BT + 60, BT + 5 * 3600)
+            assert reason is None
+            assert len(per_spec[0]) == 5       # host=* groups
+            assert len(per_spec[1]) == 2       # dc=* groups
+            ex0 = QueryExecutor(t)
+            for spec, got in zip(specs, per_spec):
+                want, _, _ = ex0.run_with_plan(spec, BT + 60,
+                                               BT + 5 * 3600)
+                _compare(want, got)
+        finally:
+            t.shutdown()
+
+    @pytest.mark.parametrize("specs,reason", [
+        ([BATCH[0]], "single-query"),
+        ([BATCH[0], QuerySpec("m.mem", {}, "sum",
+                              downsample=(300, "avg"))],
+         "ragged-intervals"),
+        ([BATCH[0], QuerySpec("m.mem", {}, "sum", rate=True,
+                              downsample=(600, "avg"))], "rate"),
+        ([BATCH[0], QuerySpec("m.mem", {}, "sum")], "no-downsample"),
+        ([BATCH[0], QuerySpec("m.mem", {}, "zimsum",
+                              downsample=(600, "avg"))],
+         "no-lerp-agg"),
+    ])
+    def test_declines_are_named(self, specs, reason):
+        t = _tsdb()
+        _load(t)
+        try:
+            exm = QueryExecutor(t, mesh=make_mesh(8))
+            got, why = exm.run_expert_batch(specs, BT + 60,
+                                            BT + 5 * 3600)
+            assert got is None
+            assert why == reason
+        finally:
+            t.shutdown()
+
+    def test_no_mesh_and_cpu_decline(self):
+        t = _tsdb()
+        _load(t)
+        try:
+            assert QueryExecutor(t).run_expert_batch(
+                BATCH, BT, BT + 3600) == (None, "no-mesh")
+            assert QueryExecutor(
+                t, backend="cpu", mesh=make_mesh(8)).run_expert_batch(
+                BATCH, BT, BT + 3600) == (None, "cpu-backend")
+        finally:
+            t.shutdown()
+
+    def test_empty_scan_returns_empty_per_spec(self):
+        t = _tsdb()
+        _load(t)
+        try:
+            exm = QueryExecutor(t, mesh=make_mesh(8))
+            got, why = exm.run_expert_batch(
+                [BATCH[0], BATCH[1]], BT + 40 * 86400,
+                BT + 41 * 86400)
+            assert why is None
+            assert got == [[], []]
+        finally:
+            t.shutdown()
+
+
+class TestServerRoute:
+    def _drive(self, tmp_path, expert: bool, ms: list[str],
+               mesh_shape: str | None = None):
+        from tests.test_admission import (http_get, make_server,
+                                          run_with_server)
+        if mesh_shape is None:
+            mesh_shape = "4" if expert else ""
+        server, tsdb = make_server(
+            backend="tpu", mesh_shape=mesh_shape,
+            expert_parallel=expert)
+        _load(tsdb, series=3, hours=3)
+
+        async def drive(port):
+            target = (f"/q?start={BT}&end={BT + 2 * 3600}&"
+                      + "&".join(f"m={m}" for m in ms)
+                      + "&json&nocache")
+            out = await http_get(port, target)
+            feed = await http_get(port, "/api/queries")
+            return out, feed
+
+        (st, _, body), (sf, _, fbody) = run_with_server(server, drive)
+        tsdb.shutdown()
+        assert st == 200 and sf == 200
+        return json.loads(body), json.loads(fbody), server
+
+    def test_served_batch_declares_expert_plan(self, tmp_path):
+        ms = ["sum:10m-avg:m.cpu", "p95:10m-avg:m.mem"]
+        out, feed, server = self._drive(tmp_path, True, ms)
+        assert out and all(r["plan"] == "expert" for r in out)
+        assert all(r["rollup"] == "expert" for r in out)
+        assert feed["plans"].get("expert", 0) >= 1
+        assert feed["mesh"]["expert"]["serve"] >= 1
+        assert feed["mesh"]["expert_enabled"] is True
+        # Answers match a serial (expert-off) server bit-for-grid.
+        out0, _, _ = self._drive(tmp_path, False, ms)
+        assert len(out) == len(out0)
+        k0 = {(r["metric"], tuple(sorted(r["tags"].items()))): r
+              for r in out0}
+        ke = {(r["metric"], tuple(sorted(r["tags"].items()))): r
+              for r in out}
+        assert set(k0) == set(ke)
+        for k in k0:
+            d0, de = k0[k]["dps"], ke[k]["dps"]
+            assert set(d0) == set(de)
+            for tkey in d0:
+                assert de[tkey] == pytest.approx(d0[tkey],
+                                                 rel=2e-6, abs=1e-4)
+
+    def test_declined_batch_is_declared(self, tmp_path):
+        # Ragged intervals: eligible for the attempt, falls off.
+        ms = ["sum:10m-avg:m.cpu", "sum:5m-avg:m.mem"]
+        out, feed, _ = self._drive(tmp_path, True, ms)
+        assert out and all(r["plan"] == "expert-decline" for r in out)
+        # The serial labels still report per-result in "rollup".
+        assert all(r["rollup"] == "raw" for r in out)
+        assert feed["plans"].get("expert-decline", 0) >= 1
+        assert feed["mesh"]["expert"]["decline"] >= 1
+
+    def test_knob_without_mesh_declares_decline(self, tmp_path):
+        # The misconfigured fleet face: expert_parallel on, no mesh —
+        # the decline is declared, never a silent serial serve.
+        ms = ["sum:10m-avg:m.cpu", "p95:10m-avg:m.mem"]
+        out, feed, _ = self._drive(tmp_path, True, ms, mesh_shape="")
+        assert out and all(r["plan"] == "expert-decline" for r in out)
+        assert feed["mesh"]["devices"] == 1
+
+    def test_expert_off_emits_no_plan_field(self, tmp_path):
+        ms = ["sum:10m-avg:m.cpu", "p95:10m-avg:m.mem"]
+        out, feed, _ = self._drive(tmp_path, False, ms)
+        assert out and all("plan" not in r for r in out)
+        assert feed["mesh"]["expert_enabled"] is False

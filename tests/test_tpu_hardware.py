@@ -312,3 +312,137 @@ def test_timeshard_carry_chip_parity():
         np.testing.assert_array_equal(got_m, want_m)
         np.testing.assert_allclose(got_v[want_m], want_v[want_m],
                                    rtol=RTOL, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# PR 15: mesh execution plane chip-parity breadth (VERDICT weak #4
+# remainder) — expert routing and devwindow eviction on the real chip.
+# ---------------------------------------------------------------------------
+
+def test_expert_dashboard_routing_chip_parity():
+    """A mixed dashboard batch routed through the expert mesh on the
+    REAL chip must match the CPU serial kernels: routing is an
+    execution strategy, never a semantics change. Uses every local TPU
+    device as an expert bucket."""
+    from opentsdb_tpu.parallel import expert
+    from opentsdb_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(29)
+    S, B, interval = 8, 24, 600
+
+    def mkq(fam, agg=None, qn=None, dsagg="avg"):
+        n = 4000
+        ts = rng.integers(0, B * interval, n).astype(np.int32)
+        vals = rng.normal(50, 9, n).astype(np.float32)
+        sid = rng.integers(0, S, n).astype(np.int32)
+        d = {"family": fam, "ts": ts, "vals": vals, "sid": sid,
+             "dsagg": dsagg}
+        if fam == "moment":
+            d["agg"] = agg
+        else:
+            d["quantile"] = qn
+        return d
+
+    queries = [mkq("moment", agg="sum"),
+               mkq("moment", agg="dev", dsagg="max"),
+               mkq("percentile", qn=0.95),
+               mkq("moment", agg="avg", dsagg="sum"),
+               mkq("percentile", qn=0.5, dsagg="min")]
+    if len(jax.devices()) < 2:
+        # Single-chip tunnel: the expert axis still exercises the
+        # dash kernel's TPU lowering, one family at a time.
+        queries = [q for q in queries if q["family"] == "moment"]
+    mesh = make_mesh(len(jax.devices()))
+    got = expert.run_dashboard_batch(queries, mesh, num_series=S,
+                                     num_buckets=B, interval=interval)
+
+    for q, (gv, gm) in zip(queries, got):
+        def cpu_ref():
+            with jax.default_device(jax.devices("cpu")[0]):
+                out = kernels.downsample_group(
+                    q["ts"], q["vals"], q["sid"],
+                    np.ones(len(q["ts"]), bool), num_series=S,
+                    num_buckets=B, interval=interval,
+                    agg_down=q["dsagg"],
+                    agg_group=q.get("agg", "count"))
+                mask = np.asarray(out["group_mask"])
+                if q["family"] == "moment":
+                    return np.asarray(out["group_values"]), mask
+                filled, in_range = kernels.gap_fill(
+                    out["series_values"], out["series_mask"], B)
+                vals = np.asarray(kernels.masked_quantile_axis0(
+                    filled, in_range,
+                    np.array([q["quantile"]], np.float32))[0])
+                return vals, mask
+
+        want_v, want_m = cpu_ref()
+        np.testing.assert_array_equal(np.asarray(gm), want_m)
+        np.testing.assert_allclose(np.asarray(gv)[want_m],
+                                   want_v[want_m],
+                                   rtol=RTOL, atol=1e-3)
+
+
+def test_devwindow_eviction_chip_parity():
+    """Devwindow eviction on the real chip: with a budget that forces
+    chunk eviction, resident answers over the still-covered suffix
+    must match the storage scan (f32 tolerance), and a range reaching
+    past complete_from must FALL BACK, never serve the evicted hole
+    approximately."""
+    from opentsdb_tpu.core.tsdb import TSDB
+    from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+    from opentsdb_tpu.storage.kv import MemKVStore
+    from opentsdb_tpu.utils.config import Config
+
+    BT = 1356998400
+    t = TSDB(MemKVStore(),
+             Config(auto_create_metrics=True, enable_sketches=False,
+                    device_window=True,
+                    device_window_staging=1 << 12,
+                    device_window_points=1 << 13),
+             start_compaction_thread=False)
+    try:
+        rng = np.random.default_rng(31)
+        span = 6 * 3600
+        # Time-interleaved ingest (the collector pattern): chunks are
+        # then time-ordered across the metric, so eviction leaves a
+        # contiguous recent suffix instead of whole series.
+        slice_s = span // 12
+        for blk in range(12):
+            for i in range(4):
+                ts = BT + blk * slice_s + np.sort(
+                    rng.choice(slice_s, 1200, replace=False))
+                t.add_batch("m.ev", ts, rng.normal(100, 10, 1200),
+                            {"host": f"h{i}"})
+        dw = t.devwindow
+        dw.flush()
+        assert dw.evicted_points > 0, \
+            "budget did not force eviction; shrink it"
+        mw = dw._metrics[t.metrics.get_id("m.ev")]
+        assert mw.complete_from is not None and not mw.dirty
+        ex = QueryExecutor(t, backend="tpu")
+        spec = QuerySpec("m.ev", {}, "sum", downsample=(600, "avg"))
+        # Covered suffix: resident serve, parity vs the scan.
+        lo = int(mw.complete_from) + 60
+        assert lo < BT + span - 600, "no covered suffix survived"
+        h0 = dw.window_hits
+        got = ex.run(spec, lo, BT + span)
+        assert dw.window_hits > h0, "expected a resident serve"
+        dwref, t.devwindow = t.devwindow, None
+        try:
+            want = ex.run(spec, lo, BT + span)
+        finally:
+            t.devwindow = dwref
+        assert len(got) == len(want)
+        for a, b in zip(got, want):
+            np.testing.assert_array_equal(a.timestamps, b.timestamps)
+            np.testing.assert_allclose(a.values, b.values,
+                                       rtol=RTOL, atol=1e-3)
+        # Evicted range: fall back (window_hits must NOT move), and
+        # the scan answer is authoritative.
+        h1 = dw.window_hits
+        full = ex.run(spec, BT, BT + span)
+        assert dw.window_hits == h1, \
+            "evicted range served resident — eviction hole ignored"
+        assert len(full) == 1 and len(full[0].timestamps) > 0
+    finally:
+        t.shutdown()
