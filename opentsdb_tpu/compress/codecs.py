@@ -20,8 +20,17 @@ uncompressed size in the file, so readers never guess:
                   widths to be minimal, which the batch encoder
                   guarantees; legacy odd rows fall back).
     ZLIB     (3)  zlib over the raw bytes — structured-but-foreign
-                  rows (rollup summary columns, UID maps, multi-cell
-                  rows) that still deflate.
+                  rows (UID maps, multi-cell rows) that still deflate.
+    ROLLSUM  (4)  structured rollup-summary block: runs of rollup
+                  records (1-byte family, one moment-map cell of
+                  fixed-stride entries + an optional sketch-map cell).
+                  Keys prefix-compress like the ts codecs; the moment
+                  entries store byte-TRANSPOSED (each struct field's
+                  bytes land contiguous, a columnar layout zlib
+                  actually bites on) and readers get the whole block's
+                  entry array back with one inflate + one frombuffer —
+                  no per-row cell unpack, and the parsed columns cache
+                  per block for rollup-served downsamples.
 
 ``encode_block`` picks the cheapest applicable codec and — belt and
 suspenders for a format whose corruption surface is every byte in the
@@ -45,9 +54,18 @@ VERBATIM = 0
 TSF32 = 1
 TSINT = 2
 ZLIB = 3
+ROLLSUM = 4
 
 CODEC_NAMES = {VERBATIM: "verbatim", TSF32: "tsf32", TSINT: "tsint",
-               ZLIB: "zlib"}
+               ZLIB: "zlib", ROLLSUM: "rollsum"}
+
+# Moment-map entry stride the ROLLSUM codec recognizes: one u2 window
+# index + the 52-byte summary record (rollup/summary.py ENTRY_DTYPE).
+# Duplicated (the _int_widths precedent) so the codec stays importable
+# without dragging the rollup tier in; the stride also rides in every
+# block header, so a future layout bump reads old blocks fine and
+# simply stops ENCODING new ones until this constant follows.
+ROLLSUM_STRIDE = 54
 
 # Write-time decode-and-compare of every structured block. Cheap next
 # to the spill's IO and the one guarantee that makes golden parity a
@@ -424,7 +442,12 @@ class TsBlock:
         return np.cumsum(d)
 
 
-def parse_ts_block(tag: int, enc) -> TsBlock:
+def parse_ts_block(tag: int, enc, keys_only: bool = False) -> TsBlock:
+    """Parse a TSF32/TSINT block. ``keys_only`` stops after the key and
+    record-structure sections — the fused source's filter pushdown
+    probes keys per block and only pays the payload parse for blocks
+    that actually hold matching in-range records (the ts/value stream
+    fields are left None)."""
     buf = np.frombuffer(enc, np.uint8)
     if len(buf) < _HDR.size:
         raise BlockCodecError("block header truncated")
@@ -455,6 +478,10 @@ def parse_ts_block(tag: int, enc) -> TsBlock:
     np.cumsum(b.npts[:-1], out=b.first_pt[1:])
     b.rec_of_pt = np.repeat(np.arange(n), b.npts)
     b.within = np.arange(P) - b.first_pt[b.rec_of_pt]
+    if keys_only:
+        b.ts_nb = b.ts_pay = b.v_nb = b.v_pay = None
+        b.K = _expand_keys(b.klen, b.kpre, ksuf)
+        return b
     (ts_pay_len,) = _U32.unpack_from(enc, off)
     off += 4
     b.ts_nb = _unpack_nibbles(take((P + 1) // 2), P)
@@ -539,6 +566,234 @@ def _decode_ts_raw(tag: int, enc) -> bytes:
     return out.tobytes()
 
 
+# -- ROLLSUM: structured rollup-summary blocks ------------------------------
+
+# nrec, table_len, family byte, entry stride
+_RS_HDR = struct.Struct(">IHBH")
+
+
+class RollupBlock:
+    """Parsed ROLLSUM block: prefix-expanded keys plus the block's
+    moment entries as ONE contiguous byte matrix ([E, stride] — view it
+    with the summary ENTRY dtype) and per-record sketch blobs. The
+    rollup tier serves straight off this (cached per block), never
+    re-materializing row bytes."""
+
+    __slots__ = ("n", "table", "fam", "stride", "K", "klen",
+                 "nm", "first_ent", "has_sketch", "sk_len", "ent_bytes",
+                 "sk_blob", "sk_off")
+
+
+def _parse_rollup_run(raw, offs: np.ndarray):
+    """Shape-check a run of v3-framed records as rollup-summary rows:
+    same table, one 1-byte family, cells exactly [qual 0x00 moment map]
+    or [qual 0x00, qual 0x01 sketch map], moment value a whole number
+    of ROLLSUM_STRIDE entries. Returns the per-record field lists or
+    None. Per-record Python is fine here: a 256 KB block holds ~100
+    packed superrows, not the ~10k points of a data block."""
+    arr = memoryview(raw) if not isinstance(raw, (bytes, bytearray)) \
+        else raw
+    n = len(offs)
+    if n == 0:
+        return None
+    keys, moms, sks, has_sk = [], [], [], []
+    table = fam = None
+    end = 0
+    for i in range(n):
+        off = int(offs[i])
+        try:
+            (tlen,) = _U16_S.unpack_from(arr, off)
+            tb = bytes(arr[off + 2:off + 2 + tlen])
+            off += 2 + tlen
+            (klen,) = _U16_S.unpack_from(arr, off)
+            key = bytes(arr[off + 2:off + 2 + klen])
+            off += 2 + klen
+            (ncells,) = _U32.unpack_from(arr, off)
+            off += 4
+            if ncells not in (1, 2):
+                return None
+            cells = []
+            for _ in range(ncells):
+                (flen,) = _U16_S.unpack_from(arr, off)
+                fb = bytes(arr[off + 2:off + 2 + flen])
+                off += 2 + flen
+                (qlen,) = _U16_S.unpack_from(arr, off)
+                q = bytes(arr[off + 2:off + 2 + qlen])
+                off += 2 + qlen
+                (vlen,) = _U32.unpack_from(arr, off)
+                v = bytes(arr[off + 4:off + 4 + vlen])
+                if len(v) != vlen:
+                    return None
+                off += 4 + vlen
+                cells.append((fb, q, v))
+        except struct.error:
+            return None
+        if table is None:
+            table = tb
+        elif tb != table:
+            return None
+        f0 = cells[0][0]
+        if len(f0) != 1 or any(f != f0 for f, _, _ in cells):
+            return None
+        if fam is None:
+            fam = f0
+        elif f0 != fam:
+            return None
+        if cells[0][1] != b"\x00" \
+                or len(cells[0][2]) % ROLLSUM_STRIDE \
+                or len(cells[0][2]) // ROLLSUM_STRIDE > 0xFFFF:
+            return None
+        if len(cells) == 2 and cells[1][1] != b"\x01":
+            return None
+        if len(key) > 0xFFFF or len(key) == 0:
+            return None
+        keys.append(key)
+        moms.append(cells[0][2])
+        sk = cells[1][2] if len(cells) == 2 else b""
+        sks.append(sk)
+        has_sk.append(len(cells) == 2)
+        end = off
+    if end != len(raw):
+        return None
+    return table, fam, keys, moms, sks, has_sk
+
+
+_U16_S = struct.Struct(">H")
+
+
+def _key_prefix_compress(keys: list[bytes]):
+    """(klen, kpre, ksuf blob) for a sorted-ish key list — the same
+    shared-prefix scheme the ts codecs use, over plain bytes."""
+    n = len(keys)
+    klen = np.fromiter((len(k) for k in keys), np.int64, n)
+    kpre = np.zeros(n, np.int64)
+    parts = [keys[0]]
+    for i in range(1, n):
+        a, b = keys[i - 1], keys[i]
+        m = min(len(a), len(b), 255)
+        p = 0
+        while p < m and a[p] == b[p]:
+            p += 1
+        kpre[i] = p
+        parts.append(b[p:])
+    return klen, kpre, b"".join(parts)
+
+
+def try_encode_rollup(raw, offs: np.ndarray) -> tuple[int, bytes] | None:
+    got = _parse_rollup_run(raw, offs)
+    if got is None:
+        return None
+    table, fam, keys, moms, sks, has_sk = got
+    n = len(keys)
+    klen, kpre, ksuf = _key_prefix_compress(keys)
+    nm = np.fromiter((len(m) // ROLLSUM_STRIDE for m in moms),
+                     np.int64, n)
+    sk_len = np.fromiter((len(s) for s in sks), np.int64, n)
+    flags = np.fromiter((1 if h else 0 for h in has_sk), np.uint8, n)
+    ent = np.frombuffer(b"".join(moms), np.uint8)
+    # Byte transpose: entry field bytes become contiguous columns —
+    # idx deltas, counts, exponent bytes of the f8 fields each deflate
+    # together instead of interleaved at stride 54.
+    ent_t = ent.reshape(-1, ROLLSUM_STRIDE).T.copy() if len(ent) \
+        else ent
+    mom_z = zlib.compress(ent_t.tobytes(), 5)
+    sk_z = zlib.compress(b"".join(sks), 5)
+    parts = [
+        _RS_HDR.pack(n, len(table), fam[0], ROLLSUM_STRIDE), table,
+        klen.astype(">u2").tobytes(), kpre.astype(np.uint8).tobytes(),
+        _U32.pack(len(ksuf)), ksuf,
+        flags.tobytes(), nm.astype(">u2").tobytes(),
+        sk_len.astype(">u4").tobytes(),
+        _U32.pack(len(mom_z)), mom_z,
+        _U32.pack(len(sk_z)), sk_z,
+    ]
+    return ROLLSUM, b"".join(parts)
+
+
+def parse_rollsum_block(enc) -> RollupBlock:
+    buf = np.frombuffer(enc, np.uint8)
+    if len(buf) < _RS_HDR.size:
+        raise BlockCodecError("rollsum header truncated")
+    n, tlen, fam, stride = _RS_HDR.unpack_from(enc, 0)
+    if stride == 0:
+        raise BlockCodecError("rollsum zero stride")
+    off = _RS_HDR.size
+    b = RollupBlock()
+    b.n, b.fam, b.stride = n, fam, stride
+
+    def take(count):
+        nonlocal off
+        if off + count > len(buf):
+            raise BlockCodecError("rollsum payload truncated")
+        out = buf[off:off + count]
+        off += count
+        return out
+
+    b.table = take(tlen).tobytes()
+    b.klen = take(2 * n).view(">u2").astype(np.int64)
+    kpre = take(n).astype(np.int64)
+    (ksuf_len,) = _U32.unpack_from(enc, off)
+    off += 4
+    ksuf = take(ksuf_len)
+    b.has_sketch = take(n) != 0
+    b.nm = take(2 * n).view(">u2").astype(np.int64)
+    b.sk_len = take(4 * n).view(">u4").astype(np.int64)
+    (mom_z_len,) = _U32.unpack_from(enc, off)
+    off += 4
+    try:
+        ent_t = np.frombuffer(zlib.decompress(take(mom_z_len)),
+                              np.uint8)
+    except zlib.error as e:
+        raise BlockCodecError(f"rollsum moment inflate: {e}") from None
+    E = int(b.nm.sum())
+    if len(ent_t) != E * stride:
+        raise BlockCodecError("rollsum moment section length mismatch")
+    b.ent_bytes = np.ascontiguousarray(
+        ent_t.reshape(stride, E).T) if E else \
+        np.empty((0, stride), np.uint8)
+    b.first_ent = np.zeros(n, np.int64)
+    if n > 1:
+        np.cumsum(b.nm[:-1], out=b.first_ent[1:])
+    (sk_z_len,) = _U32.unpack_from(enc, off)
+    off += 4
+    try:
+        b.sk_blob = zlib.decompress(take(sk_z_len).tobytes())
+    except zlib.error as e:
+        raise BlockCodecError(f"rollsum sketch inflate: {e}") from None
+    if off != len(buf):
+        raise BlockCodecError("trailing bytes after rollsum payload")
+    b.sk_off = np.zeros(n, np.int64)
+    if n > 1:
+        np.cumsum(b.sk_len[:-1], out=b.sk_off[1:])
+    if int(b.sk_len.sum()) != len(b.sk_blob):
+        raise BlockCodecError("rollsum sketch section length mismatch")
+    if ((b.sk_len > 0) & ~b.has_sketch).any():
+        raise BlockCodecError("rollsum sketch bytes on sketchless row")
+    b.K = _expand_keys(b.klen, kpre, ksuf)
+    return b
+
+
+def _decode_rollsum_raw(enc) -> bytes:
+    b = parse_rollsum_block(enc)
+    fam = bytes([b.fam])
+    out = []
+    th = _U16_S.pack(len(b.table)) + b.table
+    for i in range(b.n):
+        key = b.K[i, :b.klen[i]].tobytes()
+        mom = b.ent_bytes[b.first_ent[i]:b.first_ent[i] + b.nm[i]] \
+            .tobytes()
+        cells = [(fam, b"\x00", mom)]
+        if b.has_sketch[i]:
+            sk = b.sk_blob[b.sk_off[i]:b.sk_off[i] + b.sk_len[i]]
+            cells.append((fam, b"\x01", sk))
+        rec = [th, _U16_S.pack(len(key)), key, _U32.pack(len(cells))]
+        for f, q, v in cells:
+            rec += [_U16_S.pack(len(f)), f, _U16_S.pack(len(q)), q,
+                    _U32.pack(len(v)), v]
+        out.append(b"".join(rec))
+    return b"".join(out)
+
+
 # -- public API -------------------------------------------------------------
 
 def encode_block(raw: bytes, offs) -> tuple[int, bytes]:
@@ -551,12 +806,19 @@ def encode_block(raw: bytes, offs) -> tuple[int, bytes]:
         got = try_encode_data(raw, offs)
     except Exception:
         got = None
+    if got is None:
+        try:
+            got = try_encode_rollup(raw, offs)
+        except Exception:
+            got = None
     if got is not None:
         tag, enc = got
         if not SELF_CHECK:
             return tag, enc
         try:
-            if _decode_ts_raw(tag, enc) == raw:
+            decoded = _decode_rollsum_raw(enc) if tag == ROLLSUM \
+                else _decode_ts_raw(tag, enc)
+            if decoded == raw:
                 return tag, enc
         except Exception:
             pass
@@ -564,6 +826,70 @@ def encode_block(raw: bytes, offs) -> tuple[int, bytes]:
     if len(z) < len(raw):
         return ZLIB, z
     return VERBATIM, raw
+
+
+def encode_block_split(raw: bytes, offs) -> list:
+    """Encode one pending run as one or more blocks:
+    [(rel_raw_start, raw_slice, tag, payload)].
+
+    Usually a single entry (= encode_block). But a run whose
+    structured encode FAILS is probed at data-row metric boundaries
+    (table + 3-byte key prefix): adjacent metrics of different value
+    kinds — a float metric followed by an int metric — would otherwise
+    force the whole run to zlib, and every fused gather covering the
+    boundary block would decline. If splitting there lets at least one
+    segment encode structurally, the run is emitted as one block per
+    kind-segment (segments with equal probe outcomes are coalesced, so
+    uid-table runs and single-kind runs stay one block)."""
+    offs = np.asarray(offs, np.int64)
+    tag, enc = encode_block(raw, offs)
+    whole = [(0, raw, tag, enc)]
+    if tag not in (ZLIB, VERBATIM) or len(offs) < 2:
+        return whole
+    n = len(raw)
+    prefixes = []
+    for o in offs:
+        o = int(o)
+        if o + 2 > n:
+            return whole
+        tlen = _U16_S.unpack_from(raw, o)[0]
+        ko = o + 2 + tlen
+        if ko + 2 > n:
+            return whole
+        klen = _U16_S.unpack_from(raw, ko)[0]
+        if klen < 3 or ko + 5 > n:
+            return whole
+        prefixes.append(raw[o:o + 2 + tlen] + raw[ko + 2:ko + 5])
+    bounds = [0] + [i for i in range(1, len(prefixes))
+                    if prefixes[i] != prefixes[i - 1]]
+    if len(bounds) < 2:
+        return whole
+    bounds.append(len(offs))
+
+    def sub_run(i0: int, i1: int):
+        lo = int(offs[i0])
+        hi = int(offs[i1]) if i1 < len(offs) else n
+        return raw[lo:hi], offs[i0:i1] - lo, lo
+
+    segs: list = []  # (start record idx, structured tag or None)
+    for gi in range(len(bounds) - 1):
+        sraw, soffs, _ = sub_run(bounds[gi], bounds[gi + 1])
+        try:
+            got = try_encode_data(sraw, soffs)
+        except Exception:
+            got = None
+        stag = got[0] if got is not None else None
+        if not segs or segs[-1][1] != stag:
+            segs.append((bounds[gi], stag))
+    if len(segs) < 2 or all(s[1] is None for s in segs):
+        return whole
+    out = []
+    starts = [s[0] for s in segs] + [len(offs)]
+    for si in range(len(segs)):
+        sraw, soffs, lo = sub_run(starts[si], starts[si + 1])
+        stag, senc = encode_block(sraw, soffs)
+        out.append((lo, sraw, stag, senc))
+    return out
 
 
 def decode_block(tag: int, enc, raw_len: int) -> bytes:
@@ -584,6 +910,14 @@ def decode_block(tag: int, enc, raw_len: int) -> bytes:
         except Exception as e:
             raise BlockCodecError(f"ts block decode failed: {e!r}") \
                 from None
+    elif tag == ROLLSUM:
+        try:
+            out = _decode_rollsum_raw(enc)
+        except BlockCodecError:
+            raise
+        except Exception as e:
+            raise BlockCodecError(
+                f"rollsum block decode failed: {e!r}") from None
     else:
         raise BlockCodecError(f"unknown codec tag {tag}")
     if len(out) != raw_len:

@@ -18,9 +18,15 @@ Decode steps, all vectorized:
   the delta-of-delta entries (global cumsum minus a gather at each
   record's first entry — int32 wraparound keeps in-segment differences
   exact even when the global running sum overflows);
-- XOR undo via an associative scan, re-based per block (the encoder
-  chains xors from 0 at each block start);
-- bitcast to float32.
+- value inverse by block codec (the ``vkind`` static):
+  * TSF32: XOR undo via an associative scan, re-based per block (the
+    encoder chains xors from 0 at each block start), bitcast to f32;
+  * TSINT: zigzag undo + ONE segmented cumsum over the per-block
+    delta chain (the encoder chains int deltas from 0 at each block
+    start, the additive mirror of the XOR rebase). Eligibility
+    (compress/fused.py) has verified every decoded value fits int32,
+    so the modular cumsum is exact and the f32 cast matches the scan
+    path's own kernel-entry cast bit for bit.
 """
 
 from __future__ import annotations
@@ -64,27 +70,33 @@ def _seg_cumsum(x: jnp.ndarray, first_idx: jnp.ndarray) -> jnp.ndarray:
 
 
 def decode_points(ts_nb, ts_pay, v_nb, v_pay, first_idx, blk_first,
-                  rel_base):
+                  rel_base, *, vkind="f32"):
     """(rel_ts int32, values float32) for the concatenated point
     stream — the batched decode kernel shared by the fused stage and
-    the standalone jitted decoder."""
+    the standalone jitted decoder. ``vkind`` selects the value
+    inverse: "f32" (TSF32 XOR chain) or "int" (TSINT delta chain)."""
     ent = _unzigzag32(_varbytes_u32(ts_pay, ts_nb))
     steps = _seg_cumsum(ent, first_idx)
     deltas = _seg_cumsum(steps, first_idx)
     rel_ts = rel_base + deltas
     x = _varbytes_u32(v_pay, v_nb)
-    X = jax.lax.associative_scan(jnp.bitwise_xor, x)
-    Xp = jnp.concatenate([jnp.zeros(1, jnp.uint32), X])
-    bits = X ^ Xp[blk_first]
-    vals = jax.lax.bitcast_convert_type(bits, jnp.float32)
+    if vkind == "int":
+        vals = _seg_cumsum(_unzigzag32(x), blk_first) \
+            .astype(jnp.float32)
+    else:
+        X = jax.lax.associative_scan(jnp.bitwise_xor, x)
+        Xp = jnp.concatenate([jnp.zeros(1, jnp.uint32), X])
+        bits = X ^ Xp[blk_first]
+        vals = jax.lax.bitcast_convert_type(bits, jnp.float32)
     return rel_ts, vals
 
 
 decode_points_jit = compile_with_plan(
-    decode_points, ExecPlan(name="compress.decode_points", axis="block"))
+    decode_points, ExecPlan(name="compress.decode_points", axis="block",
+                            static_argnames=("vkind",)))
 
 _FUSED_STATICS = ("num_series", "num_buckets", "interval", "agg_down",
-                  "rate", "counter", "drop_resets")
+                  "rate", "counter", "drop_resets", "vkind")
 
 # The fused stage's mesh leg is the plane's pjit-preferred style: the
 # point stream (the concatenation of whole compressed blocks) shards
@@ -110,7 +122,7 @@ def _fused_block_stage_ops(ts_nb, ts_pay, v_nb, v_pay, first_idx,
                            shift, counter_max, reset_value, *,
                            num_series, num_buckets, interval,
                            agg_down, rate=False, counter=False,
-                           drop_resets=False):
+                           drop_resets=False, vkind="f32"):
     """All-positional face of the fused stage for the pjit mesh leg
     (pjit rejects call-time kwargs once shardings are specified).
     counter_max/reset_value ride as replicated scalar OPERANDS — they
@@ -121,7 +133,7 @@ def _fused_block_stage_ops(ts_nb, ts_pay, v_nb, v_pay, first_idx,
         sid, valid, lo, hi, shift, num_series=num_series,
         num_buckets=num_buckets, interval=interval, agg_down=agg_down,
         rate=rate, counter_max=counter_max, reset_value=reset_value,
-        counter=counter, drop_resets=drop_resets)
+        counter=counter, drop_resets=drop_resets, vkind=vkind)
 
 
 def fused_block_stage_mesh(mesh, **statics):
@@ -138,7 +150,7 @@ def _fused_block_stage(ts_nb, ts_pay, v_nb, v_pay, first_idx, blk_first,
                       rel_base, sid, valid, lo, hi, shift, *,
                       num_series, num_buckets, interval, agg_down,
                       rate=False, counter_max=0.0, reset_value=0.0,
-                      counter=False, drop_resets=False):
+                      counter=False, drop_resets=False, vkind="f32"):
     """Decode + range-mask + per-series downsample in ONE program.
 
     Inputs are per-point arrays (padded to a static size; padding has
@@ -152,7 +164,8 @@ def _fused_block_stage(ts_nb, ts_pay, v_nb, v_pay, first_idx, blk_first,
     path serves, this path serves identically.
     """
     rel_ts, vals = decode_points(ts_nb, ts_pay, v_nb, v_pay,
-                                 first_idx, blk_first, rel_base)
+                                 first_idx, blk_first, rel_base,
+                                 vkind=vkind)
     return _window_series_stage(
         rel_ts, vals, sid, valid, lo, hi, shift,
         num_series=num_series, num_buckets=num_buckets,
@@ -165,3 +178,122 @@ fused_block_stage = compile_with_plan(
     _fused_block_stage,
     ExecPlan(name="compress.fused_stage", axis="block",
              static_argnames=_FUSED_STATICS))
+
+
+def _fused_block_stage_sel(ts_nb, ts_pay, v_nb, v_pay, first_idx,
+                           blk_first, sel, rel_base, sid, valid,
+                           lo, hi, shift, *,
+                           num_series, num_buckets, interval, agg_down,
+                           rate=False, counter_max=0.0, reset_value=0.0,
+                           counter=False, drop_resets=False,
+                           vkind="f32"):
+    """The fused stage with the selector's matched-point compaction:
+    decode the FULL streams (the value chains span whole blocks, so
+    decode cannot skip records), then gather only the matched points
+    into the window stage. ``sel`` is the host-computed matched-point
+    index vector; ``rel_base``/``sid``/``valid`` are already gathered
+    on host to the same [M] layout (padding entries valid=False).
+    Stage cost scales with the MATCH fraction instead of the scan
+    width — the tag-filtered dashboard's win. Bit-identical to the
+    unselected stage: dropped points belong to records the stage
+    would have masked out anyway, and kept points stay in stream
+    order, so every per-(series, bucket) reduction sees the same
+    operands in the same order."""
+    deltas, vals = decode_points(ts_nb, ts_pay, v_nb, v_pay,
+                                 first_idx, blk_first,
+                                 jnp.int32(0), vkind=vkind)
+    rel_ts = rel_base + deltas[sel]
+    return _window_series_stage(
+        rel_ts, vals[sel], sid, valid, lo, hi, shift,
+        num_series=num_series, num_buckets=num_buckets,
+        interval=interval, agg_down=agg_down, rate=rate,
+        counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)
+
+
+fused_block_stage_sel = compile_with_plan(
+    _fused_block_stage_sel,
+    ExecPlan(name="compress.fused_stage_sel", axis="block",
+             static_argnames=_FUSED_STATICS))
+
+
+# -- device block cache legs ------------------------------------------------
+#
+# The devcache (compress/devcache.py) keeps each block's QUERY-
+# INDEPENDENT decoded columns resident on device: per-point qualifier
+# deltas, decoded f32 values, and the point->record map. A repeat
+# query then uploads only per-RECORD arrays (base time, series id,
+# validity — ~two orders of magnitude smaller than the point stream)
+# and one program expands them point-wise and runs the same window
+# stage. Answers are bit-identical to the byte-stream fused program:
+# identical decode math, identical point order, identical stage.
+
+def block_decode_columns(ts_nb, ts_pay, v_nb, v_pay, first_idx,
+                         blk_first, *, vkind="f32"):
+    """One gather's cached device columns: (qualifier deltas int32,
+    values float32) over the concatenated block streams. Padding
+    points carry nb == 0 and first_idx/blk_first == their own index,
+    so they decode to exact zeros."""
+    qd, vals = decode_points(ts_nb, ts_pay, v_nb, v_pay, first_idx,
+                             blk_first, jnp.zeros_like(first_idx),
+                             vkind=vkind)
+    return qd, vals
+
+
+block_decode_columns_jit = compile_with_plan(
+    block_decode_columns,
+    ExecPlan(name="compress.devcache_decode", axis="block",
+             static_argnames=("vkind",)))
+
+_DEV_STATICS = ("num_series", "num_buckets", "interval", "agg_down",
+                "rate", "counter", "drop_resets")
+
+
+def _devcache_window_stage(qd, vals, rec_of_pt, rel_base, sid, valid,
+                           lo, hi, shift, counter_max, reset_value, *,
+                           num_series, num_buckets, interval, agg_down,
+                           rate=False, counter=False,
+                           drop_resets=False):
+    """Window stage over cached decoded columns: expand the per-record
+    uploads point-wise (three gathers) and reduce — no payload bytes,
+    no decode. Padding points map to a trailing pad record with
+    valid=False."""
+    rel_ts = rel_base[rec_of_pt] + qd
+    return _window_series_stage(
+        rel_ts, vals, sid[rec_of_pt], valid[rec_of_pt], lo, hi, shift,
+        num_series=num_series, num_buckets=num_buckets,
+        interval=interval, agg_down=agg_down, rate=rate,
+        counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)
+
+
+devcache_window_stage = compile_with_plan(
+    _devcache_window_stage,
+    ExecPlan(name="compress.devcache_stage", axis="block",
+             static_argnames=_DEV_STATICS))
+
+
+def _devcache_window_stage_sel(qd, vals, rec_of_pt, sel, rel_base,
+                               sid, valid, lo, hi, shift, counter_max,
+                               reset_value, *, num_series, num_buckets,
+                               interval, agg_down, rate=False,
+                               counter=False, drop_resets=False):
+    """Window stage over cached columns with the selector's matched-
+    point compaction: gather only the matched points (``sel``, padded
+    with an index whose record is invalid) before expanding the
+    per-record uploads — stage cost scales with the match fraction,
+    and the cached columns stay selector-independent."""
+    rec_g = rec_of_pt[sel]
+    rel_ts = rel_base[rec_g] + qd[sel]
+    return _window_series_stage(
+        rel_ts, vals[sel], sid[rec_g], valid[rec_g], lo, hi, shift,
+        num_series=num_series, num_buckets=num_buckets,
+        interval=interval, agg_down=agg_down, rate=rate,
+        counter_max=counter_max, reset_value=reset_value,
+        counter=counter, drop_resets=drop_resets)
+
+
+devcache_window_stage_sel = compile_with_plan(
+    _devcache_window_stage_sel,
+    ExecPlan(name="compress.devcache_stage_sel", axis="block",
+             static_argnames=_DEV_STATICS))

@@ -41,6 +41,7 @@ from opentsdb_tpu.core.const import (MAX_TIMESPAN, NOLERP_AGGS,
                                      TIMESTAMP_BYTES, UID_WIDTH)
 from opentsdb_tpu.core.errors import BadRequestError
 from opentsdb_tpu.fault.faultpoints import fire as _fault
+from opentsdb_tpu.compress.devcache import pad_fine as _pad_fine
 from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.obs.registry import METRICS as _metrics
 from opentsdb_tpu.ops import kernels, oracle, sketches
@@ -51,6 +52,22 @@ from opentsdb_tpu.utils.lru import LRUCache
 # Fused decode-plus-aggregate serving off TSST4 blocks (compress/):
 # wall time of the gather + kernel dispatch per served query.
 _M_FUSED = _metrics.timer("compress.fused_agg")
+
+# Fused coverage accounting: attempts = queries past the fused gates
+# (the fused-eligible battery), served = answered plan:"fused"; the
+# gauge is their ratio, what /stats and /metrics expose. Every decline
+# between the two increments compress.fused.decline{reason=} — the
+# no-silent-declines contract is these three instruments agreeing.
+_C_FUSED_ATTEMPT = _metrics.counter("compress.fused.attempt")
+_C_FUSED_SERVED = _metrics.counter("compress.fused.served")
+_metrics.gauge(
+    "compress.fused.coverage",
+    lambda: (_C_FUSED_SERVED.value / _C_FUSED_ATTEMPT.value
+             if _C_FUSED_ATTEMPT.value else 0.0))
+
+
+def _count_decline(reason: str) -> None:
+    _metrics.counter("compress.fused.decline", {"reason": reason}).inc()
 
 
 # One fragment cache PER STORE, shared by every QueryExecutor over it
@@ -171,6 +188,15 @@ class QueryExecutor:
         # dropped generation; eligibility (dirty range, format mix) is
         # re-checked per query — only the decode+stage compute caches.
         self._fused_stage_cache = LRUCache(4)
+        # Device-side decoded-block cache (compress/devcache.py):
+        # per-block query-independent columns stay resident on device,
+        # bounded by total cached points. Keyed by SSTable OBJECT +
+        # block index (entries pin their generation against id reuse).
+        dbp = int(getattr(cfg, "devblock_points", 0))
+        self._devcache = None
+        if dbp > 0 and self.backend != "cpu":
+            from opentsdb_tpu.compress.devcache import DeviceBlockCache
+            self._devcache = DeviceBlockCache(dbp)
         # Approx-serving rail cache (sketch/serving.py): per-series
         # (bucket_ts, est, lo, hi) rails for CLEAN fully-window-
         # covered percentile ranges, revalidated against the tier's
@@ -1038,36 +1064,49 @@ class QueryExecutor:
 
     # -- fused decode-aggregate path (TSST4 blocks) --------------------
 
-    def _series_groups(self, series_keys, exact, group_bys):
-        """Filter + group a series-key directory on host UIDs — the
-        ONE implementation behind both the resident-window and fused
-        plans (they must answer identically, so their filter/group-by
-        semantics live in one place). sid = position in
-        ``series_keys``. Returns ({group_key_tuple: [sid]},
-        {sid: named_tags})."""
+    @staticmethod
+    def _series_selector(exact, group_bys):
+        """The ONE tag-filter/group-by predicate behind the resident-
+        window and fused plans (they must answer identically, so the
+        semantics live in one function): series_key -> group key tuple
+        when the series matches, None when filtered out. The fused
+        path pushes this down into compress/fused.gather, where it
+        runs against block keys BEFORE payload decode."""
         group_by_keys = sorted(k for k, _ in group_bys)
         want = dict(exact)
         gb = {k: (set(v) if v else None) for k, v in group_bys}
+
+        def selector(skey: bytes):
+            tag_uids = codec.series_tag_uids(skey)
+            for k, v in want.items():
+                if tag_uids.get(k) != v:
+                    return None
+            for k, allowed in gb.items():
+                v = tag_uids.get(k)
+                if v is None or (allowed is not None
+                                 and v not in allowed):
+                    return None
+            return tuple(tag_uids.get(k, b"") for k in group_by_keys)
+
+        return selector
+
+    def _named_tags(self, skey: bytes) -> dict[str, str]:
+        return {self.tsdb.tagk.get_name(k): self.tsdb.tagv.get_name(v)
+                for k, v in codec.series_tag_uids(skey).items()}
+
+    def _series_groups(self, series_keys, exact, group_bys):
+        """Filter + group a series-key directory on host UIDs via
+        ``_series_selector``. sid = position in ``series_keys``.
+        Returns ({group_key_tuple: [sid]}, {sid: named_tags})."""
+        selector = self._series_selector(exact, group_bys)
         groups: dict[tuple, list[int]] = {}
         named: dict[int, dict[str, str]] = {}
         for sid, skey in enumerate(series_keys):
-            tag_uids = codec.series_tag_uids(skey)
-            ok = all(tag_uids.get(k) == v for k, v in want.items())
-            if ok:
-                for k, allowed in gb.items():
-                    v = tag_uids.get(k)
-                    if v is None or (allowed is not None
-                                     and v not in allowed):
-                        ok = False
-                        break
-            if not ok:
+            g = selector(skey)
+            if g is None:
                 continue
-            groups.setdefault(
-                tuple(tag_uids.get(k, b"") for k in group_by_keys),
-                []).append(sid)
-            named[sid] = {
-                self.tsdb.tagk.get_name(k): self.tsdb.tagv.get_name(v)
-                for k, v in tag_uids.items()}
+            groups.setdefault(g, []).append(sid)
+            named[sid] = self._named_tags(skey)
         return groups, named
 
     def _run_fused_blocks(self, spec: QuerySpec, start: int, end: int,
@@ -1108,17 +1147,22 @@ class QueryExecutor:
             return None  # scan path raises the canonical error
         b_lo = codec.base_time(start)
         b_hi = min(codec.base_time(end), 0xFFFFFFFF)
+        _C_FUSED_ATTEMPT.inc()
         # Memtable-resident (dirty) data in range: decline — a frozen
         # answer must equal the scan bit-for-bit, and overlaying live
         # rows is the scan path's job.
         seqs, floors, stamps, dirty = store.chunk_state(
             tsdb.table, b_lo, b_hi + MAX_TIMESPAN)
         if dirty:
+            _count_decline("dirty")
             return None
         with _M_FUSED.time():
-            return self._run_fused_inner(
+            res = self._run_fused_inner(
                 spec, start, end, agg, metric_uid, exact, group_bys,
                 interval, dsagg, qbase, b_lo, b_hi)
+        if res is not None:
+            _C_FUSED_SERVED.inc()
+        return res
 
     def _run_fused_inner(self, spec, start, end, agg, metric_uid,
                          exact, group_bys, interval, dsagg, qbase,
@@ -1127,11 +1171,16 @@ class QueryExecutor:
         from opentsdb_tpu.compress import kernels as _ckernels
         tsdb = self.tsdb
         rate_kw = self._rate_kw(spec)
+        # The tag filter is part of the stage's identity now that it's
+        # pushed into the gather (filtered-out series never reach the
+        # stage grid) — leaving it out would serve one filter's grid
+        # under another's key.
         skey_cache = (metric_uid, b_lo, b_hi, interval, dsagg, start,
-                      end, tuple(sorted(rate_kw.items())))
+                      end, _filter_key(exact, group_bys),
+                      tuple(sorted(rate_kw.items())))
         hit = self._fused_stage_cache.get(skey_cache)
         if hit is not None:
-            gens_hit, src_keys, epoch, stage = hit
+            gens_hit, src_keys, epoch, stage, groups = hit
             # Validate against the CURRENT generation set: gens_hit
             # holds the SSTable objects the cached stage was computed
             # from (object identity — the entry pins them, so id
@@ -1148,78 +1197,169 @@ class QueryExecutor:
                 hit = None
                 self._fused_stage_cache.pop(skey_cache)
         if hit is None:
-            src = _fused.gather(tsdb.store, tsdb.table, metric_uid,
-                                b_lo, b_hi)
-            if src is None:
+            selector = self._series_selector(exact, group_bys)
+            use_dev = self._devcache is not None and self.mesh is None
+            try:
+                src = _fused.gather(tsdb.store, tsdb.table, metric_uid,
+                                    b_lo, b_hi, selector=selector,
+                                    points=not use_dev)
+            except _fused.Decline as d:
+                _count_decline(d.reason)
                 return None
             if src.npoints == 0:
                 return []
             epoch = src.epoch
             src_keys = src.series_keys
+            groups = src.groups
         else:
             src = None
+            use_dev = False
+        if not groups:
+            return []
         S_all = len(src_keys)
         S_pad = _pad_size(S_all)
         imin, imax = -(2**31), 2**31 - 1
         if not imin <= qbase - epoch <= imax:
+            _count_decline("int32-span")
             return None
         num_buckets = _pad_size(int((end - qbase) // interval + 1))
         if S_pad * num_buckets >= 2**31:
+            _count_decline("grid-too-large")
             return None
-        groups, named = self._series_groups(src_keys, exact, group_bys)
-        if not groups:
-            return []
+        named = {sid: self._named_tags(src_keys[sid])
+                 for sids in groups.values() for sid in sids}
         lo32 = np.int32(min(max(start - epoch, imin), imax))
         hi32 = np.int32(min(max(end - epoch, imin), imax))
         shift32 = np.int32(qbase - epoch)
         if hit is None:
-            P_pad = _pad_size(src.npoints)
-            def pad(a, dtype, fill=0):
-                out = np.full(P_pad, fill, dtype)
-                out[:len(a)] = a
-                return out
-            def padbuf(a):
-                out = np.zeros(_pad_size(max(len(a), 1)), np.uint8)
-                out[:len(a)] = a
-                return out
-            # With a mesh configured the fused stage runs through the
-            # plane's pjit-preferred leg: the point stream (whole
-            # compressed blocks) shards over the mesh, payloads and
-            # the [S, B] outputs replicate (compress/kernels.py
-            # FUSED_STAGE_PLAN). Shapes that don't divide the mesh
-            # run the single-device compile — never a decline.
-            if (self.mesh is not None
-                    and P_pad % int(self.mesh.devices.size) == 0):
-                fused_fn = _ckernels.fused_block_stage_mesh(
-                    self.mesh, num_series=S_pad,
-                    num_buckets=num_buckets, interval=interval,
-                    agg_down=dsagg, rate=rate_kw["rate"],
-                    counter=rate_kw["counter"],
-                    drop_resets=rate_kw["drop_resets"])
-                stage = list(fused_fn(
-                    pad(src.ts_nb, np.int32), padbuf(src.ts_pay),
-                    pad(src.v_nb, np.int32), padbuf(src.v_pay),
-                    pad(src.first_idx, np.int32),
-                    pad(src.blk_first, np.int32),
-                    pad(src.rel_base_pt, np.int32),
-                    pad(np.minimum(src.sid_pt, S_pad - 1), np.int32),
-                    pad(src.valid, bool, False),
-                    lo32, hi32, shift32,
-                    np.float32(rate_kw["counter_max"]),
-                    np.float32(rate_kw["reset_value"]))) + [None]
-            else:
-                stage = list(_ckernels.fused_block_stage(
-                    pad(src.ts_nb, np.int32), padbuf(src.ts_pay),
-                    pad(src.v_nb, np.int32), padbuf(src.v_pay),
-                    pad(src.first_idx, np.int32),
-                    pad(src.blk_first, np.int32),
-                    pad(src.rel_base_pt, np.int32),
-                    pad(np.minimum(src.sid_pt, S_pad - 1), np.int32),
-                    pad(src.valid, bool, False),
-                    lo32, hi32, shift32,
+            vkind = src.kind
+            if use_dev:
+                # Warm blocks: decoded columns already on device, so
+                # the dispatch uploads only per-record arrays (plus
+                # the matched-point index vector for selective
+                # filters) and runs the decode-free stage
+                # (bit-identical math).
+                qd, vals, rec, _P, _P_pad, _R = \
+                    self._devcache.columns(src)
+                rel_base, sid_r, valid_r, sel = \
+                    self._devcache.record_inputs(
+                        src, S_pad, selective=selector is not None)
+                dev_kw = dict(
                     num_series=S_pad, num_buckets=num_buckets,
                     interval=interval, agg_down=dsagg,
-                    **rate_kw)) + [None]
+                    rate=rate_kw["rate"], counter=rate_kw["counter"],
+                    drop_resets=rate_kw["drop_resets"])
+                if sel is not None:
+                    stage = list(_ckernels.devcache_window_stage_sel(
+                        qd, vals, rec, sel, rel_base, sid_r, valid_r,
+                        lo32, hi32, shift32,
+                        np.float32(rate_kw["counter_max"]),
+                        np.float32(rate_kw["reset_value"]),
+                        **dev_kw)) + [None]
+                else:
+                    stage = list(_ckernels.devcache_window_stage(
+                        qd, vals, rec, rel_base, sid_r, valid_r,
+                        lo32, hi32, shift32,
+                        np.float32(rate_kw["counter_max"]),
+                        np.float32(rate_kw["reset_value"]),
+                        **dev_kw)) + [None]
+            else:
+                P_pad = _pad_fine(src.npoints)
+                def pad(a, dtype, fill=0):
+                    out = np.full(P_pad, fill, dtype)
+                    out[:len(a)] = a
+                    return out
+                def padbuf(a):
+                    # Payload bytes pad pow2: decode compute is
+                    # per-POINT, byte padding costs only upload, and
+                    # one compile class per octave keeps shifted
+                    # windows from recompiling on byte-length wobble.
+                    n = max(len(a), 1)
+                    p = 1 << (n - 1).bit_length()
+                    out = np.zeros(p, np.uint8)
+                    out[:len(a)] = a
+                    return out
+                # With a mesh configured the fused stage runs through
+                # the plane's pjit-preferred leg: the point stream
+                # (whole compressed blocks) shards over the mesh,
+                # payloads and the [S, B] outputs replicate
+                # (compress/kernels.py FUSED_STAGE_PLAN). Shapes that
+                # don't divide the mesh run the single-device compile
+                # — counted (mesh-indivisible) but still served fused,
+                # never a fallback to the scan.
+                mesh_leg = (self.mesh is not None
+                            and P_pad % int(self.mesh.devices.size)
+                            == 0)
+                if self.mesh is not None and not mesh_leg:
+                    _count_decline("mesh-indivisible")
+                if mesh_leg:
+                    fused_fn = _ckernels.fused_block_stage_mesh(
+                        self.mesh, num_series=S_pad,
+                        num_buckets=num_buckets, interval=interval,
+                        agg_down=dsagg, rate=rate_kw["rate"],
+                        counter=rate_kw["counter"],
+                        drop_resets=rate_kw["drop_resets"],
+                        vkind=vkind)
+                    stage = list(fused_fn(
+                        pad(src.ts_nb, np.int32), padbuf(src.ts_pay),
+                        pad(src.v_nb, np.int32), padbuf(src.v_pay),
+                        pad(src.first_idx, np.int32),
+                        pad(src.blk_first, np.int32),
+                        pad(src.rel_base_pt, np.int32),
+                        pad(np.minimum(src.sid_pt, S_pad - 1),
+                            np.int32),
+                        pad(src.valid, bool, False),
+                        lo32, hi32, shift32,
+                        np.float32(rate_kw["counter_max"]),
+                        np.float32(rate_kw["reset_value"]))) + [None]
+                else:
+                    matched = (np.flatnonzero(src.valid)
+                               if selector is not None else None)
+                    if matched is not None \
+                            and len(matched) < src.npoints:
+                        # Selective filter: decode the full streams
+                        # (value chains span whole blocks) but stage
+                        # only the matched points — stage cost scales
+                        # with the match fraction. Padding sel
+                        # entries re-read point 0 under valid=False.
+                        M_pad = _pad_fine(max(len(matched), 1))
+                        def padm(a, dtype, fill=0):
+                            out = np.full(M_pad, fill, dtype)
+                            out[:len(matched)] = a
+                            return out
+                        stage = list(_ckernels.fused_block_stage_sel(
+                            pad(src.ts_nb, np.int32),
+                            padbuf(src.ts_pay),
+                            pad(src.v_nb, np.int32),
+                            padbuf(src.v_pay),
+                            pad(src.first_idx, np.int32),
+                            pad(src.blk_first, np.int32),
+                            padm(matched, np.int32),
+                            padm(src.rel_base_pt[matched], np.int32),
+                            padm(np.minimum(src.sid_pt[matched],
+                                            S_pad - 1), np.int32),
+                            padm(np.ones(len(matched), bool), bool,
+                                 False),
+                            lo32, hi32, shift32,
+                            num_series=S_pad, num_buckets=num_buckets,
+                            interval=interval, agg_down=dsagg,
+                            vkind=vkind, **rate_kw)) + [None]
+                    else:
+                        stage = list(_ckernels.fused_block_stage(
+                            pad(src.ts_nb, np.int32),
+                            padbuf(src.ts_pay),
+                            pad(src.v_nb, np.int32),
+                            padbuf(src.v_pay),
+                            pad(src.first_idx, np.int32),
+                            pad(src.blk_first, np.int32),
+                            pad(src.rel_base_pt, np.int32),
+                            pad(np.minimum(src.sid_pt, S_pad - 1),
+                                np.int32),
+                            pad(src.valid, bool, False),
+                            lo32, hi32, shift32,
+                            num_series=S_pad, num_buckets=num_buckets,
+                            interval=interval, agg_down=dsagg,
+                            vkind=vkind, **rate_kw)) + [None]
             # Key the entry on the SNAPSHOT the stage was actually
             # computed from (src.spans — not a fresh encoded_range,
             # which a checkpoint racing this query could have moved
@@ -1228,7 +1368,7 @@ class QueryExecutor:
             self._fused_stage_cache.put(
                 skey_cache,
                 (tuple(g for g, _, _ in src.spans),
-                 src_keys, epoch, stage))
+                 src_keys, epoch, stage, groups))
         sv, sm, filled, in_range, presence_dev = stage[:5]
         gkeys = sorted(groups)
         G = _pad_size(len(gkeys))

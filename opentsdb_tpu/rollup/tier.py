@@ -46,6 +46,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import shutil
 import threading
 from typing import Iterable
@@ -206,6 +207,14 @@ class RollupTier:
                 self.stores[r] = []
                 for d in self._dirs[r]:
                     s = MemKVStore(wal_path=os.path.join(d, "wal"))
+                    # Tier spills ride the same codec knob as the raw
+                    # store: under "tsst4" the summary superrows land
+                    # in self-describing ROLLSUM blocks (columnar
+                    # entry bytes — the block-direct read fast path in
+                    # scan_records serves off them without inflating
+                    # whole rows).
+                    s.sstable_codec = getattr(config, "sstable_codec",
+                                              "none")
                     s.ensure_table(self.table)
                     self.stores[r].append(s)
         except BaseException:
@@ -656,9 +665,12 @@ class RollupTier:
         # cell layout this replaced made reads sstable-unpack-bound.
         acc: dict[bytes, tuple[list, list, list]] = {}
         for s in self.stores[res]:
-            for key, items in s.scan_raw(self.table, start_key, stop_key,
-                                         family=ROLLUP_FAMILY,
-                                         key_regexp=key_regexp):
+            rows = self._block_rows(s, start_key, stop_key, key_regexp)
+            if rows is None:
+                rows = s.scan_raw(self.table, start_key, stop_key,
+                                  family=ROLLUP_FAMILY,
+                                  key_regexp=key_regexp)
+            for key, items in rows:
                 sb = codec.key_base_time(key)
                 skey = codec.series_key(key)
                 ent = acc.get(skey)
@@ -696,6 +708,95 @@ class RollupTier:
             if len(base_arr) or sk:
                 out[skey] = (base_arr, rec, sk)
         return out
+
+    def _block_rows(self, s, start_key: bytes, stop_key: bytes,
+                    key_regexp: bytes | None):
+        """Block-direct read of one tier store's ROLLSUM blocks:
+        [(key, [(qual, cell_bytes)])] sorted by key, or None when the
+        store must fall back to scan_raw (memtable-resident rows in
+        range, a non-ROLLSUM covering block, or duplicate keys across
+        generations needing newest-wins overlay).
+
+        Serving is byte-for-byte identical to the row scan: the cell
+        bytes come straight off the block's columnar entry matrix —
+        the very bytes the row framing would carry — so the moment/
+        sketch decode downstream sees the same input. What this skips
+        is the whole-row zlib inflate + v3 re-framing + per-row cell
+        parse of the generic path (one transposed inflate per block,
+        parsed once and cached on the immutable sstable object)."""
+        er = getattr(s, "encoded_range", None)
+        if er is None:
+            return None
+        try:
+            # Memtable/frozen rows in range overlay the blocks —
+            # that's scan_raw's job.
+            for k in s.pending_keys(self.table):
+                if start_key <= k < stop_key:
+                    return None
+            spans = er(self.table, start_key, stop_key)
+        except Exception:
+            return None
+        if spans is None:
+            return None
+        if not spans:
+            return []
+        if len(spans) > 1:
+            allk = [k for sst, lo, hi in spans
+                    for k in sst._index[self.table][0][lo:hi]]
+            if len(set(allk)) != len(allk):
+                return None   # re-folded superrow: newest-wins overlay
+        pattern = re.compile(key_regexp, re.S) if key_regexp else None
+        out = []
+        for sst, lo, hi in spans:
+            keys, offs = sst._index[self.table]
+            blk_ids = np.unique(
+                np.searchsorted(sst._blk_raw,
+                                np.asarray(offs[lo:hi], np.int64),
+                                "right") - 1)
+            for j in blk_ids.tolist():
+                rb = self._rollsum_block(sst, j)
+                if rb is None or rb.fam != ROLLUP_FAMILY[0] \
+                        or rb.table != self.table.encode():
+                    return None
+                for i in range(rb.n):
+                    key = rb.K[i, :rb.klen[i]].tobytes()
+                    if not start_key <= key < stop_key:
+                        continue
+                    if pattern is not None and not pattern.match(key):
+                        continue
+                    fe = int(rb.first_ent[i])
+                    items = [(QUAL_MOMENTS,
+                              rb.ent_bytes[fe:fe + rb.nm[i]].tobytes())]
+                    if rb.has_sketch[i]:
+                        o = int(rb.sk_off[i])
+                        items.append(
+                            (QUAL_SKETCH,
+                             rb.sk_blob[o:o + int(rb.sk_len[i])]))
+                    out.append((key, items))
+        # Generations may interleave key ranges; the row scan yields a
+        # global key-ordered merge, so match it (keys are unique here).
+        out.sort(key=lambda kv: kv[0])
+        return out
+
+    @staticmethod
+    def _rollsum_block(sst, j: int):
+        """Parsed ROLLSUM block ``j``, cached on the sstable; None for
+        any other tag (caller falls back). The parse holds no views of
+        the file mmap (all arrays are freshly inflated), so caching
+        cannot pin a closed map."""
+        from opentsdb_tpu.compress import codecs as _codecs
+        cache = sst.__dict__.setdefault("_rollsum_cache", {})
+        if j in cache:
+            return cache[j]
+        rb = None
+        try:
+            tag, _raw_len, _enc_len = sst.block_header(j)
+            if tag == _codecs.ROLLSUM:
+                rb = _codecs.parse_rollsum_block(sst.block_enc(j))
+        except Exception:
+            rb = None
+        cache[j] = rb
+        return rb
 
     # -- checkpoint integration (called by TSDB.checkpoint) ---------------
 

@@ -1123,9 +1123,28 @@ class TSDServer:
                 expert_counts["serve"] += obj.value
             elif name == "mesh.expert.decline":
                 expert_counts["decline"] += obj.value
+        # The fused-on-compressed-blocks coverage line: what fraction
+        # of fused-eligible batteries actually served fused, why the
+        # rest declined, and how warm the device block cache is.
+        fused = {"attempt": 0, "served": 0, "declines": {},
+                 "devcache": {"hit": 0, "miss": 0, "evict": 0}}
+        for name, kind, tkey, obj in METRICS._snapshot():
+            if name == "compress.fused.attempt":
+                fused["attempt"] += obj.value
+            elif name == "compress.fused.served":
+                fused["served"] += obj.value
+            elif name == "compress.fused.decline":
+                reason = dict(tkey).get("reason", "?")
+                fused["declines"][reason] = \
+                    fused["declines"].get(reason, 0) + obj.value
+            elif name.startswith("compress.devcache."):
+                fused["devcache"][name.rsplit(".", 1)[1]] = obj.value
+        fused["coverage"] = (fused["served"] / fused["attempt"]
+                             if fused["attempt"] else 0.0)
         body = {
             "uptime_s": int(time.time()) - self.start_time,
             "plans": dict(self.plan_counts),
+            "fused": fused,
             "sketch": sketch,
             "rollup": rollup,
             # The mesh execution plane's compile-cache line: devices
@@ -2276,6 +2295,17 @@ function render(t){
       return p[k];}).map(function(k){
         var cls=k==="approx"?" class='warn'":"";
         return ["<span"+cls+">"+esc(k)+"</span>", p[k]];}));
+  var f=t.fused;
+  if(f&&f.attempt){
+    var dec=Object.keys(f.declines||{}).sort().map(function(k){
+      return esc(k)+"="+esc(f.declines[k]);}).join(" ")||"none";
+    var dc=f.devcache||{};
+    document.getElementById("plans").innerHTML+=
+      "<p>fused coverage: <b>"+(100*f.coverage).toFixed(1)+"%</b> ("+
+      f.served+"/"+f.attempt+" batteries) &middot; declines: "+dec+
+      " &middot; devcache hit/miss/evict: "+(dc.hit||0)+"/"+
+      (dc.miss||0)+"/"+(dc.evict||0)+"</p>";
+  }
   document.getElementById("sketch").innerHTML=
     pills("Sketch serving (error contract)", t.sketch||{});
   var r=t.rollup;

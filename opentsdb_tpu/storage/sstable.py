@@ -281,11 +281,15 @@ class _BodyWriter:
             return
         raw = self._chunks[0] if len(self._chunks) == 1 \
             else b"".join(self._chunks)
-        tag, enc = _codecs.encode_block(raw, self._offs)
         raw_start = self.raw_off - self._pend
-        self.blocks.append((raw_start, self.f.tell()))
-        self.f.write(_BLOCK_HDR.pack(tag, len(raw), len(enc)))
-        self.f.write(enc)
+        # One flush may emit several physical blocks: a run mixing
+        # value kinds at a metric boundary splits so each side keeps a
+        # structured (fused-servable) codec instead of whole-run zlib.
+        for rel, sub, tag, enc in _codecs.encode_block_split(
+                raw, self._offs):
+            self.blocks.append((raw_start + rel, self.f.tell()))
+            self.f.write(_BLOCK_HDR.pack(tag, len(sub), len(enc)))
+            self.f.write(enc)
         # Compressed block body written, not yet durable: torn mode
         # cuts INSIDE this block specifically (header + payload), the
         # state a mid-spill power cut leaves — recovery must treat the

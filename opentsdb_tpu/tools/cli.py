@@ -622,7 +622,9 @@ def cmd_fsck(args) -> int:
                        sorted(rep.format_counts.items()))
         print(f"sstable formats: {mix}")
     if rep.blocks:
-        print(f"compressed blocks: {rep.blocks} audited, "
+        per = " ".join(f"{name}={n}" for name, n in
+                       sorted(rep.codec_counts.items()))
+        print(f"compressed blocks: {rep.blocks} audited ({per}), "
               f"{rep.codec_errors} codec errors")
     dt = max(time.time() - t0, 1e-9)
     print(f"{rep.kvs} KVs (in {rep.rows} rows) analyzed in "

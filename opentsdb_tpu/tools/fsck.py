@@ -50,6 +50,11 @@ class FsckReport:
     codec_errors: int = 0   # block-level failures (counted in errors):
     #                         unknown codec tag, decode failure, or
     #                         uncompressed-size mismatch
+    # Per-codec block counts (name -> blocks): the operator's view of
+    # how much of the store each block codec actually carries — a
+    # "tsint=0" here after an int-heavy migration is a planner bug,
+    # not a compaction lag.
+    codec_counts: dict = dataclasses.field(default_factory=dict)
 
     @property
     def clean(self) -> bool:
@@ -141,7 +146,14 @@ def _run_fsck(tsdb, fix: bool, log) -> FsckReport:
     # files carry blooms, v1/v2 files don't and simply never prune;
     # TSST4 files additionally get every block's codec tag, decode,
     # and uncompressed size verified).
-    stores = getattr(tsdb.store, "shards", None) or [tsdb.store]
+    stores = list(getattr(tsdb.store, "shards", None) or [tsdb.store])
+    # Rollup tier stores hold ROLLSUM blocks — same audit (tag known,
+    # payload decodes, size matches), same error accounting.
+    tier = getattr(tsdb, "rollups", None)
+    if tier is not None:
+        for group in getattr(tier, "stores", {}).values():
+            stores.extend(group)
+    from opentsdb_tpu.compress.codecs import CODEC_NAMES
     for s in stores:
         for sst in getattr(s, "_ssts", []):
             fmt = getattr(sst, "format", 3)
@@ -162,6 +174,14 @@ def _run_fsck(tsdb, fix: bool, log) -> FsckReport:
             audit = getattr(sst, "block_audit", None)
             if audit is not None and getattr(sst, "block_count", 0):
                 rep.blocks += sst.block_count
+                for j in range(sst.block_count):
+                    try:
+                        tag = sst.block_header(j)[0]
+                    except Exception:
+                        continue    # block_audit reports it below
+                    name = CODEC_NAMES.get(tag, f"tag{tag}")
+                    rep.codec_counts[name] = \
+                        rep.codec_counts.get(name, 0) + 1
                 bad = audit(say)
                 rep.codec_errors += bad
                 rep.errors += bad

@@ -44,6 +44,15 @@ class Config:
     # exact (the path declines rather than approximates); off forces
     # the classic decode-then-reduce scan.
     sstable_fused_agg: bool = True
+    # Device-side block cache (compress/devcache.py): total decoded
+    # POINTS kept resident on device (~12 bytes/point across the
+    # qualifier-delta/value/record columns). Warm fused queries then
+    # upload only per-record arrays instead of re-uploading and
+    # re-decoding payload byte streams. Sized so a dashboard's whole
+    # battery of rows over one window shares a single resident decode
+    # alongside a second window's entry (~100 MB at the default).
+    # 0 disables the cache.
+    devblock_points: int = 1 << 23
 
     # core behavior (names mirror the reference's system properties)
     auto_create_metrics: bool = False   # tsd.core.auto_create_metrics

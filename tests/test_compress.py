@@ -429,8 +429,10 @@ class TestFsckAndStats:
         try:
             _workload(t)
             sst = t.store._ssts[-1]
-            tag, raw_len, enc_len = sst.block_header(0)
-            pos = sst._blk_file[0] + 9 + enc_len // 2
+            # Corrupt the header's raw_len (byte 1): a size mismatch
+            # is detected for every codec, including checksum-less
+            # structured blocks.
+            pos = sst._blk_file[0] + 1
             path = sst.path
             t.shutdown()
             data = bytearray(open(path, "rb").read())
@@ -452,8 +454,7 @@ class TestFsckAndStats:
         try:
             _workload(t)
             sst = t.store._ssts[-1]
-            tag, raw_len, enc_len = sst.block_header(0)
-            pos = sst._blk_file[0] + 9 + enc_len // 2
+            pos = sst._blk_file[0] + 1   # header raw_len byte
             path = sst.path
         finally:
             t.shutdown()
@@ -593,14 +594,14 @@ class TestFusedPath:
         finally:
             t.shutdown()
 
-    def test_fused_declines_tsint_blocks_bit_identical(self, tmp_path):
-        """Int-valued series spill as TSINT blocks; the fused path is
-        float-only (TSF32 XOR chains), so it must decline cleanly to
-        the classic scan — and the scan's answers must be bit-
-        identical to a codec=none control store over the same points
-        (guards the float-only eligibility check: a silent
-        misclassification would feed int bit patterns to the f32
-        bitcast)."""
+    def test_fused_serves_tsint_blocks_bit_identical(self, tmp_path):
+        """Int-valued series spill as TSINT blocks and now SERVE the
+        fused path (zigzag-delta inverse via one segmented int32
+        cumsum) — answers must be bit-identical to a codec=none
+        control store running the classic scan: integer decode is
+        exact by the eligibility contract (every value fits int32),
+        and the f32 cast matches the scan path's own kernel-entry
+        cast."""
         import shutil as _sh
         specs = [QuerySpec("m.int", {}, "sum", downsample=(3600, "sum")),
                  QuerySpec("m.int", {"host": "*"}, "max",
@@ -640,8 +641,8 @@ class TestFusedPath:
             for spec in specs:
                 r4, plan4, _ = ex4.run_with_plan(spec, BASE + 100,
                                                  BASE + 20 * 3600)
-                assert plan4 == "raw", \
-                    "TSINT blocks must decline the fused path"
+                assert plan4 == "fused", \
+                    "TSINT blocks must serve the fused path"
                 r0, plan0, _ = ex0.run_with_plan(spec, BASE + 100,
                                                  BASE + 20 * 3600)
                 assert plan0 == "raw"
@@ -649,7 +650,7 @@ class TestFusedPath:
                 for a, b in zip(r4, r0):
                     assert a.tags == b.tags
                     assert np.array_equal(a.timestamps, b.timestamps)
-                    # Bit-identical: same classic scan both sides.
+                    # Bit-identical: exact int decode both sides.
                     assert np.array_equal(a.values, b.values)
         finally:
             t4.shutdown()
@@ -675,3 +676,459 @@ class TestFusedPath:
             assert plan == "raw"
         finally:
             t.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Decline accounting: every remaining fused decline path must (a) fall
+# back to an answer byte-identical to a codec=none control store and
+# (b) bump a NAMED compress.fused.decline{reason=} counter — "zero
+# undeclared declines" is the PR contract, and these pin each cause.
+# ---------------------------------------------------------------------------
+
+def _decline_count(reason: str) -> int:
+    from opentsdb_tpu.obs.registry import METRICS
+    return METRICS.counter("compress.fused.decline",
+                           {"reason": reason}).value
+
+
+def _mk_tpu_tsdb(tmp_path, name, codec):
+    d = str(tmp_path / name)
+    os.makedirs(d, exist_ok=True)
+    cfg = Config(auto_create_metrics=True, wal_path=d, shards=1,
+                 backend="tpu", enable_sketches=False,
+                 device_window=False, sstable_codec=codec)
+    return TSDB(MemKVStore(wal_path=os.path.join(d, "wal")), cfg,
+                start_compaction_thread=False)
+
+
+def _int_batch(t, metric, host, t0, span, step, seed, lo=-500, hi=5000):
+    rng = np.random.default_rng(seed)
+    ts = t0 + np.arange(0, span, step, dtype=np.int64)
+    t.add_batch(metric, ts, rng.integers(lo, hi, len(ts)),
+                {"host": host})
+
+
+def _pair_answers(t4, t0, spec, lo, hi):
+    """(rows, plan) from the tsst4 store and the codec=none control,
+    with the control's plan asserted 'raw'."""
+    ex4 = QueryExecutor(t4, backend="tpu")
+    ex0 = QueryExecutor(t0, backend="tpu")
+    r4, plan4, _ = ex4.run_with_plan(spec, lo, hi)
+    r0, plan0, _ = ex0.run_with_plan(spec, lo, hi)
+    assert plan0 == "raw"
+    assert len(r4) == len(r0)
+    for a, b in zip(r4, r0):
+        assert a.tags == b.tags
+        assert np.array_equal(a.timestamps, b.timestamps)
+        assert np.array_equal(a.values, b.values)
+    return plan4
+
+
+class TestFusedDeclineCounters:
+    SPEC = QuerySpec("m.d", {}, "sum", downsample=(3600, "sum"))
+
+    def test_dirty_decline_counted_fallback_identical(self, tmp_path):
+        t4 = _mk_tpu_tsdb(tmp_path, "dd4", "tsst4")
+        t0 = _mk_tpu_tsdb(tmp_path, "dd0", "none")
+        try:
+            for t in (t4, t0):
+                _int_batch(t, "m.d", "a", BASE, 6 * 3600, 300, 5)
+                t.checkpoint()
+                # Live memtable point inside the range -> dirty.
+                t.add_batch("m.d", np.array([BASE + 3600 + 7]),
+                            np.array([11.0]), {"host": "a"})
+            before = _decline_count("dirty")
+            plan4 = _pair_answers(t4, t0, self.SPEC,
+                                  BASE + 100, BASE + 5 * 3600)
+            assert plan4 == "raw"
+            assert _decline_count("dirty") >= before + 1
+        finally:
+            t4.shutdown()
+            t0.shutdown()
+
+    def test_mixed_codec_decline(self, tmp_path):
+        """One generation spills TSINT blocks, the next TSF32 blocks
+        for the same metric: one fused program cannot decode both, so
+        the gather declines 'mixed-codec' and the scan serves."""
+        t4 = _mk_tpu_tsdb(tmp_path, "mc4", "tsst4")
+        t0 = _mk_tpu_tsdb(tmp_path, "mc0", "none")
+        try:
+            for t in (t4, t0):
+                _int_batch(t, "m.d", "a", BASE, 6 * 3600, 300, 6)
+                t.checkpoint()
+                rng = np.random.default_rng(7)
+                ts = BASE + np.arange(0, 6 * 3600, 300,
+                                      dtype=np.int64) + 3
+                t.add_batch("m.d", ts,
+                            np.cumsum(rng.normal(0, 1, len(ts))),
+                            {"host": "b"})
+                t.checkpoint()
+            from opentsdb_tpu.compress.codecs import TSF32, TSINT
+            tags = set()
+            for sst in t4.store._ssts:
+                tags |= {sst.block_header(j)[0]
+                         for j in range(sst.block_count)}
+            assert TSINT in tags and TSF32 in tags
+            before = _decline_count("mixed-codec")
+            plan4 = _pair_answers(t4, t0, self.SPEC,
+                                  BASE + 100, BASE + 5 * 3600)
+            assert plan4 == "raw"
+            assert _decline_count("mixed-codec") >= before + 1
+        finally:
+            t4.shutdown()
+            t0.shutdown()
+
+    def test_duplicate_overlap_declines_disjoint_serves(self, tmp_path):
+        """The same rowkey written across two generations: overlapping
+        in-row time ranges decline (newest-wins overlay would need a
+        host re-merge); DISJOINT ranges still serve fused — the lazy
+        per-record delta-bounds check separates the two."""
+        spec = self.SPEC
+        # Overlapping: gen2 rewrites interleaved timestamps.
+        t4 = _mk_tpu_tsdb(tmp_path, "do4", "tsst4")
+        t0 = _mk_tpu_tsdb(tmp_path, "do0", "none")
+        try:
+            for t in (t4, t0):
+                _int_batch(t, "m.d", "a", BASE, 4 * 3600, 600, 8)
+                t.checkpoint()
+                _int_batch(t, "m.d", "a", BASE + 300, 4 * 3600, 600, 9)
+                t.checkpoint()
+            before = _decline_count("duplicate-overlap")
+            plan4 = _pair_answers(t4, t0, spec,
+                                  BASE + 100, BASE + 4 * 3600)
+            assert plan4 == "raw"
+            assert _decline_count("duplicate-overlap") >= before + 1
+        finally:
+            t4.shutdown()
+            t0.shutdown()
+        # Disjoint: gen1 holds each hour's first half, gen2 the rest.
+        t4 = _mk_tpu_tsdb(tmp_path, "dj4", "tsst4")
+        t0 = _mk_tpu_tsdb(tmp_path, "dj0", "none")
+        try:
+            for t in (t4, t0):
+                for h in range(4):
+                    _int_batch(t, "m.d", "a", BASE + h * 3600, 1800,
+                               300, 10 + h)
+                t.checkpoint()
+                for h in range(4):
+                    _int_batch(t, "m.d", "a",
+                               BASE + h * 3600 + 1800, 1800, 300,
+                               20 + h)
+                t.checkpoint()
+            assert len(t4.store._ssts) >= 2
+            plan4 = _pair_answers(t4, t0, spec,
+                                  BASE + 100, BASE + 4 * 3600)
+            assert plan4 == "fused"
+        finally:
+            t4.shutdown()
+            t0.shutdown()
+
+    def test_mesh_indivisible_counted_still_serves(self, tmp_path):
+        """A mesh whose device count does not divide the padded point
+        grid declines the SHARDED leg (counted) but still serves the
+        query fused on one device — same plan, same answer."""
+        import types
+        t4 = _mk_tpu_tsdb(tmp_path, "mi4", "tsst4")
+        t0 = _mk_tpu_tsdb(tmp_path, "mi0", "none")
+        try:
+            for t in (t4, t0):
+                _int_batch(t, "m.d", "a", BASE, 6 * 3600, 300, 12)
+                _int_batch(t, "m.d", "b", BASE, 6 * 3600, 300, 13)
+                t.checkpoint()
+            ex = QueryExecutor(t4, backend="tpu")
+            # Three devices never divide a pow2-padded point count.
+            ex.mesh = types.SimpleNamespace(devices=np.zeros(3))
+            before = _decline_count("mesh-indivisible")
+            r_m, plan_m, _ = ex.run_with_plan(self.SPEC, BASE + 100,
+                                              BASE + 5 * 3600)
+            assert plan_m == "fused"
+            assert _decline_count("mesh-indivisible") >= before + 1
+            plan4 = _pair_answers(t4, t0, self.SPEC,
+                                  BASE + 100, BASE + 5 * 3600)
+            assert plan4 == "fused"
+            ex0 = QueryExecutor(t0, backend="tpu")
+            r0, _, _ = ex0.run_with_plan(self.SPEC, BASE + 100,
+                                         BASE + 5 * 3600)
+            for a, b in zip(r_m, r0):
+                assert np.array_equal(a.values, b.values)
+        finally:
+            t4.shutdown()
+            t0.shutdown()
+
+
+class TestDeviceBlockCache:
+    def test_hit_miss_counters_and_repeat_identity(self, tmp_path):
+        """First fused query decodes every covering block on device
+        (misses); a second query over the same blocks re-serves from
+        the cache (hits, zero new misses) with identical answers."""
+        from opentsdb_tpu.obs.registry import METRICS
+        hit = METRICS.counter("compress.devcache.hit")
+        miss = METRICS.counter("compress.devcache.miss")
+        t4 = _mk_tpu_tsdb(tmp_path, "dc4", "tsst4")
+        t0 = _mk_tpu_tsdb(tmp_path, "dc0", "none")
+        try:
+            for t in (t4, t0):
+                for si in range(4):
+                    _int_batch(t, "m.d", f"h{si}", BASE, 24 * 3600,
+                               300, 30 + si)
+                t.checkpoint()
+            ex4 = QueryExecutor(t4, backend="tpu")
+            assert ex4._devcache is not None
+            ex0 = QueryExecutor(t0, backend="tpu")
+            spec = QuerySpec("m.d", {}, "sum", downsample=(3600, "sum"))
+            h0, m0 = hit.value, miss.value
+            r1, plan1, _ = ex4.run_with_plan(spec, BASE + 100,
+                                             BASE + 20 * 3600)
+            assert plan1 == "fused"
+            assert miss.value > m0
+            m1 = miss.value
+            assert len(ex4._devcache) > 0
+            # A different window over the same blocks: the stage cache
+            # misses but every block decode is already resident.
+            spec2 = QuerySpec("m.d", {}, "max", downsample=(7200, "max"))
+            r2, plan2, _ = ex4.run_with_plan(spec2, BASE + 50,
+                                             BASE + 18 * 3600)
+            assert plan2 == "fused"
+            assert hit.value > h0
+            assert miss.value == m1
+            for spec_i, lo, hi, rows in [
+                    (spec, BASE + 100, BASE + 20 * 3600, r1),
+                    (spec2, BASE + 50, BASE + 18 * 3600, r2)]:
+                r0, plan0, _ = ex0.run_with_plan(spec_i, lo, hi)
+                assert plan0 == "raw"
+                assert len(rows) == len(r0)
+                for a, b in zip(rows, r0):
+                    assert np.array_equal(a.timestamps, b.timestamps)
+                    assert np.array_equal(a.values, b.values)
+        finally:
+            t4.shutdown()
+            t0.shutdown()
+
+    def test_selector_compaction_bit_identical(self, tmp_path):
+        """A literal tag filter that drops most records runs the
+        compacted (sel-gather) stage: decode the full stream, gather
+        only matching points, stage cost proportional to the match.
+        Answers must stay bit-identical to the codec=none scan on BOTH
+        legs — the device cache's devcache_window_stage_sel and the
+        byte path's fused_block_stage_sel."""
+        t4 = _mk_tpu_tsdb(tmp_path, "sc4", "tsst4")
+        t0 = _mk_tpu_tsdb(tmp_path, "sc0", "none")
+        try:
+            for t in (t4, t0):
+                rng = np.random.default_rng(41)
+                for si in range(8):
+                    ts = BASE + np.arange(0, 24 * 3600, 300,
+                                          dtype=np.int64) + si
+                    t.add_batch("m.d", ts,
+                                rng.integers(-500, 5000, len(ts)),
+                                {"host": f"h{si}", "dc": f"d{si % 4}"})
+                t.checkpoint()
+            ex4 = QueryExecutor(t4, backend="tpu")
+            ex0 = QueryExecutor(t0, backend="tpu")
+            specs = [
+                # 2 of 8 series match: selective, aggregated.
+                QuerySpec("m.d", {"dc": "d1"}, "sum",
+                          downsample=(3600, "sum")),
+                # Group-by over a selective subset.
+                QuerySpec("m.d", {"host": "h2", "dc": "*"}, "max",
+                          downsample=(7200, "max"))]
+            for legs in ("devcache", "bytes"):
+                ex4._devcache = ex4._devcache if legs == "devcache" \
+                    else None
+                ex4._frag_cache.clear()
+                for spec in specs:
+                    r4, plan4, _ = ex4.run_with_plan(
+                        spec, BASE + 100, BASE + 20 * 3600)
+                    assert plan4 == "fused", (legs, spec.tags)
+                    r0, plan0, _ = ex0.run_with_plan(
+                        spec, BASE + 100, BASE + 20 * 3600)
+                    assert plan0 == "raw"
+                    assert len(r4) == len(r0) > 0
+                    for a, b in zip(r4, r0):
+                        assert a.tags == b.tags
+                        assert np.array_equal(a.timestamps,
+                                              b.timestamps)
+                        assert np.array_equal(a.values, b.values)
+        finally:
+            t4.shutdown()
+            t0.shutdown()
+
+
+class TestRollsumPath:
+    """ROLLSUM: the structured rollup-record codec. Coverage contract:
+    tier spills carry ROLLSUM-tagged blocks, rollup-served answers are
+    byte-for-byte identical to a codec=none control, the tier's
+    block-direct read path engages, fsck audits the blocks (per-codec
+    counts included), and a corrupted ROLLSUM block fails
+    ``fsck --expect-clean`` with exit 2."""
+
+    def _build(self, tmp_path, name, codec):
+        d = str(tmp_path / name)
+        os.makedirs(d, exist_ok=True)
+        cfg = Config(auto_create_metrics=True, wal_path=d, shards=1,
+                     backend="cpu", enable_sketches=False,
+                     device_window=False, sstable_codec=codec,
+                     enable_rollups=True, rollup_catchup="sync")
+        t = TSDB(MemKVStore(wal_path=os.path.join(d, "wal")), cfg,
+                 start_compaction_thread=False)
+        rng = np.random.default_rng(7)
+        for si in range(3):
+            ts = BASE + np.arange(0, 35 * 86400, 3600,
+                                  dtype=np.int64) + si
+            t.add_batch("m.cpu", ts, rng.normal(size=len(ts)),
+                        {"host": f"h{si}"})
+        t.checkpoint()
+        return t
+
+    @staticmethod
+    def _tier_tags(t):
+        from opentsdb_tpu.compress.codecs import CODEC_NAMES
+        tags = {}
+        for res, stores in t.rollups.stores.items():
+            for s in stores:
+                for sst in getattr(s, "_ssts", []):
+                    for j in range(sst.block_count):
+                        nm = CODEC_NAMES.get(sst.block_header(j)[0])
+                        tags[nm] = tags.get(nm, 0) + 1
+        return tags
+
+    def test_rollsum_blocks_serve_byte_identical(self, tmp_path):
+        t4 = self._build(tmp_path, "rs4", "tsst4")
+        t0 = self._build(tmp_path, "rs0", "none")
+        try:
+            assert self._tier_tags(t4).get("rollsum", 0) >= 1
+            ex4 = QueryExecutor(t4, backend="cpu")
+            ex0 = QueryExecutor(t0, backend="cpu")
+            spec = QuerySpec("m.cpu", {}, "sum",
+                             downsample=(86400, "avg"))
+            r4, p4, _ = ex4.run_with_plan(spec, BASE,
+                                          BASE + 30 * 86400)
+            r0, p0, _ = ex0.run_with_plan(spec, BASE,
+                                          BASE + 30 * 86400)
+            assert p4 == "1d" and p0 == "1d"
+            assert len(r4) == len(r0) > 0
+            for a, b in zip(r4, r0):
+                assert np.array_equal(a.timestamps, b.timestamps)
+                assert np.array_equal(a.values, b.values)
+            # The tier's block-direct read engaged (parsed ROLLSUM
+            # columns cached on the sstable, no per-row re-framing).
+            assert any(
+                sst.__dict__.get("_rollsum_cache")
+                for stores in t4.rollups.stores.values()
+                for s in stores for sst in getattr(s, "_ssts", []))
+        finally:
+            t4.shutdown()
+            t0.shutdown()
+
+    def test_fsck_audits_rollsum_and_codec_counts(self, tmp_path):
+        from opentsdb_tpu.tools.fsck import run_fsck
+        t = self._build(tmp_path, "rsf", "tsst4")
+        try:
+            rep = run_fsck(t)
+            assert rep.clean
+            assert rep.codec_counts.get("rollsum", 0) >= 1
+            # Data-table blocks are counted per codec too.
+            assert sum(rep.codec_counts.values()) == rep.blocks
+        finally:
+            t.shutdown()
+
+    def test_cli_expect_clean_on_corrupt_rollsum(self, tmp_path):
+        from opentsdb_tpu.compress.codecs import ROLLSUM
+        from opentsdb_tpu.tools import cli
+        t = self._build(tmp_path, "rsc", "tsst4")
+        try:
+            path = pos = None
+            for stores in t.rollups.stores.values():
+                for s in stores:
+                    for sst in getattr(s, "_ssts", []):
+                        for j in range(sst.block_count):
+                            tag, _, enc_len = sst.block_header(j)
+                            if tag == ROLLSUM:
+                                path = sst.path
+                                pos = sst._blk_file[j] + 9 \
+                                    + enc_len // 2
+                                break
+                        if path:
+                            break
+                    if path:
+                        break
+                if path:
+                    break
+            assert path is not None
+        finally:
+            t.shutdown()
+        wal = str(tmp_path / "rsc" / "wal")
+        assert cli.main(["fsck", "--wal", wal, "--backend", "cpu",
+                         "--expect-clean"]) == 0
+        data = bytearray(open(path, "rb").read())
+        data[pos] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+        assert cli.main(["fsck", "--wal", wal, "--backend", "cpu",
+                         "--expect-clean"]) == 2
+
+
+class TestFusedObservability:
+    def test_stats_queries_and_check_cover_fused(self, tmp_path,
+                                                 capsys):
+        """/stats + /metrics export compress.fused.coverage and the
+        devcache counters, /api/queries carries the fused-coverage
+        block, and `tsdb check --stats-metric` thresholds it."""
+        import asyncio
+        import json as _json
+
+        from tests.test_admission import (http_get, make_server,
+                                          run_with_server)
+
+        from opentsdb_tpu.tools.cli import main as cli_main
+        server, tsdb = make_server(tmp_path, backend="tpu",
+                                   sstable_codec="tsst4")
+        rng = np.random.default_rng(3)
+        for si in range(4):
+            ts = BASE + np.arange(0, 12 * 3600, 300,
+                                  dtype=np.int64) + si
+            tsdb.add_batch("m.cpu", ts,
+                           np.cumsum(rng.normal(0, 1, len(ts))),
+                           {"host": f"h{si}"})
+        tsdb.checkpoint()
+
+        async def drive(port):
+            sq, _, bq = await http_get(
+                port, f"/q?start={BASE + 100}&end={BASE + 10 * 3600}"
+                      "&m=sum:1h-avg:m.cpu&json&nocache")
+            sa, _, ba = await http_get(port, "/stats?json")
+            sp, _, bp = await http_get(port, "/metrics")
+            sf, _, bf = await http_get(port, "/api/queries")
+            loop = asyncio.get_running_loop()
+            # Counters are process-global (other tests may have
+            # recorded declines), so threshold at the extremes.
+            rc_ok = await loop.run_in_executor(None, cli_main, [
+                "check", "-H", "127.0.0.1", "-p", str(port),
+                "--stats-metric", "tsd.compress.fused.coverage",
+                "-x", "lt", "-c", "0.000001"])
+            rc_bad = await loop.run_in_executor(None, cli_main, [
+                "check", "-H", "127.0.0.1", "-p", str(port),
+                "--stats-metric", "tsd.compress.fused.coverage",
+                "-x", "ge", "-c", "0"])
+            return (sq, bq), (sa, ba), (sp, bp), (sf, bf), \
+                rc_ok, rc_bad
+
+        (sq, bq), (sa, ba), (sp, bp), (sf, bf), rc_ok, rc_bad = \
+            run_with_server(server, drive)
+        tsdb.shutdown()
+        assert sq == 200 and sa == 200 and sp == 200 and sf == 200
+        lines = _json.loads(ba)
+        cov = [ln for ln in lines
+               if ln.startswith("tsd.compress.fused.coverage ")]
+        assert cov and float(cov[0].split()[2]) > 0, cov
+        assert any(ln.startswith("tsd.compress.devcache.hit ")
+                   for ln in lines)
+        assert any(ln.startswith("tsd.compress.devcache.miss ")
+                   for ln in lines)
+        assert b"compress_fused_coverage" in bp \
+            or b"compress.fused.coverage" in bp
+        feed = _json.loads(bf)
+        assert feed["fused"]["attempt"] >= 1
+        assert feed["fused"]["served"] >= 1
+        assert 0 < feed["fused"]["coverage"] <= 1.0
+        assert "devcache" in feed["fused"]
+        assert rc_ok == 0 and rc_bad != 0
