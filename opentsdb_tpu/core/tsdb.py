@@ -115,11 +115,24 @@ class TSDB:
         use_devwindow = (self.config.device_window
                         and not getattr(store, "read_only", False))
         if use_devwindow and self.config.backend != "cpu":
-            from opentsdb_tpu.storage.devstore import DeviceWindow
+            if self.config.devwindow_shards > 0:
+                # Mesh-sharded hot set: logical shards round-robined
+                # over the mesh devices (storage/devshard.py) so
+                # capacity and stage throughput scale with mesh width.
+                from opentsdb_tpu.storage.devshard import \
+                    ShardedDeviceWindow
 
-            self.devwindow = DeviceWindow(
-                staging_points=self.config.device_window_staging,
-                max_points=self.config.device_window_points)
+                self.devwindow = ShardedDeviceWindow(
+                    devices=self._devwindow_devices(),
+                    n_shards=self.config.devwindow_shards,
+                    staging_points=self.config.device_window_staging,
+                    max_points=self.config.device_window_points)
+            else:
+                from opentsdb_tpu.storage.devstore import DeviceWindow
+
+                self.devwindow = DeviceWindow(
+                    staging_points=self.config.device_window_staging,
+                    max_points=self.config.device_window_points)
             self._warm_devwindow()
         # Materialized rollup tier (rollup/tier.py): daemons with a
         # persistent store only — an in-memory store never spills, so
@@ -161,6 +174,24 @@ class TSDB:
                 from opentsdb_tpu.rollup.tier import RollupTier
 
                 self.rollups = RollupTier(self, self.config)
+
+    def _devwindow_devices(self):
+        """The mesh device list the sharded hot set pins its shards to
+        (mesh_shape when set, else all local devices). Import failure
+        or an unbuildable mesh degrades to default placement — the
+        sharded path still runs, single-device."""
+        try:
+            import jax
+
+            if self.config.mesh_shape:
+                from opentsdb_tpu.parallel.plan import (
+                    build_mesh, flatten_series_mesh)
+                mesh = flatten_series_mesh(
+                    build_mesh(self.config.mesh_shape))
+                return list(mesh.devices.reshape(-1))
+            return list(jax.local_devices())
+        except Exception:
+            return [None]
 
     def _warm_devwindow(self) -> None:
         """Mirror pre-existing storage (WAL-replayed memtable + sstable

@@ -93,7 +93,10 @@ class Scenario:
     n_ops: int = 36
     delete_heavy: bool = False
     bug: str | None = None
-    kind: str = "crash"   # "crash" (child process) | "replica" (in-proc)
+    # "crash" (child process) | "replica"/"promote" (in-proc) |
+    # "meshreshard" (child process WITH jax: sharded hot set, dies at
+    # the reshard commit gate)
+    kind: str = "crash"
     # Write-side sstable codec for the workload ("none" | "tsst4"):
     # the sst.write.block scenarios need compressed spills to reach
     # their faultpoint; verification reopens with the same codec so
@@ -235,17 +238,23 @@ def open_store(dirpath: str, shards: int, read_only: bool = False):
 
 def open_tsdb(dirpath: str, shards: int, rollups: bool,
               codec: str = "none", incremental: bool = True,
-              tenant_cutoff: int = -1) -> TSDB:
+              tenant_cutoff: int = -1, mesh: bool = False) -> TSDB:
     """Writer TSDB with the harness profile: cpu backend, sketches and
     device window off (the child must stay jax-free), compactions off
     and no background threads (schedule determinism), rollup catch-up
     SYNC so a post-crash reopen finishes its rebuild before verify
     queries run. Tenant accounting stays ON (its default): every
-    crash scenario doubles as a TENANTS.json recovery check."""
+    crash scenario doubles as a TENANTS.json recovery check.
+
+    ``mesh=True`` (the meshreshard scenarios only) opts INTO jax: the
+    ingest path runs through a 2-shard resident hot set on CPU devices
+    so the ``mesh.reshard.commit`` faultpoint is reachable."""
     cfg = Config(
-        wal_path=dirpath, shards=shards, backend="cpu",
+        wal_path=dirpath, shards=shards,
+        backend="tpu" if mesh else "cpu",
         auto_create_metrics=True, enable_compactions=False,
-        enable_sketches=False, device_window=False,
+        enable_sketches=False, device_window=mesh,
+        devwindow_shards=2 if mesh else 0,
         enable_rollups=rollups, rollup_catchup="sync",
         rollup_incremental_catchup=incremental,
         sstable_codec=codec,
@@ -342,7 +351,8 @@ def _child_main(args) -> int:
         _apply_bug(args.bug)
     tsdb = open_tsdb(args.dir, args.shards, args.rollups,
                      codec=args.codec,
-                     tenant_cutoff=args.tenant_cutoff)
+                     tenant_cutoff=args.tenant_cutoff,
+                     mesh=args.mesh_reshard)
     with open(args.progress, "a") as pf:
         for i, op in enumerate(ops):
             apply_op(tsdb, op)
@@ -351,6 +361,10 @@ def _child_main(args) -> int:
             # flushed first and recovery must surface it.
             pf.write(f"{i}\n")
             pf.flush()
+            if args.mesh_reshard and i == len(ops) // 2:
+                # Live hot-set redistribution mid-schedule; the armed
+                # mesh.reshard.commit site SIGKILLs at the swap gate.
+                tsdb.devwindow.reshard(n_shards=4)
         pf.write("end\n")
         pf.flush()
     tsdb.shutdown()
@@ -658,6 +672,67 @@ def _check_catchup_parity(dirpath: str, sc: Scenario, tsdb: TSDB,
     return problems
 
 
+def _check_resident_parity(dirpath: str, sc: Scenario) -> list[str]:
+    """Post-crash REWARM of the sharded resident hot set: the SIGKILL
+    landed at the ``mesh.reshard.commit`` gate, so the swap never
+    happened and nothing half-redistributed can have reached durable
+    state (the hot set is device memory; durability is the WAL's). A
+    restart must (a) rebuild a coherent sharded window, (b) serve
+    fresh appends from the RESIDENT plan with scan-path parity, and
+    (c) complete the reshard the crash interrupted — with the same
+    parity at the new width."""
+    from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+    problems: list[str] = []
+    tsdb = open_tsdb(dirpath, sc.shards, rollups=False, mesh=True)
+    try:
+        dw = tsdb.devwindow
+        if dw is None or not hasattr(dw, "shard_of"):
+            return ["mesh reopen did not build a sharded hot set"]
+        hour = _EXTRA_HOUR + 100 * 3600
+        for i in range(3):
+            apply_op(tsdb, ("ingest", i, hour + i * 3600, 1, 300, 0,
+                            11 + i))
+        spec = QuerySpec("sys.cpu.user", {"host": "*"},
+                         aggregator="sum", downsample=(600, "avg"))
+        ex = QueryExecutor(tsdb, backend="tpu")
+        lo, hi = hour, hour + 3 * 3600
+
+        def compare(tag: str) -> None:
+            h0 = dw.window_hits
+            got = ex.run(spec, lo, hi)
+            if dw.window_hits <= h0:
+                problems.append(f"{tag}: query fell back off the "
+                                f"resident plan")
+            keep, tsdb.devwindow = tsdb.devwindow, None
+            try:
+                want = ex.run(spec, lo, hi)
+            finally:
+                tsdb.devwindow = keep
+            k_g = {tuple(sorted(r.tags.items())): r for r in got}
+            k_w = {tuple(sorted(r.tags.items())): r for r in want}
+            if set(k_g) != set(k_w):
+                problems.append(f"{tag}: resident group set != scan")
+                return
+            for gk, a in k_g.items():
+                b = k_w[gk]
+                if not (np.array_equal(a.timestamps, b.timestamps)
+                        and np.allclose(a.values, b.values,
+                                        rtol=1e-5, atol=1e-5)):
+                    problems.append(f"{tag}: resident answer != scan "
+                                    f"answer group={dict(gk)}")
+
+        compare("post-crash rewarm")
+        dw.reshard(n_shards=4)
+        if dw.n_shards != 4 or dw.reshard_count != 1:
+            problems.append("post-crash reshard did not complete")
+        compare("post-crash reshard to width 4")
+    except Exception as e:
+        problems.append(f"resident parity check crashed: {e!r}")
+    finally:
+        tsdb.shutdown()
+    return problems
+
+
 def verify(dirpath: str, sc: Scenario, ops: list[tuple],
            ops_done: int) -> tuple[list[str], str]:
     """Reopen after the crash and check every invariant. Returns
@@ -749,7 +824,9 @@ def _run_once(sc: Scenario, workdir: str) -> dict:
                                    count=sc.count, seed=sc.seed)
     env = dict(os.environ)
     env["TSDB_FAULTPOINTS"] = spec
-    env["JAX_PLATFORMS"] = "cpu"   # belt: the child never imports jax
+    # Most children never import jax; meshreshard children DO (the
+    # sharded hot set) and must stay on CPU devices.
+    env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = _repo_root() + os.pathsep + \
         env.get("PYTHONPATH", "")
     cmd = [sys.executable, "-m", "opentsdb_tpu.fault.harness",
@@ -766,6 +843,8 @@ def _run_once(sc: Scenario, workdir: str) -> dict:
         cmd += ["--codec", sc.codec]
     if sc.tenant_cutoff >= 0:
         cmd += ["--tenant-cutoff", str(sc.tenant_cutoff)]
+    if sc.kind == "meshreshard":
+        cmd.append("--mesh-reshard")
     result = {
         "label": sc.label, "site": sc.site, "mode": sc.mode,
         "skip": sc.skip, "shards": sc.shards, "rollups": sc.rollups,
@@ -795,6 +874,10 @@ def _run_once(sc: Scenario, workdir: str) -> dict:
     else:
         ops = gen_ops(sc.seed, sc.n_ops, sc.delete_heavy)
         problems, state_hash = verify(store_dir, sc, ops, ops_done)
+        if not problems and sc.kind == "meshreshard":
+            # Runs after verify's writer closed: the rewarm reopens
+            # the store read-write itself.
+            problems += _check_resident_parity(store_dir, sc)
         result["problems"] = problems
         result["status"] = "ok" if not problems else "invariant-failed"
     result["fingerprint"] = hashlib.sha1(
@@ -810,6 +893,10 @@ def repro_command(sc: Scenario) -> str:
     scenario from its explicit parameters — label-independent, so
     ad-hoc/bug-injected scenarios (whose labels are not in the matrix)
     reproduce too."""
+    if sc.kind != "crash":
+        # Non-default kinds carry behavior the flag surface doesn't
+        # encode — reproduce by matrix label.
+        return f"python scripts/crashmatrix.py --only {sc.label}"
     out = (f"python scripts/crashmatrix.py --site {sc.site} "
            f"--mode {sc.mode} --skip {sc.skip} --shards {sc.shards} "
            f"--seed {sc.seed} --n-ops {sc.n_ops}")
@@ -1035,6 +1122,7 @@ FAST_LABELS = (
     "rollup-foldflush-incrcmp-s1",
     "tenant-snap-commit-torn-s1",
     "shard-join-crash-k2",
+    "meshreshard-commit-crash",
 )
 
 
@@ -1144,6 +1232,12 @@ def build_matrix() -> list[Scenario]:
     # must recover to an estimate within the declared error bound.
     add("tenant-snap-commit-torn-hll", "tenant.snapshot.commit",
         "torn", shards=1, rollups=True, seed=5101, tenant_cutoff=0)
+    # Sharded resident hot set: SIGKILL at the reshard commit gate.
+    # The swap never lands; a restart must rebuild coherent, serve
+    # resident with scan parity, and finish the interrupted reshard.
+    add("meshreshard-commit-crash", "mesh.reshard.commit", "crash",
+        shards=1, rollups=False, kind="meshreshard", seed=6001,
+        n_ops=12)
     # Replica refresh faults (in-process, no child crash).
     add("replica-refresh-ioerror", "replica.refresh", "ioerror",
         shards=1, kind="replica", seed=3101)
@@ -1202,6 +1296,7 @@ def main(argv=None) -> int:
     p.add_argument("--codec", default="none",
                    choices=("none", "tsst4"))
     p.add_argument("--tenant-cutoff", type=int, default=-1)
+    p.add_argument("--mesh-reshard", action="store_true")
     args = p.parse_args(argv)
     return _child_main(args)
 

@@ -855,7 +855,13 @@ class QueryExecutor:
         un-downsampled queries, dirty/evicted windows, unknown UIDs,
         out-of-int32 epochs/ranges)."""
         dw = getattr(self.tsdb, "devwindow", None)
-        if (dw is None or self.backend == "cpu" or self.mesh is not None
+        # A mesh executor serves the resident path only through the
+        # mesh-SHARDED window (devshard.py): the plain single-device
+        # window under a mesh keeps declining as before (its columns
+        # live on one device while the mesh plans expect sharding).
+        sharded = hasattr(dw, "shard_of")
+        if (dw is None or self.backend == "cpu"
+                or (self.mesh is not None and not sharded)
                 or not spec.downsample
                 or agg.kind not in ("moment", "percentile")
                 or Aggregators.get(spec.downsample[1]).kind
@@ -894,7 +900,10 @@ class QueryExecutor:
         # (e.g. an all-time query against a metric whose epoch is past
         # 2^31), fall back to the scan path rather than silently
         # mis-bucketing (devstore's exact-or-fall-back contract).
-        if not imin <= qbase - cols.epoch <= imax:
+        # Sharded windows carry one epoch PER shard; all must fit.
+        epochs = ([sc.epoch for sc in cols.shards if sc is not None]
+                  if sharded else [cols.epoch])
+        if not all(imin <= qbase - e <= imax for e in epochs):
             return None
         num_buckets = _pad_size(int((end - qbase) // interval + 1))
         S_all = len(cols.series_keys)
@@ -926,14 +935,24 @@ class QueryExecutor:
                 for sid in groups[gkey]:
                     include[sid] = True
                     gmap[sid] = gi
-            include, gmap = jax.device_put(include), jax.device_put(gmap)
+            # Sharded window: commit to the combine device (the first
+            # owning shard's) so the apply's inputs are colocated with
+            # the gathered stage grids.
+            tgt = None
+            if sharded:
+                for sc in cols.shards:
+                    if sc is not None and sc.chunks:
+                        try:
+                            tgt = next(iter(sc.chunks[0][0].devices()))
+                        except Exception:
+                            tgt = None
+                        break
+            include = jax.device_put(include, tgt)
+            gmap = jax.device_put(gmap, tgt)
             # Generation lives in the VALUE (the _dw_plan_cache
             # pattern): a directory growth overwrites in place, so dead
             # generations never accumulate device arrays.
             mask_cache.put(mkey, (cols.generation, include, gmap))
-        lo32 = np.int32(min(max(start - cols.epoch, imin), imax))
-        hi32 = np.int32(min(max(end - cols.epoch, imin), imax))
-        shift32 = np.int32(qbase - cols.epoch)
         ngroups = 1 if len(gkeys) == 1 else G
         rate_kw = self._rate_kw(spec)
         # The heavy N-point half of ANY window query (range mask +
@@ -951,10 +970,24 @@ class QueryExecutor:
         stage = cache.get(skey)
         if stage is None:
             try:
-                grids = kernels.window_series_stage_chunks(
-                    cols.chunks, lo32, hi32, shift32,
-                    num_series=S_pad, num_buckets=num_buckets,
-                    interval=interval, agg_down=dsagg, **rate_kw)
+                if sharded:
+                    grids = self._dw_sharded_stage(
+                        cols, start, end, qbase,
+                        num_buckets=num_buckets, S_pad=S_pad,
+                        interval=interval, dsagg=dsagg,
+                        rate_kw=rate_kw)
+                    if grids is None:
+                        return None
+                else:
+                    lo32 = np.int32(
+                        min(max(start - cols.epoch, imin), imax))
+                    hi32 = np.int32(
+                        min(max(end - cols.epoch, imin), imax))
+                    shift32 = np.int32(qbase - cols.epoch)
+                    grids = kernels.window_series_stage_chunks(
+                        cols.chunks, lo32, hi32, shift32,
+                        num_series=S_pad, num_buckets=num_buckets,
+                        interval=interval, agg_down=dsagg, **rate_kw)
             except Exception as e:
                 # A near-HBM window can still OOM building the stage
                 # grids; degrade to the storage scan (the
@@ -1040,6 +1073,69 @@ class QueryExecutor:
                 spec.metric, tags, aggregated, grid_ts,
                 gv[gi][mask].astype(np.float64)))
         return results
+
+    def _dw_sharded_stage(self, cols, start: int, end: int, qbase: int,
+                          *, num_buckets: int, S_pad: int,
+                          interval: int, dsagg: str, rate_kw: dict):
+        """The stage half of a resident query over the mesh-SHARDED
+        hot set (storage/devshard.py): each shard's chunk fold runs on
+        its OWN device (the committed chunk inputs pin the jit there;
+        async dispatch overlaps the shards), then only the [S_shard, B]
+        stage grids — never the N-point columns — travel to the first
+        shard's device, concatenate in combined-directory order, and
+        pad to S_pad. Row order equals ``cols.series_keys`` order, so
+        include/gmap and the apply kernels are oblivious to sharding.
+
+        Numeric contract (declared, README "Serving mesh"): the
+        per-shard folds are the SAME f32 kernels as the 1-shard path
+        and a series never splits across shards, so count/min/max rows
+        are byte-identical across shard counts while sum/avg/dev rows
+        agree to f32 tolerance (bucket partial sums reassociate across
+        chunk boundaries that fall differently per shard).
+
+        Returns the window_series_stage grid tuple, or None when some
+        shard's epoch shift cannot represent in int32 (scan fallback,
+        checked again here because the caller's probe reads the shards
+        it captured — a reshard between the two is benign either way).
+        """
+        import jax.numpy as jnp
+        imin, imax = -(2**31), 2**31 - 1
+        parts = []
+        for sc in cols.shards:
+            if sc is None:
+                continue
+            if not imin <= qbase - sc.epoch <= imax:
+                return None
+            S_i = len(sc.series_keys)
+            grids = kernels.window_series_stage_chunks(
+                sc.chunks,
+                np.int32(min(max(start - sc.epoch, imin), imax)),
+                np.int32(min(max(end - sc.epoch, imin), imax)),
+                np.int32(qbase - sc.epoch),
+                num_series=_pad_size(S_i), num_buckets=num_buckets,
+                interval=interval, agg_down=dsagg, **rate_kw)
+            parts.append((S_i, grids))
+        if not parts:
+            return None
+        try:
+            target = next(iter(parts[0][1][0].devices()))
+        except Exception:
+            target = None
+        outs = []
+        for gi in range(5):
+            rows = [jax.device_put(grids[gi][:S_i], target)
+                    for S_i, grids in parts]
+            cat = rows[0] if len(rows) == 1 else jnp.concatenate(rows)
+            short = S_pad - int(cat.shape[0])
+            if short:
+                # Zero/False rows are exactly what the 1-shard stage
+                # produces for its padding sids (no points: mask and
+                # in_range False, values 0) — the apply's include mask
+                # never selects them either way.
+                cat = jnp.pad(cat, [(0, short)]
+                              + [(0, 0)] * (cat.ndim - 1))
+            outs.append(cat)
+        return tuple(outs)
 
     def _devwindow_groups(self, dw, metric_uid: bytes, cols, exact,
                           group_bys):

@@ -503,3 +503,154 @@ def window_summaries_sharded(series, res: int, mesh):
                           + origin - wbase).astype(np.uint32)
         results[gi] = (wbase, rec)
     return results
+
+
+# ---------------------------------------------------------------------------
+# Device CHECKPOINT fold (opt-in, declared storage contract)
+# ---------------------------------------------------------------------------
+#
+# window_summaries (above) is the canonical float64-HOST checkpoint
+# fold with a bit-exactness contract against raw float64 scans. This
+# section moves that fold on-device behind the execution plane
+# (Config.rollup_device_fold): f64 accumulation where the backend
+# supports it (jax x64 — CPU yes, TPU no), else f32 with the contract
+# explicitly RELAXED. Either way the fold KIND is declared in the
+# tier's state file ("fold": host-f64 | device-f64 | device-f32),
+# because even the f64 device fold is tolerance-level vs the host
+# pairwise sum: XLA's scatter-add reduction order is unspecified,
+# while the host fold pins numpy's pairwise order. Callers that need
+# the byte contract keep the default (host).
+
+_DEVICE_F64: bool | None = None
+
+
+def device_f64_supported() -> bool:
+    """Probe (once) whether the default jax backend really computes in
+    float64 under x64 mode — CPU does; TPU silently can't."""
+    global _DEVICE_F64
+    if _DEVICE_F64 is None:
+        try:
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import enable_x64
+
+            with enable_x64():
+                x = jax.device_put(np.array([1.0, 2.0**-40]))
+                _DEVICE_F64 = bool(
+                    np.asarray(x).dtype == np.float64
+                    and float(jnp.sum(x)) != 1.0)
+        except Exception:
+            _DEVICE_F64 = False
+    return _DEVICE_F64
+
+
+def device_fold_kind() -> str:
+    """The storage-contract label a device checkpoint fold would run
+    under on this backend (the tier declares it in its state file)."""
+    return "device-f64" if device_f64_supported() else "device-f32"
+
+
+def _device_fold_fn():
+    """The jitted fold body, built lazily (summary stays importable
+    without jax) and registered on the execution plane."""
+    import jax.numpy as jnp
+
+    from opentsdb_tpu.parallel.compile import jit_plan
+    from opentsdb_tpu.parallel.plan import ExecPlan
+
+    @jit_plan(ExecPlan(name="rollup.checkpoint_fold", axis="series",
+                       static_argnames=("num_windows", "res")))
+    def fold(rel_ts, vals, valid, *, num_windows, res):
+        n = rel_ts.shape[0]
+        w = jnp.clip(rel_ts // res, 0, num_windows - 1)
+        w = jnp.where(valid, w, num_windows)    # spill row for padding
+        nW = num_windows + 1
+        count = jnp.zeros(nW, jnp.int32).at[w].add(1)
+        total = jnp.zeros(nW, vals.dtype).at[w].add(
+            jnp.where(valid, vals, 0))
+        mn = jnp.full(nW, jnp.inf, vals.dtype).at[w].min(
+            jnp.where(valid, vals, jnp.inf))
+        mx = jnp.full(nW, -jnp.inf, vals.dtype).at[w].max(
+            jnp.where(valid, vals, -jnp.inf))
+        idx = jnp.arange(n, dtype=jnp.int32)
+        i_first = jnp.full(nW, n, jnp.int32).at[w].min(
+            jnp.where(valid, idx, n))
+        i_last = jnp.full(nW, -1, jnp.int32).at[w].max(
+            jnp.where(valid, idx, -1))
+        gf = jnp.clip(i_first, 0, n - 1)
+        gl = jnp.clip(i_last, 0, n - 1)
+        return (count[:num_windows], total[:num_windows],
+                mn[:num_windows], mx[:num_windows],
+                vals[gf][:num_windows], vals[gl][:num_windows],
+                rel_ts[gf][:num_windows], rel_ts[gl][:num_windows])
+
+    return fold
+
+
+_DEVICE_FOLD = None
+
+
+def window_summaries_device(ts: np.ndarray, vals: np.ndarray,
+                            res: int) -> tuple[np.ndarray, np.ndarray]:
+    """:func:`window_summaries` computed ON DEVICE behind the plane.
+    Same (window_bases, REC_DTYPE records) return; sums accumulate in
+    f64 when the backend supports it (:func:`device_fold_kind`), and
+    the result is tolerance-level — NOT byte-identical — vs the host
+    fold (XLA scatter order). Spans the int32 rebase can't carry (or a
+    missing/odd jax) fall back to the host fold silently: the caller's
+    declared kind stays honest because the contract it declares is
+    "at most this relaxed"."""
+    n = len(ts)
+    if n == 0:
+        return (np.empty(0, np.int64), np.empty(0, REC_DTYPE))
+    origin = int(ts[0]) - int(ts[0]) % res
+    span = int(ts[-1]) - origin
+    num_windows = span // res + 1
+    if span > 2**31 - 1 or num_windows > 1 << 22:
+        return window_summaries(ts, vals, res)
+    global _DEVICE_FOLD
+    try:
+        import jax
+
+        if _DEVICE_FOLD is None:
+            _DEVICE_FOLD = _device_fold_fn()
+        f64 = device_f64_supported()
+        pad_n = 1 << max(int(n - 1).bit_length(), 10)
+        pad_w = 1 << max(int(num_windows - 1).bit_length(), 6)
+        rel = np.zeros(pad_n, np.int32)
+        rel[:n] = (np.asarray(ts, np.int64) - origin).astype(np.int32)
+        v = np.zeros(pad_n, np.float64 if f64 else np.float32)
+        v[:n] = vals
+        valid = np.zeros(pad_n, bool)
+        valid[:n] = True
+
+        def run():
+            return [np.asarray(g) for g in _DEVICE_FOLD(
+                jax.device_put(rel), jax.device_put(v),
+                jax.device_put(valid), num_windows=pad_w, res=res)]
+
+        if f64:
+            from jax.experimental import enable_x64
+
+            with enable_x64():
+                grids = run()
+        else:
+            grids = run()
+    except Exception:
+        return window_summaries(ts, vals, res)
+    count, total, mn, mx, first, last, t_first, t_last = grids
+    mask = count > 0
+    w_idx = np.flatnonzero(mask)
+    rec = np.empty(len(w_idx), REC_DTYPE)
+    rec["count"] = count[mask].astype(np.uint32)
+    rec["sum"] = total[mask].astype(np.float64)
+    rec["min"] = mn[mask].astype(np.float64)
+    rec["max"] = mx[mask].astype(np.float64)
+    rec["first"] = first[mask].astype(np.float64)
+    rec["last"] = last[mask].astype(np.float64)
+    wbase = origin + w_idx.astype(np.int64) * res
+    rec["first_dt"] = (t_first[mask].astype(np.int64)
+                       + origin - wbase).astype(np.uint32)
+    rec["last_dt"] = (t_last[mask].astype(np.int64)
+                      + origin - wbase).astype(np.uint32)
+    return wbase, rec

@@ -286,6 +286,22 @@ class RollupTier:
         self.sketch_byte_budget = int(getattr(config,
                                               "sketch_byte_budget", 0))
 
+        # Checkpoint fold backend. Default is the host NumPy f64
+        # pairwise fold (bit-exact across chunkings); Config.
+        # rollup_device_fold moves the scatter fold on-device — f64
+        # accumulation where the backend keeps it, else an EXPLICITLY
+        # relaxed f32 contract. The applied kind is declared in the
+        # state file: records folded under different kinds mix
+        # accumulation orders inside the same stored rows, so a kind
+        # change rebuilds like any layout change (but a legacy state
+        # file with no "fold" key means host-f64 — see _needs_rebuild).
+        if bool(getattr(config, "rollup_device_fold", False)):
+            self.fold_kind = summary.device_fold_kind()
+            self._fold_fn = summary.window_summaries_device
+        else:
+            self.fold_kind = "host-f64"
+            self._fold_fn = summary.window_summaries
+
         store = tsdb.store
         self._sharded = hasattr(store, "shards") and hasattr(store, "_route")
         base_dirs: list[str]
@@ -434,7 +450,12 @@ class RollupTier:
                 # allocation (_needs_rebuild) so record-count drift
                 # around a quantization edge can't flap the layout.
                 "alloc": {str(r): list(self.sketch_alloc[r])
-                          for r in self.resolutions}}
+                          for r in self.resolutions},
+                # Declared numeric contract of the records: which fold
+                # backend accumulated them. Compared with a host-f64
+                # default so pre-existing state files (no key) stay
+                # adopted — see _needs_rebuild / _adopt_state.
+                "fold": self.fold_kind}
 
     @classmethod
     def adopt_config(cls, state_path: str, config) -> bool:
@@ -523,8 +544,11 @@ class RollupTier:
                     self.resolutions):
                 self.sketch_alloc = adopted
                 cfg = self._config_dict()
-        config_ok = all(st.get(k) == v for k, v in cfg.items()
-                        if k != "pending")
+        # "fold" compares against a host-f64 default: legacy state
+        # files predate the key and their records ARE host-f64 folds.
+        config_ok = (all(st.get(k) == v for k, v in cfg.items()
+                         if k not in ("pending", "fold"))
+                     and st.get("fold", "host-f64") == self.fold_kind)
         if st.get("pending", True):
             wins = st.get("inflight")
             if (config_ok and isinstance(wins, list)
@@ -1133,7 +1157,7 @@ class RollupTier:
                      buf: _MapBuffer) -> None:
         head, tail = skey[:UID_WIDTH], skey[UID_WIDTH:]
         for r in self.resolutions:
-            wb, recs = summary.window_summaries(ts, vals, r)
+            wb, recs = self._fold_fn(ts, vals, r)
             blob = recs.tobytes()
             span = r * self.pack
             # Window emission is the fold's per-record hot loop: hoist
@@ -1286,6 +1310,10 @@ class RollupTier:
 
     def collect_stats(self, collector) -> None:
         collector.record("rollup.ready", int(self._ready))
+        # Declared fold backend (gauge-of-1 with a kind tag): lets
+        # operators confirm which numeric contract the stored records
+        # carry without reading ROLLUP.json.
+        collector.record("rollup.fold", 1, f"kind={self.fold_kind}")
         collector.record("rollup.folds", self.folds)
         collector.record("rollup.records", self.records_written)
         collector.record("rollup.rebuilds", self.rebuilds)
@@ -1471,6 +1499,10 @@ class ReadOnlyRollupTier(RollupTier):
         self.moment_k = int(st.get("moment_k", 0))
         self.moment_min_res = int(st.get("moment_min_res", 0))
         self.sketch_byte_budget = int(st.get("budget", 0))
+        # Replicas never fold; adopting the writer's declared fold
+        # kind just keeps _config_dict comparisons stable (a legacy
+        # file with no key means host-f64).
+        self.fold_kind = str(st.get("fold", "host-f64"))
         alloc = st.get("alloc")
         if isinstance(alloc, dict):
             try:

@@ -991,7 +991,7 @@ class RouterServer:
                     await self._hop_writer(b, target, deadline,
                                            sub=ms[0])
             else:
-                owner = series_hash(ms[0].encode()) % len(self.backends)
+                owner = self._owner_index(ms[0])
                 status, ctype, body, extra, _spans = await self._hop(
                     target, owner, deadline, sub=ms[0])
             return status, ctype, body, extra
@@ -1011,7 +1011,7 @@ class RouterServer:
             hops = [self._hop(
                 "/q?" + urllib.parse.urlencode(
                     dict(base, m=m, json="")),
-                series_hash(m.encode()) % len(self.backends),
+                self._owner_index(m),
                 deadline, sub=m)
                 for m in ms]
         outs = await asyncio.gather(*hops, return_exceptions=True)
@@ -1125,6 +1125,30 @@ class RouterServer:
         one metric live with its owner), unlike single-writer mode's
         whole-spec hash which only had cache affinity to optimize."""
         return m.split("{", 1)[0].split(":")[-1]
+
+    def _owner_index(self, m: str) -> int:
+        """Preferred backend for one sub-query. Mesh-aware: each
+        backend advertises its serving-mesh width (resident hot-set
+        shards) in /healthz, and ownership weights the series space by
+        it — a backend with 8 resident shards owns 8x the slots of a
+        1-shard one, so fleet hot-set capacity is actually used
+        instead of bottlenecking on the narrowest box. A uniform fleet
+        (every width 1, or probes not yet landed) degrades to the
+        legacy plain modulo, keeping existing layouts' cache affinity
+        byte-for-byte."""
+        h = series_hash(m.encode())
+        widths = [max(1, int((b.last_health.get("mesh") or {})
+                             .get("width", 1)))
+                  for b in self.backends]
+        total = sum(widths)
+        if total == len(widths):
+            return h % len(widths)
+        slot = h % total
+        for i, w in enumerate(widths):
+            slot -= w
+            if slot < 0:
+                return i
+        return 0
 
     async def _hop_cluster(self, m: str, base: dict, deadline: float):
         """One sub-query in multi-writer mode: concurrent hops to

@@ -529,6 +529,7 @@ class TSDServer:
             "/promote": self._http_promote,
             "/demote": self._http_demote,
             "/healthz": self._http_healthz,
+            "/api/mesh/reshard": self._http_mesh_reshard,
             "/metrics": self._http_metrics,
             "/api/traces": self._http_traces,
             "/dropcaches": self._http_dropcaches,
@@ -908,9 +909,67 @@ class TSDServer:
             body["fenced_by_epoch"] = guard.fenced_epoch
         body["uptime_s"] = int(time.time()) - self.start_time
         body["inflight_queries"] = self.admission.inflight_queries
+        mesh = self._mesh_serving_info()
+        if mesh is not None:
+            # The router's fan-out weights series ownership by this
+            # width (resident hot-set shards): a wide backend owns
+            # proportionally more of the series space.
+            body["mesh"] = mesh
         status = 200 if body.get("ok") else 503
         return (status, "application/json",
                 json.dumps(body).encode(), {})
+
+    def _mesh_serving_info(self) -> dict | None:
+        """The serving-mesh block for /healthz and /api/queries: plane
+        membership (when --mesh-plane joined one) and the sharded
+        resident hot set's live shape. None when neither is on — the
+        body stays byte-compatible for non-mesh fleets."""
+        from opentsdb_tpu.parallel.fleet import plane_info
+        plane = plane_info()
+        dw = getattr(self.tsdb, "devwindow", None)
+        sharded = dw is not None and hasattr(dw, "shard_of")
+        if plane is None and not sharded:
+            return None
+        out: dict = {"width": dw.n_shards if sharded else 1}
+        if plane is not None:
+            out["plane"] = dict(plane)
+        if sharded:
+            out["resident"] = {
+                "shards": dw.n_shards,
+                "points": dw.resident_points(),
+                "generation": dw.generation,
+                "reshards": dw.reshard_count,
+                "last_reshard_ms": round(dw.reshard_ms, 2),
+            }
+        return out
+
+    async def _http_mesh_reshard(self, req) -> tuple:
+        """Live hot-set resharding admin: ``/api/mesh/reshard?shards=N``
+        redistributes the resident device columns over N shards
+        (coherent swap — pre-swap queries finish on the complete old
+        set; see storage/devshard.py). Runs in the worker pool: the
+        drain/rebuild must not block the event loop's ingest."""
+        dw = getattr(self.tsdb, "devwindow", None)
+        if dw is None or not hasattr(dw, "shard_of"):
+            raise BadRequestError(
+                "resident hot set is not sharded (start the daemon "
+                "with --devwindow-shards or --mesh-plane)")
+        try:
+            n = int(req.q.get("shards", "0"))
+        except ValueError:
+            raise BadRequestError(
+                f"invalid shards: {req.q.get('shards')}") from None
+        if n < 1:
+            raise BadRequestError("shards must be >= 1")
+        loop = asyncio.get_running_loop()
+        try:
+            stats = await loop.run_in_executor(
+                self._pool, lambda: dw.reshard(n_shards=n))
+        except RuntimeError as e:
+            return (409, "application/json",
+                    json.dumps({"error": str(e)}).encode(), {})
+        return (200, "application/json",
+                json.dumps(stats).encode(), {})
 
     # ------------------------------------------------------------------
     # Cluster failover (opentsdb_tpu/cluster/): promote / demote
@@ -1157,6 +1216,10 @@ class TSDServer:
                 "expert_enabled": bool(self.expert_enabled),
                 "compile_cache": cache_info(),
                 "expert": expert_counts,
+                # Serving-mesh shape (None outside --mesh-plane /
+                # --devwindow-shards): plane membership + the sharded
+                # resident hot set's live width/points/reshard stats.
+                "serving": self._mesh_serving_info(),
             },
             "qcache": {"hit": self.executor.qcache_hits,
                        "miss": self.executor.qcache_misses,

@@ -123,7 +123,8 @@ class DeviceWindow:
     def __init__(self, staging_points: int = 1 << 20,
                  max_points: int = 1 << 26,
                  background: bool = True,
-                 stall_timeout: float = 60.0) -> None:
+                 stall_timeout: float = 60.0,
+                 device=None) -> None:
         # Process-unique instance token: DevColumns.version counters
         # restart at 0 in a replacement window, so derived-result caches
         # key on (instance_id, version) to survive window swaps.
@@ -132,6 +133,12 @@ class DeviceWindow:
         self.staging_points = staging_points
         self.max_points = max_points
         self.background = background
+        # Optional device pin: a mesh shard's window commits its chunks
+        # to one specific device, so the stage kernels that consume the
+        # committed inputs execute there — the per-shard placement the
+        # sharded hot set (storage/devshard.py) is built on. None keeps
+        # the historical behavior (jax's default device).
+        self.device = device
         # Degraded-mode guard: a wedged accelerator (hung transport)
         # freezes the uploader mid-device-call FOREVER. Ingest and
         # queries must not hang with it — after stall_timeout they
@@ -355,9 +362,12 @@ class DeviceWindow:
             vals = np.pad(vals, (0, pad - n))
             sid = np.pad(sid, (0, pad - n))
         valid = np.arange(pad) < n
+        dev = self.device
         chunk = {
-            "ts": jax.device_put(rel), "vals": jax.device_put(vals),
-            "sid": jax.device_put(sid), "valid": jax.device_put(valid),
+            "ts": jax.device_put(rel, dev),
+            "vals": jax.device_put(vals, dev),
+            "sid": jax.device_put(sid, dev),
+            "valid": jax.device_put(valid, dev),
             "n": n, "pad": pad, "seq": seq,
             "min_ts": int(ts.min()), "max_ts": int(ts.max()),
         }
@@ -414,6 +424,55 @@ class DeviceWindow:
         while (self._pending.unfinished_tasks
                and _time.monotonic() < deadline):
             _time.sleep(0.01)
+
+    def quiesce(self) -> None:
+        """Materialize EVERYTHING into device chunks: upload all staged
+        batches and wait for every metric's in-flight uploads. The
+        reshard gate's drain step (devshard.py) — after it returns, a
+        refs-only chunk snapshot is the complete window. A metric whose
+        uploads stall past the wedge deadline degrades to dirty (the
+        standard sticky fallback) rather than blocking forever."""
+        self.flush()
+        deadline = _time.monotonic() + 2 * self.stall_timeout
+        with self._cond:
+            while any(mw.inflight > 0 and not mw.dirty
+                      for mw in self._metrics.values()):
+                now = _time.monotonic()
+                if now >= deadline:
+                    for mw in self._metrics.values():
+                        if mw.inflight > 0 and not mw.dirty:
+                            self.upload_stalls += 1
+                            self._mark_dirty(mw)
+                    self._cond.notify_all()
+                    break
+                self._cond.wait(timeout=min(deadline - now, 0.05))
+
+    def _snapshot_metrics(self) -> dict:
+        """Refs-only snapshot for the reshard rebuild (devshard.py):
+        per metric, the directory, chunk list, and coverage state at
+        this instant. Chunks are immutable once inserted, so holding
+        refs is safe; the caller must treat every field as read-only.
+        Call after ``quiesce`` — staged/in-flight batches are not
+        represented."""
+        with self._lock:
+            return {uid: {"keys": list(mw.keys),
+                          "epoch": mw.epoch,
+                          "chunks": list(mw.chunks),
+                          "dirty": mw.dirty,
+                          "complete_from": mw.complete_from}
+                    for uid, mw in self._metrics.items()}
+
+    def set_complete_from(self, metric_uid: bytes, floor: int) -> None:
+        """Raise (never lower) a metric's coverage floor — the reshard
+        rebuild carries the source shards' eviction horizon into the
+        redistributed window so it never claims coverage the old set
+        had already evicted."""
+        with self._lock:
+            mw = self._metrics.get(metric_uid)
+            if mw is None:
+                return
+            if mw.complete_from is None or floor > mw.complete_from:
+                mw.complete_from = floor
 
     def invalidate(self, metric_uid: bytes | None = None) -> None:
         """Mark window state unusable after storage mutations the append

@@ -182,6 +182,26 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         cfg.mesh_devices = getattr(args, "mesh_devices", 0)
         cfg.mesh_shape = getattr(args, "mesh", "") or ""
         cfg.expert_parallel = getattr(args, "expert_parallel", False)
+        cfg.mesh_plane = getattr(args, "mesh_plane", "") or ""
+        cfg.mesh_plane_procs = getattr(args, "mesh_plane_procs", 1)
+        cfg.mesh_plane_id = getattr(args, "mesh_plane_id", 0)
+        cfg.devwindow_shards = getattr(args, "devwindow_shards", 0)
+        cfg.rollup_device_fold = getattr(args, "rollup_device_fold",
+                                         False)
+        if cfg.mesh_plane:
+            # Join the serving mesh BEFORE the storage engine touches a
+            # jax backend (TSDB construction warms the device window):
+            # the distributed client and the CPU collectives transport
+            # latch at backend init. A failed join is a boot failure —
+            # a daemon asked to be part of a mesh must not silently
+            # serve as a singleton.
+            from opentsdb_tpu.parallel.fleet import init_plane
+            plane = init_plane(cfg.mesh_plane, cfg.mesh_plane_procs,
+                               cfg.mesh_plane_id)
+            if cfg.devwindow_shards == 0:
+                # Default the resident hot set to one shard per local
+                # device — the deployment mode's whole point.
+                cfg.devwindow_shards = max(1, plane["devices_local"])
         cfg.slow_query_ms = getattr(args, "slow_query_ms", 0.0)
         cfg.selfmon_interval_s = getattr(args, "selfmon_interval", 0.0)
         cfg.trace_sample_n = getattr(args, "trace_sample_n", 0)
@@ -938,6 +958,36 @@ def main(argv: list[str] | None = None) -> int:
                         "set XLA_FLAGS="
                         "--xla_force_host_platform_device_count=N "
                         "first (see README 'Mesh execution')")
+    p.add_argument("--mesh-plane", default="",
+                   help="serving mesh fleet: join the jax.distributed "
+                        "plane at HOST:PORT before boot (gloo TCP on "
+                        "CPU, native transport on TPU pods) and shard "
+                        "the device-resident hot set over this "
+                        "process's local devices. Pair with "
+                        "--mesh-plane-procs/--mesh-plane-id; fronted "
+                        "by a --role router whose fan-out weights each "
+                        "backend by its advertised mesh width (see "
+                        "README 'Serving mesh')")
+    p.add_argument("--mesh-plane-procs", type=int, default=1,
+                   help="total process count in the --mesh-plane fleet")
+    p.add_argument("--mesh-plane-id", type=int, default=0,
+                   help="this process's rank in the --mesh-plane fleet")
+    p.add_argument("--devwindow-shards", type=int, default=0,
+                   help="shard the device-resident hot window into N "
+                        "columns round-robined over the local mesh "
+                        "devices (storage/devshard.py): capacity and "
+                        "fold throughput scale with device count, and "
+                        "the set reshards LIVE on grow/shrink "
+                        "(/api/mesh/reshard). 0 = one resident window "
+                        "(defaulted to the local device count under "
+                        "--mesh-plane)")
+    p.add_argument("--rollup-device-fold", action="store_true",
+                   help="run the rollup checkpoint fold on-device "
+                        "behind the mesh plane (f64 accumulation where "
+                        "the backend supports it, else a DECLARED f32 "
+                        "contract; the applied kind is persisted in "
+                        "ROLLUP.json and a kind change rebuilds the "
+                        "tier)")
     p.add_argument("--expert-parallel", action="store_true",
                    help="with --mesh: pack mixed /q dashboard batches "
                         "into expert buckets (one mesh dispatch per "

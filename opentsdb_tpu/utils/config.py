@@ -140,6 +140,13 @@ class Config:
     device_window: bool = True
     device_window_staging: int = 1 << 20   # points per upload chunk
     device_window_points: int = 1 << 26    # resident budget (~12 B/point)
+    # Mesh-sharded hot set (storage/devshard.py): shard the resident
+    # window over the mesh devices on the series axis so capacity and
+    # dashboard throughput scale with mesh width. 0 = off (single
+    # window, historical behavior); N >= 1 = N logical shards round-
+    # robined over the mesh devices (N may exceed the device count —
+    # the tier-1 suite runs the whole sharded path on one CPU device).
+    devwindow_shards: int = 0
     # Halve window-query [G, B] value payloads on the wire by casting
     # to bfloat16 ON DEVICE before the device->host fetch (the
     # ~30 MB/s tunnel made wide group-by fetches payload-bound).
@@ -320,6 +327,25 @@ class Config:
     # counter) and serve serially — exact-or-fall-back, the TSINT
     # fused-decline discipline.
     expert_parallel: bool = False
+    # Served mesh-plane deployment mode (tsd --mesh-plane, PR 18):
+    # non-empty = coordinator address ("host:port"); the daemon joins a
+    # gloo/TPU process plane via jax.distributed.initialize before the
+    # backend initializes (parallel/fleet.py). Each process still
+    # serves its OWN local mesh (multi-controller jax cannot run
+    # per-request cross-process collectives); plane membership is
+    # reported in /healthz so the serve router fans out by mesh width.
+    mesh_plane: str = ""
+    mesh_plane_procs: int = 1          # processes in the plane
+    mesh_plane_id: int = 0             # this process's plane rank
+    # Rollup checkpoint fold on device (rollup/summary.py
+    # window_summaries_device): accumulate the per-window sum in f64 on
+    # the accelerator where the backend supports it, else f32 with the
+    # contract RELAXED — either way the fold kind is DECLARED in the
+    # tier state ("fold": host-f64 | device-f64 | device-f32) because
+    # XLA reduction order makes even the f64 device fold tolerance-
+    # level, not byte-identical, vs the host pairwise sum. Default off:
+    # the rollup parity suite pins the host-f64 byte contract.
+    rollup_device_fold: bool = False
 
     # network
     port: int = 4242

@@ -35,7 +35,7 @@ class TestMatrixShape:
 
     def test_fast_subset_resolves(self):
         fast = harness.fast_matrix()
-        assert len(fast) == len(harness.FAST_LABELS) == 12
+        assert len(fast) == len(harness.FAST_LABELS) == 13
 
 
 class TestFastSubset:
@@ -49,7 +49,7 @@ class TestFastSubset:
         res = harness.run_scenario(sc, str(tmp_path), shrink=False)
         assert res["status"] == "ok", (res["problems"], res)
         # crash-kind scenarios must actually have crashed at the site.
-        if sc.kind == "crash":
+        if sc.kind in ("crash", "meshreshard"):
             assert res["child_exit"] == faultpoints.EXIT_CODE
 
 

@@ -1,0 +1,323 @@
+"""Mesh-sharded resident hot set (storage/devshard.py + serving).
+
+Contract under test: sharding the device window on the series axis is
+SEMANTICALLY INVISIBLE. A series lives in exactly one shard (the
+fleet-wide identity hash), so for any shard count:
+
+- grids are byte-identical to the unsharded/scan answer and values are
+  f32-tolerant (chunk-boundary reassociation only) — count/min/max are
+  byte-identical ACROSS widths, the declared per-kernel contract;
+- an owning shard that cannot cover the range declines the WHOLE
+  window (never a partial union), while other metrics keep serving;
+- live reshard (grow/shrink) returns identical answers before, during
+  (journaled dual-writes), and after the swap; an ABORTED reshard
+  leaves the old generation serving.
+
+Shards here are LOGICAL (more shards than the single CPU device), so
+tier-1 covers routing/eviction/reshard without hardware.
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.fault import faultpoints
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.storage.devshard import ShardedDeviceWindow
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+SPAN = 7200
+
+
+def make_tsdb(shards=4, **over):
+    kw = dict(auto_create_metrics=True, enable_sketches=False,
+              device_window=True, devwindow_shards=shards)
+    kw.update(over)
+    return TSDB(MemKVStore(), Config(**kw),
+                start_compaction_thread=False)
+
+
+def load(t, series=10, points=240, metric="m.cpu", seed=7):
+    rng = np.random.default_rng(seed)
+    for i in range(series):
+        ts = BT + np.sort(rng.choice(SPAN, points, replace=False))
+        t.add_batch(metric, ts, rng.normal(100, 10, points),
+                    {"host": f"h{i}",
+                     "dc": "east" if i % 2 else "west"})
+
+
+def run_pair(t, spec, start=BT, end=BT + SPAN, expect_hit=True):
+    """Resident-plan answer vs the scan answer over the same engine."""
+    ex = QueryExecutor(t, backend="tpu")
+    dw = t.devwindow
+    h0 = dw.window_hits
+    got = ex.run(spec, start, end)
+    hit = dw.window_hits > h0
+    assert hit == expect_hit, f"window hit={hit}, wanted {expect_hit}"
+    keep, t.devwindow = t.devwindow, None
+    try:
+        want = ex.run(spec, start, end)
+    finally:
+        t.devwindow = keep
+    assert len(got) == len(want)
+    for a, b in zip(got, want):
+        assert a.tags == b.tags
+        np.testing.assert_array_equal(a.timestamps, b.timestamps)
+        np.testing.assert_allclose(a.values, b.values, rtol=1e-5,
+                                   atol=1e-4)
+    return got
+
+
+class TestRouting:
+    def test_series_land_on_their_hash_shard_disjointly(self):
+        t = make_tsdb(shards=5)   # logical > physical: still exact
+        try:
+            load(t)
+            dw = t.devwindow
+            dw.flush()
+            uid = t.metrics.get_id("m.cpu")
+            cols = dw.chunk_columns(uid, BT, BT + SPAN)
+            assert cols is not None
+            seen = set()
+            occupied = 0
+            for i, per in enumerate(cols.shards):
+                if per is None:
+                    continue
+                occupied += 1
+                for key in per.series_keys:
+                    assert dw.shard_of(key) == i
+                    assert key not in seen, "series split across shards"
+                    seen.add(key)
+            assert len(seen) == 10
+            assert occupied >= 2, "hash routed everything to one shard"
+        finally:
+            t.shutdown()
+
+
+class TestShardedParity:
+    def test_parity_at_every_width_and_byte_stable_kernels(self):
+        """Resident == scan at widths 1/3/4/9; count/min/max grids are
+        byte-identical ACROSS widths (a series never splits, so those
+        folds see identical operand sets); sum/avg within f32
+        tolerance (chunk-boundary reassociation)."""
+        specs = {
+            "count": QuerySpec("m.cpu", {}, "sum",
+                               downsample=(600, "count")),
+            "min": QuerySpec("m.cpu", {"host": "*"}, "min",
+                             downsample=(600, "min")),
+            "max": QuerySpec("m.cpu", {"dc": "east"}, "max",
+                             downsample=(300, "max")),
+            "sum": QuerySpec("m.cpu", {}, "sum",
+                             downsample=(600, "sum")),
+            "avg": QuerySpec("m.cpu", {"host": "*"}, "avg",
+                             downsample=(600, "avg")),
+        }
+        by_width = {}
+        for shards in (1, 3, 4, 9):
+            t = make_tsdb(shards=shards)
+            try:
+                load(t)
+                t.devwindow.flush()
+                by_width[shards] = {
+                    k: run_pair(t, sp) for k, sp in specs.items()}
+            finally:
+                t.shutdown()
+        ref = by_width[1]
+        for shards, got in by_width.items():
+            for kind in ("count", "min", "max"):
+                for a, b in zip(got[kind], ref[kind]):
+                    np.testing.assert_array_equal(
+                        a.timestamps, b.timestamps)
+                    assert a.values.tobytes() == b.values.tobytes(), \
+                        f"{kind} not byte-stable at width {shards}"
+            for kind in ("sum", "avg"):
+                for a, b in zip(got[kind], ref[kind]):
+                    np.testing.assert_allclose(a.values, b.values,
+                                               rtol=1e-5, atol=1e-4)
+
+
+class TestEviction:
+    def test_per_shard_eviction_declines_whole_window_only(self):
+        """A shard over budget evicts ITS oldest chunks: full-range
+        queries on the evicted metric fall back (no partial union),
+        the covered suffix still serves with parity, and a small
+        recent metric in the same fleet keeps serving resident."""
+        # The fleet budget splits per shard (1<<14 over 2 shards =
+        # the single-window test's 1<<13 per device).
+        t = make_tsdb(shards=2, device_window_staging=1 << 12,
+                      device_window_points=1 << 14)
+        try:
+            rng = np.random.default_rng(31)
+            span = 6 * 3600
+            slice_s = span // 12
+            # Time-interleaved (collector pattern): eviction leaves a
+            # contiguous recent suffix, not whole series.
+            for blk in range(12):
+                for i in range(4):
+                    ts = BT + blk * slice_s + np.sort(
+                        rng.choice(slice_s, 1100, replace=False))
+                    t.add_batch("m.ev", ts,
+                                rng.normal(100, 10, 1100),
+                                {"host": f"h{i}"})
+            t.add_batch("m.ok", BT + span - 600 + np.arange(60) * 10,
+                        rng.normal(5, 1, 60), {"host": "solo"})
+            dw = t.devwindow
+            dw.flush()
+            assert sum(s.evicted_points for s in dw._shards) > 0, \
+                "budget did not force eviction; shrink it"
+            uid = t.metrics.get_id("m.ev")
+            floors = [s._metrics[uid].complete_from
+                      for s in dw._shards if uid in s._metrics]
+            assert floors and all(f is not None for f in floors)
+            lo = max(floors) + 60
+            assert lo < BT + span - 600, "no covered suffix survived"
+            spec = QuerySpec("m.ev", {}, "sum", downsample=(600, "avg"))
+            run_pair(t, spec, start=lo, end=BT + span)   # suffix serves
+            run_pair(t, spec, end=BT + span,
+                     expect_hit=False)                   # hole declines
+            run_pair(t, QuerySpec("m.ok", {}, "sum",
+                                  downsample=(60, "avg")),
+                     start=BT + span - 600,
+                     end=BT + span)                      # neighbor fine
+        finally:
+            t.shutdown()
+
+
+class TestReshard:
+    def test_grow_shrink_identical_answers(self):
+        t = make_tsdb(shards=4)
+        try:
+            load(t)
+            dw = t.devwindow
+            dw.flush()
+            spec = QuerySpec("m.cpu", {"host": "*"}, "sum",
+                             downsample=(600, "count"))
+            base = run_pair(t, spec)
+            for n in (8, 2):
+                r = dw.reshard(n_shards=n)
+                assert r["n_shards"] == n
+                got = run_pair(t, spec)
+                for a, b in zip(got, base):
+                    np.testing.assert_array_equal(a.timestamps,
+                                                  b.timestamps)
+                    assert a.values.tobytes() == b.values.tobytes()
+            assert dw.generation == 2 and dw.reshard_count == 2
+            assert dw.reshard_ms >= 0.0
+            # Post-reshard appends route by the NEW mapping and serve.
+            load(t, seed=8, metric="m.cpu2")
+            dw.flush()
+            run_pair(t, QuerySpec("m.cpu2", {}, "sum",
+                                  downsample=(600, "avg")))
+        finally:
+            t.shutdown()
+
+    def test_journaled_appends_survive_the_swap(self, monkeypatch):
+        """Ingest landing DURING the off-gate rebuild dual-writes into
+        the journal; the drained journal must put those points in the
+        new shard set — resident answers after the swap include them
+        with scan parity."""
+        t = make_tsdb(shards=3)
+        try:
+            load(t)
+            dw = t.devwindow
+            dw.flush()
+            orig = ShardedDeviceWindow._split_series
+            fired = []
+
+            def mid_reshard_split(metric_snaps):
+                if not fired:
+                    fired.append(True)
+                    # Storage + window append while the journal is on.
+                    t.add_batch("m.cpu",
+                                BT + SPAN + np.arange(30) * 60,
+                                np.arange(30, dtype=np.float64),
+                                {"host": "late"})
+                return orig(metric_snaps)
+
+            monkeypatch.setattr(ShardedDeviceWindow, "_split_series",
+                                staticmethod(mid_reshard_split))
+            dw.reshard(n_shards=6)
+            assert fired, "reshard never reached the rebuild phase"
+            dw.flush()
+            got = run_pair(t, QuerySpec("m.cpu", {"host": "late"},
+                                        "sum", downsample=(60, "avg")),
+                           start=BT + SPAN, end=BT + SPAN + 1800)
+            assert len(got) == 1 and len(got[0].timestamps) == 30
+        finally:
+            t.shutdown()
+
+    def test_aborted_reshard_keeps_old_generation_serving(self):
+        """A failure at the commit gate must leave the OLD shard set
+        live and coherent (the swap never happened), the journal off,
+        and a retry must succeed."""
+        t = make_tsdb(shards=4)
+        try:
+            load(t)
+            dw = t.devwindow
+            dw.flush()
+            spec = QuerySpec("m.cpu", {}, "sum", downsample=(600, "sum"))
+            base = run_pair(t, spec)
+            faultpoints.arm("mesh.reshard.commit", "raise")
+            try:
+                with pytest.raises(faultpoints.FaultInjected):
+                    dw.reshard(n_shards=8)
+            finally:
+                faultpoints.disarm("mesh.reshard.commit")
+            assert dw.generation == 0 and dw.reshard_count == 0
+            assert dw.n_shards == 4
+            assert dw._journal is None, "abort left the journal armed"
+            got = run_pair(t, spec)
+            for a, b in zip(got, base):
+                np.testing.assert_array_equal(a.timestamps,
+                                              b.timestamps)
+                np.testing.assert_array_equal(a.values, b.values)
+            assert dw.reshard(n_shards=8)["n_shards"] == 8   # retry
+            run_pair(t, spec)
+        finally:
+            t.shutdown()
+
+    def test_concurrent_reshard_refused(self):
+        t = make_tsdb(shards=2)
+        try:
+            load(t, series=4)
+            dw = t.devwindow
+            with dw._lock:
+                dw._journal = []      # simulate an in-flight reshard
+                with pytest.raises(RuntimeError, match="in progress"):
+                    dw.reshard(n_shards=4)
+                dw._journal = None
+        finally:
+            t.shutdown()
+
+
+class TestObservability:
+    def test_mesh_resident_gauges(self):
+        t = make_tsdb(shards=3)
+        try:
+            load(t)
+            dw = t.devwindow
+            dw.flush()
+            run_pair(t, QuerySpec("m.cpu", {}, "sum",
+                                  downsample=(600, "avg")))
+            dw.reshard(n_shards=2)
+            dw.flush()   # stage -> device: resident_points counts HBM
+
+            class Sink:
+                lines = {}
+
+                def record(self, name, value, tag=None):
+                    self.lines[name] = value
+
+            sink = Sink()
+            dw.collect_stats(sink)
+            assert sink.lines["mesh.resident.points"] > 0
+            assert sink.lines["mesh.resident.shards"] == 2
+            assert sink.lines["mesh.resident.reshard.count"] == 1
+            assert sink.lines["mesh.resident.reshard_ms"] >= 0
+            assert sink.lines["devwindow.hits"] >= 1
+            assert sink.lines["mesh.resident.points"] == \
+                sink.lines["devwindow.points.resident"]
+        finally:
+            t.shutdown()

@@ -369,3 +369,129 @@ class TestServerObservability:
         assert "compile_cache" in feed["mesh"]
         assert rc_ok == 0
         assert rc_bad == 2
+
+
+@pytest.mark.skipif(
+    not _cpu_collectives_available(),
+    reason="this jaxlib's CPU client has no cross-process collectives "
+           "transport (no xla_extension.make_gloo_tcp_collectives)")
+def test_two_process_served_deployment_mode():
+    """The SERVED deployment-mode smoke across a real process boundary:
+    two gloo-joined tsd-equivalent daemons (parallel/fleet.init_plane,
+    the same bootstrap ``tsd --mesh-plane`` uses), each sharding its
+    resident hot set over 4 local devices and self-checking over HTTP:
+    advertised mesh width, resident gauges, resident-plan/scan parity,
+    and a LIVE grow/shrink reshard with identical answers."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "multihost_run.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    r = subprocess.run([sys.executable, script, "--serve"], env=env,
+                       capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stderr[-3000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["mode"] == "serve"
+    assert rec["process_count"] == 2
+    assert rec["devices_global"] == 8
+    assert rec["width_advertised"] == 4
+    assert rec["resident_query_parity"] is True
+    assert rec["reshard_answers_identical"] is True
+
+
+class TestServingMeshObservability:
+    """The sharded resident hot set on the serving surfaces: /healthz
+    width + resident block, /stats + /metrics gauges, /api/queries
+    serving section, the /api/mesh/reshard admin endpoint, and
+    ``tsdb check --stats-metric tsd.mesh.resident.points``."""
+
+    def test_resident_gauges_and_reshard_endpoint(self, tmp_path):
+        from tests.test_admission import (http_get, make_server,
+                                          run_with_server)
+
+        from opentsdb_tpu.tools.cli import main as cli_main
+        server, tsdb = make_server(tmp_path, backend="tpu",
+                                   devwindow_shards=3,
+                                   device_window=True)
+        BT = 1356998400
+        rng = np.random.default_rng(5)
+        for i in range(6):
+            tsdb.add_batch("m.mesh", BT + np.arange(120) * 60,
+                           rng.normal(10, 2, 120), {"h": f"x{i}"})
+        tsdb.devwindow.flush()
+
+        async def drive(port):
+            sh, _, bh = await http_get(port, "/healthz")
+            ss, _, bs = await http_get(port, "/stats?json")
+            sm, _, bm = await http_get(port, "/metrics")
+            sq, _, bq = await http_get(port, "/api/queries")
+            # Nagios-style coverage of the new gauge, BEFORE the
+            # reshard below empties the freshly staged shard set.
+            loop = asyncio.get_running_loop()
+            rc_ok = await loop.run_in_executor(None, cli_main, [
+                "check", "-H", "127.0.0.1", "-p", str(port),
+                "--stats-metric", "tsd.mesh.resident.points",
+                "-x", "lt", "-c", "1"])
+            rc_bad = await loop.run_in_executor(None, cli_main, [
+                "check", "-H", "127.0.0.1", "-p", str(port),
+                "--stats-metric", "tsd.mesh.resident.points",
+                "-x", "lt", "-c", "999999999"])
+            sr, _, br = await http_get(port,
+                                       "/api/mesh/reshard?shards=2")
+            sh2, _, bh2 = await http_get(port, "/healthz")
+            sbad, _, _ = await http_get(port,
+                                        "/api/mesh/reshard?shards=0")
+            return ((sh, bh), (ss, bs), (sm, bm), (sq, bq), (sr, br),
+                    (sh2, bh2), sbad, rc_ok, rc_bad)
+
+        ((sh, bh), (ss, bs), (sm, bm), (sq, bq), (sr, br), (sh2, bh2),
+         sbad, rc_ok, rc_bad) = run_with_server(server, drive)
+        tsdb.shutdown()
+        assert sh == ss == sm == sq == sr == sh2 == 200
+        mesh = json.loads(bh)["mesh"]
+        assert mesh["width"] == 3
+        assert mesh["resident"]["shards"] == 3
+        assert mesh["resident"]["points"] > 0
+        assert mesh["resident"]["reshards"] == 0
+        lines = json.loads(bs)
+        pts = [ln for ln in lines
+               if ln.startswith("tsd.mesh.resident.points ")]
+        assert pts and float(pts[0].split()[2]) > 0, \
+            [ln for ln in lines if "resident" in ln]
+        assert any(ln.startswith("tsd.mesh.resident.shards ")
+                   for ln in lines)
+        assert any(ln.startswith("tsd.mesh.resident.reshard.count ")
+                   for ln in lines)
+        assert b"tsd_mesh_resident_points" in bm   # /metrics export
+        serving = json.loads(bq)["mesh"]["serving"]
+        assert serving["width"] == 3
+        assert serving["resident"]["shards"] == 3
+        # The live reshard admin endpoint: shrink 3 -> 2 committed...
+        rr = json.loads(br)
+        assert rr["n_shards"] == 2 and rr["generation"] == 1
+        mesh2 = json.loads(bh2)["mesh"]
+        assert mesh2["resident"]["shards"] == 2
+        assert mesh2["resident"]["reshards"] == 1
+        # ...and invalid widths refuse.
+        assert sbad == 400
+        assert rc_ok == 0
+        assert rc_bad == 2
+
+    def test_unsharded_daemon_refuses_reshard(self, tmp_path):
+        from tests.test_admission import (http_get, make_server,
+                                          run_with_server)
+        server, tsdb = make_server(tmp_path)
+
+        async def drive(port):
+            s, _, b = await http_get(port,
+                                     "/api/mesh/reshard?shards=2")
+            sh, _, bh = await http_get(port, "/healthz")
+            return s, b, json.loads(bh)
+
+        s, b, health = run_with_server(server, drive)
+        tsdb.shutdown()
+        assert s == 400 and b"not sharded" in b
+        # Non-mesh daemons keep a mesh-free healthz body.
+        assert "mesh" not in health

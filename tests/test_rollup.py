@@ -610,3 +610,116 @@ def test_rollup_smoke_small_corpus(tmp_path):
         assert_equal_results(a, b, exact=True)
     finally:
         tsdb.shutdown()
+
+
+class TestDeviceFold:
+    """On-device checkpoint folds (rollup_device_fold=True): the tier's
+    scatter fold runs through jax segment ops instead of the host f64
+    loop. Contract: count/min/max/first/last and the window brackets
+    are byte-identical to the host fold; sum is f64-exact where the
+    backend supports f64 ("device-f64") and f32-tolerant otherwise —
+    the DECLARED kind is persisted in the tier state, a kind change is
+    a layout change (full rebuild), and legacy state files without the
+    key read as host-f64."""
+
+    def test_unit_fold_parity_vs_host(self):
+        from opentsdb_tpu.rollup import summary
+        rng = np.random.default_rng(7)
+        ts = np.sort(rng.integers(BASE, BASE + 3 * 86400,
+                                  5000)).astype(np.int64)
+        vals = rng.normal(50, 10, len(ts)).astype(np.float64)
+        for res in (3600, 7200, 86400):
+            wb_h, rec_h = summary.window_summaries(ts, vals, res)
+            wb_d, rec_d = summary.window_summaries_device(ts, vals, res)
+            np.testing.assert_array_equal(wb_h, wb_d)
+            for k in ("count", "min", "max", "first", "last",
+                      "first_dt", "last_dt"):
+                np.testing.assert_array_equal(rec_h[k], rec_d[k])
+            if summary.device_fold_kind() == "device-f64":
+                np.testing.assert_allclose(rec_h["sum"], rec_d["sum"],
+                                           rtol=1e-12)
+            else:
+                np.testing.assert_allclose(rec_h["sum"], rec_d["sum"],
+                                           rtol=1e-5)
+
+    def test_device_fold_tier_matches_raw_and_declares_kind(
+            self, tmp_path):
+        import json
+
+        from opentsdb_tpu.rollup import summary
+        tsdb = make_tsdb(str(tmp_path), rollup_device_fold=True)
+        try:
+            ingest(tsdb)
+            tsdb.checkpoint()
+            assert tsdb.rollups.ready
+            assert tsdb.rollups.fold_kind == summary.device_fold_kind()
+            ex = QueryExecutor(tsdb, backend="cpu")
+            start, end = BASE + 1801, BASE + 3 * 86400 - 901
+            exact = summary.device_fold_kind() == "device-f64"
+            for interval, dsagg in [(3600, "sum"), (3600, "avg"),
+                                    (7200, "min"), (7200, "max"),
+                                    (86400, "sum"), (3600, "count")]:
+                spec = QuerySpec(METRIC, {}, "sum",
+                                 downsample=(interval, dsagg))
+                a, plan, b = run_both(ex, spec, start, end)
+                assert plan in ("1h", "1d"), plan
+                # min/max/count stay bit-exact regardless of kind.
+                kind_exact = exact or dsagg in ("min", "max", "count")
+                assert_equal_results(a, b, exact=kind_exact)
+            with open(tsdb.rollups.state_path) as f:
+                st = json.load(f)
+            assert st["fold"] == summary.device_fold_kind()
+        finally:
+            tsdb.shutdown()
+
+    def test_fold_kind_change_is_a_layout_change(self, tmp_path):
+        tsdb = make_tsdb(str(tmp_path), rollup_device_fold=True)
+        try:
+            ingest(tsdb, days=1)
+            tsdb.checkpoint()
+            assert tsdb.rollups.ready
+        finally:
+            tsdb.shutdown()
+        # Same kind: the tier adopts cleanly, no rebuild.
+        tsdb = make_tsdb(str(tmp_path), rollup_device_fold=True)
+        try:
+            assert tsdb.rollups.ready
+            assert tsdb.rollups.rebuilds == 0
+        finally:
+            tsdb.shutdown()
+        # Kind flipped back to host-f64: full rebuild, then parity.
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            assert (tsdb.rollups.rebuilds >= 1
+                    or not tsdb.rollups.ready or tsdb.rollups._behind)
+            tsdb.checkpoint()
+            assert tsdb.rollups.ready
+            ex = QueryExecutor(tsdb, backend="cpu")
+            spec = QuerySpec(METRIC, {}, "sum", downsample=(3600, "sum"))
+            a, plan, b = run_both(ex, spec, BASE, BASE + 86400)
+            assert plan == "1h"
+            assert_equal_results(a, b, exact=True)
+        finally:
+            tsdb.shutdown()
+
+    def test_legacy_state_without_fold_key_reads_as_host(self, tmp_path):
+        import json
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            ingest(tsdb, days=1)
+            tsdb.checkpoint()
+            sp = tsdb.rollups.state_path
+        finally:
+            tsdb.shutdown()
+        with open(sp) as f:
+            st = json.load(f)
+        del st["fold"]
+        with open(sp, "w") as f:
+            json.dump(st, f)
+        tsdb = make_tsdb(str(tmp_path))
+        try:
+            assert tsdb.rollups.ready
+            assert tsdb.rollups.rebuilds == 0
+            assert tsdb.rollups.fold_kind == "host-f64"
+        finally:
+            tsdb.shutdown()

@@ -382,12 +382,21 @@ def test_expert_dashboard_routing_chip_parity():
                                    rtol=RTOL, atol=1e-3)
 
 
-def test_devwindow_eviction_chip_parity():
+@pytest.mark.parametrize("shards", [0, 4], ids=["single", "sharded"])
+def test_devwindow_eviction_chip_parity(shards):
     """Devwindow eviction on the real chip: with a budget that forces
     chunk eviction, resident answers over the still-covered suffix
     must match the storage scan (f32 tolerance), and a range reaching
     past complete_from must FALL BACK, never serve the evicted hole
-    approximately."""
+    approximately.
+
+    The sharded leg runs the same contract with the hot set split over
+    4 mesh shards round-robined on the chip's devices (the serving
+    fleet's resident layout): each shard evicts INDEPENDENTLY on its
+    own device, and any owning shard's eviction hole must decline the
+    whole window — never a partial cross-shard union. The per-shard
+    budget (fleet budget / 4) equals the single-window leg's, so both
+    legs exercise the same eviction pressure."""
     from opentsdb_tpu.core.tsdb import TSDB
     from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
     from opentsdb_tpu.storage.kv import MemKVStore
@@ -397,8 +406,10 @@ def test_devwindow_eviction_chip_parity():
     t = TSDB(MemKVStore(),
              Config(auto_create_metrics=True, enable_sketches=False,
                     device_window=True,
+                    devwindow_shards=shards,
                     device_window_staging=1 << 12,
-                    device_window_points=1 << 13),
+                    device_window_points=(1 << 13 if shards == 0
+                                          else 1 << 15)),
              start_compaction_thread=False)
     try:
         rng = np.random.default_rng(31)
@@ -415,14 +426,24 @@ def test_devwindow_eviction_chip_parity():
                             {"host": f"h{i}"})
         dw = t.devwindow
         dw.flush()
-        assert dw.evicted_points > 0, \
-            "budget did not force eviction; shrink it"
-        mw = dw._metrics[t.metrics.get_id("m.ev")]
-        assert mw.complete_from is not None and not mw.dirty
+        if shards:
+            assert sum(s.evicted_points for s in dw._shards) > 0, \
+                "budget did not force eviction; shrink it"
+            uid = t.metrics.get_id("m.ev")
+            floors = [s._metrics[uid].complete_from
+                      for s in dw._shards if uid in s._metrics]
+            assert floors and all(f is not None for f in floors)
+            cf = max(floors)
+        else:
+            assert dw.evicted_points > 0, \
+                "budget did not force eviction; shrink it"
+            mw = dw._metrics[t.metrics.get_id("m.ev")]
+            assert mw.complete_from is not None and not mw.dirty
+            cf = int(mw.complete_from)
         ex = QueryExecutor(t, backend="tpu")
         spec = QuerySpec("m.ev", {}, "sum", downsample=(600, "avg"))
         # Covered suffix: resident serve, parity vs the scan.
-        lo = int(mw.complete_from) + 60
+        lo = cf + 60
         assert lo < BT + span - 600, "no covered suffix survived"
         h0 = dw.window_hits
         got = ex.run(spec, lo, BT + span)
