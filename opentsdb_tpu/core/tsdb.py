@@ -66,6 +66,17 @@ class TSDB:
                     f"(one of: none, tsst4)")
             if hasattr(store, "sstable_codec"):
                 store.sstable_codec = codec
+        # WAL group commit (storage/kv.py): pushed onto the store the
+        # same way; replicas never append so the knob is writer-only.
+        group_ms = float(getattr(self.config, "wal_group_ms", 0.0) or 0.0)
+        if group_ms > 0 and hasattr(store, "wal_group_ms") \
+                and not getattr(store, "read_only", False):
+            store.wal_group_ms = group_ms
+        # Spill-encode pipelining (storage/sstable.py module knob —
+        # the writer pool is shared across stores/shards).
+        from opentsdb_tpu.storage import sstable as _sstable_mod
+        _sstable_mod.set_encode_workers(
+            int(getattr(self.config, "spill_encode_workers", 0) or 0))
         self._lock = threading.Lock()
         # Serializes checkpoint() end to end so the rollup tier's spill
         # bracketing (begin_spill ... fold_after_spill) pairs 1:1 with
@@ -679,6 +690,11 @@ class TSDB:
         if self.sketches is not None:
             self.sketches.note_series(skey)
         self.store.put(self.table, row, FAMILY, qual, buf, durable=durable)
+        # Scalar puts bypass the delta-fold feed (add_batch): their
+        # coarse window must fall back to the full fold rescan.
+        delta = getattr(self.rollups, "delta", None)
+        if delta is not None:
+            delta.invalidate(skey, base_ts)
         if self.config.enable_compactions:
             self.compactionq.add(row)
         self.datapoints_added += 1
@@ -695,7 +711,7 @@ class TSDB:
                   durable: bool = True,
                   is_float: np.ndarray | None = None,
                   int_values: np.ndarray | None = None,
-                  tenant: str = "default") -> int:
+                  tenant: str = "default", sync: bool = True) -> int:
         """Columnar ingest for one series: pre-compacted cell per row-hour.
 
         ``values`` may be an integer or floating dtype; float points are
@@ -704,7 +720,10 @@ class TSDB:
         individually within a float-dtyped ``values`` array (mixed series,
         like per-line telnet/import ingest produces) — and ``int_values``
         (int64) alongside it to keep integers above 2^53 exact, since
-        float64 cannot represent them. Returns the points written.
+        float64 cannot represent them. ``sync=False`` skips the per-call
+        WAL group-commit barrier so a multi-series caller can batch many
+        series under one covering ``store.wal_barrier()`` before acking
+        (no-op when group commit is off). Returns the points written.
         """
         timestamps = np.asarray(timestamps, dtype=np.int64)
         if timestamps.size == 0:
@@ -773,10 +792,16 @@ class TSDB:
         # and must be queued so the per-batch compacted cells merge into
         # one; the store reports that per row in a single locked pass.
         # A mid-batch throttle still queues the rows that DID apply.
+        delta = getattr(self.rollups, "delta", None)
         try:
             existed = self.store.put_many_columnar(
-                self.table, FAMILY, kb, L, quals, vals, durable=durable)
+                self.table, FAMILY, kb, L, quals, vals, durable=durable,
+                sync=sync)
         except PleaseThrottleError as e:
+            # Which rows landed is unknowable from here; the batch's
+            # rollup windows can no longer be folded incrementally.
+            if delta is not None:
+                delta.kill_batch(skey, base[row_starts])
             existed = getattr(e, "partial_existed", [])
             if self.config.enable_compactions:
                 for i, ex in enumerate(existed):
@@ -797,6 +822,12 @@ class TSDB:
             for i, e in enumerate(existed):
                 if e:
                     self.compactionq.add(kb[i * L:(i + 1) * L])
+        # Rollup delta accumulators (rollup/delta.py): the applied
+        # batch's columns ARE what a checkpoint fold's raw rescan
+        # would decode, so buffer them for the incremental fold path.
+        if delta is not None:
+            delta.feed(skey, ts_s, f_s, i_s, m_s, base, row_starts,
+                       existed)
         n = len(ts_s)
         self.datapoints_added += n
         self._account_points(tenant, metric, tag_map, n, skey)
@@ -823,6 +854,20 @@ class TSDB:
         is written before the originals are deleted, and an original cell
         that already equals the merged form is never deleted-after-write.
         """
+        delta = getattr(self.rollups, "delta", None)
+        if delta is None:
+            self._compact_row(key)
+            return
+        # Compaction preserves the row's point set: mark this thread's
+        # deletes as preserving so the store delete hook doesn't kill
+        # the row's rollup delta window (rollup/delta.py).
+        delta.preserve.on = True
+        try:
+            self._compact_row(key)
+        finally:
+            delta.preserve.on = False
+
+    def _compact_row(self, key: bytes) -> None:
         cells = self.store.get(self.table, key, FAMILY)
         if len(cells) <= 1:
             if cells:

@@ -77,7 +77,7 @@ _EXTRA_HOUR = T0 + 5000 * 3600
 
 CHILD_TIMEOUT = 120.0
 
-BUGS = ("torn-bracket",)
+BUGS = ("torn-bracket", "ack-before-fsync")
 
 
 @dataclasses.dataclass
@@ -112,6 +112,11 @@ class Scenario:
     # HLL sketch tier, so the tenant-snapshot crash rows cover the
     # estimate-within-error recovery contract, not just the exact one.
     tenant_cutoff: int = -1
+    # WAL group-commit linger (Config.wal_group_ms) for the workload:
+    # >0 routes every append through the coalescing flusher, so the
+    # kv.wal.group.* faultpoints are reachable and acked ops must be
+    # covered by a group fsync before the progress file sees them.
+    wal_group_ms: float = 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -238,7 +243,8 @@ def open_store(dirpath: str, shards: int, read_only: bool = False):
 
 def open_tsdb(dirpath: str, shards: int, rollups: bool,
               codec: str = "none", incremental: bool = True,
-              tenant_cutoff: int = -1, mesh: bool = False) -> TSDB:
+              tenant_cutoff: int = -1, mesh: bool = False,
+              wal_group_ms: float = 0.0) -> TSDB:
     """Writer TSDB with the harness profile: cpu backend, sketches and
     device window off (the child must stay jax-free), compactions off
     and no background threads (schedule determinism), rollup catch-up
@@ -257,7 +263,7 @@ def open_tsdb(dirpath: str, shards: int, rollups: bool,
         devwindow_shards=2 if mesh else 0,
         enable_rollups=rollups, rollup_catchup="sync",
         rollup_incremental_catchup=incremental,
-        sstable_codec=codec,
+        sstable_codec=codec, wal_group_ms=wal_group_ms,
         # Sub-day sketch columns so the 1h resolution carries digests
         # too (more fold surface for the crash sites to land in).
         rollup_sketch_min_res=3600)
@@ -337,6 +343,14 @@ def _apply_bug(bug: str) -> None:
             orig_write(self, pending)
 
         RollupTier._write_state = torn_write
+    elif bug == "ack-before-fsync":
+        # The group-commit regression class: the WAL barrier returns
+        # before the covering group fsync, so sync=True appends ack
+        # (and the progress file records them) while their bytes sit
+        # in the page cache only as far as write() — a crash at
+        # kv.wal.group.write loses acknowledged ops and verify must
+        # flag the missing rows.
+        MemKVStore._ACK_BEFORE_FSYNC = True
     else:
         raise ValueError(f"unknown --bug {bug!r} (one of {BUGS})")
 
@@ -352,7 +366,8 @@ def _child_main(args) -> int:
     tsdb = open_tsdb(args.dir, args.shards, args.rollups,
                      codec=args.codec,
                      tenant_cutoff=args.tenant_cutoff,
-                     mesh=args.mesh_reshard)
+                     mesh=args.mesh_reshard,
+                     wal_group_ms=args.wal_group_ms)
     with open(args.progress, "a") as pf:
         for i, op in enumerate(ops):
             apply_op(tsdb, op)
@@ -749,7 +764,8 @@ def verify(dirpath: str, sc: Scenario, ops: list[tuple],
     try:
         tsdb = open_tsdb(dirpath, sc.shards, sc.rollups,
                          codec=sc.codec,
-                         tenant_cutoff=sc.tenant_cutoff)
+                         tenant_cutoff=sc.tenant_cutoff,
+                         wal_group_ms=sc.wal_group_ms)
     except Exception as e:
         return [f"reopen failed: {e!r}"], ""
     try:
@@ -843,6 +859,8 @@ def _run_once(sc: Scenario, workdir: str) -> dict:
         cmd += ["--codec", sc.codec]
     if sc.tenant_cutoff >= 0:
         cmd += ["--tenant-cutoff", str(sc.tenant_cutoff)]
+    if sc.wal_group_ms > 0:
+        cmd += ["--wal-group-ms", str(sc.wal_group_ms)]
     if sc.kind == "meshreshard":
         cmd.append("--mesh-reshard")
     result = {
@@ -910,6 +928,8 @@ def repro_command(sc: Scenario) -> str:
         out += f" --codec {sc.codec}"
     if sc.tenant_cutoff >= 0:
         out += f" --tenant-cutoff {sc.tenant_cutoff}"
+    if sc.wal_group_ms > 0:
+        out += f" --wal-group-ms {sc.wal_group_ms}"
     return out
 
 
@@ -1111,6 +1131,7 @@ def run_scenario(sc: Scenario, work_root: str,
 # Tier-1 subset: one scenario per durability machine, cheapest configs.
 FAST_LABELS = (
     "wal-append-torn-s1",
+    "wal-group-fsync-torn-s1",
     "ckpt-freeze-crash-s1",
     "ckpt-commit-crash-s1",
     "sst-body-torn-s1",
@@ -1145,6 +1166,24 @@ def build_matrix() -> list[Scenario]:
             skip=11, **c)
         add(f"wal-fsync-crash-{t}", "kv.wal.fsync", "crash",
             skip=4, **c)
+        # Group commit (Config.wal_group_ms): the coalescing flusher's
+        # write and fsync sites. Crash AT the buffered write (the
+        # whole group's bytes may be lost — but none of its ops were
+        # acked, the barrier still held them) and crash/torn at the
+        # group fsync (the torn cut lands inside the unfsynced tail of
+        # the WAL, never into bytes a barrier already released).
+        add(f"wal-group-write-crash-{t}", "kv.wal.group.write",
+            "crash", skip=30, wal_group_ms=2.0,
+            **{**c, "seed": 7000 + shards})
+        add(f"wal-group-fsync-crash-{t}", "kv.wal.group.fsync",
+            "crash", skip=25, wal_group_ms=2.0,
+            **{**c, "seed": 7010 + shards})
+        add(f"wal-group-fsync-torn-{t}", "kv.wal.group.fsync",
+            "torn", skip=30, wal_group_ms=2.0,
+            **{**c, "seed": 7020 + shards})
+        add(f"wal-group-fsync-torn-late-{t}", "kv.wal.group.fsync",
+            "torn", skip=45, wal_group_ms=2.0,
+            **{**c, "seed": 7030 + shards})
         add(f"ckpt-freeze-crash-{t}", "kv.checkpoint.freeze", "crash",
             **c)
         add(f"ckpt-freeze-crash2-{t}", "kv.checkpoint.freeze", "crash",
@@ -1296,6 +1335,7 @@ def main(argv=None) -> int:
     p.add_argument("--codec", default="none",
                    choices=("none", "tsst4"))
     p.add_argument("--tenant-cutoff", type=int, default=-1)
+    p.add_argument("--wal-group-ms", type=float, default=0.0)
     p.add_argument("--mesh-reshard", action="store_true")
     args = p.parse_args(argv)
     return _child_main(args)

@@ -80,6 +80,12 @@ _FLUSH_CELLS = 1 << 16
 _M_FOLD = _metrics.timer("rollup.fold")
 _M_CATCHUP = _metrics.timer("rollup.catchup")
 
+# Checkpoint-fold path split (ISSUE-20 delta folds): (metric, coarse
+# window) groups served from ingest-time delta accumulators vs groups
+# that took the full raw rescan.
+_M_FOLD_DELTA = _metrics.counter("rollup.fold.delta")
+_M_FOLD_FULL = _metrics.counter("rollup.fold.full")
+
 
 class _TierClosed(Exception):
     """Internal: the catch-up rebuild was aborted by close()."""
@@ -192,6 +198,12 @@ class _MapBuffer:
 class RollupTier:
     def __init__(self, tsdb, config) -> None:
         self._init_layout(tsdb, config)
+        if bool(getattr(config, "rollup_delta_fold", True)):
+            from opentsdb_tpu.rollup.delta import DeltaFolds
+            self.delta = DeltaFolds(
+                coarse=self.resolutions[-1],
+                cap_points=int(getattr(config, "rollup_delta_points",
+                                       1 << 22)))
         store = tsdb.store
         st = self._read_state()
         rebuild = self._needs_rebuild(st)
@@ -221,6 +233,8 @@ class RollupTier:
             self.close()
             raise
         store.record_spill_keys = True
+        if self.delta is not None and hasattr(store, "delete_hook"):
+            store.delete_hook = self._delta_delete_hook
         if rebuild != "none":
             windows = (self._incr_windows if rebuild == "incr"
                        else None)
@@ -323,6 +337,12 @@ class RollupTier:
         self.folds = 0
         self.records_written = 0
         self.rebuilds = 0
+        # Fold-path split counters and the delta accumulators
+        # themselves; the writer tier attaches DeltaFolds in its
+        # __init__ (the read-only replica never folds).
+        self.fold_delta = 0
+        self.fold_full = 0
+        self.delta = None
 
         self._ready = False
         # True while a full catch-up is owed (crash/foreign state):
@@ -943,17 +963,34 @@ class RollupTier:
             return
         with self._fold_lock:
             coarse = self.resolutions[-1]
-            per_metric: dict[bytes, set[int]] = {}
+            groups: dict[tuple[bytes, int], list[bytes]] = {}
             must: set[bytes] = set()
             for k in keys:
                 if len(k) < UID_WIDTH + TIMESTAMP_BYTES:
                     continue
-                must.add(bytes(k))
+                kb = bytes(k)
+                must.add(kb)
                 hb = codec.key_base_time(k)
-                per_metric.setdefault(
-                    bytes(k[:UID_WIDTH]), set()).add(hb - hb % coarse)
+                groups.setdefault(
+                    (kb[:UID_WIDTH], hb - hb % coarse), []).append(kb)
             buf = _MapBuffer(self, track_emitted=True)
             seen: set[bytes] = set()
+            # Delta fast path (rollup/delta.py): a (metric, coarse
+            # window) group whose every spilled series-window is
+            # completely buffered emits straight from memory; the rest
+            # take the replace-from-raw rescan below. Both paths write
+            # through the same buffer under this lock, so the final
+            # record bytes are independent of the split.
+            per_metric: dict[bytes, set[int]] = {}
+            for (muid, cb), ks in groups.items():
+                if self.delta is not None and self.delta.serve(
+                        self, cb, ks, buf, seen):
+                    self.fold_delta += 1
+                    _M_FOLD_DELTA.inc()
+                    continue
+                per_metric.setdefault(muid, set()).add(cb)
+                self.fold_full += 1
+                _M_FOLD_FULL.inc()
             # Bound one scan chunk to ~4 days of coarse windows.
             chunk = max(1, (4 * 86400) // coarse)
             for metric_uid, cbases in per_metric.items():
@@ -1331,7 +1368,26 @@ class RollupTier:
             for s in stores:
                 s.flush()
 
+    def _delta_delete_hook(self, table: str, key: bytes) -> None:
+        """Store delete hook: any raw-table delete (operator tools,
+        query-path cleanups, sabotage workloads) drops the row's
+        window from the delta accumulators. Compaction's preserving
+        rewrites are excluded by the accumulator's thread-local
+        preserve window (TSDB.compact_row)."""
+        if table == self.table and self.delta is not None:
+            self.delta.invalidate_key(key)
+
     def close(self) -> None:
+        # Unhook from the raw store first: the store outlives tier
+        # swaps (refresh_replica), and a stale hook would pin this
+        # tier's accumulators alive.
+        try:
+            store = self.tsdb.store
+            if getattr(store, "delete_hook", None) == \
+                    self._delta_delete_hook:
+                store.delete_hook = None
+        except Exception:   # pragma: no cover - teardown best-effort
+            pass
         # Stop + join the catch-up thread BEFORE closing its stores:
         # racing it would discard the whole rebuild into _rebuild_error
         # and close WAL fds out from under its writes.

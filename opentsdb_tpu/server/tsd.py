@@ -325,7 +325,7 @@ class TSDServer:
         # router forwards the line ahead of forwarded puts, so
         # attribution survives the hop (it used to stop at the
         # router).
-        conn = {"tenant": "default"}
+        conn = {"tenant": "default", "line": 0}
         # Per-connection two-stage ingest pipeline (SURVEY §2.9 PP row):
         # chunk N's decode runs in the pool while chunk N-1's ingest is
         # still applying — the server-loop form of wire.pipelined_ingest.
@@ -360,10 +360,18 @@ class TSDServer:
                         chunk, buf = buf[:prefix_len], buf[prefix_len:]
                         if older is not None:
                             await older
+                        # The connection's line counter advances NOW
+                        # (synchronously, before the next chunk is
+                        # carved) so each in-flight bulk task knows the
+                        # exact stream line its chunk starts at — error
+                        # lines report the connection-wide line number,
+                        # not the chunk-relative offset.
+                        line_base = conn["line"]
+                        conn["line"] += chunk.count(b"\n")
                         older, pending = pending, asyncio.create_task(
                             self._bulk_puts_pipelined(
                                 chunk, pending, writer,
-                                conn["tenant"]))
+                                conn["tenant"], line_base))
                         continue
                 # Ordering: bulk results (error lines, stats) land
                 # before any later single-line command executes.
@@ -371,6 +379,7 @@ class TSDServer:
                     await pending
                     pending = older = None
                 line, buf = buf[:nl], buf[nl + 1:]
+                conn["line"] += 1
                 if len(line) > MAX_LINE:
                     raise ValueError(f"frame length exceeds {MAX_LINE}")
                 words = tags_mod.split_string(
@@ -394,16 +403,20 @@ class TSDServer:
     async def _bulk_puts_pipelined(self, chunk: bytes,
                                    prev: asyncio.Task | None,
                                    writer,
-                                   tenant: str = "default") -> None:
+                                   tenant: str = "default",
+                                   line_base: int = 0) -> None:
         """Stage A (decode) runs immediately in the pool — overlapping
         the previous chunk's stage B — then awaits ``prev`` so ingest
-        and error reporting stay in arrival order."""
+        and error reporting stay in arrival order. ``line_base`` is the
+        connection-wide line number of this chunk's first line, so a
+        mid-batch parse error reports its exact stream line."""
         from opentsdb_tpu.server import wire
 
         t0 = time.time()
         loop = asyncio.get_running_loop()
         batch = await loop.run_in_executor(
-            self._pool, wire.decode_puts, chunk)
+            self._pool, functools.partial(
+                wire.decode_puts, chunk, line_base=line_base))
         if prev is not None:
             await prev
         # Ingest admission (serve/admission.py): shed the whole batch
@@ -432,10 +445,16 @@ class TSDServer:
                 self.admission.ingest_done(npts)
         self.telnet_rpcs += n + len(batch.errors)
         self.requests_put += n + len(batch.errors)
-        for err in batch.errors:
+        elines = list(batch.error_lines)
+        for k, err in enumerate(batch.errors):
             self.illegal_arguments_put += 1
             _M_TELNET_ERRORS.inc()
-            writer.write(f"put: illegal argument: {err}\n".encode())
+            # 1-based stream line numbers when the decoder attributed
+            # them (the native path doesn't); same line prefix either
+            # way so `grep "put: illegal argument"` keeps working.
+            at = f" at line {elines[k] + 1}" if k < len(elines) else ""
+            writer.write(
+                f"put: illegal argument{at}: {err}\n".encode())
         for err in series_errors:
             _M_TELNET_ERRORS.inc()
             if "No such name" in err:
@@ -1159,7 +1178,15 @@ class TSDServer:
                                    "hll_p": a[2]}
                     for r, a in sorted(tier.sketch_alloc.items())},
                 "sketch_bytes": dict(tier.sketch_bytes),
+                # Checkpoint fold sourcing: windows served from the
+                # in-memory delta buffers vs full re-reads of spilled
+                # rows (rollup/delta.py). A healthy append-mostly
+                # daemon should see delta dominate.
+                "folds": {"delta": tier.fold_delta,
+                          "full": tier.fold_full},
             }
+            if tier.delta is not None:
+                rollup["delta"] = tier.delta.stats()
         sketch: dict = {}
         for name, kind, tkey, obj in METRICS._snapshot():
             if not name.startswith("sketch."):
@@ -1200,10 +1227,35 @@ class TSDServer:
                 fused["devcache"][name.rsplit(".", 1)[1]] = obj.value
         fused["coverage"] = (fused["served"] / fused["attempt"]
                              if fused["attempt"] else 0.0)
+        # The ingest fast path (wire decode + WAL group commit):
+        # batches-per-fsync is the coalescing win, wait_ms p95 the
+        # latency each acked batch paid for its covering fsync.
+        ingest = {"group": {"batches": 0, "points": 0, "fsyncs": 0,
+                            "waits": 0, "wait_ms_p95": 0.0},
+                  "parse": {"count": 0, "p95_ms": 0.0}}
+        for name, kind, tkey, obj in METRICS._snapshot():
+            if name == "wal.group.batches":
+                ingest["group"]["batches"] += obj.value
+            elif name == "wal.group.points":
+                ingest["group"]["points"] += obj.value
+            elif name == "wal.group.fsyncs":
+                ingest["group"]["fsyncs"] += obj.value
+            elif name == "wal.group.wait_ms" and kind == "timer":
+                ingest["group"]["waits"] += obj.count
+                ingest["group"]["wait_ms_p95"] = round(
+                    obj.digest.percentile(95), 4)
+            elif name == "ingest.parse" and kind == "timer":
+                ingest["parse"]["count"] += obj.count
+                ingest["parse"]["p95_ms"] = round(
+                    obj.digest.percentile(95), 4)
+        g = ingest["group"]
+        g["batches_per_fsync"] = (g["batches"] / g["fsyncs"]
+                                  if g["fsyncs"] else 0.0)
         body = {
             "uptime_s": int(time.time()) - self.start_time,
             "plans": dict(self.plan_counts),
             "fused": fused,
+            "ingest": ingest,
             "sketch": sketch,
             "rollup": rollup,
             # The mesh execution plane's compile-cache line: devices
@@ -1272,7 +1324,9 @@ class TSDServer:
     async def _http_put(self, req) -> tuple:
         """HTTP ingest: a POST body of telnet-format ``put`` lines
         (no leading "put " required per line — both spellings
-        accepted), attributed to ``?tenant=``. The HTTP face of the
+        accepted) or a JSON datapoint object/array (the reference
+        ``/api/put`` shape), attributed to ``?tenant=``. Both bodies
+        decode into the same columnar batch. The HTTP face of the
         tenant-limit contract: when every line was refused by the
         cardinality limiter the answer is 429 naming the limit;
         partial refusals report per-series errors in a 200 body so
@@ -1284,19 +1338,32 @@ class TSDServer:
             raise BadRequestError("empty body")
         tenant = req.q.get("tenant", "default")
         raw = req.body
-        if not raw.endswith(b"\n"):
-            raw += b"\n"
-        # Accept bare "metric ts value tags" lines by prefixing the
-        # telnet verb; lines already carrying it pass through.
-        lines = []
-        for ln in raw.split(b"\n"):
-            if ln and not ln.startswith(b"put "):
-                ln = b"put " + ln
-            lines.append(ln)
-        raw = b"\n".join(lines)
         loop = asyncio.get_running_loop()
-        batch = await loop.run_in_executor(self._pool, wire.decode_puts,
-                                           raw)
+        # JSON bodies are unambiguous: no telnet put line can start
+        # with '{' or '[' (the metric charset forbids both).
+        if raw.lstrip()[:1] in (b"{", b"["):
+            try:
+                obj = json.loads(raw)
+            except ValueError as e:
+                raise BadRequestError(f"invalid json: {e}")
+            try:
+                batch = await loop.run_in_executor(
+                    self._pool, wire.decode_json_puts, obj)
+            except ValueError as e:
+                raise BadRequestError(str(e))
+        else:
+            if not raw.endswith(b"\n"):
+                raw += b"\n"
+            # Accept bare "metric ts value tags" lines by prefixing
+            # the telnet verb; lines already carrying it pass through.
+            lines = []
+            for ln in raw.split(b"\n"):
+                if ln and not ln.startswith(b"put "):
+                    ln = b"put " + ln
+                lines.append(ln)
+            raw = b"\n".join(lines)
+            batch = await loop.run_in_executor(
+                self._pool, wire.decode_puts, raw)
         npts = len(batch.sid)
         wait = self.admission.admit_ingest(npts, tenant) if npts \
             else 0.0

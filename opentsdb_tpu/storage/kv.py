@@ -72,6 +72,14 @@ _M_WAL_APPENDS = _metrics.counter("wal.appends")
 _M_WAL_BYTES = _metrics.counter("wal.append_bytes")
 _M_WAL_APPEND = _metrics.timer("wal.append")
 _M_WAL_FSYNC = _metrics.timer("wal.fsync")
+# Group commit (Config.wal_group_ms): batches = append calls whose
+# flush was deferred to a group leader, points = WAL records inside
+# them, fsyncs = covering group flushes, wait_ms = time ack paths
+# spent parked in the barrier.
+_M_GRP_BATCHES = _metrics.counter("wal.group.batches")
+_M_GRP_POINTS = _metrics.counter("wal.group.points")
+_M_GRP_FSYNCS = _metrics.counter("wal.group.fsyncs")
+_M_GRP_WAIT = _metrics.timer("wal.group.wait_ms")
 _M_CKPT_PHASE = {ph: _metrics.timer("checkpoint.phase", {"phase": ph})
                  for ph in ("freeze", "spill", "commit")}
 
@@ -108,7 +116,7 @@ class KVStore:
 
     def put_many(self, table: str, family: bytes,
                  cells: list[tuple[bytes, bytes, bytes]],
-                 durable: bool = True) -> list[bool]:
+                 durable: bool = True, sync: bool = True) -> list[bool]:
         """Write (key, qualifier, value) cells; returns, per cell, True
         when the row holds other cells by the time this one lands —
         either it existed before the batch, or an earlier cell of the
@@ -116,7 +124,9 @@ class KVStore:
         compaction). On PleaseThrottleError mid-batch the exception's
         ``partial_existed`` carries the flags for the cells that DID
         apply. Default loops over put(); MemKVStore overrides with a
-        single-lock batch.
+        single-lock batch. ``sync=False`` defers the WAL group-commit
+        wait (stores without group commit ignore it): the caller must
+        issue ``wal_barrier()`` before acknowledging.
         """
         existed: list[bool] = []
         seen: set[bytes] = set()
@@ -134,7 +144,8 @@ class KVStore:
     def put_many_columnar(self, table: str, family: bytes,
                           key_blob: bytes, key_len: int,
                           quals: list[bytes], vals: list[bytes],
-                          durable: bool = True) -> list[bool]:
+                          durable: bool = True,
+                          sync: bool = True) -> list[bool]:
         """put_many with columnar inputs: cell i's key is the i-th
         ``key_len``-byte slice of ``key_blob``. Semantics identical to
         ``put_many`` on the zipped triples; exists so the batch ingest
@@ -144,7 +155,7 @@ class KVStore:
         keys = [key_blob[i:i + key_len]
                 for i in range(0, key_len * len(quals), key_len)]
         return self.put_many(table, family, list(zip(keys, quals, vals)),
-                             durable=durable)
+                             durable=durable, sync=sync)
 
     def delete(self, table: str, key: bytes, family: bytes,
                qualifiers: list[bytes]) -> None:
@@ -189,6 +200,12 @@ class KVStore:
 
     def flush(self) -> None:
         raise NotImplementedError
+
+    def wal_barrier(self, ticket: int | None = None) -> None:
+        """Wait for the WAL group-commit flush covering everything
+        appended so far (see MemKVStore). Default: no-op — stores
+        without group commit are already durable at return from every
+        mutation."""
 
     def ensure_table(self, table: str) -> None:
         raise NotImplementedError
@@ -377,6 +394,12 @@ class MemKVStore(KVStore):
     this layer, so lock traffic is per-batch, not per-point).
     """
 
+    # Sabotage gate for the crash matrix (fault/harness.py --bug
+    # ack-before-fsync): True makes _wal_barrier return immediately,
+    # acking group-commit writes before their covering fsync — the
+    # exact regression the kv.wal.group.* matrix rows must catch.
+    _ACK_BEFORE_FSYNC = False
+
     def __init__(self, wal_path: str | None = None,
                  throttle_rows: int | None = None,
                  fsync: bool = False, read_only: bool = False,
@@ -455,6 +478,23 @@ class MemKVStore(KVStore):
         # flipping this only affects FUTURE spills (compaction
         # re-encodes as generations merge).
         self.sstable_codec = "none"
+        # WAL group commit (Config.wal_group_ms, set externally like
+        # sstable_codec): > 0 defers the per-append flush+fsync into a
+        # leader-elected group flush. Append paths bump _grp_written
+        # (a ticket counter) UNDER the store lock; ack paths call
+        # _wal_barrier(ticket) AFTER releasing it and park on
+        # _grp_cond until _grp_flushed covers their ticket. Lock
+        # order is store lock -> _grp_cond everywhere.
+        self._wal_group_ms = 0.0
+        self._grp_cond = threading.Condition()
+        self._grp_written = 0     # tickets issued (appends recorded)
+        self._grp_flushed = 0     # tickets covered by an fsync
+        self._grp_leader = False  # a leader is collecting/flushing
+        self._grp_file_epoch = 0  # bumped per WAL rotation
+        # Last byte offset covered by a group fsync — bounds the torn
+        # span the kv.wal.group.fsync faultpoint may cut (never into
+        # previously durable bytes).
+        self._grp_synced_pos = 0
         # Flush failures SWALLOWED on put_many's exceptional exit (the
         # in-flight throttle error wins) — the one case where a flush
         # failure cannot propagate to the caller. Ordinary flush
@@ -474,6 +514,11 @@ class MemKVStore(KVStore):
         # memtable; take_spill_keys() drains the record.
         self.record_spill_keys = False
         self._last_spill_keys: dict[str, list[bytes]] = {}
+        # Rollup-tier hook: called as fn(table, key) on every delete /
+        # delete_row so the incremental-fold accumulators (rollup/
+        # delta.py) learn when a row's point set changed out-of-band;
+        # None when no tier is listening.
+        self.delete_hook = None
         # Dirty-base refcounts of the UNDRAINED spill record (the
         # frozen tier's dirty index, carried over at phase 3 and summed
         # across checkpoints like _last_spill_keys): spilled keys count
@@ -1150,9 +1195,12 @@ class MemKVStore(KVStore):
         _M_WAL_APPENDS.inc()
         _M_WAL_BYTES.inc(_REC.size + len(payload))
         if flush:
-            self._wal_flush()
-            _fault("kv.wal.append", self._wal_path,
-                   _REC.size + len(payload))
+            if self._wal_group_ms > 0:
+                self._grp_note(1)
+            else:
+                self._wal_flush()
+                _fault("kv.wal.append", self._wal_path,
+                       _REC.size + len(payload))
 
     def _wal_flush(self) -> None:
         self._wal.flush()
@@ -1167,6 +1215,174 @@ class MemKVStore(KVStore):
             if self._fsync:
                 with _M_WAL_FSYNC.time():
                     os.fsync(self._wal.fileno())
+        # In group mode every direct (non-deferred) flush runs under
+        # the store lock — checkpoint rotation, close(), flush() — and
+        # covers every record written so far: mark all issued tickets
+        # durable so parked barriers wake instead of re-flushing.
+        if self._wal_group_ms > 0:
+            self._grp_sync_locked()
+
+    # -- WAL group commit (Config.wal_group_ms) ---------------------------
+    #
+    # Appends keep writing into the WAL's userspace buffer under the
+    # store lock, but the per-append flush+fsync is deferred: each
+    # append takes a ticket (_grp_written), and the ACK path — after
+    # releasing the store lock — parks in _wal_barrier until a group
+    # flush covers its ticket. The first parked thread elects itself
+    # leader, lingers up to wal_group_ms collecting followers, then
+    # performs ONE flush+fsync for everything written so far. The
+    # durability contract is unchanged (nothing acks before its
+    # covering fsync); only the fsync count changes.
+
+    def _grp_note(self, points: int) -> None:
+        """Record a deferred-flush append (called under the store
+        lock). Fires the write-side faultpoint with NO path/bytes
+        context on purpose: the deferred record may still sit in the
+        userspace buffer, so a torn cut here could reach into bytes an
+        earlier group fsync already made durable — the site therefore
+        degrades torn to a plain crash."""
+        _fault("kv.wal.group.write")
+        with self._grp_cond:
+            self._grp_written += 1
+        _M_GRP_BATCHES.inc()
+        _M_GRP_POINTS.inc(points)
+
+    def _grp_ticket(self) -> int:
+        """Ticket for _wal_barrier, captured while the store lock is
+        still held (every _grp_written bump happens under it). 0 =
+        group mode off, nothing to wait for."""
+        if self._wal_group_ms > 0 and self._wal is not None:
+            return self._grp_written
+        return 0
+
+    def _grp_sync_locked(self) -> None:
+        """After a direct full flush under the store lock: every
+        issued ticket is covered — advance the flushed watermark and
+        the durable byte position, and wake parked barriers."""
+        pos = 0
+        if self._wal is not None:
+            try:
+                pos = self._wal.tell()
+            except ValueError:
+                pos = 0
+        with self._grp_cond:
+            self._grp_flushed = self._grp_written
+            self._grp_synced_pos = max(self._grp_synced_pos, pos)
+            self._grp_cond.notify_all()
+
+    def _grp_rotated_locked(self) -> None:
+        """The WAL was just rotated to a fresh segment (store lock
+        held): reset the durable position for the new file and bump
+        the file epoch so a stale leader mid-flush on the old fd
+        cannot clobber the new file's position."""
+        with self._grp_cond:
+            self._grp_file_epoch += 1
+            self._grp_synced_pos = 0
+
+    def _wal_group_flush(self) -> None:
+        """The leader's covering flush (+fsync), run WITHOUT the store
+        lock — BufferedWriter serializes internally against concurrent
+        buffered appends. Raises ValueError/OSError if a rotation
+        closed the file underneath us (the barrier handles it)."""
+        wal = self._wal
+        if wal is None:
+            return
+        with self._grp_cond:
+            epoch = self._grp_file_epoch
+            synced = self._grp_synced_pos
+        # Position BEFORE the userspace flush: <= the on-disk size
+        # after it, so the torn span below can never cut into bytes a
+        # previous group fsync already covered (acked records all sit
+        # at or below _grp_synced_pos).
+        tell_pos = wal.tell()
+        wal.flush()
+        with _trace.span("wal.fsync"):
+            _fault("kv.wal.group.fsync", self._wal_path,
+                   max(tell_pos - synced, 1))
+            if self._fsync:
+                with _M_WAL_FSYNC.time():
+                    os.fsync(wal.fileno())
+        with self._grp_cond:
+            if self._grp_file_epoch == epoch:
+                self._grp_synced_pos = max(self._grp_synced_pos,
+                                           tell_pos)
+        _M_GRP_FSYNCS.inc()
+
+    def _wal_barrier(self, ticket: int) -> None:
+        """Park until a group flush covers ``ticket`` (leader-elected:
+        the first uncovered caller lingers wal_group_ms to collect
+        followers, then flushes for everyone). Call AFTER releasing
+        the store lock — lock order is store lock -> _grp_cond."""
+        if not ticket or MemKVStore._ACK_BEFORE_FSYNC:
+            return
+        t0 = _perf()
+        cond = self._grp_cond
+        linger = self._wal_group_ms / 1000.0
+        while True:
+            with cond:
+                if self._grp_flushed >= ticket:
+                    break
+                if self._grp_leader:
+                    # A leader is collecting or flushing; the timeout
+                    # is belt-and-braces against a lost notify.
+                    cond.wait(0.05)
+                    continue
+                self._grp_leader = True
+                if linger > 0:
+                    cond.wait(linger)
+                target = self._grp_written
+            err = None
+            try:
+                self._wal_group_flush()
+            except BaseException as e:
+                err = e
+            with cond:
+                self._grp_leader = False
+                if err is None:
+                    self._grp_flushed = max(self._grp_flushed, target)
+                covered = self._grp_flushed >= ticket
+                cond.notify_all()
+            if err is not None:
+                # A rotation/close can legitimately yank the file out
+                # from under an elected leader — but only after its
+                # own full flush covered every issued ticket.
+                if covered and isinstance(err, (ValueError, OSError)):
+                    break
+                raise err
+        _M_GRP_WAIT.observe((_perf() - t0) * 1000.0)
+
+    def wal_barrier(self, ticket: int | None = None) -> None:
+        """Block until every WAL record appended so far (or, with a
+        ``ticket`` from a mutation's return, up to that ticket) is
+        covered by a group flush. No-op outside group mode; safe to
+        call without the store lock. Batch ingest calls this ONCE per
+        wire batch (put_many(..., sync=False) per series, then one
+        barrier) instead of once per series."""
+        if self._wal_group_ms <= 0 or self._wal is None:
+            return
+        if ticket is None:
+            with self._grp_cond:
+                ticket = self._grp_written
+        self._wal_barrier(ticket)
+
+    @property
+    def wal_group_ms(self) -> float:
+        return self._wal_group_ms
+
+    @wal_group_ms.setter
+    def wal_group_ms(self, ms: float) -> None:
+        """Set externally like sstable_codec (make_tsdb plumbs
+        Config.wal_group_ms here). Enabling seeds the durable byte
+        position from the current WAL end: everything already on disk
+        (replayed history) must never fall inside a torn group span."""
+        self._wal_group_ms = float(ms)
+        if self._wal_group_ms > 0 and self._wal is not None:
+            with self._grp_cond:
+                try:
+                    self._grp_synced_pos = max(self._grp_synced_pos,
+                                               self._wal.tell())
+                except ValueError:
+                    pass
 
     def _stamp_epoch_header(self, force: bool = False) -> None:
         """Begin (or continue) this writer's ownership span in the WAL
@@ -1263,6 +1479,10 @@ class MemKVStore(KVStore):
                             + payload)
             _M_WAL_APPENDS.inc()
             _M_WAL_BYTES.inc(_REC.size + len(payload))
+        if self._wal_group_ms > 0:
+            self._grp_note(n)
+            _M_WAL_APPEND.observe((_perf() - t_app0) * 1000.0)
+            return
         self._wal_flush()
         _M_WAL_APPEND.observe((_perf() - t_app0) * 1000.0)
         _fault("kv.wal.append", self._wal_path,
@@ -1296,6 +1516,10 @@ class MemKVStore(KVStore):
                             + payload)
             _M_WAL_APPENDS.inc()
             _M_WAL_BYTES.inc(_REC.size + len(payload))
+        if self._wal_group_ms > 0:
+            self._grp_note(n)
+            _M_WAL_APPEND.observe((_perf() - t_app0) * 1000.0)
+            return
         self._wal_flush()
         _M_WAL_APPEND.observe((_perf() - t_app0) * 1000.0)
         _fault("kv.wal.append", self._wal_path,
@@ -1420,6 +1644,8 @@ class MemKVStore(KVStore):
             if self._wal is not None:
                 self._wal.flush()
                 os.fsync(self._wal.fileno())
+                if self._wal_group_ms > 0:
+                    self._grp_sync_locked()
 
     def close(self) -> None:
         with self._lock:
@@ -1577,6 +1803,10 @@ class MemKVStore(KVStore):
         WAL, so a crash anywhere in here loses nothing."""
         _fault("cluster.promote.rotate", self._wal_path)
         if self._wal is not None:
+            # Cover every deferred group-commit ticket before the fd
+            # goes away (close() only reaches the page cache; parked
+            # barriers must see their fsync happen, not vanish).
+            self._wal_flush()
             self._wal.close()
             self._wal = None
         old_path = self._wal_path + ".old"
@@ -1611,6 +1841,7 @@ class MemKVStore(KVStore):
             os.replace(tmp, self._wal_path)
         else:
             self._wal = open(self._wal_path, "ab")
+        self._grp_rotated_locked()
         self._stamp_epoch_header(force=True)
         self._wal_flush()
 
@@ -1705,6 +1936,11 @@ class MemKVStore(KVStore):
             self._tables = {name: _Table() for name in self._frozen}
             self.mutation_seq += 1
             if self._wal is not None:
+                # Cover every deferred group-commit ticket before the
+                # fd goes away — parked barriers wake durable, and a
+                # leader racing the close sees its ticket covered.
+                if self._wal_group_ms > 0:
+                    self._wal_flush()
                 self._wal.close()
                 if os.path.exists(old_path):
                     # A crash-recovered .old is still live state: append the
@@ -1735,6 +1971,7 @@ class MemKVStore(KVStore):
                 else:
                     os.replace(self._wal_path, old_path)
                     self._wal = open(self._wal_path, "ab")
+                self._grp_rotated_locked()
                 # A cluster-mode writer begins the fresh segment with
                 # its epoch header (replay-side fence anchor).
                 self._stamp_epoch_header(force=True)
@@ -2080,102 +2317,143 @@ class MemKVStore(KVStore):
                 self._wal_append(_OP_PUT, table.encode(), key, family,
                                  qualifier, value)
             self._apply_put(table, key, family, qualifier, value)
+            ticket = self._grp_ticket()
+        self._wal_barrier(ticket)
 
     def put_many(self, table: str, family: bytes,
                  cells: list[tuple[bytes, bytes, bytes]],
-                 durable: bool = True) -> list[bool]:
+                 durable: bool = True, sync: bool = True) -> list[bool]:
         """Batched put: one lock acquisition and one existence probe per
         distinct key for the whole batch — the ingest hot path writes one
         cell per row-hour, so per-call locking dominated before this.
         Semantics identical to a put() loop (WAL order, throttle check
         per new row, partial application if throttled mid-batch).
+
+        ``sync=False`` (group-commit mode only) returns WITHOUT waiting
+        for the covering group fsync: the caller batches several
+        put_many calls and then issues ONE ``wal_barrier()`` before
+        acknowledging any of them (server/wire.ingest_batch).
         """
         self._check_writable()
         existed: list[bool] = []
         if not cells:
             return existed
         tenc = table.encode()
-        with self._lock:
-            self.mutation_seq += 1
-            t = self._table(table)
-            rows = t.rows
-            # With no lower tiers the memtable is the whole truth, so
-            # existence is one dict probe (the default-config hot path).
-            pure_mem = not self._ssts and self._frozen is None
-            throttle = self.throttle_rows
-            wal = self._wal is not None and durable
-            keys = [c[0] for c in cells]
-            quals = [c[1] for c in cells]
-            vals = [c[2] for c in cells]
-            fast = self._try_fast_batch(
-                table, t, family, keys, quals, vals,
-                (lambda: self._wal_append_batch(tenc, family, cells))
-                if wal else None)
-            if fast is not None:
-                return fast
-            batch_ok = False
-            try:
-                for key, qualifier, value in cells:
-                    row = rows.get(key)
-                    if row is None:
-                        if throttle is not None and len(rows) >= throttle:
-                            err = PleaseThrottleError(
-                                f"table '{table}' holds >= {throttle} "
-                                f"rows")
-                            err.partial_existed = existed
-                            raise err
-                        e = (False if pure_mem
-                             else self._has_row_locked(table, key))
-                    else:
-                        e = True if pure_mem \
-                            else self._has_row_locked(table, key)
-                    if row is None:
-                        row = rows[key] = {}
-                        t.note_insert(key)
-                        t.dirty_add(key, self.mutation_seq)
-                    row[(family, qualifier)] = value
-                    existed.append(e)
-                batch_ok = True
-            finally:
-                if wal and existed:
-                    # ONE batch WAL record + flush covering exactly the
-                    # applied prefix (len(existed) cells), written in a
-                    # finally because a mid-batch throttle has already
-                    # APPLIED (and will acknowledge, via
-                    # partial_existed) the earlier cells: their records
-                    # must reach the OS before the exception escapes,
-                    # same promise as the success path. Writing AFTER
-                    # the mutations is equivalent to put()'s
-                    # WAL-before-mutation order here: the lock is held
-                    # for the whole batch, so no reader observes
-                    # mid-batch state, and an in-process crash loses
-                    # the unacknowledged memtable state along with the
-                    # unwritten record. The ack boundary, not the
-                    # record, is the durability unit. A WAL failure
-                    # (e.g. ENOSPC) must not REPLACE an in-flight
-                    # exception, though: callers rely on
-                    # PleaseThrottleError.partial_existed to know which
-                    # cells applied, so the WAL error surfaces only
-                    # when the batch itself succeeded. (A local flag,
-                    # not sys.exc_info(): exc_info also sees a HANDLED
-                    # exception in any CALLER's except block, which
-                    # would silently swallow real flush failures for
-                    # callers running retry loops.)
+        ticket = 0
+        try:
+            with self._lock:
+                self.mutation_seq += 1
+                t = self._table(table)
+                rows = t.rows
+                # With no lower tiers the memtable is the whole truth, so
+                # existence is one dict probe (the default-config hot
+                # path).
+                pure_mem = not self._ssts and self._frozen is None
+                throttle = self.throttle_rows
+                wal = self._wal is not None and durable
+                keys = [c[0] for c in cells]
+                quals = [c[1] for c in cells]
+                vals = [c[2] for c in cells]
+                fast = self._try_fast_batch(
+                    table, t, family, keys, quals, vals,
+                    (lambda: self._wal_append_batch(tenc, family, cells))
+                    if wal else None)
+                if fast is not None:
+                    existed = fast
+                else:
+                    batch_ok = False
                     try:
-                        self._wal_append_batch(tenc, family,
-                                               cells[:len(existed)])
-                    except Exception:
-                        if batch_ok:
-                            raise
-                        # Can't replace the in-flight exception, but a
-                        # swallowed WAL failure means the applied
-                        # cells' durability promise is BROKEN until the
-                        # next successful flush — leave a trace.
-                        self.wal_swallowed_flush_errors += 1
-                        logging.getLogger(__name__).exception(
-                            "WAL batch append failed during exceptional "
-                            "put_many exit; %d applied cells not yet "
-                            "durable", len(existed))
+                        for key, qualifier, value in cells:
+                            row = rows.get(key)
+                            if row is None:
+                                if throttle is not None \
+                                        and len(rows) >= throttle:
+                                    err = PleaseThrottleError(
+                                        f"table '{table}' holds >= "
+                                        f"{throttle} rows")
+                                    err.partial_existed = existed
+                                    raise err
+                                e = (False if pure_mem
+                                     else self._has_row_locked(table,
+                                                               key))
+                            else:
+                                e = True if pure_mem \
+                                    else self._has_row_locked(table, key)
+                            if row is None:
+                                row = rows[key] = {}
+                                t.note_insert(key)
+                                t.dirty_add(key, self.mutation_seq)
+                            row[(family, qualifier)] = value
+                            existed.append(e)
+                        batch_ok = True
+                    finally:
+                        if wal and existed:
+                            # ONE batch WAL record + flush covering
+                            # exactly the applied prefix (len(existed)
+                            # cells), written in a finally because a
+                            # mid-batch throttle has already APPLIED
+                            # (and will acknowledge, via
+                            # partial_existed) the earlier cells: their
+                            # records must reach the OS before the
+                            # exception escapes, same promise as the
+                            # success path. Writing AFTER the mutations
+                            # is equivalent to put()'s
+                            # WAL-before-mutation order here: the lock
+                            # is held for the whole batch, so no reader
+                            # observes mid-batch state, and an
+                            # in-process crash loses the unacknowledged
+                            # memtable state along with the unwritten
+                            # record. The ack boundary, not the record,
+                            # is the durability unit. A WAL failure
+                            # (e.g. ENOSPC) must not REPLACE an
+                            # in-flight exception, though: callers rely
+                            # on PleaseThrottleError.partial_existed to
+                            # know which cells applied, so the WAL
+                            # error surfaces only when the batch itself
+                            # succeeded. (A local flag, not
+                            # sys.exc_info(): exc_info also sees a
+                            # HANDLED exception in any CALLER's except
+                            # block, which would silently swallow real
+                            # flush failures for callers running retry
+                            # loops.)
+                            try:
+                                self._wal_append_batch(
+                                    tenc, family, cells[:len(existed)])
+                            except Exception:
+                                if batch_ok:
+                                    raise
+                                # Can't replace the in-flight
+                                # exception, but a swallowed WAL
+                                # failure means the applied cells'
+                                # durability promise is BROKEN until
+                                # the next successful flush — leave a
+                                # trace.
+                                self.wal_swallowed_flush_errors += 1
+                                logging.getLogger(__name__).exception(
+                                    "WAL batch append failed during "
+                                    "exceptional put_many exit; %d "
+                                    "applied cells not yet durable",
+                                    len(existed))
+                ticket = self._grp_ticket()
+        except BaseException:
+            # An exceptional exit (mid-batch throttle) has already
+            # applied — and will acknowledge, via partial_existed — a
+            # prefix of the batch: in group mode those records are
+            # still unflushed tickets, so attempt the covering barrier
+            # before the exception escapes. A barrier failure must not
+            # replace the in-flight error (same contract as the WAL
+            # append above).
+            if sync and self._wal_group_ms > 0:
+                try:
+                    self.wal_barrier()
+                except Exception:
+                    self.wal_swallowed_flush_errors += 1
+                    logging.getLogger(__name__).exception(
+                        "group-commit barrier failed during "
+                        "exceptional put_many exit")
+            raise
+        if sync:
+            self._wal_barrier(ticket)
         return existed
 
     def _dirty_add_new(self, t: _Table, keys: list[bytes],
@@ -2301,11 +2579,13 @@ class MemKVStore(KVStore):
     def put_many_columnar(self, table: str, family: bytes,
                           key_blob: bytes, key_len: int,
                           quals: list[bytes], vals: list[bytes],
-                          durable: bool = True) -> list[bool]:
+                          durable: bool = True,
+                          sync: bool = True) -> list[bool]:
         """Columnar batched put: keys arrive as one contiguous blob that
         flows straight through to the WAL record. Shares the bulk fast
         path with put_many; anything irregular zips the triples and
-        delegates to put_many (identical semantics)."""
+        delegates to put_many (identical semantics). ``sync=False``:
+        see put_many."""
         self._check_writable()
         n = len(quals)
         L = key_len
@@ -2331,26 +2611,39 @@ class MemKVStore(KVStore):
                 (lambda: self._wal_append_batch_columnar(
                     table.encode(), family, key_blob, n, L, quals,
                     vals)) if wal else None)
-            if fast is not None:
-                return fast
+            ticket = self._grp_ticket()
+        if fast is not None:
+            if sync:
+                self._wal_barrier(ticket)
+            return fast
         return self.put_many(table, family, list(zip(keys, quals, vals)),
-                             durable=durable)
+                             durable=durable, sync=sync)
 
     def delete(self, table: str, key: bytes, family: bytes,
                qualifiers: list[bytes]) -> None:
         self._check_writable()
+        hook = self.delete_hook
+        if hook is not None:
+            hook(table, key)
         with self._lock:
             self.mutation_seq += 1
             self._wal_append(_OP_DELETE, table.encode(), key, family,
                              *qualifiers)
             self._apply_delete(table, key, family, qualifiers)
+            ticket = self._grp_ticket()
+        self._wal_barrier(ticket)
 
     def delete_row(self, table: str, key: bytes) -> None:
         self._check_writable()
+        hook = self.delete_hook
+        if hook is not None:
+            hook(table, key)
         with self._lock:
             self.mutation_seq += 1
             self._wal_append(_OP_DELETE_ROW, table.encode(), key)
             self._apply_delete_row(table, key)
+            ticket = self._grp_ticket()
+        self._wal_barrier(ticket)
 
     # -- reads ------------------------------------------------------------
 
@@ -2582,7 +2875,9 @@ class MemKVStore(KVStore):
             self._wal_append(_OP_PUT, table.encode(), key, family, qualifier,
                              packed)
             self._apply_put(table, key, family, qualifier, packed)
-            return value
+            ticket = self._grp_ticket()
+        self._wal_barrier(ticket)
+        return value
 
     def compare_and_set(self, table: str, key: bytes, family: bytes,
                         qualifier: bytes, expected: bytes | None,
@@ -2599,4 +2894,6 @@ class MemKVStore(KVStore):
             self._wal_append(_OP_PUT, table.encode(), key, family, qualifier,
                              value)
             self._apply_put(table, key, family, qualifier, value)
-            return True
+            ticket = self._grp_ticket()
+        self._wal_barrier(ticket)
+        return True

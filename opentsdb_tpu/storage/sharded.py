@@ -285,10 +285,10 @@ class ShardedKVStore(KVStore):
 
     def put_many(self, table: str, family: bytes,
                  cells: list[tuple[bytes, bytes, bytes]],
-                 durable: bool = True) -> list[bool]:
+                 durable: bool = True, sync: bool = True) -> list[bool]:
         if self.shard_count == 1:
             return self.shards[0].put_many(table, family, cells,
-                                           durable=durable)
+                                           durable=durable, sync=sync)
         by_shard: dict[int, list[int]] = {}
         for i, (key, _, _) in enumerate(cells):
             by_shard.setdefault(self._route(table, key), []).append(i)
@@ -298,7 +298,8 @@ class ShardedKVStore(KVStore):
             sub = [cells[i] for i in idx]
             try:
                 flags = self.shards[si].put_many(table, family, sub,
-                                                 durable=durable)
+                                                 durable=durable,
+                                                 sync=sync)
             except PleaseThrottleError as e:
                 part = getattr(e, "partial_existed", [])
                 for i, f in zip(idx, part):
@@ -312,7 +313,8 @@ class ShardedKVStore(KVStore):
     def put_many_columnar(self, table: str, family: bytes,
                           key_blob: bytes, key_len: int,
                           quals: list[bytes], vals: list[bytes],
-                          durable: bool = True) -> list[bool]:
+                          durable: bool = True,
+                          sync: bool = True) -> list[bool]:
         n = len(quals)
         if len(vals) != n or len(key_blob) != n * key_len:
             raise ValueError(
@@ -323,7 +325,7 @@ class ShardedKVStore(KVStore):
         if self.shard_count == 1:
             return self.shards[0].put_many_columnar(
                 table, family, key_blob, key_len, quals, vals,
-                durable=durable)
+                durable=durable, sync=sync)
         L = key_len
         # Same-series fast path — the add_batch hot shape: one series
         # per batch, keys differing only in their base-time bytes. One
@@ -341,7 +343,7 @@ class ShardedKVStore(KVStore):
         if same:
             return self.shards[self._route(table, key_blob[:L])] \
                 .put_many_columnar(table, family, key_blob, L, quals,
-                                   vals, durable=durable)
+                                   vals, durable=durable, sync=sync)
         # Mixed batch: route per key, regroup into per-shard sub-blobs
         # (numpy row gather keeps them columnar — no per-cell tuples).
         routes = np.fromiter(
@@ -356,7 +358,7 @@ class ShardedKVStore(KVStore):
             try:
                 flags = self.shards[int(si)].put_many_columnar(
                     table, family, sub_blob, L, sub_q, sub_v,
-                    durable=durable)
+                    durable=durable, sync=sync)
             except PleaseThrottleError as e:
                 part = getattr(e, "partial_existed", [])
                 for i, f in zip(idx.tolist(), part):
@@ -439,6 +441,22 @@ class ShardedKVStore(KVStore):
     def sstable_codec(self, codec: str) -> None:
         for s in self.shards:
             s.sstable_codec = codec
+
+    @property
+    def wal_group_ms(self) -> float:
+        return self.shards[0].wal_group_ms if self.shards else 0.0
+
+    @wal_group_ms.setter
+    def wal_group_ms(self, ms: float) -> None:
+        for s in self.shards:
+            s.wal_group_ms = ms
+
+    def wal_barrier(self, ticket: int | None = None) -> None:
+        """Group-commit barrier across every shard (per-shard tickets
+        are not comparable store-wide, so the fan-out always waits for
+        each shard's own current watermark)."""
+        for s in self.shards:
+            s.wal_barrier()
 
     def sstable_format_bytes(self) -> dict[int, int]:
         out: dict[int, int] = {}
@@ -535,6 +553,15 @@ class ShardedKVStore(KVStore):
     def record_spill_keys(self, value: bool) -> None:
         for s in self.shards:
             s.record_spill_keys = value
+
+    @property
+    def delete_hook(self):
+        return self.shards[0].delete_hook if self.shards else None
+
+    @delete_hook.setter
+    def delete_hook(self, fn) -> None:
+        for s in self.shards:
+            s.delete_hook = fn
 
     @property
     def spilled(self) -> bool:

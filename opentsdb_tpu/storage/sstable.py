@@ -73,6 +73,7 @@ import io
 import mmap
 import os
 import struct
+import threading
 import zlib
 from bisect import bisect_left, bisect_right
 from typing import Iterable, Iterator
@@ -81,6 +82,7 @@ import numpy as np
 
 from opentsdb_tpu.compress import codecs as _codecs
 from opentsdb_tpu.core.const import TIMESTAMP_BYTES, UID_WIDTH
+from opentsdb_tpu.fault import faultpoints as _fp
 from opentsdb_tpu.fault.faultpoints import fire as _fault
 from opentsdb_tpu.obs.registry import METRICS as _metrics
 from opentsdb_tpu.utils.nativeext import ext as _EXT
@@ -103,6 +105,51 @@ _BLOCK_HDR = struct.Struct(">BII")  # codec tag, raw_len, enc_len
 # enough that a point-get decodes a bounded unit. Runs longer than
 # this split at record boundaries.
 BLOCK_RAW_TARGET = 1 << 18
+
+# Pipelined spill encode (Config.spill_encode_workers): per-block
+# TSST4 encoding — including the codec's self-check round-trip — runs
+# on a small shared thread pool while the spill keeps framing the next
+# run, so compression stops serializing behind the memtable freeze.
+# Completed blocks drain strictly in submission order, so the file
+# bytes (and the sst.write.block fault/flush cadence) are identical to
+# the serial encode; the pool is simply bypassed while faultpoints are
+# armed so crash schedules stay deterministic. 0 workers = serial.
+_ENC_LOCK = threading.Lock()
+_ENC_WORKERS = 0
+_ENC_POOL = None
+# Encoded-but-unwritten blocks allowed in flight per writer before the
+# producer blocks on the oldest (bounds memory at a few raw blocks).
+_ENC_MAX_PENDING = 4
+
+
+def set_encode_workers(n: int) -> None:
+    """Configure the shared encode pool (make_tsdb plumbs
+    Config.spill_encode_workers here). Shrinking/zeroing takes effect
+    for FUTURE _BodyWriters; an existing pool is retired lazily."""
+    global _ENC_WORKERS, _ENC_POOL
+    n = max(int(n), 0)
+    with _ENC_LOCK:
+        if n == _ENC_WORKERS:
+            return
+        old = _ENC_POOL
+        _ENC_WORKERS = n
+        _ENC_POOL = None
+    if old is not None:
+        old.shutdown(wait=False)
+
+
+def _encode_pool():
+    """The lazily created shared pool, or None when disabled."""
+    global _ENC_POOL
+    with _ENC_LOCK:
+        if _ENC_WORKERS <= 0:
+            return None
+        if _ENC_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _ENC_POOL = ThreadPoolExecutor(
+                max_workers=min(_ENC_WORKERS, 4),
+                thread_name_prefix="sst-encode")
+        return _ENC_POOL
 
 # Whole-block decode on the read path (scan, point get, copy-merge,
 # fsck round-trip audits) — p50/p95/p99 + count via /stats + /metrics.
@@ -225,6 +272,19 @@ class _BodyWriter:
         self._pend = 0
         self._table: str | None = None
         self.blocks: list[tuple[int, int]] = []  # (raw_start, file_start)
+        # Pipelined encode (set_encode_workers): in-flight
+        # (raw_start, future) pairs, drained FIFO so file bytes match
+        # the serial encode exactly. None = serial (v2/v3 format, pool
+        # disabled, or faultpoints armed — the crash schedules count
+        # fault firings, which must happen on the spilling thread in
+        # deterministic order).
+        self._futs = None
+        if self.v4 and not _fp.active():
+            pool = _encode_pool()
+            if pool is not None:
+                self._pool = pool
+                from collections import deque
+                self._futs = deque()
 
     def _append(self, table: str, buf: bytes, starts) -> int:
         """Queue record bytes for the current block; returns the raw
@@ -282,11 +342,29 @@ class _BodyWriter:
         raw = self._chunks[0] if len(self._chunks) == 1 \
             else b"".join(self._chunks)
         raw_start = self.raw_off - self._pend
+        self._chunks.clear()
+        self._offs, offs = [], self._offs
+        self._pend = 0
+        self._table = None
+        if self._futs is not None:
+            self._futs.append((raw_start, self._pool.submit(
+                _codecs.encode_block_split, raw, offs)))
+            while len(self._futs) > _ENC_MAX_PENDING:
+                self._write_parts(*self._futs.popleft(), blocking=True)
+            return
+        self._write_parts(raw_start,
+                          _codecs.encode_block_split(raw, offs))
+
+    def _write_parts(self, raw_start: int, parts,
+                     blocking: bool = False) -> None:
+        """Write one flushed run's encoded blocks (``parts`` is the
+        encode_block_split result, or its future when pipelined)."""
+        if blocking:
+            parts = parts.result()
         # One flush may emit several physical blocks: a run mixing
         # value kinds at a metric boundary splits so each side keeps a
         # structured (fused-servable) codec instead of whole-run zlib.
-        for rel, sub, tag, enc in _codecs.encode_block_split(
-                raw, self._offs):
+        for rel, sub, tag, enc in parts:
             self.blocks.append((raw_start + rel, self.f.tell()))
             self.f.write(_BLOCK_HDR.pack(tag, len(sub), len(enc)))
             self.f.write(enc)
@@ -299,15 +377,13 @@ class _BodyWriter:
         self.f.flush()
         _fault("sst.write.block", getattr(self.f, "name", None),
                _BLOCK_HDR.size + len(enc))
-        self._chunks.clear()
-        self._offs.clear()
-        self._pend = 0
-        self._table = None
 
     def finish(self) -> int:
         """Flush pending blocks; returns the footer's file offset."""
         if self.v4:
             self._flush_block()
+            while self._futs:
+                self._write_parts(*self._futs.popleft(), blocking=True)
         return self.f.tell()
 
 

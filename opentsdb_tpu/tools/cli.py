@@ -168,6 +168,7 @@ def make_tsdb(args, start_thread: bool = False) -> TSDB:
         cfg.cachedir = args.cachedir
         cfg.flush_interval = args.flush_interval
         cfg.checkpoint_interval = getattr(args, "checkpoint_interval", 0.0)
+        cfg.wal_group_ms = getattr(args, "wal_group_ms", 0.0)
         if getattr(args, "read_only", False) \
                 and not cfg.checkpoint_interval \
                 and getattr(args, "role", "writer") != "replica":
@@ -943,6 +944,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--staticroot", default=None)
     p.add_argument("--cachedir", default=None)
     p.add_argument("--flush-interval", type=float, default=10.0)
+    p.add_argument("--wal-group-ms", type=float, default=0.0,
+                   help="WAL group-commit window in ms: concurrent "
+                        "durable appends coalesce into one WAL "
+                        "write+fsync per window, acks release only "
+                        "after the covering fsync (storage/kv.py). "
+                        "0 (default) = legacy per-barrier flushing, "
+                        "bit-identical WAL bytes")
     p.add_argument("--checkpoint-interval", type=float, default=0.0,
                    help="seconds between sstable spills + WAL truncation "
                         "(0 disables; requires --wal)")

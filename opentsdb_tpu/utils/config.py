@@ -38,6 +38,21 @@ class Config:
     #   flipping this only changes FUTURE spills; compaction
     #   re-encodes as generations merge.
     sstable_codec: str = "none"
+    # WAL group commit (storage/kv.py): > 0 lets concurrent appends
+    # coalesce into one buffered write + fsync per this many
+    # milliseconds — acks (telnet ok lines, HTTP 2xx, router-forwarded
+    # puts) still release only AFTER the covering fsync, so the
+    # durability contract is unchanged and the crash matrix proves it.
+    # 0 (default) keeps today's flush-per-append behavior with
+    # bit-identical WAL bytes.
+    wal_group_ms: float = 0.0
+    # Spill-encode pipelining (storage/sstable.py): overlap per-block
+    # TSST4 encoding (including its self-check round-trip) with the
+    # spill's file writes using this many encoder threads. Output
+    # bytes are identical to serial encode (blocks drain in submission
+    # order); 0 disables. Automatically serialized while faultpoints
+    # are armed so crash schedules stay deterministic.
+    spill_encode_workers: int = 2
     # Fused decode-plus-aggregate serving (compress/kernels.py): let
     # eligible downsample queries run straight off TSST4 blocks — the
     # decoded column exists only inside one XLA program. Answers are
@@ -81,6 +96,18 @@ class Config:
     # instead of rebuilding the whole tier. False forces the legacy
     # full rebuild (the parity oracle for tests).
     rollup_incremental_catchup: bool = True
+    # Incremental delta folds (rollup/delta.py): maintain per-(series,
+    # coarse-window) point buffers at ingest time so the checkpoint
+    # fold summarizes ONLY from memory for windows whose full point
+    # set is buffered, skipping the spilled-key re-read. Windows
+    # touched by deletes, backfill into already-folded history, or
+    # buffer eviction fall back to the full re-read; either path
+    # produces byte-identical records. False forces every fold down
+    # the full re-read (the parity oracle for tests).
+    rollup_delta_fold: bool = True
+    # Total buffered points across all delta windows; oldest windows
+    # are evicted (to the full-fold path) past this. ~17 B/point.
+    rollup_delta_points: int = 1 << 22
     # Moment-sketch columns (opentsdb_tpu/sketch/moment.py,
     # arXiv:1803.01969): ~104 B/record of count/min/max/power-moments
     # (+ log-moments), merged by pure addition — the tiny quantile

@@ -492,6 +492,318 @@ def run_codec_compare(args) -> int:
     return 0
 
 
+def run_ingest_battery(args) -> int:
+    """BENCH_INGEST.json: the ingest fast-path acceptance legs.
+
+    One telnet-format corpus (time-major, int/float value mix, two tag
+    dimensions) is synthesized ONCE and pushed through the real wire
+    path — decode_puts -> ingest_batch with durable acks — by
+    concurrent writer threads against a store opened with fsync=True
+    (without real fsyncs in the ack path, group commit has nothing to
+    coalesce and the comparison would flatter nobody honestly).
+    Checkpoints run between rounds so every leg pays its spill + rollup
+    fold costs inside the sustained-dps window.
+
+    Legs: the PR-19 ingest shape (scalar per-line decode, no group
+    commit, full re-read folds) vs the fast path (vectorized decode,
+    group commit, delta folds), each at codec none and tsst4, plus
+    single-axis legs isolating group commit and delta folds. A decode
+    micro-section times scalar vs vectorized (vs native when built) on
+    the same corpus, and every leg's 1h-downsample answer is
+    fingerprinted — delta-fold legs must serve byte-identical answers
+    to full-refold legs.
+    """
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                   capture_output=True)
+    import hashlib
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/jax_comp"))
+    except Exception:
+        pass
+    dev = jax.devices()[0]
+    log(f"device: {dev}")
+
+    from opentsdb_tpu.core.tsdb import TSDB
+    from opentsdb_tpu.obs.registry import METRICS
+    from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+    from opentsdb_tpu.server import wire
+    from opentsdb_tpu.storage.kv import MemKVStore
+    from opentsdb_tpu.storage.sharded import ShardedKVStore
+    from opentsdb_tpu.utils.config import Config
+    from opentsdb_tpu.utils.gctune import tune_for_ingest
+
+    # Untouched defaults mean "size for this host": the acceptance
+    # recipe is 100M/4-shard, but a 1-core container gets an honest
+    # small corpus with the same shape rather than a number that only
+    # measures swap.
+    pts = args.points if args.points != 1_000_000_000 else 1_200_000
+    series = args.series if args.series != 2_000 else 48
+    shards = args.shards or 4
+    writers = 4
+    base = 1356998400
+    step = 2
+    pps = max(pts // series, 1)
+    end = base + pps * step
+    pts = pps * series
+
+    log(f"synthesizing {pts:,} points ({series} series, {pps} "
+        f"pts/series, step {step}s, shards {shards})")
+    # One stream per writer over DISJOINT series — the collector
+    # model: a given series arrives over one connection, concurrency
+    # comes from different collectors carrying different hosts.
+    # (Interleaving every series into every stream would make writer
+    # threads race same-(series,hour) feeds, which soundly kills delta
+    # buffers — a hostile shape no real deployment ingests at.) The
+    # first time block goes into a separate priming chunk, ingested
+    # single-threaded, so UID assignment order (and with it the
+    # per-leg answer fingerprint) is deterministic.
+    tag_s = [f"host=h{si:03d} dc=d{si % 4}" for si in range(series)]
+    prime_lines: list[str] = []
+    stream_lines: list[list[str]] = [[] for _ in range(writers)]
+    for b in range(pps):
+        ts = base + b * step
+        for si in range(series):
+            if si % 3:
+                line = f"put ingest.m {ts} {(b + si) % 1000} {tag_s[si]}"
+            else:
+                line = (f"put ingest.m {ts} {(b + si) % 1000}."
+                        f"{si % 100:02d} {tag_s[si]}")
+            (prime_lines if b == 0
+             else stream_lines[si % writers]).append(line)
+    chunk_lines = 12000
+    prime_chunk = ("\n".join(prime_lines) + "\n").encode()
+    chunks_by_w = [
+        [("\n".join(sl[i:i + chunk_lines]) + "\n").encode()
+         for i in range(0, len(sl), chunk_lines)]
+        for sl in stream_lines]
+    n_lines = pps * series
+    all_chunks = [prime_chunk] + [c for cl in chunks_by_w for c in cl]
+    del prime_lines, stream_lines
+
+    out = {"device": str(dev), "points": pts, "series": series,
+           "step_s": step, "shards": shards, "writers": writers,
+           "chunk_lines": chunk_lines,
+           "checkpoint_every_points": writers * chunk_lines,
+           "fsync": True, "wal_group_ms": 0.5,
+           "native_decode_built": wire.native_available(),
+           "host": {"cores": os.cpu_count(),
+                    "ram_gb": round(os.sysconf("SC_PAGE_SIZE")
+                                    * os.sysconf("SC_PHYS_PAGES")
+                                    / (1 << 30))},
+           "decode": {}, "legs": {}}
+
+    # Decode micro-bench: same corpus, whole pass per decoder. The
+    # scalar loop is the PR-19 parse; _decode_python is the vectorized
+    # numpy pass; native is the C arena parser when the ext built.
+    decoders = [("scalar", lambda ch: wire._decode_scalar(ch)),
+                ("vectorized",
+                 lambda ch: wire.decode_puts(ch, use_native=False))]
+    if wire.native_available():
+        decoders.append(
+            ("native", lambda ch: wire.decode_puts(ch, use_native=True)))
+    for dname, dfn in decoders:
+        t0 = time.perf_counter()
+        bad = 0
+        for ch in all_chunks:
+            bad += len(dfn(ch).errors)
+        dt = time.perf_counter() - t0
+        out["decode"][dname] = {"wall_s": round(dt, 3),
+                                "lines_per_s": round(n_lines / dt),
+                                "errors": bad}
+        log(f"  decode[{dname}]: {n_lines / dt:,.0f} lines/s")
+    out["decode"]["vectorized_speedup"] = round(
+        out["decode"]["scalar"]["wall_s"]
+        / max(out["decode"]["vectorized"]["wall_s"], 1e-9), 2)
+
+    group_counters = ("wal.group.batches", "wal.group.points",
+                      "wal.group.fsyncs")
+    fold_counters = ("rollup.fold.delta", "rollup.fold.full")
+
+    def run_leg(label: str, codec: str, group: bool, delta: bool,
+                scalar_decode: bool, record: bool = True):
+        wd = os.path.join(args.workdir, f"ingest-{label}")
+        shutil.rmtree(wd, ignore_errors=True)
+        os.makedirs(wd)
+        cfg = Config(auto_create_metrics=True, wal_path=wd,
+                     shards=shards, sstable_codec=codec,
+                     enable_sketches=False, device_window=False,
+                     enable_rollups=True, rollup_catchup="sync",
+                     rollup_delta_fold=delta,
+                     wal_group_ms=(0.5 if group else 0.0))
+        store = (ShardedKVStore(wd, shards=shards, fsync=True)
+                 if shards > 1
+                 else MemKVStore(wal_path=os.path.join(wd, "wal"),
+                                 fsync=True))
+        tsdb = TSDB(store, cfg, start_compaction_thread=False)
+        tune_for_ingest()
+        c0 = {n: METRICS.counter(n).value
+              for n in group_counters + fold_counters}
+        w0 = METRICS.timer("wal.group.wait_ms").count
+        # Checkpoint after every round of one chunk per writer
+        # (~4*chunk_lines points). This approximates the 100M/20-
+        # checkpoint recipe's fold regime: what matters for the delta-
+        # fold axis is the ratio of corpus re-read per full fold to
+        # new points per checkpoint (~10x there, ~12x here), not the
+        # absolute corpus size.
+        streams = (chunks_by_w if record
+                   else [cl[:2] for cl in chunks_by_w])
+        n_rounds = max(len(cl) for cl in streams)
+        per_r = 1
+        written = 0
+        ingest_errors: list[str] = []
+        lock = threading.Lock()
+        ckpt_s = 0.0
+
+        def ingest_one(ch: bytes) -> None:
+            nonlocal written
+            if scalar_decode:
+                batch = wire._decode_scalar(ch)
+            else:
+                batch = wire.decode_puts(ch)
+            n, errs = wire.ingest_batch(tsdb, batch, durable=True)
+            with lock:
+                written += n
+                ingest_errors.extend(errs)
+                ingest_errors.extend(batch.errors)
+
+        t0 = time.perf_counter()
+        ingest_one(prime_chunk)
+        for r in range(n_rounds):
+            threads = [
+                threading.Thread(target=lambda cl=cl: [
+                    ingest_one(ch)
+                    for ch in cl[r * per_r:(r + 1) * per_r]])
+                for cl in streams]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            tc = time.perf_counter()
+            tsdb.checkpoint()
+            ckpt_s += time.perf_counter() - tc
+        wall = time.perf_counter() - t0
+        # Served-answer fingerprint: same corpus every leg, so every
+        # leg must produce bit-identical bytes — this is the
+        # delta-fold-vs-full-refold parity check on what queries
+        # actually serve, not on internals.
+        ex = QueryExecutor(tsdb, backend="tpu")
+        # Group-by host: per-series rows, so the fingerprint never
+        # depends on cross-series float-sum association order.
+        spec = QuerySpec("ingest.m", {"host": "*"}, "sum",
+                         downsample=(3600, "avg"))
+        res, plan, _ = ex.run_with_plan(spec, base - 3600, end + 3600)
+        h = hashlib.sha1()
+        for row in sorted(res, key=lambda r: tuple(sorted(
+                r.tags.items()))):
+            h.update(repr(sorted(row.tags.items())).encode())
+            h.update(np.ascontiguousarray(row.timestamps).tobytes())
+            h.update(np.ascontiguousarray(row.values).tobytes())
+        cd = {n: METRICS.counter(n).value - c0[n]
+              for n in group_counters + fold_counters}
+        leg = {
+            "codec": codec, "group_commit": group,
+            "delta_folds": delta,
+            "decode": "scalar" if scalar_decode else "vectorized",
+            "points": written, "wall_s": round(wall, 2),
+            "dps": round(written / wall),
+            "checkpoint_s": round(ckpt_s, 2),
+            "dir_bytes": du(wd),
+            "ingest_errors": len(ingest_errors),
+            "wal_group": {k.rsplit(".", 1)[1]: cd[k]
+                          for k in group_counters},
+            "wal_group_waits": METRICS.timer("wal.group.wait_ms").count
+                               - w0,
+            "folds": {"delta": cd["rollup.fold.delta"],
+                      "full": cd["rollup.fold.full"]},
+            "query_plan": plan, "answer_sha1": h.hexdigest(),
+        }
+        tsdb.shutdown()
+        if record:
+            if written != pts or ingest_errors:
+                raise SystemExit(
+                    f"leg {label}: wrote {written}/{pts} points, "
+                    f"errors {ingest_errors[:3]}")
+            out["legs"][label] = leg
+            log(f"  [{label}] {leg['dps']:,} dps (ckpt "
+                f"{leg['checkpoint_s']}s, folds {leg['folds']}, "
+                f"group {leg['wal_group']})")
+
+    # Unrecorded warm-up: first checkpoint + first query pay one-time
+    # jit/uid warm costs that would otherwise bias whichever leg runs
+    # first (the baseline — inflating the headline speedup).
+    run_leg("warmup", "tsst4", True, True, False, record=False)
+
+    legs_def = [
+        # PR-19 ingest shape: per-line scalar parse, a barrier (and
+        # with fsync=True, an fsync wait) per batch, full re-read folds.
+        ("baseline-none", "none", False, False, True),
+        ("baseline-tsst4", "tsst4", False, False, True),
+        # Single-axis legs (both on the vectorized decoder).
+        ("group-tsst4", "tsst4", True, False, False),
+        ("delta-tsst4", "tsst4", False, True, False),
+        # The full fast path.
+        ("fast-none", "none", True, True, False),
+        ("fast-tsst4", "tsst4", True, True, False),
+    ]
+    for label, codec, group, delta, scalar in legs_def:
+        run_leg(label, codec, group, delta, scalar)
+
+    fps = {lb: leg["answer_sha1"] for lb, leg in out["legs"].items()}
+    speed = (out["legs"]["fast-tsst4"]["dps"]
+             / max(out["legs"]["baseline-tsst4"]["dps"], 1))
+    out["summary"] = {
+        "speedup_fast_vs_baseline_tsst4": round(speed, 2),
+        "speedup_fast_vs_baseline_none": round(
+            out["legs"]["fast-none"]["dps"]
+            / max(out["legs"]["baseline-none"]["dps"], 1), 2),
+        # The single-axis legs keep the vectorized decoder, so these
+        # are decode+axis gains; the marginal fold-axis gain alone is
+        # fast/group, the marginal group-axis gain alone fast/delta.
+        "decode_plus_group_gain_tsst4": round(
+            out["legs"]["group-tsst4"]["dps"]
+            / max(out["legs"]["baseline-tsst4"]["dps"], 1), 2),
+        "decode_plus_delta_gain_tsst4": round(
+            out["legs"]["delta-tsst4"]["dps"]
+            / max(out["legs"]["baseline-tsst4"]["dps"], 1), 2),
+        "delta_fold_marginal_gain": round(
+            out["legs"]["fast-tsst4"]["dps"]
+            / max(out["legs"]["group-tsst4"]["dps"], 1), 2),
+        "group_commit_marginal_gain": round(
+            out["legs"]["fast-tsst4"]["dps"]
+            / max(out["legs"]["delta-tsst4"]["dps"], 1), 2),
+        "target_2x_met": bool(speed >= 2.0),
+        "answers_identical_across_legs": len(set(fps.values())) == 1,
+    }
+    if not out["summary"]["answers_identical_across_legs"]:
+        log(f"ANSWER MISMATCH across legs: {fps}")
+
+    suffixed = os.path.join(
+        REPO, f"BENCH_INGEST_{pts // 1_000}k_S{shards}.json")
+    with open(suffixed, "w") as f:
+        json.dump(out, f, indent=2)
+    canonical = os.path.join(REPO, "BENCH_INGEST.json")
+    prev_pts = -1
+    try:
+        with open(canonical) as f:
+            prev_pts = json.load(f)["points"]
+    except Exception:
+        pass
+    if pts >= prev_pts:
+        with open(canonical, "w") as f:
+            json.dump(out, f, indent=2)
+    else:
+        log(f"clobber guard: BENCH_INGEST.json records {prev_pts:,} "
+            f"points; this run kept in {os.path.basename(suffixed)}")
+    log(f"summary: {out['summary']}")
+    print(json.dumps(out["summary"]))
+    return 0
+
+
 def run_sketch_serve(args) -> int:
     """BENCH_SKETCH.json: the accuracy-budgeted approximate-serving
     legs. One rollup-backed corpus (digest + moment sketch columns at
@@ -1640,6 +1952,18 @@ def main() -> int:
                          "check), per-kind tier bytes, and the "
                          "Storyboard allocation at three byte "
                          "budgets; writes BENCH_SKETCH.json")
+    ap.add_argument("--ingest-battery", action="store_true",
+                    help="run the ingest fast-path comparison instead "
+                         "of the plain scale run: one telnet-format "
+                         "corpus through decode_puts -> ingest_batch "
+                         "with durable acks on an fsync=True store, "
+                         "legs crossing group-commit on/off x delta-"
+                         "vs-full rollup folds x codec none/tsst4 "
+                         "(plus the PR-19 scalar-decode baseline and "
+                         "a decode micro-bench), every leg's served "
+                         "1h answer fingerprint-checked identical; "
+                         "writes BENCH_INGEST.json (clobber-guarded, "
+                         "+ a size/shard-suffixed artifact)")
     ap.add_argument("--tenants", type=int, default=0,
                     help="hostile-workload profile (ISSUE 14): spread "
                          "the series over N tenant ids so the timed "
@@ -1687,6 +2011,8 @@ def main() -> int:
         return run_codec_compare(args)
     if args.sketch_serve:
         return run_sketch_serve(args)
+    if args.ingest_battery:
+        return run_ingest_battery(args)
 
     # Native hot loops (gitignored artifact) before any package import.
     subprocess.run(["make", "-C", os.path.join(REPO, "native")],

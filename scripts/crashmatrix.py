@@ -70,6 +70,10 @@ def main(argv=None) -> int:
                    help="tenant accounting exact-tier cutoff for the "
                         "ad-hoc scenario's workload (0 forces the HLL "
                         "sketch tier; -1 = config default)")
+    p.add_argument("--wal-group-ms", type=float, default=0.0,
+                   help="WAL group-commit linger for the ad-hoc "
+                        "scenario's workload (kv.wal.group.* sites "
+                        "need it >0 to be reachable)")
     p.add_argument("--bug", default=None,
                    help="deliberately re-introduce a historical bug in "
                         "the child (harness.BUGS) — for harness "
@@ -89,7 +93,8 @@ def main(argv=None) -> int:
             site=args.site, mode=args.mode, skip=args.skip,
             shards=args.shards, rollups=not args.no_rollups,
             delete_heavy=args.delete_heavy, bug=args.bug,
-            codec=args.codec, tenant_cutoff=args.tenant_cutoff)]
+            codec=args.codec, tenant_cutoff=args.tenant_cutoff,
+            wal_group_ms=args.wal_group_ms)]
     else:
         scens = (harness.fast_matrix() if args.fast
                  else harness.build_matrix())

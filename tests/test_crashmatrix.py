@@ -30,12 +30,13 @@ class TestMatrixShape:
                      "kv.checkpoint.commit", "sst.write.body",
                      "sharded.spill.shard", "rollup.fold.start",
                      "rollup.bracket.flip", "replica.refresh",
-                     "sst.write.footer", "sst.write.block"):
+                     "sst.write.footer", "sst.write.block",
+                     "kv.wal.group.write", "kv.wal.group.fsync"):
             assert want in sites, f"matrix lost coverage of {want}"
 
     def test_fast_subset_resolves(self):
         fast = harness.fast_matrix()
-        assert len(fast) == len(harness.FAST_LABELS) == 13
+        assert len(fast) == len(harness.FAST_LABELS) == 14
 
 
 class TestFastSubset:
@@ -106,6 +107,29 @@ class TestHarnessHonesty:
         # not label-bound: ad-hoc scenarios reproduce too.
         assert "--site rollup.fold.start" in res["repro"]
         assert "--bug torn-bracket" in res["repro"]
+
+    def test_reintroduced_ack_before_fsync_bug_is_caught(self,
+                                                         tmp_path):
+        """The group-commit acceptance gate: sabotage the WAL barrier
+        so sync appends acknowledge BEFORE their covering group fsync
+        (MemKVStore._ACK_BEFORE_FSYNC), crash at the buffered group
+        write — the matrix must flag acked-but-lost rows. The clean
+        variant of the same scenario passes (wal-group-write-crash-s1
+        in the matrix), so the failure is the bug, not the harness."""
+        sc = dataclasses.replace(
+            _by_label()["wal-group-write-crash-s1"],
+            label="bug-ack-before-fsync", bug="ack-before-fsync")
+        clean = harness.run_scenario(
+            _by_label()["wal-group-write-crash-s1"],
+            str(tmp_path / "clean"), shrink=False)
+        assert clean["status"] == "ok", clean["problems"]
+        res = harness.run_scenario(sc, str(tmp_path / "bug"),
+                                   shrink=False)
+        assert res["status"] == "invariant-failed", res
+        # Self-contained repro: site + linger + the injected bug.
+        assert "--site kv.wal.group.write" in res["repro"]
+        assert "--bug ack-before-fsync" in res["repro"]
+        assert "--wal-group-ms" in res["repro"]
 
     def test_clean_run_with_same_seed_passes(self, tmp_path):
         """The bug test above is meaningful only if the same scenario

@@ -1,0 +1,349 @@
+"""Ingest fast path (ISSUE 20): WAL group-commit coalescing and
+durability, hostile-corpus parity between the vectorized wire decoder
+and the scalar oracle, exact per-line telnet error indices across
+chunked bursts, and the /stats | /metrics | /queries observability
+surface with `tsdb check --stats-metric` coverage."""
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.obs.registry import METRICS
+from opentsdb_tpu.server import wire
+from opentsdb_tpu.server.tsd import TSDServer
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.tools.cli import main as cli_main
+from opentsdb_tpu.utils.config import Config
+
+BT = 1356998400
+
+
+def _counter(name):
+    return METRICS.counter(name).value
+
+
+# ---------------------------------------------------------------------------
+# WAL group commit (storage/kv.py)
+# ---------------------------------------------------------------------------
+
+class TestGroupCommit:
+    def test_concurrent_appends_coalesce_and_stay_durable(self, tmp_path):
+        """Many threads issuing sync puts under a linger window: the
+        appends coalesce into far fewer fsyncs than batches, every
+        acked put is durable across a reopen, and the sabotage flag
+        (_ACK_BEFORE_FSYNC) is off by default."""
+        assert MemKVStore._ACK_BEFORE_FSYNC is False
+        wal = str(tmp_path / "wal")
+        store = MemKVStore(wal_path=wal)
+        store.wal_group_ms = 20.0
+        b0, f0 = _counter("wal.group.batches"), _counter("wal.group.fsyncs")
+        n_threads, per = 6, 8
+
+        def work(t):
+            for i in range(per):
+                store.put("tsdb", b"K%d-%d" % (t, i), b"t", b"q",
+                          b"v%d" % i)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        batches = _counter("wal.group.batches") - b0
+        fsyncs = _counter("wal.group.fsyncs") - f0
+        assert batches >= n_threads * per
+        assert 1 <= fsyncs < batches, (batches, fsyncs)
+        store.close()
+        re = MemKVStore(wal_path=wal)
+        try:
+            rows = sum(1 for _ in re.scan_raw("tsdb", b"", b"\xff" * 8))
+            assert rows == n_threads * per
+        finally:
+            re.close()
+
+    def test_barrier_without_group_window_is_noop(self, tmp_path):
+        """wal_group_ms=0 (the default): puts keep the direct
+        append+fsync path, and wal_barrier stays callable."""
+        store = MemKVStore(wal_path=str(tmp_path / "wal"))
+        b0 = _counter("wal.group.batches")
+        store.put("tsdb", b"K", b"t", b"q", b"v")
+        store.wal_barrier()
+        assert _counter("wal.group.batches") == b0
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# Vectorized wire decode vs the scalar oracle (server/wire.py)
+# ---------------------------------------------------------------------------
+
+# Every shape the vectorized pass special-cases: fast rows, oracle
+# detours (multi-space, \r, NUL, trailing space, "+ts"), every value
+# grammar branch, every error message, non-UTF-8 bytes, width caps.
+HOSTILE_LINES = [
+    b"put m.ok 1356998401 42 host=a",
+    b"put m.ok 1356998402 4.5 host=a",
+    b"put m.ok 1356998403 -7 host=b cpu=0",
+    b"put m.ok 1356998404 +3 host=a",          # signed int
+    b"put m.ok 1356998405 5. host=a",          # trailing-dot float
+    b"put m.ok 1356998406 .5 host=a",          # leading-dot float
+    b"put m.ok 1356998407 1e3 host=a",         # exponent
+    b"put m.ok 1356998408 -2.5E-2 host=a",
+    b"put m.ok 1356998409 9007199254740993 host=a",   # > 2^53 exact
+    b"put m.ok 1356998410 9223372036854775807 host=a",  # int64 max
+    b"put m.ok 1356998411 9223372036854775808 host=a",  # overflow
+    b"put m.ok 1356998412 " + b"1" * 25 + b" host=a",   # >18 digits
+    b"put m.ok 1356998413 " + b"9" * 60 + b".5 host=a",  # >48b value
+    b"put m.ok 1356998414 nan host=a",
+    b"put m.ok 1356998415 0x1F host=a",
+    b"put m.ok 1356998416 - host=a",
+    b"put m.ok   1356998417 1 host=a",         # multi-space run
+    b"put m.ok 1356998418 1 host=a ",          # trailing space
+    b"put m.ok 1356998419 1 host=a\r",         # CR ending
+    b"put m.ok 1356998420 1 ho\x00st=a",       # NUL byte
+    b"put m.ok +1356998421 1 host=a",          # "+ts" form
+    b"put m.ok 135699842112345678901 1 host=a",  # >20-digit ts
+    b"put m.ok 99999999999 1 host=a",          # 11 digits, > u32
+    b"put m.ok 01356998436 1 host=a",          # leading zero, valid
+    b"put m.ok 00000000000001356998437 1 host=a",  # 23-char valid ts
+    b"put m.ok 0 1 host=a",                    # ts == 0
+    b"put m.ok -5 1 host=a",                   # negative ts
+    b"put m.ok notatime 1 host=a",
+    b"put m.ok 1356998422 1",                  # no tags
+    b"put m.ok 1356998423 1 ===",
+    b"put m.ok 1356998424 1 a=",
+    b"put m.ok 1356998425 1 =b",
+    b"put m.ok 1356998426 1 a=b a=c",          # duplicate tag
+    b"put bad metric! 1356998427 1 a=b",
+    b"put m\xffx 1356998428 1 a=b",            # non-UTF-8 metric
+    b"put m.ok 1356998429 1 a=\xffv",          # non-UTF-8 tag value
+    b"",
+    b"   ",
+    b"version",
+    b"putx m.ok 1356998430 1 a=b",
+    b"PUT m.ok 1356998431 1 a=b",
+    b"put",
+    b"put m.ok",
+    b"put m.ok 1356998432",
+    b"put m.ok 1356998433 7 a=b c=d e=f g=h",
+    b"put later.series 1356998434 8 z=1",      # new series late
+    b"put m.ok 1356998435 42 host=a",          # repeat series
+]
+
+
+def _assert_batches_equal(a, b):
+    np.testing.assert_array_equal(a.timestamps, b.timestamps)
+    np.testing.assert_array_equal(a.ivalues, b.ivalues)
+    np.testing.assert_array_equal(a.is_float, b.is_float)
+    # Bit-exact float parity: the vectorized cast and strtod must agree.
+    np.testing.assert_array_equal(
+        np.asarray(a.fvalues).view(np.uint64),
+        np.asarray(b.fvalues).view(np.uint64))
+    np.testing.assert_array_equal(a.sid, b.sid)
+    assert a.series == b.series
+    assert a.errors == b.errors
+    assert list(a.error_lines) == list(b.error_lines)
+    assert a.consumed == b.consumed
+
+
+class TestVectorizedDecodeParity:
+    def test_hostile_corpus_matches_oracle(self):
+        buf = b"\n".join(HOSTILE_LINES) + b"\n"
+        vec = wire._decode_python(buf, line_base=3)
+        ora = wire._decode_scalar(buf, line_base=3)
+        _assert_batches_equal(vec, ora)
+        assert len(vec.errors) > 10         # the corpus actually bites
+        assert len(vec.timestamps) > 10     # ...and actually parses
+
+    def test_hostile_corpus_survives_shuffling(self):
+        """Line order changes series numbering and error interleaving;
+        parity must hold for any order (10 seeded shuffles)."""
+        for seed in range(10):
+            rng = np.random.default_rng(seed)
+            lines = [HOSTILE_LINES[i]
+                     for i in rng.permutation(len(HOSTILE_LINES))]
+            buf = b"\n".join(lines) + b"\n"
+            _assert_batches_equal(wire._decode_python(buf),
+                                  wire._decode_scalar(buf))
+
+    def test_random_differential(self):
+        """Seeded random soup of valid/invalid tokens: 800 lines, all
+        columns byte-identical to the oracle."""
+        rng = np.random.default_rng(20)
+        metrics = ["m.a", "m.b", "bad metric", "métrica", "m.c"]
+        tss = ["1356998401", "0", "notatime", "99999999999",
+               "1356998500", "+7", "00000000001"]
+        vals = ["1", "-42", "4.25", ".5", "5.", "1e2", "nan", "0x10",
+                "9007199254740993", "1" * 22, "-", "+0.125"]
+        tagss = ["h=a", "h=a c=0", "", "===", "a=b a=c", "h=a ",
+                 "x=ÿ"]
+        lines = []
+        for _ in range(800):
+            lines.append(" ".join([
+                rng.choice(["put", "put", "put", "puts", "stats"]),
+                str(rng.choice(metrics)), str(rng.choice(tss)),
+                str(rng.choice(vals)), str(rng.choice(tagss))]).encode())
+        buf = b"\n".join(lines) + b"\n"
+        _assert_batches_equal(wire._decode_python(buf),
+                              wire._decode_scalar(buf))
+
+    def test_chunked_line_base_tracks_stream_lines(self):
+        """Chunked decoding with accumulated line_base reports the same
+        stream line numbers as one-shot decoding."""
+        buf = b"\n".join(HOSTILE_LINES) + b"\n"
+        one = wire.decode_puts(buf, use_native=False)
+        cuts = [0, 7, 19, 31, len(HOSTILE_LINES)]
+        got = []
+        base = 0
+        for a, b in zip(cuts, cuts[1:]):
+            chunk = b"\n".join(HOSTILE_LINES[a:b]) + b"\n"
+            d = wire.decode_puts(chunk, use_native=False,
+                                 line_base=base)
+            got += list(d.error_lines)
+            base += chunk.count(b"\n")
+        assert got == list(one.error_lines)
+
+
+# ---------------------------------------------------------------------------
+# Telnet bulk puts: exact per-line error indices across chunks
+# ---------------------------------------------------------------------------
+
+def run_with_server(coro_fn, **cfg_kw):
+    kw = dict(auto_create_metrics=True, port=0, bind="127.0.0.1",
+              backend="cpu", enable_sketches=False,
+              device_window=False)
+    kw.update(cfg_kw)
+    cfg = Config(**kw)
+    wal = kw.get("wal_path")
+    store = MemKVStore(wal_path=wal) if wal else MemKVStore()
+    tsdb = TSDB(store, cfg, start_compaction_thread=False)
+    server = TSDServer(tsdb)
+
+    async def main():
+        await server.start()
+        try:
+            return await coro_fn(server.port, tsdb)
+        finally:
+            server._pool.shutdown(wait=False)
+            server._server.close()
+            await server._server.wait_closed()
+
+    return asyncio.run(main()), server, tsdb
+
+
+async def http_get(port, target):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n"
+                 "Connection: close\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    return int(head.split(b" ", 2)[1]), body
+
+
+class TestTelnetErrorLines:
+    def test_burst_errors_carry_stream_line_numbers(self):
+        """Malformed lines interleaved in vectorized bursts report
+        their 1-based CONNECTION-wide line number, even when the bad
+        line arrives in a later chunk (line_base accumulates)."""
+        async def drive(port, tsdb):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            writer.write(
+                (f"put m.a {BT + 1} 1 a=b\n"
+                 f"put m.a notatime 2 a=b\n"       # stream line 2
+                 f"put m.a {BT + 3} 3 a=b\n").encode())
+            await writer.drain()
+            await asyncio.sleep(0.3)
+            writer.write(
+                (f"put m.a {BT + 4} 4 a=b\n"
+                 f"put m.a {BT + 5} 0x1F a=b\n"    # stream line 5
+                 f"put m.a {BT + 6} 6 a=b\n").encode())
+            await writer.drain()
+            await asyncio.sleep(0.3)
+            data = await asyncio.wait_for(reader.read(1000), 1.0)
+            writer.close()
+            return data
+
+        out, server, tsdb = run_with_server(drive)
+        tsdb.shutdown()
+        assert tsdb.datapoints_added == 4
+        assert b"put: illegal argument at line 2: " in out
+        assert b"put: illegal argument at line 5: " in out
+        assert out.count(b"put: illegal argument") == 2
+
+
+# ---------------------------------------------------------------------------
+# Observability: /stats + /metrics + /queries + `tsdb check`
+# ---------------------------------------------------------------------------
+
+class TestIngestObservability:
+    def test_counters_reach_every_surface(self, tmp_path):
+        """Drive telnet ingest through group commit + a checkpoint
+        fold, then read the new instruments off /stats, /metrics and
+        the /queries feed, and threshold one with
+        `tsdb check --stats-metric`."""
+        async def drive(port, tsdb):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            lines = [f"put obs.m {BT + i * 60} {i} host=h{i % 2}"
+                     for i in range(240)]
+            writer.write(("\n".join(lines) + "\n").encode())
+            await writer.drain()
+            await asyncio.sleep(0.5)
+            writer.close()
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, tsdb.checkpoint)
+            sa, ba = await http_get(port, "/stats?json")
+            sp, bp = await http_get(port, "/metrics")
+            sf, bf = await http_get(port, "/api/queries")
+            rc_ok = await loop.run_in_executor(None, cli_main, [
+                "check", "-H", "127.0.0.1", "-p", str(port),
+                "--stats-metric", "tsd.wal.group.batches",
+                "-x", "lt", "-c", "0.5"])
+            rc_bad = await loop.run_in_executor(None, cli_main, [
+                "check", "-H", "127.0.0.1", "-p", str(port),
+                "--stats-metric", "tsd.wal.group.fsyncs",
+                "-x", "ge", "-c", "0"])
+            return (sa, ba), (sp, bp), (sf, bf), rc_ok, rc_bad
+
+        res, _server, tsdb = run_with_server(
+            drive, wal_path=str(tmp_path / "wal"), wal_group_ms=5.0,
+            enable_rollups=True, rollup_catchup="sync")
+        tsdb.shutdown()
+        (sa, ba), (sp, bp), (sf, bf), rc_ok, rc_bad = res
+        assert sa == 200 and sp == 200 and sf == 200
+        lines = json.loads(ba)
+
+        def val(name):
+            got = [float(ln.split()[2]) for ln in lines
+                   if ln.split()[0] == name]
+            assert got, f"{name} missing from /stats"
+            return max(got)
+
+        assert val("tsd.wal.group.batches") >= 1
+        # Cell mutations, not raw datapoints: a columnar append packs
+        # a whole row's points into one cell.
+        assert val("tsd.wal.group.points") >= 1
+        assert val("tsd.wal.group.fsyncs") >= 1
+        assert val("tsd.wal.group.wait_ms.count") >= 1
+        assert val("tsd.ingest.parse.count") >= 1
+        assert val("tsd.rollup.fold.delta") >= 1
+        # Prometheus exposition carries the same instruments.
+        assert b"wal_group_batches" in bp or b"wal.group.batches" in bp
+        assert b"rollup_fold_delta" in bp or b"rollup.fold.delta" in bp
+        # The /queries planner feed: the ingest section + fold split.
+        feed = json.loads(bf)
+        assert feed["ingest"]["group"]["batches"] >= 1
+        assert feed["ingest"]["group"]["points"] >= 1
+        assert feed["ingest"]["group"]["batches_per_fsync"] > 0
+        assert feed["ingest"]["parse"]["count"] >= 1
+        assert feed["rollup"]["folds"]["delta"] >= 1
+        assert feed["rollup"]["delta"]["windows"] >= 1
+        assert feed["rollup"]["delta"]["served"] >= 1
+        assert rc_ok == 0 and rc_bad != 0

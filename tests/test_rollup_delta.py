@@ -1,0 +1,280 @@
+"""Incremental delta rollup folds (rollup/delta.py): bit-parity with
+the full replace-from-raw rescan.
+
+The contract (ISSUE 20): with ``Config.rollup_delta_fold`` on, every
+stored summary record — moment columns AND sketch columns, at every
+resolution, at shards=1 and shards=4 — is byte-identical to what the
+full fold writes, across live checkpoint cycles, backfill into folded
+windows, deletes, scalar puts, and duplicate re-ingest. Non-additive
+cases must FALL BACK (and the tests assert the fast path actually
+engages in the append-only cases, so parity isn't trivially satisfied
+by a path that never runs).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core.tsdb import TSDB
+from opentsdb_tpu.query.executor import QueryExecutor, QuerySpec
+from opentsdb_tpu.rollup.summary import ROLLUP_FAMILY
+from opentsdb_tpu.storage.kv import MemKVStore
+from opentsdb_tpu.storage.sharded import ShardedKVStore
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1356998400
+METRIC = "delta.metric"
+
+
+def make_tsdb(path, shards=1, delta=True, **over):
+    os.makedirs(path, exist_ok=True)
+    wal = os.path.join(path, "wal")
+    kw = dict(auto_create_metrics=True, wal_path=wal,
+              enable_rollups=True, enable_sketches=False,
+              device_window=False, backend="cpu",
+              rollup_catchup="sync", shards=shards,
+              rollup_delta_fold=delta)
+    kw.update(over)
+    cfg = Config(**kw)
+    store = (ShardedKVStore(path, shards=shards) if shards > 1
+             else MemKVStore(wal_path=wal))
+    return TSDB(store, cfg, start_compaction_thread=False)
+
+
+def dump_records(tsdb):
+    """Every rollup cell in the tier, byte-exact:
+    {(res, shard, row key, qualifier): value}."""
+    tier = tsdb.rollups
+    out = {}
+    for r, stores in tier.stores.items():
+        for si, s in enumerate(stores):
+            for key, items in s.scan_raw(tier.table, b"", b"",
+                                         family=ROLLUP_FAMILY):
+                for q, v in items:
+                    out[(r, si, bytes(key), bytes(q))] = bytes(v)
+    return out
+
+
+def assert_record_parity(t_delta, t_full):
+    a, b = dump_records(t_delta), dump_records(t_full)
+    assert set(a) == set(b)
+    diff = [k for k in a if a[k] != b[k]]
+    assert not diff, f"{len(diff)} rollup cells differ: {diff[:3]}"
+
+
+def batches(series=3, cycles=3, hours=30, step=60, seed=7,
+            big_ints=False):
+    """Per-cycle per-series (ts, vals) append-only batches: mixed
+    int/float typing, values that stress f32 quantization, and
+    (optionally) integers above 2^53."""
+    rng = np.random.default_rng(seed)
+    per = (hours * 3600) // step // cycles
+    for c in range(cycles):
+        out = []
+        for i in range(series):
+            ts = (BASE + c * per * step
+                  + np.arange(0, per * step, step, dtype=np.int64)
+                  + int(rng.integers(0, step // 3)))
+            if big_ints and i == 0:
+                vals = rng.integers(1 << 52, 1 << 60, len(ts))
+            elif i % 2:
+                vals = rng.integers(-1000, 1000, len(ts))
+            else:
+                vals = rng.normal(0.1, 3.0, len(ts))
+            out.append((f"h{i}", ts, vals))
+        yield out
+
+
+def drive(tsdb, gen):
+    for cycle in gen:
+        for host, ts, vals in cycle:
+            tsdb.add_batch(METRIC, ts, vals, {"host": host})
+        tsdb.checkpoint()
+
+
+@pytest.mark.parametrize("shards", [1, 4])
+def test_append_only_parity_and_engagement(tmp_path, shards):
+    """Sustained append-only ingest across live checkpoint cycles:
+    records byte-identical, and the delta path actually served."""
+    td = make_tsdb(str(tmp_path / "d"), shards=shards, delta=True)
+    tf = make_tsdb(str(tmp_path / "f"), shards=shards, delta=False)
+    try:
+        drive(td, batches())
+        drive(tf, batches())
+        assert_record_parity(td, tf)
+        assert tf.rollups.delta is None
+        assert td.rollups.fold_delta > 0, \
+            "delta fast path never engaged — parity is vacuous"
+        assert td.rollups.delta.served > 0
+        # Append-only single-metric ingest: every group should serve.
+        assert td.rollups.fold_full == 0
+        # And the end-to-end answers agree between the two daemons.
+        exd = QueryExecutor(td, backend="cpu")
+        exf = QueryExecutor(tf, backend="cpu")
+        spec = QuerySpec(METRIC, {}, "sum", downsample=(3600, "sum"))
+        ra, plana, _ = exd.run_with_plan(spec, BASE, BASE + 40 * 3600)
+        rb, planb, _ = exf.run_with_plan(spec, BASE, BASE + 40 * 3600)
+        assert plana == planb == "1h"
+        np.testing.assert_array_equal(ra[0].values, rb[0].values)
+    finally:
+        td.shutdown()
+        tf.shutdown()
+
+
+@pytest.mark.parametrize("shards", [1, 4])
+def test_big_int_parity(tmp_path, shards):
+    """Integers above 2^53: the buffer's i64→f64 widening must round
+    exactly like decode_cells_flat's."""
+    td = make_tsdb(str(tmp_path / "d"), shards=shards, delta=True)
+    tf = make_tsdb(str(tmp_path / "f"), shards=shards, delta=False)
+    try:
+        drive(td, batches(big_ints=True))
+        drive(tf, batches(big_ints=True))
+        assert_record_parity(td, tf)
+        assert td.rollups.fold_delta > 0
+    finally:
+        td.shutdown()
+        tf.shutdown()
+
+
+@pytest.mark.parametrize("shards", [1, 4])
+def test_backfill_into_folded_window(tmp_path, shards):
+    """Late points landing in an already-folded window: while the
+    series' buffer is alive it stays COMPLETE (buffers are retained
+    across folds, the new points append), so the refold is served
+    incrementally and must still be byte-identical. Once the buffer is
+    gone the restart test below proves the fallback."""
+    td = make_tsdb(str(tmp_path / "d"), shards=shards, delta=True)
+    tf = make_tsdb(str(tmp_path / "f"), shards=shards, delta=False)
+    try:
+        for t in (td, tf):
+            drive(t, batches(cycles=2, hours=20))
+            # Backfill an existing series' folded hour AND a brand-new
+            # series into the same folded coarse window.
+            late = BASE + np.arange(30, 3600, 300, dtype=np.int64)
+            t.add_batch(METRIC, late, np.full(len(late), 2.5),
+                        {"host": "h0"})
+            t.add_batch(METRIC, late + 7, np.full(len(late), 3.5),
+                        {"host": "h9"})
+            t.checkpoint()
+        assert_record_parity(td, tf)
+        assert td.rollups.fold_delta > 0
+    finally:
+        td.shutdown()
+        tf.shutdown()
+
+
+@pytest.mark.parametrize("shards", [1, 4])
+def test_delete_and_scalar_put_parity(tmp_path, shards):
+    """Raw deletes (the store hook) and scalar add_point writes (the
+    feed bypass) both force the full path; records stay identical,
+    including the count-0 zeroing of deleted rows."""
+    td = make_tsdb(str(tmp_path / "d"), shards=shards, delta=True)
+    tf = make_tsdb(str(tmp_path / "f"), shards=shards, delta=False)
+    try:
+        for t in (td, tf):
+            drive(t, batches(cycles=2, hours=20))
+            t.add_point(METRIC, BASE + 26 * 3600 + 11, 42,
+                        {"host": "h0"})
+            key = t.row_key_for(METRIC, {"host": "h1"}, BASE)
+            t.store.delete_row(t.table, key)
+            t.checkpoint()
+        assert_record_parity(td, tf)
+    finally:
+        td.shutdown()
+        tf.shutdown()
+
+
+def test_duplicate_reingest_falls_back(tmp_path):
+    """Re-putting the same timestamps (same values) across batches is
+    a cell overwrite the buffer can't model — the window must fall
+    back, and both daemons keep byte-identical records."""
+    td = make_tsdb(str(tmp_path / "d"), delta=True)
+    tf = make_tsdb(str(tmp_path / "f"), delta=False)
+    try:
+        ts = BASE + np.arange(0, 7200, 60, dtype=np.int64)
+        vals = np.arange(len(ts), dtype=np.int64)
+        for t in (td, tf):
+            t.add_batch(METRIC, ts, vals, {"host": "h0"})
+            t.add_batch(METRIC, ts[:40], vals[:40], {"host": "h0"})
+            t.checkpoint()
+        assert_record_parity(td, tf)
+        assert td.rollups.fold_full > 0
+        assert td.rollups.fold_delta == 0
+    finally:
+        td.shutdown()
+        tf.shutdown()
+
+
+def test_compaction_preserves_eligibility(tmp_path):
+    """compact_row's delete-after-put rewrite keeps the point set: it
+    must NOT kill the window's buffer (the preserve context), and the
+    post-compaction fold must still match the full path byte-for-byte."""
+    td = make_tsdb(str(tmp_path / "d"), delta=True)
+    tf = make_tsdb(str(tmp_path / "f"), delta=False)
+    try:
+        ts1 = BASE + np.arange(0, 1800, 60, dtype=np.int64)
+        ts2 = BASE + np.arange(1800, 3600, 60, dtype=np.int64)
+        for t in (td, tf):
+            t.add_batch(METRIC, ts1, ts1 % 97, {"host": "h0"})
+            t.add_batch(METRIC, ts2, ts2 % 89, {"host": "h0"})
+            key = t.row_key_for(METRIC, {"host": "h0"}, BASE)
+            t.compact_row(key)
+            t.checkpoint()
+        assert_record_parity(td, tf)
+        assert td.rollups.fold_delta > 0
+        assert td.rollups.fold_full == 0
+    finally:
+        td.shutdown()
+        tf.shutdown()
+
+
+def test_eviction_cap_falls_back_soundly(tmp_path):
+    """A tiny rollup_delta_points cap evicts buffers mid-ingest; the
+    fold silently takes the full path and parity holds."""
+    td = make_tsdb(str(tmp_path / "d"), delta=True,
+                   rollup_delta_points=64)
+    tf = make_tsdb(str(tmp_path / "f"), delta=False)
+    try:
+        drive(td, batches())
+        drive(tf, batches())
+        assert_record_parity(td, tf)
+        assert td.rollups.delta.evicted > 0
+    finally:
+        td.shutdown()
+        tf.shutdown()
+
+
+def test_restart_over_prior_data_falls_back(tmp_path):
+    """A fresh process has empty buffers; new appends to windows whose
+    data predates it (records exist / WAL-replayed rows) must not be
+    served from the partial buffer."""
+    path = str(tmp_path / "d")
+    t = make_tsdb(path, delta=True)
+    ts1 = BASE + np.arange(0, 1800, 60, dtype=np.int64)
+    t.add_batch(METRIC, ts1, ts1 % 97, {"host": "h0"})
+    t.checkpoint()
+    t.shutdown()
+    # Reopen: append MORE points into the same (already folded) coarse
+    # window — a new hour, so existed=False and only the prior-records
+    # check stands between the partial buffer and wrong summaries.
+    t = make_tsdb(path, delta=True)
+    tf = make_tsdb(str(tmp_path / "f"), delta=False)
+    try:
+        ts2 = BASE + 3600 + np.arange(0, 1800, 60, dtype=np.int64)
+        t.add_batch(METRIC, ts2, ts2 % 89, {"host": "h0"})
+        t.checkpoint()
+        tf.add_batch(METRIC, ts1, ts1 % 97, {"host": "h0"})
+        tf.checkpoint()
+        tf.add_batch(METRIC, ts2, ts2 % 89, {"host": "h0"})
+        tf.checkpoint()
+        assert_record_parity(t, tf)
+        # The reopened process's partial buffer must have been vetoed
+        # by the prior-records check — cross-session backfill is the
+        # canonical full-path fallback.
+        assert t.rollups.fold_full > 0
+        assert t.rollups.fold_delta == 0
+    finally:
+        t.shutdown()
+        tf.shutdown()
